@@ -1,0 +1,88 @@
+"""MLP training example (reference: examples/mlp/, unverified — config #1
+in BASELINE.json).  Trains a 2-layer MLP on a synthetic two-moon-style
+dataset, exactly mirroring the reference script's flow:
+
+    python examples/mlp/train.py [--use-graph] [--epochs N] [--device tpu|cpu]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from singa_tpu import device, opt, tensor  # noqa: E402
+from singa_tpu.models.mlp import MLP  # noqa: E402
+
+
+def load_data(n=400, seed=0):
+    """Synthetic separable data (reference uses a generated 2-D dataset)."""
+    rng = np.random.RandomState(seed)
+    # two gaussian blobs, 2 classes
+    x0 = rng.randn(n // 2, 2).astype(np.float32) + np.array([2, 2], np.float32)
+    x1 = rng.randn(n // 2, 2).astype(np.float32) + np.array([-2, -2], np.float32)
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(n // 2), np.ones(n // 2)]).astype(np.int32)
+    idx = rng.permutation(n)
+    return x[idx], y[idx]
+
+
+def accuracy(pred, target):
+    return float((pred.argmax(-1) == target).mean())
+
+
+def run(args):
+    dev = device.create_tpu_device(0) if args.device == "tpu" else \
+        device.get_default_device()
+    dev.SetRandSeed(args.seed)
+
+    x_np, y_np = load_data()
+    n_train = int(0.8 * len(x_np))
+    batch = args.batch_size
+
+    m = MLP(data_size=2, perceptron_size=3, num_classes=2)
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
+    m.set_optimizer(sgd)
+
+    tx = tensor.Tensor((batch, 2), dev)
+    m.compile([tx], is_train=True, use_graph=args.use_graph, sequential=False)
+
+    if batch > n_train:
+        raise SystemExit(
+            f"batch size {batch} exceeds training set size {n_train}")
+
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        tot_loss, correct, seen = 0.0, 0, 0
+        for i in range(0, n_train - batch + 1, batch):
+            xb = tensor.from_numpy(x_np[i:i + batch], dev)
+            yb = tensor.from_numpy(y_np[i:i + batch], dev)
+            out, loss = m(xb, yb)
+            tot_loss += float(loss.data)
+            correct += int((tensor.to_numpy(out).argmax(-1) == y_np[i:i + batch]).sum())
+            seen += batch
+        print(f"epoch {epoch}: loss={tot_loss / max(1, seen // batch):.4f} "
+              f"acc={correct / seen:.4f} time={time.time() - t0:.3f}s")
+
+    # eval
+    m.eval()
+    xe = tensor.from_numpy(x_np[n_train:], dev)
+    out = m(xe)
+    acc = accuracy(tensor.to_numpy(out), y_np[n_train:])
+    print(f"eval accuracy: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--use-graph", action="store_true", default=False)
+    p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    acc = run(args)
+    assert acc > 0.9, f"MLP failed to learn (acc={acc})"

@@ -1,0 +1,12 @@
+"""singa_tpu — a TPU-native deep-learning framework with the capabilities
+of Apache SINGA (reference: yaochang/singa), built from scratch on
+JAX/XLA/Pallas.  See SURVEY.md for the reference layer map this package
+rebuilds and README.md for the design stance.
+"""
+
+from . import config  # noqa: F401
+from .config import VERSION as __version__  # noqa: F401
+
+# Submodules are imported lazily by user code (`from singa_tpu import
+# tensor, device, autograd, layer, model, opt, sonnx`), mirroring how
+# reference scripts import `from singa import ...`.

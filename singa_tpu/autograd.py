@@ -1,0 +1,635 @@
+"""Tape-based autograd, API-shaped after the reference's
+``python/singa/autograd.py`` (~4.5k LoC, unverified — SURVEY.md §2.2/§3.2).
+
+Reference behavior being rebuilt:
+  * ``Operation`` base class with ``forward(*xs)`` / ``backward(*dys)``
+    over raw backend tensors; ``__call__`` records a ``src`` edge list when
+    ``training`` is on.
+  * ``backward(y, dy)``: dependency-counted reverse-topological walk over
+    ``Operation.src`` that **yields** ``(param_tensor, grad_tensor)`` pairs
+    as each gradient becomes final — a generator, so ``opt.DistOpt`` can
+    overlap all-reduce of early grads with backward of later layers
+    (SURVEY.md §3.2: "the generator design is load-bearing").
+  * dozens of concrete ops (ReLU, Matmul/Gemm, SoftMax, CrossEntropy,
+    Conv2d, BatchNorm2d, Pooling, RNN, reshape ops, ...) each with a
+    hand-written VJP calling cuDNN/cuBLAS kernels.
+
+TPU-native design: an op's forward is a **pure jnp/lax function** and its
+backward is ``jax.vjp`` of that function — XLA differentiates the same
+program it compiles, so hand-written VJPs (and their cuDNN mirror-kernel
+bookkeeping) disappear.  The tape itself is kept because SINGA's public
+API (``autograd.backward`` generator, ``Operation`` subclassing, stateful
+handles) is defined in terms of it; under graph mode the entire
+tape-record + walk executes *inside* a ``jax.jit`` trace, so the runtime
+cost of the Python walk is paid once at compile time (the reference pays
+its scheduler dispatch every iteration).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, _wrap, _raw
+from .device import get_default_device
+
+# module-level training flag, same contract as reference autograd.training
+training = False
+
+
+def set_training(flag: bool):
+    global training
+    training = bool(flag)
+
+
+class Operation:
+    """One differentiable op instance; records tape edges when training.
+
+    Subclasses implement ``forward(*xs)`` over raw jax arrays and either
+    implement ``backward(*dys)`` explicitly (reference style) or set
+    ``self.grad_fn`` inside ``forward`` (jax.vjp style; see ``_Func``).
+    """
+
+    op_count = 0
+
+    def __init__(self, name=None):
+        if name is None:
+            name = f"{type(self).__name__}#{Operation.op_count}"
+            Operation.op_count += 1
+        self.name = name
+        self.src = []
+        self.y_id2idx = {}
+        self.requires_grad = False
+
+    def __call__(self, *xs):
+        return self._do_forward(*xs)
+
+    def _do_forward(self, *xs):
+        assert all(isinstance(x, Tensor) for x in xs), (
+            f"{self.name}: inputs must be Tensors, got {[type(x) for x in xs]}"
+        )
+        if training:
+            self.src = []
+            for x in xs:
+                if x.requires_grad and (
+                    x.creator is None
+                    or (isinstance(x.creator, Dummy)
+                        and id(x.data) not in x.creator.y_id2idx)
+                ):
+                    # leaf: attach a Dummy so multi-consumer grads
+                    # accumulate at one node before being yielded.  A stale
+                    # Dummy (param array rebound by opt.update since last
+                    # step) is replaced.
+                    x.creator = Dummy(x)
+                if x.requires_grad:
+                    self.src.append((x.creator, id(x.data), x, x.stores_grad))
+                else:
+                    self.src.append((None, id(x.data), None, False))
+            self.requires_grad = any(x.requires_grad for x in xs)
+        ys = self.forward(*[x.data for x in xs])
+        single = not isinstance(ys, tuple)
+        if single:
+            ys = (ys,)
+        dev = xs[0].device if xs else get_default_device()
+        if training:
+            self.y_id2idx = {id(y): i for i, y in enumerate(ys)}
+            outs = tuple(
+                Tensor(device=dev, data=y, requires_grad=self.requires_grad,
+                       creator=self if self.requires_grad else None)
+                for y in ys
+            )
+        else:
+            outs = tuple(_wrap(y, dev) for y in ys)
+        return outs[0] if single else outs
+
+    def _do_backward(self, *dys):
+        dxs = self.backward(*dys)
+        if not isinstance(dxs, tuple):
+            dxs = (dxs,)
+        return dxs
+
+    def forward(self, *xs):
+        raise NotImplementedError
+
+    def backward(self, *dys):
+        raise NotImplementedError
+
+
+class Dummy(Operation):
+    """Placeholder creator for leaf tensors (reference: autograd.Dummy)."""
+
+    def __init__(self, tensor, name=None):
+        super().__init__(name)
+        self.src = []
+        self.y_id2idx = {id(tensor.data): 0}
+        self.tensor = tensor
+        self.requires_grad = tensor.requires_grad
+
+
+def infer_dependency(op) -> dict:
+    """Count, for each reachable op, how many downstream consumers must
+    deliver a gradient before its own backward can run (reference:
+    autograd.infer_dependency)."""
+    counts = {op: 0}
+    queue = deque([op])
+    while queue:
+        cur = queue.popleft()
+        for src_op, _, _, _ in cur.src:
+            if src_op is None:
+                continue
+            if src_op not in counts:
+                counts[src_op] = 0
+                queue.append(src_op)
+            counts[src_op] += 1
+    return counts
+
+
+def gradients(y, dy=None):
+    """Run backward and return {param_tensor: grad_tensor} (reference
+    helper of the same name)."""
+    return {p: g for p, g in backward(y, dy)}
+
+
+def backward(y, dy=None):
+    """Reverse-topo walk from loss ``y``; yields ``(tensor, grad)`` for
+    every tensor with ``stores_grad`` as its gradient becomes final.
+
+    Matches reference ``autograd.backward`` semantics including the
+    generator contract consumed by ``opt.DistOpt`` (SURVEY.md §3.3).
+    """
+    assert isinstance(y, Tensor), "backward target must be a Tensor"
+    if y.creator is None:
+        return
+    if dy is None:
+        dy = jnp.ones(y.shape, dtype=y.data.dtype)
+    else:
+        dy = _raw(dy)
+
+    dependency = infer_dependency(y.creator)
+    ready = deque([(y.creator, (dy,))])
+    not_ready = {}  # op -> list of accumulated output grads
+
+    while ready:
+        op, dys = ready.popleft()
+        if isinstance(op, Dummy):
+            continue
+        dxs = op._do_backward(*dys)
+        assert len(dxs) == len(op.src), (
+            f"{op.name}: backward returned {len(dxs)} grads for "
+            f"{len(op.src)} inputs"
+        )
+        for (src_op, x_id, x_tensor, x_stores_grad), dx in zip(op.src, dxs):
+            if src_op is None or dx is None or _is_float0(dx):
+                continue
+            y_idx = src_op.y_id2idx[x_id]
+            if src_op not in not_ready:
+                slots = [None] * len(src_op.y_id2idx)
+                slots[y_idx] = dx
+                not_ready[src_op] = slots
+            else:
+                slots = not_ready[src_op]
+                slots[y_idx] = dx if slots[y_idx] is None else slots[y_idx] + dx
+            dependency[src_op] -= 1
+            if dependency[src_op] == 0:
+                if x_stores_grad and x_tensor is not None:
+                    g = not_ready[src_op][y_idx]
+                    yield (x_tensor, _wrap(g, x_tensor.device))
+                if not isinstance(src_op, Dummy) and src_op.requires_grad:
+                    ready.append((src_op, tuple(not_ready[src_op])))
+                del not_ready[src_op]
+
+
+def _is_float0(dx):
+    return hasattr(dx, "dtype") and dx.dtype == jax.dtypes.float0
+
+
+# ---------------------------------------------------------------------------
+# Generic op machinery: forward = pure function, backward = jax.vjp.
+# ---------------------------------------------------------------------------
+
+class _Func(Operation):
+    """Op whose VJP comes from jax.vjp of its pure forward function.
+
+    ``fn(*xs)`` must be pure over its array arguments; keyword parameters
+    are closed over at construction.  Replaces the reference's per-op
+    hand-written backward + cuDNN bwd-kernel calls.
+    """
+
+    fn = None  # subclasses set a staticmethod, or pass fn to __init__
+
+    def __init__(self, fn=None, name=None, **params):
+        super().__init__(name)
+        if fn is not None:
+            self.fn = fn
+        self.params = params
+
+    def forward(self, *xs):
+        f = self.fn
+        if self.params:
+            p = self.params
+            g = lambda *a: f(*a, **p)  # noqa: E731
+        else:
+            g = f
+        if training:
+            y, self.grad_fn = jax.vjp(g, *xs)
+            # remember multi-output avals so unconsumed outputs can get
+            # zero cotangents in backward
+            self._out_aval = (
+                [(o.shape, o.dtype) for o in y] if isinstance(y, tuple) else None
+            )
+            return y
+        return g(*xs)
+
+    def backward(self, *dys):
+        if self._out_aval is not None:
+            cts = tuple(
+                d if d is not None else jnp.zeros(s, dt)
+                for d, (s, dt) in zip(dys, self._out_aval)
+            )
+            return self.grad_fn(cts)
+        return self.grad_fn(dys[0])
+
+
+def _op(fn, *xs, _name=None, **params):
+    """Apply a pure function as a recorded autograd op over Tensors."""
+    return _Func(fn=fn, name=_name, **params)(*xs)
+
+
+# ---------------------------------------------------------------------------
+# Functional API (mirrors reference autograd module functions)
+# ---------------------------------------------------------------------------
+
+def relu(x):
+    return _op(jax.nn.relu, x, _name="ReLU")
+
+
+def leakyrelu(x, a=0.01):
+    return _op(lambda v, a: jax.nn.leaky_relu(v, a), x, _name="LeakyRelu", a=a)
+
+
+def elu(x, alpha=1.0):
+    return _op(lambda v, alpha: jax.nn.elu(v, alpha), x, _name="Elu", alpha=alpha)
+
+
+def selu(x):
+    return _op(jax.nn.selu, x, _name="SeLU")
+
+
+def gelu(x, approximate=True):
+    return _op(lambda v, approximate: jax.nn.gelu(v, approximate=approximate),
+               x, _name="Gelu", approximate=approximate)
+
+
+def sigmoid(x):
+    return _op(jax.nn.sigmoid, x, _name="Sigmoid")
+
+
+def tanh(x):
+    return _op(jnp.tanh, x, _name="Tanh")
+
+
+def softplus(x):
+    return _op(jax.nn.softplus, x, _name="SoftPlus")
+
+
+def softsign(x):
+    return _op(lambda v: v / (1 + jnp.abs(v)), x, _name="SoftSign")
+
+
+def relu6(x):
+    return _op(jax.nn.relu6, x, _name="ReLU6")
+
+
+def swish(x):
+    return _op(jax.nn.swish, x, _name="Swish")
+
+
+def hardsigmoid(x, alpha=0.2, gamma=0.5):
+    return _op(lambda v, alpha, gamma: jnp.clip(alpha * v + gamma, 0, 1),
+               x, _name="HardSigmoid", alpha=alpha, gamma=gamma)
+
+
+def abs(x):  # noqa: A001
+    return _op(jnp.abs, x, _name="Abs")
+
+
+def exp(x):
+    return _op(jnp.exp, x, _name="Exp")
+
+
+def log(x):
+    return _op(jnp.log, x, _name="Log")
+
+
+def sqrt(x):
+    return _op(jnp.sqrt, x, _name="Sqrt")
+
+
+def square(x):
+    return _op(jnp.square, x, _name="Square")
+
+
+def sign(x):
+    return _op(jnp.sign, x, _name="Sign")
+
+
+def sin(x):
+    return _op(jnp.sin, x, _name="Sin")
+
+
+def cos(x):
+    return _op(jnp.cos, x, _name="Cos")
+
+
+def negative(x):
+    return _op(jnp.negative, x, _name="Negative")
+
+
+def reciprocal(x):
+    return _op(jnp.reciprocal, x, _name="Reciprocal")
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return _op(lambda v, min, max: jnp.clip(v, min, max), x,
+               _name="Clip", min=min, max=max)
+
+
+def add(a, b):
+    return _op(jnp.add, a, b, _name="Add")
+
+
+def sub(a, b):
+    return _op(jnp.subtract, a, b, _name="Sub")
+
+
+def mul(a, b):
+    return _op(jnp.multiply, a, b, _name="Mul")
+
+
+def div(a, b):
+    return _op(jnp.divide, a, b, _name="Div")
+
+
+def pow(a, b):  # noqa: A001
+    return _op(jnp.power, a, b, _name="Pow")
+
+
+def minimum(a, b):
+    return _op(jnp.minimum, a, b, _name="Min")
+
+
+def maximum(a, b):
+    return _op(jnp.maximum, a, b, _name="Max")
+
+
+def matmul(a, b):
+    """Reference: autograd.Matmul → cuBLAS GEMM; here lax dot on the MXU."""
+    return _op(jnp.matmul, a, b, _name="Matmul")
+
+
+def add_bias(x, b, axis=0):
+    """Reference: autograd.AddBias (bias add over rows/cols of a matrix)."""
+    if axis == 0:
+        return _op(lambda v, w: v + w, x, b, _name="AddBias")
+    return _op(lambda v, w: v + w[:, None], x, b, _name="AddBias")
+
+
+def gemm(A, B, C=None, alpha=1.0, beta=1.0, transA=False, transB=False):
+    """ONNX-style Gemm (reference autograd.Gemm)."""
+
+    def f(a, b, *rest, alpha=alpha, beta=beta, transA=transA, transB=transB):
+        a = a.T if transA else a
+        b = b.T if transB else b
+        y = alpha * jnp.matmul(a, b)
+        if rest:
+            y = y + beta * rest[0]
+        return y
+
+    if C is None:
+        return _op(f, A, B, _name="Gemm")
+    return _op(f, A, B, C, _name="Gemm")
+
+
+def reshape(x, shape):
+    return _op(lambda v, shape: jnp.reshape(v, shape), x,
+               _name="Reshape", shape=tuple(int(s) for s in shape))
+
+
+def flatten(x, axis=1):
+    """Reference autograd.Flatten: collapse dims from ``axis`` on."""
+
+    def f(v, axis):
+        lead = int(np.prod(v.shape[:axis])) if axis > 0 else 1
+        return jnp.reshape(v, (lead, -1))
+
+    return _op(f, x, _name="Flatten", axis=axis)
+
+
+def transpose(x, shape=None):
+    """Reference autograd.Transpose(perm); arg named `shape` upstream."""
+    perm = tuple(shape) if shape is not None else None
+    return _op(lambda v, perm: jnp.transpose(v, perm), x,
+               _name="Transpose", perm=perm)
+
+
+def cat(xs, axis=0):
+    return _Func(
+        fn=lambda *vs, axis=axis: jnp.concatenate(vs, axis=axis), name="Concat"
+    )(*xs)
+
+
+concat = cat
+
+
+def split(x, axis, parts):
+    """Reference autograd.Split: sizes list → tuple of outputs."""
+    offsets = np.cumsum(parts)[:-1].tolist()
+    return _Func(
+        fn=lambda v: tuple(jnp.split(v, offsets, axis=axis)), name="Split"
+    )(x)
+
+
+def squeeze(x, axis=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _op(lambda v, ax: jnp.squeeze(v, ax), x, _name="Squeeze", ax=ax)
+
+
+def unsqueeze(x, axis):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return _op(lambda v, ax: jnp.expand_dims(v, ax), x, _name="Unsqueeze", ax=ax)
+
+
+def gather(x, axis, indices):
+    idx = jnp.asarray(np.asarray(indices, dtype=np.int32))
+    return _op(lambda v, axis, idx: jnp.take(v, idx, axis=axis), x,
+               _name="Gather", axis=axis, idx=idx)
+
+
+def mean(*xs):
+    """Reference autograd.Mean: elementwise mean of N tensors."""
+    return _Func(
+        fn=lambda *vs: _sum_list(vs) / float(len(vs)), name="Mean"
+    )(*xs)
+
+
+def reduce_mean(x, axes=None, keepdims=False):
+    ax = tuple(axes) if axes is not None else None
+    return _op(lambda v, ax, keepdims: jnp.mean(v, axis=ax, keepdims=keepdims),
+               x, _name="ReduceMean", ax=ax, keepdims=bool(keepdims))
+
+
+def reduce_sum(x, axes=None, keepdims=False):
+    ax = tuple(axes) if axes is not None else None
+    return _op(lambda v, ax, keepdims: jnp.sum(v, axis=ax, keepdims=keepdims),
+               x, _name="ReduceSum", ax=ax, keepdims=bool(keepdims))
+
+
+def sum(*xs):  # noqa: A001  (reference: autograd.sum = eltwise sum of N)
+    return _Func(fn=lambda *vs: _sum_list(vs), name="Sum")(*xs)
+
+
+def _sum_list(vs):
+    out = vs[0]
+    for v in vs[1:]:
+        out = out + v
+    return out
+
+
+def softmax(x, axis=1):
+    """Reference autograd.SoftMax defaults to axis=1 (2-D logits)."""
+    return _op(lambda v, axis: jax.nn.softmax(v, axis=axis), x,
+               _name="SoftMax", axis=axis)
+
+
+def log_softmax(x, axis=1):
+    return _op(lambda v, axis: jax.nn.log_softmax(v, axis=axis), x,
+               _name="LogSoftMax", axis=axis)
+
+
+class _CrossEntropy(Operation):
+    """Reference autograd.CrossEntropy: input is a probability matrix
+    (post-softmax); target is one-hot or class indices."""
+
+    def forward(self, p, t):
+        t1h = _to_one_hot(t, p.shape)
+        self._saved = (p, t1h)
+        eps = 1e-10
+        return -jnp.sum(t1h * jnp.log(p + eps)) / p.shape[0]
+
+    def backward(self, dy):
+        p, t1h = self._saved
+        return (dy * (-t1h / (p + 1e-10)) / p.shape[0], None)
+
+
+class _SoftMaxCrossEntropy(Operation):
+    """Reference autograd.SoftMaxCrossEntropy: fused, numerically stable.
+    Loss = mean over batch of CE(softmax(logits), target)."""
+
+    def forward(self, x, t):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        t1h = _to_one_hot(t, x.shape)
+        self._saved = (jnp.exp(logp), t1h)
+        return -jnp.sum(t1h * logp) / x.shape[0]
+
+    def backward(self, dy):
+        p, t1h = self._saved
+        return (dy * (p - t1h) / p.shape[0], None)
+
+
+def _to_one_hot(t, logits_shape):
+    if t.ndim == len(logits_shape) and t.shape == tuple(logits_shape):
+        return t.astype(jnp.float32)
+    return jax.nn.one_hot(t.astype(jnp.int32), logits_shape[-1], dtype=jnp.float32)
+
+
+def cross_entropy(p, t):
+    return _CrossEntropy()(p, t)
+
+
+def softmax_cross_entropy(x, t):
+    return _SoftMaxCrossEntropy()(x, t)
+
+
+def mse_loss(x, t):
+    return _op(lambda a, b: jnp.mean(jnp.square(a - b)), x, t, _name="MSE")
+
+
+def binary_cross_entropy(p, t):
+    eps = 1e-7
+    return _op(
+        lambda a, b: -jnp.mean(b * jnp.log(a + eps) + (1 - b) * jnp.log(1 - a + eps)),
+        p, t, _name="BCE",
+    )
+
+
+def nll_loss(logp, t):
+    def f(lp, tt):
+        t1h = _to_one_hot(tt, lp.shape)
+        return -jnp.sum(t1h * lp) / lp.shape[0]
+
+    return _op(f, logp, t, _name="NLL")
+
+
+class _Dropout(Operation):
+    """Reference autograd.Dropout: scaled mask at train time.  The mask key
+    comes from the input tensor's device PRNG so graph mode can thread it
+    as traced state."""
+
+    def __init__(self, ratio=0.5):
+        super().__init__()
+        self.ratio = float(ratio)
+
+    def _do_forward(self, *xs):
+        self._key = xs[0].device.rng_key()
+        return super()._do_forward(*xs)
+
+    def forward(self, x):
+        self._mask = None
+        if not training or self.ratio == 0.0:
+            return x
+        keep = 1.0 - self.ratio
+        mask = jax.random.bernoulli(self._key, keep, x.shape)
+        self._mask = mask
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def backward(self, dy):
+        if self._mask is None:  # ratio == 0: identity
+            return dy
+        keep = 1.0 - self.ratio
+        return jnp.where(self._mask, dy / keep, 0.0).astype(dy.dtype)
+
+
+def dropout(x, ratio=0.5):
+    return _Dropout(ratio)(x)
+
+
+def identity(x):
+    return _op(lambda v: v, x, _name="Identity")
+
+
+def erf(x):
+    return _op(jax.lax.erf, x, _name="Erf")
+
+
+def cast(x, to):
+    dt = to
+    return _op(lambda v, dt: v.astype(dt), x, _name="Cast", dt=dt)
+
+
+def equal(a, b):
+    return _op(lambda x, y: (x == y).astype(jnp.float32), a, b, _name="Equal")
+
+
+def greater(a, b):
+    return _op(lambda x, y: (x > y).astype(jnp.float32), a, b, _name="Greater")
+
+
+def less(a, b):
+    return _op(lambda x, y: (x < y).astype(jnp.float32), a, b, _name="Less")
+
+
+def where_op(cond, a, b):
+    return _op(lambda c, x, y: jnp.where(c != 0, x, y), cond, a, b, _name="Where")

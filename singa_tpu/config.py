@@ -1,0 +1,27 @@
+"""Build/capability flags.
+
+Reference parity: apache/singa surfaces compile-time CMake options
+(``USE_CUDA``, ``USE_DNNL``, ``ENABLE_DIST``, ... baked into
+``singa_config.h.in`` — see SURVEY.md §5.6, unverified paths) to Python.
+Here the stack is a single-language JAX/XLA build, so the flags are computed
+at import time from the live environment instead of at compile time.
+"""
+
+import jax
+
+# The TPU-native stack replaces SINGA's CUDA/cuDNN/OpenCL backends entirely.
+USE_CUDA = False
+USE_CUDNN = False
+USE_OPENCL = False
+USE_DNNL = False
+
+# JAX is always present; an accelerator backend may or may not be.
+USE_TPU = any(d.platform in ("tpu", "axon") for d in jax.devices())
+USE_PYTHON = True
+
+# Distributed training (DistOpt over ICI/DCN collectives) is always compiled
+# in: jax collectives need no extra build flag, unlike NCCL/MPI.
+ENABLE_DIST = True
+
+CPP_VERSION = None  # no native C++ tensor core; see native/ for IO helpers
+VERSION = "0.1.0"

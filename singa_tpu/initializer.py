@@ -1,0 +1,76 @@
+"""Parameter initializers (reference: python/singa/initializer.py,
+unverified — gaussian/uniform/xavier/he fills mutating a Tensor)."""
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _fan(t: Tensor):
+    shape = t.shape
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) >= 3:
+        receptive = int(np.prod(shape[2:]))
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    else:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    return fan_in, fan_out
+
+
+def uniform(t: Tensor, low=0.0, high=1.0):
+    return t.uniform(low, high)
+
+
+def gaussian(t: Tensor, mean=0.0, std=0.01):
+    return t.gaussian(mean, std)
+
+
+def xavier(t: Tensor):
+    """Glorot uniform."""
+    fan_in, fan_out = _fan(t)
+    a = np.sqrt(6.0 / (fan_in + fan_out))
+    return t.uniform(-a, a)
+
+
+glorot_uniform = xavier
+
+
+def glorot_normal(t: Tensor):
+    fan_in, fan_out = _fan(t)
+    std = np.sqrt(2.0 / (fan_in + fan_out))
+    return t.gaussian(0.0, std)
+
+
+def msra(t: Tensor):
+    """He normal (reference name: msra)."""
+    fan_in, _ = _fan(t)
+    return t.gaussian(0.0, np.sqrt(2.0 / fan_in))
+
+
+he_normal = msra
+
+
+def he_uniform(t: Tensor):
+    fan_in, _ = _fan(t)
+    a = np.sqrt(6.0 / fan_in)
+    return t.uniform(-a, a)
+
+
+def lecun_uniform(t: Tensor):
+    fan_in, _ = _fan(t)
+    a = np.sqrt(3.0 / fan_in)
+    return t.uniform(-a, a)
+
+
+def constant(t: Tensor, value=0.0):
+    return t.set_value(value)
+
+
+def zeros(t: Tensor):
+    return t.set_value(0.0)
+
+
+def ones(t: Tensor):
+    return t.set_value(1.0)

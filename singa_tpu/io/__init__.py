@@ -1,0 +1,2 @@
+"""IO — the rebuild of src/io (snapshot key-value store, binfile
+readers/writers, data loaders); native C++ fast path in native/."""

@@ -1,0 +1,406 @@
+"""Stateful layer API, shaped after the reference's ``python/singa/layer.py``
+(v3 era, ~1.5k LoC, unverified — SURVEY.md §2.2): ``Layer`` base with
+parameter creation deferred to the first call (``initialize``), hierarchical
+param naming, ``get_params/set_params/get_states/set_states``; concrete
+layers ``Linear``, ``Conv2d``, ``BatchNorm2d``, ``Pooling2d`` variants,
+``LSTM``, plus op-wrapper layers (``ReLU``, ``Flatten``, losses...).
+
+Conv/BN/Pool/RNN layers call into ``singa_tpu.ops`` (the rebuild of the
+reference's ``src/model/operation/*`` cuDNN handle kernels).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import autograd, initializer, tensor
+from .tensor import Tensor
+
+
+class Layer:
+    sep = "."
+
+    def __init__(self):
+        self.name = self.__class__.__name__
+        self._initialized = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, *input):
+        """Create params from the first input's shapes (reference: params
+        are created on first call, not at construction)."""
+
+    def forward(self, *input):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        if not self._initialized:
+            self.initialize(*args, **kwargs)
+            self._initialized = True
+            self._name_params()
+        return self.forward(*args, **kwargs)
+
+    # -- introspection -----------------------------------------------------
+    def _sublayers(self):
+        out = []
+        for attr, val in sorted(self.__dict__.items()):
+            if isinstance(val, Layer):
+                out.append((attr, val))
+            elif isinstance(val, (list, tuple)):
+                for i, v in enumerate(val):
+                    if isinstance(v, Layer):
+                        out.append((f"{attr}{i}", v))
+        return out
+
+    def _own_param_attrs(self):
+        """Names of attributes holding this layer's own parameter Tensors."""
+        return [
+            a for a, v in sorted(self.__dict__.items())
+            if isinstance(v, Tensor) and v.stores_grad
+        ]
+
+    def _own_state_attrs(self):
+        """Own non-param persistent state (e.g. BN running stats)."""
+        return [
+            a for a, v in sorted(self.__dict__.items())
+            if isinstance(v, Tensor) and not v.stores_grad
+            and getattr(v, "_is_layer_state", False)
+        ]
+
+    def _name_params(self):
+        for a in self._own_param_attrs() + self._own_state_attrs():
+            t = getattr(self, a)
+            if t.name is None:
+                t.name = f"{self.name}{self.sep}{a}"
+
+    def set_name(self, name):
+        self.name = name
+        # re-name any already-created param/state tensors to the new
+        # hierarchical path (first-call naming may have used the bare
+        # class name)
+        for a in self._own_param_attrs() + self._own_state_attrs():
+            getattr(self, a).name = f"{name}{self.sep}{a}"
+        for attr, sub in self._sublayers():
+            sub.set_name(f"{name}{self.sep}{attr}")
+
+    # -- params / states ---------------------------------------------------
+    def get_params(self) -> dict:
+        params = {}
+        for a in self._own_param_attrs():
+            t = getattr(self, a)
+            params[t.name or f"{self.name}{self.sep}{a}"] = t
+        for _, sub in self._sublayers():
+            params.update(sub.get_params())
+        return params
+
+    @staticmethod
+    def _load_into(t: Tensor, src):
+        """Rebind t's buffer from src, preserving t's device placement."""
+        import jax
+        import jax.numpy as jnp
+
+        arr = src.data if isinstance(src, Tensor) else jnp.asarray(np.asarray(src))
+        t.data = jax.device_put(arr, t.device.jax_device)
+        t.creator = None
+
+    def set_params(self, params: dict):
+        for name, t in self.get_params().items():
+            if name in params:
+                self._load_into(t, params[name])
+
+    def get_states(self) -> dict:
+        states = dict(self.get_params())
+        for a in self._own_state_attrs():
+            t = getattr(self, a)
+            states[t.name or f"{self.name}{self.sep}{a}"] = t
+        for _, sub in self._sublayers():
+            states.update(sub.get_states())
+        return states
+
+    def set_states(self, states: dict):
+        for name, t in self.get_states().items():
+            if name in states:
+                self._load_into(t, states[name])
+
+    def register_state(self, t: Tensor):
+        """Mark a non-param Tensor as persistent layer state."""
+        t._is_layer_state = True
+        t.requires_grad = False
+        t.stores_grad = False
+        return t
+
+    def device_check(self, *inputs):
+        devs = {id(x.device) for x in inputs if isinstance(x, Tensor)}
+        assert len(devs) <= 1, f"{self.name}: inputs on different devices"
+
+
+# ---------------------------------------------------------------------------
+# Linear
+# ---------------------------------------------------------------------------
+
+class Linear(Layer):
+    """Reference layer.Linear: y = x W + b, W created as (in, out) on
+    first call, xavier-initialized."""
+
+    def __init__(self, out_features, bias=True):
+        super().__init__()
+        self.out_features = int(out_features)
+        self.bias = bool(bias)
+
+    def initialize(self, x):
+        in_features = x.shape[-1]
+        self.W = Tensor(
+            (in_features, self.out_features), device=x.device,
+            dtype=x.data.dtype, requires_grad=True, stores_grad=True,
+        )
+        initializer.xavier(self.W)
+        if self.bias:
+            self.b = Tensor(
+                (self.out_features,), device=x.device, dtype=x.data.dtype,
+                requires_grad=True, stores_grad=True,
+            )
+            self.b.set_value(0.0)
+
+    def forward(self, x):
+        y = autograd.matmul(x, self.W)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        return y
+
+
+# ---------------------------------------------------------------------------
+# op-wrapper layers (stateless; reference v4 exposes these too)
+# ---------------------------------------------------------------------------
+
+class ReLU(Layer):
+    def forward(self, x):
+        return autograd.relu(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, a=0.01):
+        super().__init__()
+        self.a = a
+
+    def forward(self, x):
+        return autograd.leakyrelu(x, self.a)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return autograd.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return autograd.tanh(x)
+
+
+class Gelu(Layer):
+    def forward(self, x):
+        return autograd.gelu(x)
+
+
+class SoftMax(Layer):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.softmax(x, self.axis)
+
+
+class Flatten(Layer):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.flatten(x, self.axis)
+
+
+class Reshape(Layer):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = shape
+
+    def forward(self, x):
+        return autograd.reshape(x, self.shape)
+
+
+class Dropout(Layer):
+    def __init__(self, ratio=0.5):
+        super().__init__()
+        self.ratio = ratio
+
+    def forward(self, x):
+        return autograd.dropout(x, self.ratio)
+
+
+class Cat(Layer):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, xs):
+        return autograd.cat(xs, self.axis)
+
+
+class Add(Layer):
+    def forward(self, a, b):
+        return autograd.add(a, b)
+
+
+class SoftMaxCrossEntropy(Layer):
+    def forward(self, x, t):
+        return autograd.softmax_cross_entropy(x, t)
+
+
+class CrossEntropy(Layer):
+    def forward(self, p, t):
+        return autograd.cross_entropy(p, t)
+
+
+class MSELoss(Layer):
+    def forward(self, x, t):
+        return autograd.mse_loss(x, t)
+
+
+class BinaryCrossEntropy(Layer):
+    def forward(self, p, t):
+        return autograd.binary_cross_entropy(p, t)
+
+
+# ---------------------------------------------------------------------------
+# Conv / BN / Pool / RNN layers — bodies in singa_tpu.ops (added with the
+# op kernels; imported lazily so the core has no hard dep ordering)
+# ---------------------------------------------------------------------------
+
+class Conv2d(Layer):
+    """Reference layer.Conv2d over operation/convolution.cc's ConvHandle
+    (unverified).  NCHW layout, like the reference."""
+
+    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+                 dilation=1, group=1, bias=True, pad_mode="NOTSET",
+                 activation="NOTSET"):
+        super().__init__()
+        self.nb_kernels = int(nb_kernels)
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.dilation = _pair(dilation)
+        self.group = int(group)
+        self.bias = bool(bias)
+        self.pad_mode = pad_mode
+        self.activation = activation
+
+    def initialize(self, x):
+        in_channels = x.shape[1]
+        assert in_channels % self.group == 0
+        w_shape = (self.nb_kernels, in_channels // self.group) + self.kernel_size
+        self.W = Tensor(w_shape, device=x.device, dtype=x.data.dtype,
+                        requires_grad=True, stores_grad=True)
+        # reference init: he-style scaled gaussian over receptive field
+        std = math.sqrt(2.0 / (w_shape[1] * np.prod(self.kernel_size) + self.nb_kernels))
+        self.W.gaussian(0.0, std)
+        if self.bias:
+            self.b = Tensor((self.nb_kernels,), device=x.device,
+                            dtype=x.data.dtype, requires_grad=True,
+                            stores_grad=True)
+            self.b.set_value(0.0)
+
+    def forward(self, x):
+        from .ops import conv as conv_ops
+
+        y = conv_ops.conv2d(
+            x, self.W, self.b if self.bias else None,
+            stride=self.stride, padding=self.padding,
+            dilation=self.dilation, group=self.group, pad_mode=self.pad_mode,
+        )
+        if self.activation == "RELU":
+            y = autograd.relu(y)
+        return y
+
+
+class BatchNorm2d(Layer):
+    """Reference layer.BatchNorm2d over operation/batchnorm.cc (cuDNN
+    spatial BN, unverified): per-channel affine + running stats."""
+
+    def __init__(self, momentum=0.9, eps=1e-5):
+        super().__init__()
+        self.momentum = float(momentum)
+        self.eps = float(eps)
+
+    def initialize(self, x):
+        c = x.shape[1]
+        dt = x.data.dtype
+        self.scale = Tensor((c,), device=x.device, dtype=dt,
+                            requires_grad=True, stores_grad=True).set_value(1.0)
+        self.bias = Tensor((c,), device=x.device, dtype=dt,
+                           requires_grad=True, stores_grad=True).set_value(0.0)
+        self.running_mean = self.register_state(
+            Tensor((c,), device=x.device, dtype=tensor.float32).set_value(0.0))
+        self.running_var = self.register_state(
+            Tensor((c,), device=x.device, dtype=tensor.float32).set_value(1.0))
+
+    def forward(self, x):
+        from .ops import batchnorm as bn_ops
+
+        return bn_ops.batchnorm2d(
+            x, self.scale, self.bias, self.running_mean, self.running_var,
+            momentum=self.momentum, eps=self.eps,
+        )
+
+
+class Pooling2d(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, is_max=True,
+                 pad_mode="NOTSET"):
+        super().__init__()
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride) if stride is not None else self.kernel_size
+        self.padding = _pair(padding)
+        self.is_max = bool(is_max)
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        from .ops import pooling as pool_ops
+
+        return pool_ops.pooling2d(
+            x, kernel=self.kernel_size, stride=self.stride,
+            padding=self.padding, is_max=self.is_max,
+        )
+
+
+class MaxPool2d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__(kernel_size, stride, padding, is_max=True, **kw)
+
+
+class AvgPool2d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__(kernel_size, stride, padding, is_max=False, **kw)
+
+
+class GlobalAvgPool2d(Layer):
+    def forward(self, x):
+        return autograd.reduce_mean(x, axes=(2, 3), keepdims=False)
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# RNN layers are defined next to the rnn op kernels and re-exported here.
+def __getattr__(name):
+    if name in ("LSTM", "GRU", "RNN", "CudnnRNN"):
+        from .ops import rnn as rnn_ops
+
+        return getattr(rnn_ops, name)
+    if name == "MultiHeadAttention":
+        from .ops import attention as attn_ops
+
+        return attn_ops.MultiHeadAttention
+    raise AttributeError(name)

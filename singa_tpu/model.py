@@ -1,0 +1,301 @@
+"""``Model`` API, shaped after the reference's ``python/singa/model.py``
+(~400 LoC, unverified — SURVEY.md §2.2): ``compile(inputs, is_train,
+use_graph, sequential)``, user-overridden ``train_one_batch``,
+``set_optimizer``, ``save_states``/``load_states``, train/eval switches.
+
+Graph mode, TPU-native: the reference's buffering graph scheduler
+(``src/core/scheduler/scheduler.cc`` — record Exec lambdas on iteration 1,
+topo-sort by block deps, replay thereafter) collapses into ``jax.jit``:
+
+  * iteration 1 runs **eagerly** (exactly like the reference: the first
+    iteration both executes and materializes graph state — here it also
+    lets optimizers create their momentum buffers);
+  * iteration 2 traces the user's ``train_one_batch`` into one pure
+    function over (persistent state, batch) and compiles it with donated
+    state buffers — XLA's scheduler then owns op ordering, fusion, memory
+    reuse and latency hiding (the jobs of scheduler.cc + cnmem);
+  * later iterations replay the cached executable, keyed by input
+    shape/dtype like the reference keys its graph on buffered shapes.
+
+"Persistent state" = model params + layer states (BN running stats) +
+optimizer state (momentum, step counter) + the device PRNG key (so dropout
+advances deterministically inside the compiled step).
+"""
+
+from __future__ import annotations
+
+import os
+import zipfile
+import io as _io
+
+import numpy as np
+import jax
+
+from . import autograd, layer, tensor
+from .tensor import Tensor
+
+# registry of graph runners (for Device.ResetGraph / PrintTimeProfiling)
+_graph_runners = []
+
+
+def _clear_compiled_caches(device=None):
+    for r in _graph_runners:
+        r.clear()
+
+
+def _compiled_cost_tables(device=None):
+    out = []
+    for r in _graph_runners:
+        out.extend(r.cost_tables())
+    return out
+
+
+class Model(layer.Layer):
+    """Subclass and override ``forward`` and ``train_one_batch`` (reference
+    contract; see examples/)."""
+
+    def __init__(self):
+        super().__init__()
+        self._optimizer = None
+        self.graph_mode = False
+        self.sequential = False
+        self._graph_runner = None
+        self.dist = False
+
+    # -- reference API -----------------------------------------------------
+    def compile(self, inputs, is_train=True, use_graph=False, sequential=False):
+        """Initialize params with a dummy forward over ``inputs`` and fix
+        the execution mode (reference: model.Model.compile)."""
+        assert isinstance(inputs, (list, tuple)), "inputs must be a list"
+        self.train(is_train)
+        # name the layer tree before the dummy forward so params are
+        # created with unique hierarchical names
+        self.set_name(self.name)
+        # dummy forward creates params eagerly (reference does the same)
+        prev = autograd.training
+        autograd.set_training(False)
+        try:
+            self.forward(*inputs)
+        finally:
+            autograd.set_training(prev)
+        self._initialized = True
+        # params created during the dummy forward get their final names now
+        self.set_name(self.name)
+        names = list(self.get_states().keys())
+        assert len(names) == len(set(names)), (
+            f"duplicate param/state names after compile: {names}")
+        self.graph_mode = bool(use_graph)
+        self.sequential = bool(sequential)
+        if inputs:
+            self.device = inputs[0].device
+            self.device.EnableGraph(use_graph)
+        if self.graph_mode:
+            self._graph_runner = _GraphRunner(self)
+            _graph_runners.append(self._graph_runner)
+        if self._optimizer is not None and self.dist:
+            self._optimizer.attach_model(self)
+
+    def forward(self, *input):
+        raise NotImplementedError
+
+    def train_one_batch(self, *input, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *input, **kwargs):
+        if not self._initialized:
+            # allow un-compiled eager use, like a plain Layer
+            self.initialize(*input)
+            self._initialized = True
+        if autograd.training:
+            return self._call_train_one_batch(*input, **kwargs)
+        return self.forward(*input, **kwargs)
+
+    def _call_train_one_batch(self, *args, **kwargs):
+        if self.graph_mode and self._graph_runner is not None:
+            return self._graph_runner.run(args, kwargs)
+        return self.train_one_batch(*args, **kwargs)
+
+    def train(self, mode=True):
+        self.training = bool(mode)
+        autograd.set_training(mode)
+
+    def eval(self):
+        self.train(False)
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self.dist = getattr(optimizer, "is_distributed", False)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @optimizer.setter
+    def optimizer(self, opt):
+        self.set_optimizer(opt)
+
+    # -- state (params + layer states + optimizer states) ------------------
+    def persistent_tensors(self) -> dict:
+        """Ordered name->Tensor map of everything that survives across
+        steps; the traced state of graph mode."""
+        d = dict(sorted(self.get_states().items()))
+        if self._optimizer is not None:
+            for k, v in sorted(self._optimizer.state_tensors().items()):
+                d[f"__opt__{k}"] = v
+        return d
+
+    # -- checkpointing (reference: save_states/load_states zip format,
+    #    SURVEY.md §3.5/§5.4) ---------------------------------------------
+    def save_states(self, fpath, aux_states=None):
+        """Zip of one .npy per state tensor + optimizer state + aux."""
+        states = {k: tensor.to_numpy(v) for k, v in self.get_states().items()}
+        if self._optimizer is not None:
+            for k, v in self._optimizer.get_states().items():
+                states[f"__opt__{k}"] = np.asarray(v)
+        if aux_states:
+            for k, v in aux_states.items():
+                states[f"__aux__{k}"] = np.asarray(v)
+        tmp = fpath + ".tmp"
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            for k, v in states.items():
+                buf = _io.BytesIO()
+                np.save(buf, v)
+                zf.writestr(k + ".npy", buf.getvalue())
+        os.replace(tmp, fpath)
+
+    def load_states(self, fpath):
+        aux = {}
+        opt_states = {}
+        states = {}
+        with zipfile.ZipFile(fpath, "r") as zf:
+            for info in zf.namelist():
+                k = info[:-len(".npy")]
+                arr = np.load(_io.BytesIO(zf.read(info)), allow_pickle=False)
+                if k.startswith("__aux__"):
+                    aux[k[len("__aux__"):]] = arr
+                elif k.startswith("__opt__"):
+                    opt_states[k[len("__opt__"):]] = arr
+                else:
+                    states[k] = arr
+        self.set_states(states)
+        if self._optimizer is not None and opt_states:
+            self._optimizer.set_states(opt_states)
+        return aux
+
+
+class _GraphRunner:
+    """Compiles/replays ``train_one_batch`` (see module docstring)."""
+
+    def __init__(self, model: Model):
+        self.model = model
+        self._compiled = {}  # key -> (jit_fn, state_names)
+        self._warm = False
+
+    def clear(self):
+        self._compiled.clear()
+        self._warm = False
+
+    def cost_tables(self):
+        """XLA cost analysis per compiled step (feeds
+        Device.PrintTimeProfiling, the rebuild of the reference's per-op
+        CUDA-event profiling)."""
+        out = []
+        for key, entry in self._compiled.items():
+            cost = entry[2] if len(entry) > 2 else None
+            if cost:
+                out.append((str(key), cost))
+        return out
+
+    @staticmethod
+    def _abstract_key(args, kwargs):
+        def sig(v):
+            if isinstance(v, Tensor):
+                return ("T", tuple(v.shape), str(np.dtype(v.data.dtype)))
+            return ("V", v)
+
+        return (
+            tuple(sig(a) for a in args),
+            tuple(sorted((k, sig(v)) for k, v in kwargs.items())),
+        )
+
+    def run(self, args, kwargs):
+        model = self.model
+        if not self._warm:
+            # iteration 1: eager — executes AND materializes lazy state
+            # (optimizer buffers), mirroring the reference's build-while-run
+            # first graph iteration.
+            out = model.train_one_batch(*args, **kwargs)
+            self._warm = True
+            return out
+
+        key = self._abstract_key(args, kwargs)
+        state = model.persistent_tensors()
+        names = list(state.keys())
+        tensors = [state[n] for n in names]
+        dev = model.device
+
+        state_arrays = [jax.device_put(t.data, dev.jax_device) for t in tensors]
+        state_arrays.append(jax.device_put(dev._rng_key, dev.jax_device))
+        in_arrays = [a.data for a in args if isinstance(a, Tensor)]
+        in_arrays += [v.data for k, v in sorted(kwargs.items())
+                      if isinstance(v, Tensor)]
+
+        if key not in self._compiled or self._compiled[key][1] != names:
+            fn = self._build(args, kwargs, names)
+            cost = None
+            try:
+                compiled = fn.lower(state_arrays, in_arrays).compile()
+                cost = compiled.cost_analysis()
+                fn = compiled
+            except Exception:
+                pass  # fall back to on-demand jit compile
+            self._compiled[key] = (fn, names, cost)
+        fn = self._compiled[key][0]
+        new_state, out_tree = fn(state_arrays, in_arrays)
+        for t, a in zip(tensors, new_state[:-1]):
+            t.data = a
+            t.creator = None
+        dev._rng_key = new_state[-1]
+        return jax.tree.map(
+            lambda a: tensor._wrap(a, dev),
+            out_tree,
+        )
+
+    def _build(self, args, kwargs, names):
+        model = self.model
+        dev = model.device
+        tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        tensor_kw = sorted(k for k, v in kwargs.items() if isinstance(v, Tensor))
+
+        def step(state_arrays, in_arrays):
+            state = model.persistent_tensors()
+            tensors = [state[n] for n in names]
+            saved = [(t, t.data) for t in tensors]
+            saved_key = dev._rng_key
+            try:
+                for t, a in zip(tensors, state_arrays[:-1]):
+                    t.data = a
+                    t.creator = None
+                dev._rng_key = state_arrays[-1]
+                call_args = list(args)
+                for i, arr in zip(tensor_idx, in_arrays[:len(tensor_idx)]):
+                    call_args[i] = tensor._wrap(arr, dev)
+                    call_args[i].requires_grad = False
+                call_kwargs = dict(kwargs)
+                for k, arr in zip(tensor_kw, in_arrays[len(tensor_idx):]):
+                    call_kwargs[k] = tensor._wrap(arr, dev)
+                    call_kwargs[k].requires_grad = False
+                out = model.train_one_batch(*call_args, **call_kwargs)
+                new_state = [t.data for t in tensors] + [dev._rng_key]
+                out_tree = jax.tree.map(
+                    lambda v: v.data if isinstance(v, Tensor) else v, out,
+                    is_leaf=lambda v: isinstance(v, Tensor),
+                )
+                return new_state, out_tree
+            finally:
+                for t, a in saved:
+                    t.data = a
+                    t.creator = None
+                dev._rng_key = saved_key
+
+        return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,3 @@
+"""Model zoo mirroring the reference's ``examples/*/model/`` trees
+(SURVEY.md §2.4): MLP, CNN, AlexNet, ResNet, XceptionNet, char-RNN LSTM,
+BERT."""

@@ -1,0 +1,43 @@
+"""MLP (reference: examples/mlp/model.py, unverified — config #1 workload
+in BASELINE.json)."""
+
+from .. import layer, model
+
+
+class MLP(model.Model):
+    def __init__(self, data_size=10, perceptron_size=100, num_classes=10):
+        super().__init__()
+        self.num_classes = num_classes
+        self.dimension = 2
+        self.linear1 = layer.Linear(perceptron_size)
+        self.relu1 = layer.ReLU()
+        self.linear2 = layer.Linear(num_classes)
+        self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
+
+    def forward(self, inputs):
+        y = self.linear1(inputs)
+        y = self.relu1(y)
+        y = self.linear2(y)
+        return y
+
+    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+        out = self.forward(x)
+        loss = self.softmax_cross_entropy(out, y)
+        if dist_option == "plain":
+            self.optimizer(loss)
+        elif dist_option == "fp16":
+            self.optimizer.backward_and_update_half(loss)
+        elif dist_option == "partialUpdate":
+            self.optimizer.backward_and_partial_update(loss)
+        elif dist_option == "sparseTopK":
+            self.optimizer.backward_and_sparse_update(loss, topK=True, spars=spars)
+        elif dist_option == "sparseThreshold":
+            self.optimizer.backward_and_sparse_update(loss, topK=False, spars=spars)
+        return out, loss
+
+    def set_optimizer(self, optimizer):
+        super().set_optimizer(optimizer)
+
+
+def create_model(**kwargs):
+    return MLP(**kwargs)

@@ -1,0 +1,3 @@
+"""Op kernels — the rebuild of the reference's ``src/model/operation/``
+(cuDNN handle kernels, unverified): conv, batchnorm, pooling, rnn,
+attention; plus Pallas custom kernels under ``ops/pallas/``."""

@@ -1,0 +1,1 @@
+"""Pallas TPU custom kernels (flash attention, fused LSTM cell)."""

@@ -1,0 +1,294 @@
+"""Optimizers, API-shaped after the reference's ``python/singa/opt.py``
+(~900 LoC, unverified — SURVEY.md §2.2): ``Optimizer`` base with decay
+scheduling, ``SGD`` (momentum/nesterov/dampening/weight-decay), ``RMSProp``,
+``AdaGrad``, ``Adam``, and ``DistOpt`` (defined in this module, implemented
+over the ICI communicator in ``parallel/communicator.py``).
+
+TPU-native notes: every piece of optimizer state — momentum buffers, step
+counter — is a ``Tensor`` so graph mode (``model.py``) can thread it through
+the jitted train step as traced state; the update math is plain jnp and
+fuses into the step executable (the reference dispatches one axpy-style
+kernel per parameter per update).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, tensor
+from .tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# learning-rate / momentum schedulers (reference: opt.DecayScheduler)
+# ---------------------------------------------------------------------------
+
+class DecayScheduler:
+    def __init__(self, init_value):
+        self.init_value = float(init_value)
+
+    def __call__(self, step):
+        raise NotImplementedError
+
+    def get_states(self):
+        return {"init_value": self.init_value}
+
+
+class Constant(DecayScheduler):
+    def __call__(self, step):
+        return jnp.asarray(self.init_value, dtype=jnp.float32)
+
+
+class ExponentialDecay(DecayScheduler):
+    """lr = init * decay_rate ^ (step / decay_steps), optionally staircased
+    (reference: opt.ExponentialDecay)."""
+
+    def __init__(self, init_value, decay_steps, decay_rate, staircase=False):
+        super().__init__(init_value)
+        self.decay_steps = int(decay_steps)
+        self.decay_rate = float(decay_rate)
+        self.staircase = bool(staircase)
+
+    def __call__(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        p = step / self.decay_steps
+        if self.staircase:
+            p = jnp.floor(p)
+        return jnp.asarray(self.init_value * self.decay_rate**p, dtype=jnp.float32)
+
+
+class StepDecay(DecayScheduler):
+    """lr = init * gamma ^ floor(step / step_size)."""
+
+    def __init__(self, init_value, step_size, gamma=0.1):
+        super().__init__(init_value)
+        self.step_size = int(step_size)
+        self.gamma = float(gamma)
+
+    def __call__(self, step):
+        k = jnp.floor(jnp.asarray(step, jnp.float32) / self.step_size)
+        return jnp.asarray(self.init_value * self.gamma**k, dtype=jnp.float32)
+
+
+def _as_scheduler(v):
+    return v if isinstance(v, DecayScheduler) else Constant(v)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer base
+# ---------------------------------------------------------------------------
+
+class Optimizer:
+    """Reference contract: ``apply(param_name, param, grad)`` updates one
+    parameter in place; ``__call__(loss)`` / ``backward_and_update(loss)``
+    consume the ``autograd.backward`` generator; ``step()`` advances the
+    schedule."""
+
+    def __init__(self, lr, dtype=tensor.float32):
+        self.lr = _as_scheduler(lr)
+        self.dtype = dtype
+        # step counter is a Tensor so lr schedules stay correct inside a
+        # compiled graph-mode step
+        self.step_counter = Tensor(shape=(), dtype=tensor.float32,
+                                   requires_grad=False)
+        self._states = {}  # name -> Tensor (momentum buffers etc.)
+        self._name_of = {}  # id(param Tensor) -> assigned name
+
+    # -- naming / state ----------------------------------------------------
+    def _param_name(self, param) -> str:
+        pid = id(param)
+        if pid not in self._name_of:
+            n = param.name if param.name else f"param_{len(self._name_of)}"
+            # ensure uniqueness
+            if n in self._name_of.values():
+                n = f"{n}_{pid:x}"
+            self._name_of[pid] = n
+        return self._name_of[pid]
+
+    def _state(self, key, like) -> Tensor:
+        if key not in self._states:
+            t = Tensor(shape=like.shape, dtype=like.data.dtype,
+                       device=like.device, requires_grad=False)
+            self._states[key] = t
+        t = self._states[key]
+        if t.device is not like.device:
+            # e.g. restored from checkpoint before params were seen
+            t.to_device(like.device)
+        return t
+
+    def _step_on(self, param):
+        """Step counter placed on the param's device (it is created before
+        any param is seen, so its first placement may be wrong)."""
+        if self.step_counter.device is not param.device:
+            self.step_counter.to_device(param.device)
+        return self.step_counter.data
+
+    def state_tensors(self) -> dict:
+        """All persistent state (used by graph mode + checkpointing)."""
+        d = dict(self._states)
+        d["__step_counter__"] = self.step_counter
+        return d
+
+    def get_states(self) -> dict:
+        return {k: tensor.to_numpy(v) for k, v in self.state_tensors().items()}
+
+    def set_states(self, states: dict):
+        import jax
+
+        for k, v in states.items():
+            if k == "__step_counter__":
+                self.step_counter.data = jax.device_put(
+                    jnp.asarray(v), self.step_counter.device.jax_device)
+            elif k in self._states:
+                t = self._states[k]
+                t.data = jax.device_put(jnp.asarray(v), t.device.jax_device)
+            else:
+                # buffer not materialized yet (momentum is created lazily on
+                # first apply); stage it on the default device — _state()
+                # is keyed by name, so the staged tensor is picked up and
+                # later math follows the param's placement
+                self._states[k] = tensor.from_numpy(np.asarray(v))
+
+    # -- the reference API -------------------------------------------------
+    def __call__(self, loss):
+        self.backward_and_update(loss)
+
+    def backward_and_update(self, loss):
+        for p, g in autograd.backward(loss):
+            self.apply(self._param_name(p), p, g)
+        self.step()
+
+    def call_with_returns(self, loss):
+        pn_p_g = []
+        for p, g in autograd.backward(loss):
+            self.apply(self._param_name(p), p, g)
+            pn_p_g.append((self._param_name(p), p, g))
+        self.step()
+        return pn_p_g
+
+    def step(self):
+        self.step_counter.data = self.step_counter.data + 1
+
+    def apply(self, param_name, param, grad):
+        raise NotImplementedError
+
+    def update(self, param, grad):
+        """Reference alias: update one param given its grad."""
+        self.apply(self._param_name(param), param, grad)
+
+    # applying an update rebinds param.data; reset its creator so autograd
+    # attaches a fresh Dummy next step
+    @staticmethod
+    def _assign(param, new_value):
+        param.data = new_value.astype(param.data.dtype)
+        param.creator = None
+
+
+class SGD(Optimizer):
+    """Reference opt.SGD: momentum, dampening, nesterov, weight decay."""
+
+    def __init__(self, lr=0.1, momentum=0.0, dampening=0.0, weight_decay=0.0,
+                 nesterov=False, dtype=tensor.float32):
+        super().__init__(lr, dtype)
+        self.momentum = _as_scheduler(momentum)
+        self.dampening = _as_scheduler(dampening)
+        self.weight_decay = _as_scheduler(weight_decay)
+        self.nesterov = bool(nesterov)
+        if nesterov and (momentum == 0 if isinstance(momentum, (int, float)) else False):
+            raise ValueError("nesterov requires momentum > 0")
+
+    def apply(self, param_name, param, grad):
+        step = self._step_on(param)
+        lr = self.lr(step)
+        mom = self.momentum(step)
+        damp = self.dampening(step)
+        wd = self.weight_decay(step)
+        g = grad.data.astype(jnp.float32)
+        p = param.data.astype(jnp.float32)
+        g = g + wd * p
+        has_momentum = not (isinstance(self.momentum, Constant)
+                            and self.momentum.init_value == 0.0)
+        if has_momentum:
+            buf = self._state(f"{param_name}:momentum", param)
+            new_buf = mom * buf.data.astype(jnp.float32) + (1.0 - damp) * g
+            buf.data = new_buf
+            g = (g + mom * new_buf) if self.nesterov else new_buf
+        self._assign(param, p - lr * g)
+
+
+class RMSProp(Optimizer):
+    """Reference opt.RMSProp: running mean of squared grads."""
+
+    def __init__(self, lr=0.1, rho=0.9, epsilon=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.rho = float(rho)
+        self.epsilon = float(epsilon)
+        self.weight_decay = _as_scheduler(weight_decay)
+
+    def apply(self, param_name, param, grad):
+        step = self._step_on(param)
+        lr = self.lr(step)
+        wd = self.weight_decay(step)
+        g = grad.data.astype(jnp.float32)
+        p = param.data.astype(jnp.float32)
+        g = g + wd * p
+        v = self._state(f"{param_name}:sq", param)
+        v.data = self.rho * v.data.astype(jnp.float32) + (1 - self.rho) * g * g
+        self._assign(param, p - lr * g / jnp.sqrt(v.data + self.epsilon))
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.epsilon = float(epsilon)
+        self.weight_decay = _as_scheduler(weight_decay)
+
+    def apply(self, param_name, param, grad):
+        step = self._step_on(param)
+        lr = self.lr(step)
+        wd = self.weight_decay(step)
+        g = grad.data.astype(jnp.float32)
+        p = param.data.astype(jnp.float32)
+        g = g + wd * p
+        h = self._state(f"{param_name}:accum", param)
+        h.data = h.data.astype(jnp.float32) + g * g
+        self._assign(param, p - lr * g / jnp.sqrt(h.data + self.epsilon))
+
+
+class Adam(Optimizer):
+    """Reference opt.Adam with bias correction."""
+
+    def __init__(self, lr=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
+                 weight_decay=0.0):
+        super().__init__(lr)
+        self.beta_1 = float(beta_1)
+        self.beta_2 = float(beta_2)
+        self.epsilon = float(epsilon)
+        self.weight_decay = _as_scheduler(weight_decay)
+
+    def apply(self, param_name, param, grad):
+        step = self._step_on(param)
+        lr = self.lr(step)
+        wd = self.weight_decay(step)
+        t = step.astype(jnp.float32) + 1.0
+        g = grad.data.astype(jnp.float32)
+        p = param.data.astype(jnp.float32)
+        g = g + wd * p
+        m = self._state(f"{param_name}:m", param)
+        v = self._state(f"{param_name}:v", param)
+        m.data = self.beta_1 * m.data.astype(jnp.float32) + (1 - self.beta_1) * g
+        v.data = self.beta_2 * v.data.astype(jnp.float32) + (1 - self.beta_2) * g * g
+        m_hat = m.data / (1 - self.beta_1**t)
+        v_hat = v.data / (1 - self.beta_2**t)
+        self._assign(param, p - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon))
+
+
+# DistOpt lives with the communicator; re-exported here to match the
+# reference import path `from singa import opt; opt.DistOpt(sgd)`.
+def __getattr__(name):
+    if name == "DistOpt":
+        from .parallel.dist_opt import DistOpt
+
+        return DistOpt
+    raise AttributeError(name)

@@ -1,0 +1,3 @@
+"""Distributed training — the rebuild of the reference's NCCL/MPI
+``Communicator`` (src/io/communicator.cc, unverified) on ICI/DCN
+collectives via jax mesh + shard_map."""

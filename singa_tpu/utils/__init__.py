@@ -1,0 +1,2 @@
+"""Utilities — the rebuild of include/singa/utils (logging channels,
+timer, metrics)."""

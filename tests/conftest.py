@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference (apache/singa) could only test its NCCL Communicator with >=2
+physical GPUs (SURVEY.md §4); here every distributed code path runs in CI on
+a virtual 8-device CPU topology.
+
+Note: this environment's sitecustomize registers the `axon` TPU backend and
+pins ``jax_platforms`` at interpreter boot, so setting JAX_PLATFORMS in the
+environment is not enough — we must override the jax config after import
+(but before any backend initializes, i.e. before singa_tpu or test modules
+touch jax.devices()).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

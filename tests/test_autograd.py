@@ -1,0 +1,182 @@
+"""Autograd ops vs jax.grad goldens and tape-walk semantics (reference test
+strategy: test/python/test_autograd.py & test_operation.py, unverified)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, tensor
+from singa_tpu import device as device_module
+from singa_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+@pytest.fixture(autouse=True)
+def _training():
+    autograd.set_training(True)
+    yield
+    autograd.set_training(False)
+
+
+def _param(arr, dev):
+    t = tensor.from_numpy(arr, dev)
+    t.requires_grad = True
+    t.stores_grad = True
+    return t
+
+
+def test_backward_simple_chain(dev):
+    # loss = sum(relu(x W)) ; check dW against jax.grad
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(4, 3).astype(np.float32)
+    w_np = rng.randn(3, 5).astype(np.float32)
+    x = tensor.from_numpy(x_np, dev)
+    w = _param(w_np, dev)
+
+    y = autograd.matmul(x, w)
+    z = autograd.relu(y)
+    loss = autograd.reduce_sum(z)
+    grads = dict(autograd.backward(loss))
+    assert w in grads
+    ref = jax.grad(lambda W: jnp.sum(jax.nn.relu(x_np @ W)))(w_np)
+    np.testing.assert_allclose(tensor.to_numpy(grads[w]), ref, rtol=1e-5)
+
+
+def test_backward_shared_param_accumulates(dev):
+    # w used twice: grads must accumulate at the Dummy before yielding
+    w_np = np.array([1.0, 2.0], np.float32)
+    w = _param(w_np, dev)
+    a = autograd.mul(w, w)           # w^2
+    b = autograd.add(a, w)           # w^2 + w
+    loss = autograd.reduce_sum(b)
+    grads = dict(autograd.backward(loss))
+    np.testing.assert_allclose(tensor.to_numpy(grads[w]), 2 * w_np + 1)
+
+
+def test_softmax_cross_entropy_grad(dev):
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(6, 4).astype(np.float32)
+    t_np = rng.randint(0, 4, size=(6,))
+    x = _param(x_np, dev)
+    t = tensor.from_numpy(t_np.astype(np.int32), dev)
+    loss = autograd.softmax_cross_entropy(x, t)
+
+    def ref_loss(xv):
+        lp = jax.nn.log_softmax(xv, -1)
+        oh = jax.nn.one_hot(t_np, 4)
+        return -jnp.sum(oh * lp) / xv.shape[0]
+
+    np.testing.assert_allclose(float(loss.data), float(ref_loss(x_np)), rtol=1e-5)
+    grads = dict(autograd.backward(loss))
+    ref = jax.grad(ref_loss)(x_np)
+    np.testing.assert_allclose(tensor.to_numpy(grads[x]), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_matches_softmax_path(dev):
+    rng = np.random.RandomState(2)
+    x_np = rng.randn(3, 5).astype(np.float32)
+    t_np = np.eye(5, dtype=np.float32)[[0, 2, 4]]
+    x = _param(x_np, dev)
+    t = tensor.from_numpy(t_np, dev)
+    p = autograd.softmax(x, axis=1)
+    loss = autograd.cross_entropy(p, t)
+    l2 = autograd.softmax_cross_entropy(_param(x_np, dev), tensor.from_numpy(t_np, dev))
+    np.testing.assert_allclose(float(loss.data), float(l2.data), rtol=1e-5)
+    grads = dict(autograd.backward(loss))
+    ref = jax.grad(
+        lambda xv: -jnp.sum(t_np * jax.nn.log_softmax(xv, -1)) / 3.0
+    )(x_np)
+    np.testing.assert_allclose(tensor.to_numpy(grads[x]), ref, rtol=1e-4, atol=1e-6)
+
+
+def test_mse_and_elementwise_ops(dev):
+    rng = np.random.RandomState(3)
+    a_np = rng.rand(4).astype(np.float32) + 0.5
+    b_np = rng.rand(4).astype(np.float32) + 0.5
+    a, b = _param(a_np, dev), tensor.from_numpy(b_np, dev)
+    loss = autograd.mse_loss(autograd.mul(autograd.exp(a), b), b)
+    grads = dict(autograd.backward(loss))
+    ref = jax.grad(lambda av: jnp.mean((jnp.exp(av) * b_np - b_np) ** 2))(a_np)
+    np.testing.assert_allclose(tensor.to_numpy(grads[a]), ref, rtol=1e-5)
+
+
+def test_reshape_flatten_transpose_grads(dev):
+    x_np = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    x = _param(x_np, dev)
+    y = autograd.reshape(x, (6, 4))
+    z = autograd.transpose(y, (1, 0))
+    f = autograd.flatten(z, axis=1)
+    loss = autograd.reduce_sum(autograd.mul(f, f))
+    grads = dict(autograd.backward(loss))
+    np.testing.assert_allclose(tensor.to_numpy(grads[x]), 2 * x_np, rtol=1e-6)
+
+
+def test_concat_and_multi_output_split(dev):
+    a_np = np.ones((2, 3), np.float32)
+    b_np = 2 * np.ones((2, 3), np.float32)
+    a, b = _param(a_np, dev), _param(b_np, dev)
+    c = autograd.cat([a, b], axis=0)
+    parts = autograd.split(c, axis=0, parts=[1, 3])
+    loss = autograd.reduce_sum(autograd.mul(parts[1], parts[1]))
+    grads = dict(autograd.backward(loss))
+    # row 0 of `a` flows into parts[0] (unused -> zero grad)
+    expect_a = np.vstack([np.zeros((1, 3)), 2 * np.ones((1, 3))]).astype(np.float32)
+    np.testing.assert_allclose(tensor.to_numpy(grads[a]), expect_a)
+    np.testing.assert_allclose(tensor.to_numpy(grads[b]), 2 * b_np)
+
+
+def test_dropout_train_eval(dev):
+    x = tensor.from_numpy(np.ones((1000,), np.float32), dev)
+    y = autograd.dropout(x, 0.4)
+    arr = tensor.to_numpy(y)
+    kept = arr != 0
+    assert 0.45 < kept.mean() < 0.75
+    np.testing.assert_allclose(arr[kept], 1.0 / 0.6, rtol=1e-5)
+    autograd.set_training(False)
+    y2 = autograd.dropout(x, 0.4)
+    np.testing.assert_array_equal(tensor.to_numpy(y2), np.ones(1000))
+
+
+def test_no_tape_when_eval(dev):
+    autograd.set_training(False)
+    x = _param(np.ones((2, 2), np.float32), dev)
+    y = autograd.relu(x)
+    assert y.creator is None
+
+
+def test_backward_generator_yields_incrementally(dev):
+    x = tensor.from_numpy(np.ones((2, 3), np.float32), dev)
+    w1 = _param(np.ones((3, 4), np.float32), dev)
+    w2 = _param(np.ones((4, 2), np.float32), dev)
+    h = autograd.matmul(x, w1)
+    out = autograd.matmul(h, w2)
+    loss = autograd.reduce_sum(out)
+    gen = autograd.backward(loss)
+    first = next(gen)
+    # grads arrive reverse-topologically: w2 first (closest to loss)
+    assert first[0] is w2
+    rest = list(gen)
+    assert rest[0][0] is w1
+
+
+def test_gemm_variants(dev):
+    rng = np.random.RandomState(4)
+    A = rng.randn(3, 4).astype(np.float32)
+    B = rng.randn(5, 4).astype(np.float32)
+    C = rng.randn(3, 5).astype(np.float32)
+    ta, tb, tc = _param(A, dev), _param(B, dev), _param(C, dev)
+    y = autograd.gemm(ta, tb, tc, alpha=2.0, beta=0.5, transB=True)
+    np.testing.assert_allclose(
+        tensor.to_numpy(y), 2 * (A @ B.T) + 0.5 * C, rtol=1e-5)
+    loss = autograd.reduce_sum(y)
+    grads = dict(autograd.backward(loss))
+    assert set(grads) == {ta, tb, tc}
+    np.testing.assert_allclose(
+        tensor.to_numpy(grads[tc]), 0.5 * np.ones_like(C), rtol=1e-6)

@@ -1,0 +1,51 @@
+"""Device/platform tests (reference: test/singa/test_platform.cc, unverified)."""
+
+import numpy as np
+
+from singa_tpu import device as device_module
+from singa_tpu import tensor
+
+
+def test_default_device():
+    dev = device_module.get_default_device()
+    assert dev.lang() == "kCpp"
+    assert device_module.get_default_device() is dev  # singleton
+
+
+def test_create_tpu_device():
+    dev = device_module.create_tpu_device(0)
+    assert dev.lang() == "kTpu"
+    # cached per id (Platform caches devices in the reference too)
+    assert device_module.create_tpu_device(0) is dev
+
+
+def test_cuda_aliases_map_to_accelerator():
+    dev = device_module.create_cuda_gpu()
+    assert dev is device_module.create_tpu_device(0)
+    devs = device_module.create_cuda_gpus_on([0, 1])
+    assert len(devs) == 2
+
+
+def test_tensor_on_tpu_device_roundtrip():
+    dev = device_module.create_tpu_device(0)
+    x = np.arange(8, dtype=np.float32)
+    t = tensor.from_numpy(x, dev)
+    t2 = (t * 2.0) + 1.0
+    np.testing.assert_allclose(tensor.to_numpy(t2), 2 * x + 1)
+    t.to_host()
+    assert t.device.lang() == "kCpp"
+
+
+def test_graph_flag():
+    dev = device_module.create_tpu_device(0)
+    assert not dev.graph_enabled()
+    dev.EnableGraph(True)
+    assert dev.graph_enabled()
+    dev.EnableGraph(False)
+
+
+def test_sync_and_query():
+    dev = device_module.get_default_device()
+    dev.Sync()  # must not raise
+    info = device_module.device_query()
+    assert info["num_devices"] >= 1

@@ -1,0 +1,133 @@
+"""Model API + graph-vs-eager consistency (reference: test/python/test_model.py,
+unverified; the jit≡eager test is SURVEY.md §4's 'implication for TPU build')."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import layer, model, opt, tensor
+from singa_tpu import device as device_module
+from singa_tpu.models.mlp import MLP
+from singa_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def dev():
+    d = device_module.create_tpu_device(0)
+    d.SetRandSeed(0)
+    return d
+
+
+def _data(dev, n=32, d_in=10, classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d_in).astype(np.float32)
+    y = rng.randint(0, classes, size=(n,)).astype(np.int32)
+    return tensor.from_numpy(x, dev), tensor.from_numpy(y, dev)
+
+
+def _make(dev, use_graph, seed=0):
+    dev.SetRandSeed(seed)
+    m = MLP(data_size=10, perceptron_size=16, num_classes=10)
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    x, _ = _data(dev)
+    m.compile([x], is_train=True, use_graph=use_graph, sequential=False)
+    return m
+
+
+def test_mlp_eager_loss_decreases(dev):
+    m = _make(dev, use_graph=False)
+    x, y = _data(dev)
+    losses = []
+    for _ in range(20):
+        _, loss = m(x, y)
+        losses.append(float(loss.data))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_mlp_graph_loss_decreases(dev):
+    m = _make(dev, use_graph=True)
+    x, y = _data(dev)
+    losses = []
+    for _ in range(20):
+        _, loss = m(x, y)
+        losses.append(float(loss.data))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_graph_equals_eager(dev):
+    """use_graph=True must be numerically ≡ use_graph=False."""
+    m1 = _make(dev, use_graph=False, seed=7)
+    m2 = _make(dev, use_graph=True, seed=7)
+    # identical initial params
+    p1 = {k: tensor.to_numpy(v) for k, v in m1.get_params().items()}
+    m2.set_params({k: tensor.from_numpy(v) for k, v in p1.items()})
+    x, y = _data(dev, seed=3)
+    for i in range(6):
+        _, l1 = m1(x, y)
+        _, l2 = m2(x, y)
+        np.testing.assert_allclose(
+            float(l1.data), float(l2.data), rtol=2e-4,
+            err_msg=f"diverged at step {i}")
+    for k in p1:
+        np.testing.assert_allclose(
+            tensor.to_numpy(m1.get_params()[k]),
+            tensor.to_numpy(m2.get_params()[k]), rtol=2e-3, atol=2e-5)
+
+
+def test_graph_recompiles_on_new_batch_size(dev):
+    m = _make(dev, use_graph=True)
+    x, y = _data(dev, n=32)
+    m(x, y)
+    m(x, y)
+    x2, y2 = _data(dev, n=16)
+    _, loss = m(x2, y2)  # different shape key -> new compile, not crash
+    assert np.isfinite(float(loss.data))
+
+
+def test_eval_mode_forward(dev):
+    m = _make(dev, use_graph=False)
+    x, y = _data(dev)
+    m.eval()
+    out = m(x)
+    assert out.shape == (32, 10)
+    m.train()
+
+
+def test_save_load_states_roundtrip(tmp_path, dev):
+    m = _make(dev, use_graph=False)
+    x, y = _data(dev)
+    for _ in range(3):
+        m(x, y)
+    fpath = str(tmp_path / "ckpt.zip")
+    m.save_states(fpath, aux_states={"epoch": np.int64(3)})
+    params_before = {k: tensor.to_numpy(v) for k, v in m.get_params().items()}
+
+    m2 = _make(dev, use_graph=False, seed=99)
+    aux = m2.load_states(fpath)
+    assert int(aux["epoch"]) == 3
+    for k, v in m2.get_params().items():
+        np.testing.assert_array_equal(tensor.to_numpy(v), params_before[k])
+    # optimizer momentum restored too
+    assert float(m2.optimizer.step_counter.data) == float(m.optimizer.step_counter.data)
+    # training continues from the checkpoint without error
+    _, loss = m2(x, y)
+    assert np.isfinite(float(loss.data))
+
+
+def test_param_naming_hierarchical(dev):
+    m = _make(dev, use_graph=False)
+    names = set(m.get_params().keys())
+    assert any("linear1" in n and n.endswith(".W") for n in names), names
+    assert any("linear2" in n and n.endswith(".b") for n in names), names
+
+
+def test_layer_get_set_params(dev):
+    lin = layer.Linear(4)
+    x = tensor.from_numpy(np.ones((2, 3), np.float32), dev)
+    lin(x)
+    params = lin.get_params()
+    assert len(params) == 2
+    newp = {k: tensor.from_numpy(np.zeros_like(tensor.to_numpy(v)))
+            for k, v in params.items()}
+    lin.set_params(newp)
+    y = lin(x)
+    np.testing.assert_array_equal(tensor.to_numpy(y), np.zeros((2, 4), np.float32))

@@ -1,0 +1,111 @@
+"""Optimizer math vs hand-computed goldens (reference: test/python/test_opt.py,
+unverified)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, opt, tensor
+from singa_tpu import device as device_module
+from singa_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def dev():
+    return device_module.get_default_device()
+
+
+def _param(arr, dev, name=None):
+    t = tensor.from_numpy(arr, dev)
+    t.requires_grad = True
+    t.stores_grad = True
+    t.name = name
+    return t
+
+
+def _grad(arr, dev):
+    return tensor.from_numpy(arr, dev)
+
+
+def test_sgd_vanilla(dev):
+    p = _param(np.array([1.0, 2.0], np.float32), dev, "p")
+    g = _grad(np.array([0.5, 0.5], np.float32), dev)
+    sgd = opt.SGD(lr=0.1)
+    sgd.update(p, g)
+    np.testing.assert_allclose(tensor.to_numpy(p), [0.95, 1.95], rtol=1e-6)
+
+
+def test_sgd_momentum(dev):
+    p = _param(np.array([1.0], np.float32), dev, "p")
+    g = _grad(np.array([1.0], np.float32), dev)
+    sgd = opt.SGD(lr=0.1, momentum=0.9)
+    sgd.update(p, g)   # buf=1.0, p=1-0.1
+    np.testing.assert_allclose(tensor.to_numpy(p), [0.9], rtol=1e-6)
+    sgd.update(p, g)   # buf=0.9*1+1=1.9, p=0.9-0.19
+    np.testing.assert_allclose(tensor.to_numpy(p), [0.71], rtol=1e-6)
+
+
+def test_sgd_weight_decay(dev):
+    p = _param(np.array([1.0], np.float32), dev, "p")
+    g = _grad(np.array([0.0], np.float32), dev)
+    sgd = opt.SGD(lr=0.1, weight_decay=0.1)
+    sgd.update(p, g)
+    np.testing.assert_allclose(tensor.to_numpy(p), [0.99], rtol=1e-6)
+
+
+def test_adam_first_step(dev):
+    p = _param(np.array([1.0], np.float32), dev, "p")
+    g = _grad(np.array([0.5], np.float32), dev)
+    adam = opt.Adam(lr=0.001)
+    adam.update(p, g)
+    # bias-corrected first step ≈ lr * sign(g)
+    np.testing.assert_allclose(tensor.to_numpy(p), [1.0 - 0.001], rtol=1e-4)
+
+
+def test_rmsprop_adagrad_run(dev):
+    for O in (opt.RMSProp, opt.AdaGrad):
+        p = _param(np.ones((3,), np.float32), dev, "p")
+        g = _grad(np.full((3,), 0.1, np.float32), dev)
+        o = O(lr=0.01)
+        for _ in range(3):
+            o.update(p, g)
+            o.step()
+        assert np.all(tensor.to_numpy(p) < 1.0)
+
+
+def test_exponential_decay_schedule(dev):
+    sched = opt.ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+    assert abs(float(sched(0)) - 0.1) < 1e-7
+    assert abs(float(sched(10)) - 0.05) < 1e-7
+    stair = opt.ExponentialDecay(0.1, 10, 0.5, staircase=True)
+    assert abs(float(stair(9)) - 0.1) < 1e-7
+
+
+def test_backward_and_update_consumes_generator(dev):
+    autograd.set_training(True)
+    try:
+        x = tensor.from_numpy(np.ones((4, 3), np.float32), dev)
+        w = _param(np.ones((3, 2), np.float32) * 0.5, dev, "w")
+        sgd = opt.SGD(lr=0.1)
+        before = tensor.to_numpy(w).copy()
+        y = autograd.matmul(x, w)
+        loss = autograd.reduce_sum(autograd.mul(y, y))
+        sgd(loss)
+        after = tensor.to_numpy(w)
+        assert not np.allclose(before, after)
+        assert float(sgd.step_counter.data) == 1.0
+    finally:
+        autograd.set_training(False)
+
+
+def test_optimizer_state_roundtrip(dev):
+    p = _param(np.ones((2,), np.float32), dev, "p")
+    g = _grad(np.ones((2,), np.float32), dev)
+    sgd = opt.SGD(lr=0.1, momentum=0.9)
+    sgd.update(p, g)
+    sgd.step()
+    states = sgd.get_states()
+    sgd2 = opt.SGD(lr=0.1, momentum=0.9)
+    sgd2.set_states(states)
+    assert float(sgd2.step_counter.data) == 1.0
+    k = [k for k in states if k.endswith(":momentum")][0]
+    np.testing.assert_allclose(states[k], [1.0, 1.0])

@@ -1,0 +1,170 @@
+"""Tensor semantics vs numpy goldens (reference test strategy: SURVEY.md §4,
+test/python/test_tensor.py, unverified)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu import device as device_module
+from singa_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def dev():
+    return device_module.get_default_device()
+
+
+def test_create_zeros(dev):
+    t = Tensor((3, 4), device=dev)
+    assert t.shape == (3, 4)
+    assert t.size() == 12
+    assert t.ndim() == 2
+    np.testing.assert_array_equal(tensor.to_numpy(t), np.zeros((3, 4), np.float32))
+
+
+def test_from_to_numpy_roundtrip(dev):
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = tensor.from_numpy(x, dev)
+    np.testing.assert_array_equal(tensor.to_numpy(t), x)
+
+
+def test_float64_input_downcast(dev):
+    x = np.ones((2, 2), dtype=np.float64)
+    t = tensor.from_numpy(x, dev)
+    assert np.dtype(t.dtype) == np.float32
+
+
+def test_operators(dev):
+    a = tensor.from_numpy(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32), dev)
+    b = tensor.from_numpy(np.array([[5.0, 6.0], [7.0, 8.0]], np.float32), dev)
+    np.testing.assert_allclose(tensor.to_numpy(a + b), [[6, 8], [10, 12]])
+    np.testing.assert_allclose(tensor.to_numpy(a - b), [[-4, -4], [-4, -4]])
+    np.testing.assert_allclose(tensor.to_numpy(a * b), [[5, 12], [21, 32]])
+    np.testing.assert_allclose(tensor.to_numpy(b / a), [[5, 3], [7 / 3, 2]], rtol=1e-6)
+    np.testing.assert_allclose(tensor.to_numpy(a + 1.0), [[2, 3], [4, 5]])
+    np.testing.assert_allclose(tensor.to_numpy(2.0 * a), [[2, 4], [6, 8]])
+    np.testing.assert_allclose(tensor.to_numpy(-a), [[-1, -2], [-3, -4]])
+
+
+def test_inplace_rebinding(dev):
+    a = tensor.from_numpy(np.ones((2, 2), np.float32), dev)
+    a += 2.0
+    np.testing.assert_allclose(tensor.to_numpy(a), 3 * np.ones((2, 2)))
+    a *= 2.0
+    np.testing.assert_allclose(tensor.to_numpy(a), 6 * np.ones((2, 2)))
+
+
+def test_comparison_returns_float_mask(dev):
+    a = tensor.from_numpy(np.array([1.0, 5.0, 3.0], np.float32), dev)
+    m = a > 2.0
+    assert m.data.dtype == np.float32
+    np.testing.assert_array_equal(tensor.to_numpy(m), [0.0, 1.0, 1.0])
+
+
+def test_matmul_and_mult(dev):
+    a = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    b = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+    ta, tb = tensor.from_numpy(a, dev), tensor.from_numpy(b, dev)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.mult(ta, tb)), a @ b, rtol=1e-5)
+    c = Tensor((4, 3), device=dev)
+    c.set_value(1.0)
+    out = tensor.mult(ta, tb, C=c, alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(tensor.to_numpy(out), 2 * (a @ b) + 0.5, rtol=1e-5)
+
+
+def test_unary_and_reductions(dev):
+    x = np.random.RandomState(2).rand(3, 4).astype(np.float32) + 0.1
+    t = tensor.from_numpy(x, dev)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.exp(t)), np.exp(x), rtol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.log(t)), np.log(x), rtol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.sqrt(t)), np.sqrt(x), rtol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.tanh(t)), np.tanh(x), rtol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.sum(t)), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.sum(t, axis=0)), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(tensor.mean(t, axis=1)), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        tensor.to_numpy(tensor.softmax(t)),
+        np.exp(x) / np.exp(x).sum(-1, keepdims=True),
+        rtol=1e-5,
+    )
+
+
+def test_axpy(dev):
+    x = tensor.from_numpy(np.ones((3,), np.float32), dev)
+    y = tensor.from_numpy(np.full((3,), 2.0, np.float32), dev)
+    tensor.axpy(0.5, x, y)
+    np.testing.assert_allclose(tensor.to_numpy(y), [2.5, 2.5, 2.5])
+
+
+def test_row_column_ops(dev):
+    M = tensor.from_numpy(np.ones((2, 3), np.float32), dev)
+    v = tensor.from_numpy(np.array([1.0, 2.0], np.float32), dev)
+    tensor.add_column(v, M)
+    np.testing.assert_allclose(tensor.to_numpy(M), [[2, 2, 2], [3, 3, 3]])
+    w = tensor.from_numpy(np.array([1.0, 2.0, 3.0], np.float32), dev)
+    tensor.mult_row(w, M)
+    np.testing.assert_allclose(tensor.to_numpy(M), [[2, 4, 6], [3, 6, 9]])
+    np.testing.assert_allclose(tensor.to_numpy(tensor.sum_rows(M)), [5, 10, 15])
+    np.testing.assert_allclose(tensor.to_numpy(tensor.sum_columns(M)), [12, 18])
+
+
+def test_reshape_transpose(dev):
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t = tensor.from_numpy(x, dev)
+    np.testing.assert_array_equal(tensor.to_numpy(t.reshape((3, 2))), x.reshape(3, 2))
+    np.testing.assert_array_equal(tensor.to_numpy(t.T), x.T)
+    np.testing.assert_array_equal(tensor.to_numpy(tensor.transpose(t)), x.T)
+
+
+def test_random_fills(dev):
+    t = Tensor((1000,), device=dev)
+    t.gaussian(1.0, 2.0)
+    arr = tensor.to_numpy(t)
+    assert np.abs(arr.mean() - 1.0) < 0.3
+    assert np.abs(arr.std() - 2.0) < 0.3
+    t.uniform(-1.0, 1.0)
+    arr = tensor.to_numpy(t)
+    assert arr.min() >= -1.0 and arr.max() <= 1.0
+    t.bernoulli(0.3)
+    arr = tensor.to_numpy(t)
+    assert set(np.unique(arr)).issubset({0.0, 1.0})
+    assert np.abs(arr.mean() - 0.3) < 0.1
+
+
+def test_rng_reproducible(dev):
+    dev.SetRandSeed(42)
+    a = Tensor((16,), device=dev).gaussian(0, 1)
+    dev.SetRandSeed(42)
+    b = Tensor((16,), device=dev).gaussian(0, 1)
+    np.testing.assert_array_equal(tensor.to_numpy(a), tensor.to_numpy(b))
+
+
+def test_copy_semantics(dev):
+    a = tensor.from_numpy(np.ones((2, 2), np.float32), dev)
+    b = a.clone()
+    a += 1.0
+    np.testing.assert_allclose(tensor.to_numpy(b), np.ones((2, 2)))  # clone detached
+    c = Tensor((2, 2), device=dev)
+    c.copy_data(a)
+    np.testing.assert_allclose(tensor.to_numpy(c), 2 * np.ones((2, 2)))
+
+
+def test_set_value_and_norms(dev):
+    t = Tensor((4,), device=dev)
+    t.SetValue(3.0)
+    np.testing.assert_allclose(tensor.to_numpy(t), [3, 3, 3, 3])
+    assert abs(t.l1() - 3.0) < 1e-6
+    assert abs(t.l2() - 3.0) < 1e-6
+
+
+def test_concat_stack(dev):
+    a = tensor.from_numpy(np.ones((2, 2), np.float32), dev)
+    b = tensor.from_numpy(np.zeros((2, 2), np.float32), dev)
+    assert tensor.concatenate([a, b], axis=0).shape == (4, 2)
+    assert tensor.stack([a, b], axis=0).shape == (2, 2, 2)
+
+
+def test_astype(dev):
+    t = tensor.from_numpy(np.array([1.5, 2.5], np.float32), dev)
+    ti = t.as_type(tensor.int32)
+    assert np.dtype(ti.dtype) == np.int32
