@@ -1,0 +1,32 @@
+"""Dataset providers for the CNN examples (reference:
+examples/cnn/data/{mnist,cifar10,cifar100}.py, unverified — those download
+real datasets; this container has no network, so data is synthesized with
+the real datasets' shapes/statistics, which is what the reference's own
+benchmark.py does for throughput runs)."""
+
+import numpy as np
+
+_SPECS = {
+    "mnist": dict(channels=1, size=28, classes=10),
+    "cifar10": dict(channels=3, size=32, classes=10),
+    "cifar100": dict(channels=3, size=32, classes=100),
+    "imagenet": dict(channels=3, size=224, classes=1000),
+}
+
+
+def load(name, n_train=512, n_val=128, seed=0):
+    spec = _SPECS[name]
+    rng = np.random.RandomState(seed)
+    c, s, k = spec["channels"], spec["size"], spec["classes"]
+
+    def gen(n):
+        # class-dependent mean shift so models can actually learn
+        y = rng.randint(0, k, (n,)).astype(np.int32)
+        x = rng.randn(n, c, s, s).astype(np.float32) * 0.5
+        shift = (y.astype(np.float32) / k - 0.5)[:, None, None, None]
+        x += shift
+        return x, y
+
+    x_tr, y_tr = gen(n_train)
+    x_va, y_va = gen(n_val)
+    return (x_tr, y_tr), (x_va, y_va), spec

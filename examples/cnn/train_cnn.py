@@ -1,0 +1,98 @@
+"""CNN training entry (reference: examples/cnn/train_cnn.py, unverified):
+
+    python examples/cnn/train_cnn.py cnn mnist --use-graph
+    python examples/cnn/train_cnn.py resnet18 cifar10 --epochs 2
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+sys.path.insert(0, __file__.rsplit("/train_cnn.py", 1)[0])
+
+from singa_tpu import device, opt, tensor  # noqa: E402
+import data as data_mod  # noqa: E402
+
+
+def create_model(name, num_classes, num_channels):
+    if name == "cnn":
+        from singa_tpu.models.cnn import CNN
+        return CNN(num_classes=num_classes, num_channels=num_channels)
+    if name == "alexnet":
+        from singa_tpu.models.alexnet import AlexNet
+        return AlexNet(num_classes=num_classes, num_channels=num_channels)
+    if name == "xceptionnet":
+        from singa_tpu.models.xceptionnet import Xception
+        return Xception(num_classes=num_classes, num_channels=num_channels)
+    if name.startswith("resnet"):
+        from singa_tpu.models import resnet
+        return resnet.create_model(name, num_classes=num_classes)
+    raise ValueError(f"unknown model {name}")
+
+
+def run(args):
+    dev = device.create_tpu_device(0) if args.device == "tpu" else \
+        device.get_default_device()
+    dev.SetRandSeed(args.seed)
+
+    (x_tr, y_tr), (x_va, y_va), spec = data_mod.load(
+        args.data, n_train=args.n_train, n_val=args.n_val, seed=args.seed)
+    batch = args.batch_size
+    n_train = (len(x_tr) // batch) * batch
+    if n_train == 0:
+        raise SystemExit(f"batch size {batch} exceeds dataset size {len(x_tr)}")
+
+    m = create_model(args.model, spec["classes"], spec["channels"])
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
+    m.set_optimizer(sgd)
+    tx = tensor.Tensor((batch, spec["channels"], spec["size"], spec["size"]), dev)
+    m.compile([tx], is_train=True, use_graph=args.use_graph, sequential=False)
+
+    for epoch in range(args.epochs):
+        m.train()
+        t0 = time.time()
+        tot_loss, correct, seen = 0.0, 0, 0
+        for i in range(0, n_train, batch):
+            xb = tensor.from_numpy(x_tr[i:i + batch], dev)
+            yb = tensor.from_numpy(y_tr[i:i + batch], dev)
+            out, loss = m(xb, yb)
+            tot_loss += float(loss.data)
+            correct += int((tensor.to_numpy(out).argmax(-1) == y_tr[i:i + batch]).sum())
+            seen += batch
+        dt = time.time() - t0
+        print(f"epoch {epoch}: loss={tot_loss / (seen // batch):.4f} "
+              f"acc={correct / seen:.4f} time={dt:.2f}s "
+              f"({seen / dt:.1f} samples/s)")
+
+    m.eval()
+    correct = 0
+    for i in range(0, len(x_va) - batch + 1, batch):
+        xb = tensor.from_numpy(x_va[i:i + batch], dev)
+        out = m(xb)
+        correct += int((tensor.to_numpy(out).argmax(-1) == y_va[i:i + batch]).sum())
+    n_eval = (len(x_va) // batch) * batch
+    if n_eval:
+        print(f"eval accuracy: {correct / n_eval:.4f}")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("model", nargs="?", default="cnn",
+                   choices=["cnn", "alexnet", "resnet18", "resnet34",
+                            "resnet50", "resnet101", "resnet152",
+                            "xceptionnet"])
+    p.add_argument("data", nargs="?", default="mnist",
+                   choices=["mnist", "cifar10", "cifar100", "imagenet"])
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.005)
+    p.add_argument("--use-graph", action="store_true", default=False)
+    p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--n-train", type=int, default=512)
+    p.add_argument("--n-val", type=int, default=128)
+    args = p.parse_args()
+    run(args)

@@ -1,0 +1,107 @@
+"""Distributed data-parallel CNN training (reference:
+examples/cnn/train_mpi.py + train_multiprocess.py, unverified — the
+DistOpt/NCCL entry points; config #5 workload).
+
+In the TPU-native stack there is no mpiexec: a single controller drives
+every chip in the mesh (multi-host via --coordinator, the
+jax.distributed control plane).  All five reference sync modes:
+
+    python examples/cnn/train_dist.py resnet18 cifar10 --dist-option plain
+    python examples/cnn/train_dist.py cnn mnist --dist-option sparseTopK --spars 0.05
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+sys.path.insert(0, __file__.rsplit("/train_dist.py", 1)[0])
+
+
+def run(args):
+    if args.force_cpu_devices:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.parallel.dist_opt import DistOpt
+    import data as data_mod
+    from train_cnn import create_model
+
+    if args.coordinator:
+        from singa_tpu.parallel.communicator import initialize_distributed
+
+        initialize_distributed(args.coordinator, args.num_processes,
+                               args.process_id)
+
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(args.seed)
+
+    (x_tr, y_tr), _, spec = data_mod.load(args.data, n_train=args.n_train,
+                                          seed=args.seed)
+    batch = args.batch_size
+
+    m = create_model(args.model, spec["classes"], spec["channels"])
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
+    dist_opt = DistOpt(sgd, num_devices=args.num_devices)
+    m.set_optimizer(dist_opt)
+    print(f"world size: {dist_opt.world_size} "
+          f"(devices: {len(jax.devices())}, dist_option={args.dist_option})")
+    if batch % dist_opt.world_size:
+        raise SystemExit(f"batch {batch} % world {dist_opt.world_size} != 0")
+
+    tx = tensor.Tensor((batch, spec["channels"], spec["size"], spec["size"]),
+                       dev)
+    m.compile([tx], is_train=True, use_graph=True, sequential=False)
+
+    n_train = (len(x_tr) // batch) * batch
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        tot, seen, correct = 0.0, 0, 0
+        for i in range(0, n_train, batch):
+            xb = tensor.from_numpy(x_tr[i:i + batch], dev)
+            yb = tensor.from_numpy(y_tr[i:i + batch], dev)
+            out, loss = m(xb, yb, dist_option=args.dist_option,
+                          spars=args.spars)
+            tot += float(loss.data)
+            correct += int((tensor.to_numpy(out).argmax(-1) == y_tr[i:i + batch]).sum())
+            seen += batch
+        dt = time.time() - t0
+        print(f"epoch {epoch}: loss={tot / (seen // batch):.4f} "
+              f"acc={correct / seen:.4f} time={dt:.2f}s "
+              f"({seen / dt:.1f} samples/s global, "
+              f"{seen / dt / dist_opt.world_size:.1f}/chip)")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("model", nargs="?", default="cnn")
+    p.add_argument("data", nargs="?", default="mnist")
+    p.add_argument("--dist-option", default="plain",
+                   choices=["plain", "fp16", "partialUpdate", "sparseTopK",
+                            "sparseThreshold"])
+    p.add_argument("--spars", type=float, default=0.05)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.005)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--n-train", type=int, default=512)
+    p.add_argument("--num-devices", type=int, default=None)
+    p.add_argument("--force-cpu-devices", type=int, default=0,
+                   help="simulate an N-device mesh on CPU (no TPU pod here)")
+    # multi-host control plane (jax.distributed; untestable single-host)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    args = p.parse_args()
+    run(args)

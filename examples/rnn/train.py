@@ -1,0 +1,105 @@
+"""char-RNN training (reference: examples/rnn char-rnn LSTM over a text
+corpus, unverified — config #3).  No network here, so the default corpus
+is this repository's own documentation.
+
+    python examples/rnn/train.py [--use-graph] [--corpus FILE]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from singa_tpu import device, opt, tensor  # noqa: E402
+from singa_tpu.models.char_rnn import CharRNN, one_hot  # noqa: E402
+
+
+class Corpus:
+    def __init__(self, path, seq_length):
+        with open(path, "r", encoding="utf-8", errors="ignore") as f:
+            self.raw = f.read()
+        chars = sorted(set(self.raw))
+        self.char2idx = {c: i for i, c in enumerate(chars)}
+        self.idx2char = chars
+        self.vocab_size = len(chars)
+        self.data = np.array([self.char2idx[c] for c in self.raw], np.int32)
+        self.seq_length = seq_length
+
+    def batches(self, batch_size, rng):
+        n = len(self.data) - self.seq_length - 1
+        starts = rng.randint(0, n, (batch_size,))
+        x = np.stack([self.data[s:s + self.seq_length] for s in starts])
+        y = np.stack([self.data[s + 1:s + self.seq_length + 1] for s in starts])
+        return x, y
+
+
+def sample(m, corpus, dev, length=120, seed_text="the "):
+    """Greedy sampling.  Context is padded to a fixed seq_length so every
+    eval forward reuses one compiled shape."""
+    m.eval()
+    T = corpus.seq_length
+    idx = [corpus.char2idx.get(c, 0) for c in seed_text]
+    for _ in range(length):
+        ctx = idx[-T:]
+        n = len(ctx)
+        padded = np.zeros((1, T), np.int64)
+        padded[0, :n] = ctx
+        x = tensor.from_numpy(one_hot(padded, corpus.vocab_size), dev)
+        logits = tensor.to_numpy(m(x))  # (T, vocab)
+        nxt = int(logits[n - 1].argmax())
+        idx.append(nxt)
+    m.train()
+    return "".join(corpus.idx2char[i] for i in idx)
+
+
+def run(args):
+    dev = device.create_tpu_device(0) if args.device == "tpu" else \
+        device.get_default_device()
+    dev.SetRandSeed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    corpus = Corpus(args.corpus, args.seq_length)
+    print(f"corpus: {len(corpus.raw)} chars, vocab {corpus.vocab_size}")
+
+    m = CharRNN(corpus.vocab_size, hidden_size=args.hidden_size,
+                num_layers=args.num_layers, seq_length=args.seq_length)
+    m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+    x0 = tensor.Tensor((args.batch_size, args.seq_length, corpus.vocab_size),
+                       dev)
+    m.compile([x0], is_train=True, use_graph=args.use_graph)
+
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        tot = 0.0
+        for _ in range(args.iters):
+            xb, yb = corpus.batches(args.batch_size, rng)
+            x = tensor.from_numpy(one_hot(xb, corpus.vocab_size), dev)
+            y = tensor.from_numpy(yb, dev)
+            _, loss = m(x, y)
+            tot += float(loss.data)
+        dt = time.time() - t0
+        cps = args.iters * args.batch_size * args.seq_length / dt
+        print(f"epoch {epoch}: loss={tot / args.iters:.4f} "
+              f"time={dt:.2f}s ({cps:.0f} chars/s)")
+    print("sample:", repr(sample(m, corpus, dev)[:100]))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    default_corpus = __file__.rsplit("/examples", 1)[0] + "/SURVEY.md"
+    p.add_argument("--corpus", default=default_corpus)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-length", type=int, default=64)
+    p.add_argument("--hidden-size", type=int, default=128)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--use-graph", action="store_true", default=False)
+    p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    run(args)

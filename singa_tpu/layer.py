@@ -369,6 +369,7 @@ class Pooling2d(Layer):
         return pool_ops.pooling2d(
             x, kernel=self.kernel_size, stride=self.stride,
             padding=self.padding, is_max=self.is_max,
+            pad_mode=self.pad_mode,
         )
 
 

@@ -30,6 +30,8 @@ import io as _io
 
 import numpy as np
 import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from . import autograd, layer, tensor
 from .tensor import Tensor
@@ -61,6 +63,9 @@ class Model(layer.Layer):
         self.sequential = False
         self._graph_runner = None
         self.dist = False
+        # distributed output reassembly: "auto" (scalars -> cross-replica
+        # mean, others -> merge per-rank batch) or "stack" (raw (W, ...))
+        self.dist_outputs = "auto"
 
     # -- reference API -----------------------------------------------------
     def compile(self, inputs, is_train=True, use_graph=False, sequential=False):
@@ -234,11 +239,37 @@ class _GraphRunner:
         tensors = [state[n] for n in names]
         dev = model.device
 
-        state_arrays = [jax.device_put(t.data, dev.jax_device) for t in tensors]
-        state_arrays.append(jax.device_put(dev._rng_key, dev.jax_device))
         in_arrays = [a.data for a in args if isinstance(a, Tensor)]
         in_arrays += [v.data for k, v in sorted(kwargs.items())
                       if isinstance(v, Tensor)]
+        if model.dist:
+            # replicate state over the mesh, shard batch inputs on dim 0
+            from jax.sharding import NamedSharding
+
+            comm = model._optimizer.communicator
+            mesh, axis = comm.mesh, comm.axis_name
+            for a in in_arrays:
+                if a.ndim >= 1 and a.shape[0] % comm.world_size != 0:
+                    raise ValueError(
+                        f"global batch dim {a.shape[0]} not divisible by "
+                        f"world size {comm.world_size}")
+            rep = NamedSharding(mesh, P())
+            ranked = NamedSharding(mesh, P(axis))
+            state_arrays = [
+                jax.device_put(t.data,
+                               ranked if "__residual__" in n else rep)
+                for n, t in zip(names, tensors)
+            ]
+            state_arrays.append(jax.device_put(dev._rng_key, rep))
+            in_arrays = [
+                jax.device_put(
+                    a, NamedSharding(mesh, P(axis) if a.ndim >= 1 else P()))
+                for a in in_arrays
+            ]
+        else:
+            state_arrays = [jax.device_put(t.data, dev.jax_device)
+                            for t in tensors]
+            state_arrays.append(jax.device_put(dev._rng_key, dev.jax_device))
 
         if key not in self._compiled or self._compiled[key][1] != names:
             fn = self._build(args, kwargs, names)
@@ -256,6 +287,21 @@ class _GraphRunner:
             t.data = a
             t.creator = None
         dev._rng_key = new_state[-1]
+        if model.dist and model.dist_outputs != "stack":
+            # Outputs come back stacked per-rank (see _build).  The "auto"
+            # reassembly contract: per-rank scalars, now (W,), become the
+            # cross-replica mean (the global loss); everything else is
+            # treated as batch-leading and the first two dims merge,
+            # (W, B/W, ...) -> (B, ...).  Outputs that are neither (e.g.
+            # RNN hidden states shaped (L, B/W, H)) reassemble wrongly
+            # under this rule — set model.dist_outputs = "stack" to
+            # receive the raw (W, ...) per-rank stack instead.
+            def unstack(a):
+                if a.ndim == 1:
+                    return jnp.mean(a)
+                return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+
+            out_tree = jax.tree.map(unstack, out_tree)
         return jax.tree.map(
             lambda a: tensor._wrap(a, dev),
             out_tree,
@@ -298,4 +344,53 @@ class _GraphRunner:
                     t.creator = None
                 dev._rng_key = saved_key
 
-        return jax.jit(step, donate_argnums=(0,))
+        if not model.dist:
+            return jax.jit(step, donate_argnums=(0,))
+
+        # DistOpt: run the step per-rank under shard_map — SINGA's SPMD
+        # programming model recovered inside a single-controller runtime.
+        # Replicated state (params, optimizer moments) uses P(); per-rank
+        # accumulators (DistOpt residuals, global shape (W, ...)) are
+        # sharded P(axis) so each rank keeps a private slice; layer state
+        # that legitimately diverges per rank (BN running stats computed
+        # on the local shard) is pmean'd — tiny arrays, and strictly
+        # better-defined than the reference's "rank 0's copy wins".
+        comm = model._optimizer.communicator
+        mesh, axis = comm.mesh, comm.axis_name
+        state_specs = [
+            P(axis) if "__residual__" in n else P() for n in names
+        ] + [P()]  # trailing entry: PRNG base key
+        layer_state_names = set(model.get_states()) - set(model.get_params())
+        pmean_idx = [i for i, n in enumerate(names)
+                     if n in layer_state_names]
+
+        def rank_step(state_arrays, in_arrays):
+            # advance the PRNG base once (replicated), give each rank an
+            # independent subkey so dropout masks differ across ranks
+            base = state_arrays[-1]
+            new_base, sub = jax.random.split(base)
+            rank_key = jax.random.fold_in(sub, jax.lax.axis_index(axis))
+            new_state, out_tree = step(
+                list(state_arrays[:-1]) + [rank_key], in_arrays)
+            new_state = list(new_state[:-1]) + [new_base]
+            for i in pmean_idx:
+                new_state[i] = jax.lax.pmean(new_state[i], axis)
+            # stack every output with a leading per-rank axis so one
+            # out_spec covers arbitrary train_one_batch return trees
+            out_stacked = jax.tree.map(lambda a: jnp.expand_dims(a, 0),
+                                       out_tree)
+            return new_state, out_stacked
+
+        in_tensors = [x for x in args if isinstance(x, Tensor)] \
+            + [kwargs[k] for k in tensor_kw]
+        in_tensor_specs = [
+            P(axis) if t.data.ndim >= 1 else P() for t in in_tensors
+        ]
+        sharded = jax.shard_map(
+            rank_step,
+            mesh=mesh,
+            in_specs=(state_specs, in_tensor_specs),
+            out_specs=(state_specs, P(axis)),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))

@@ -1,0 +1,46 @@
+"""char-RNN language model (reference: examples/rnn/ char-rnn LSTM,
+unverified — config #3 workload in BASELINE.json): one-hot chars →
+multi-layer LSTM → per-timestep linear over the vocab."""
+
+import numpy as np
+
+from .. import autograd, layer, model, tensor
+
+
+class CharRNN(model.Model):
+    def __init__(self, vocab_size, hidden_size=256, num_layers=2,
+                 seq_length=100):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.seq_length = seq_length
+        self.lstm = layer.LSTM(hidden_size, num_layers=num_layers,
+                               batch_first=True)
+        self.dense = layer.Linear(vocab_size)
+        self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x, hx=None, cx=None):
+        """x: (B, T, vocab) one-hot. Returns (B*T, vocab) logits."""
+        y, _ = self.lstm(x, hx, cx)
+        y = autograd.reshape(y, (-1, self.hidden_size))
+        return self.dense(y)
+
+    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+        from .common import apply_dist_option
+
+        out = self.forward(x)
+        loss = self.softmax_cross_entropy(out, autograd.reshape(y, (-1,)))
+        apply_dist_option(self.optimizer, loss, dist_option, spars)
+        return out, loss
+
+
+def one_hot(idx_batch, vocab_size):
+    """(B, T) int -> (B, T, V) float32 one-hot."""
+    b, t = idx_batch.shape
+    out = np.zeros((b, t, vocab_size), np.float32)
+    out[np.arange(b)[:, None], np.arange(t)[None, :], idx_batch] = 1.0
+    return out
+
+
+def create_model(**kw):
+    return CharRNN(**kw)

@@ -1,0 +1,53 @@
+"""Batch normalization (reference: src/model/operation/batchnorm.{h,cc},
+unverified — cuDNN spatial BN fwd/bwd with saved mean/inv-var and running
+stats).
+
+TPU-native: the normalization is one pure jnp function whose VJP (via
+jax.vjp) covers the full dependence on batch statistics — no hand-written
+cuDNN-mirror backward.  Running stats live on the BatchNorm2d layer as
+state Tensors; their update is a functional rebind with stop_gradient'd
+batch stats, which graph mode threads through the compiled step like any
+other persistent state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..autograd import _op
+
+
+def batchnorm2d(x, scale, bias, running_mean, running_var,
+                momentum=0.9, eps=1e-5):
+    """NCHW spatial BN.  Training: normalize by batch stats and update
+    running stats (running = momentum*running + (1-momentum)*batch, the
+    reference's convention).  Eval: normalize by running stats."""
+    if autograd.training:
+        axes = (0, 2, 3)
+        bm = jnp.mean(x.data, axes)
+        bv = jnp.var(x.data, axes)
+        running_mean.data = (momentum * running_mean.data
+                             + (1.0 - momentum) * jax.lax.stop_gradient(bm))
+        running_var.data = (momentum * running_var.data
+                            + (1.0 - momentum) * jax.lax.stop_gradient(bv))
+
+        def f(xv, sv, bv_, eps=eps):
+            m = jnp.mean(xv, (0, 2, 3), keepdims=True)
+            v = jnp.var(xv, (0, 2, 3), keepdims=True)
+            inv = jax.lax.rsqrt(v + eps)
+            return (xv - m) * inv * sv[None, :, None, None] \
+                + bv_[None, :, None, None]
+
+        return _op(f, x, scale, bias, _name="BatchNorm2d")
+
+    rm = running_mean.data
+    rv = running_var.data
+
+    def f(xv, sv, bv_, rm=rm, rv=rv, eps=eps):
+        inv = jax.lax.rsqrt(rv + eps)[None, :, None, None]
+        return (xv - rm[None, :, None, None]) * inv * sv[None, :, None, None] \
+            + bv_[None, :, None, None]
+
+    return _op(f, x, scale, bias, _name="BatchNorm2dEval")

@@ -1,0 +1,58 @@
+"""Convolution (reference: src/model/operation/convolution.{h,cc},
+unverified — ``ConvHandle``/``CudnnConvHandle`` + ``GpuConvForward`` /
+``GpuConvBackwardx/W`` cuDNN calls, CPU im2col+GEMM fallback).
+
+TPU-native: one ``lax.conv_general_dilated`` in NCHW/OIHW layout; XLA
+lowers it onto the MXU and autodiff provides the backward-data /
+backward-filter convs the reference hand-wires to cuDNN.  The handle
+structs disappear — algorithm selection and workspace management are
+XLA's job.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd import _op
+
+
+def _resolve_padding(pad_mode, padding, kernel, dilation):
+    if pad_mode in ("SAME_UPPER", "SAME_LOWER", "SAME"):
+        pads = []
+        for k, d in zip(kernel, dilation):
+            eff = d * (k - 1)
+            lo = eff // 2
+            hi = eff - lo
+            if pad_mode == "SAME_LOWER":
+                lo, hi = hi, lo
+            pads.append((lo, hi))
+        return tuple(pads)
+    if pad_mode == "VALID":
+        return ((0, 0), (0, 0))
+    return tuple((p, p) for p in padding)
+
+
+def conv2d(x, W, b=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
+           group=1, pad_mode="NOTSET"):
+    """NCHW conv; W is OIHW (O = out channels, I = in/group)."""
+    kernel = W.shape[2:]
+    pads = _resolve_padding(pad_mode, padding, kernel, dilation)
+
+    def f(xv, wv, *rest, stride=tuple(stride), pads=pads,
+          dilation=tuple(dilation), group=int(group)):
+        y = lax.conv_general_dilated(
+            xv, wv,
+            window_strides=stride,
+            padding=pads,
+            rhs_dilation=dilation,
+            feature_group_count=group,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if rest:
+            y = y + rest[0][None, :, None, None]
+        return y
+
+    if b is None:
+        return _op(f, x, W, _name="Conv2d")
+    return _op(f, x, W, b, _name="Conv2d")

@@ -1,0 +1,48 @@
+"""Pooling (reference: src/model/operation/pooling.{h,cc}, unverified —
+``PoolingHandle`` max/avg cuDNN fwd/bwd).
+
+TPU-native: ``lax.reduce_window``; autodiff of the max window reduce is
+XLA's select-and-scatter, replacing cuDNN's pooling-backward kernel.
+Average pooling divides by the full window size (count-include-pad,
+matching cuDNN's default mode used by the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd import _op
+
+
+def pooling2d(x, kernel, stride, padding=(0, 0), is_max=True,
+              pad_mode="NOTSET"):
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    if pad_mode in ("SAME", "SAME_UPPER", "SAME_LOWER"):
+        spatial = []
+        for k in kernel:
+            lo = (k - 1) // 2
+            hi = (k - 1) - lo
+            if pad_mode == "SAME_LOWER":
+                lo, hi = hi, lo
+            spatial.append((lo, hi))
+        pads = ((0, 0), (0, 0)) + tuple(spatial)
+    else:
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+
+    if is_max:
+        def f(xv):
+            return lax.reduce_window(
+                xv, -jnp.inf, lax.max, window, strides, pads)
+
+        return _op(f, x, _name="MaxPool2d")
+
+    wsize = float(np.prod(kernel))
+
+    def f(xv):
+        s = lax.reduce_window(xv, 0.0, lax.add, window, strides, pads)
+        return s / wsize
+
+    return _op(f, x, _name="AvgPool2d")

@@ -1,0 +1,193 @@
+"""ICI/DCN communicator — the rebuild of the reference's NCCL+MPI backend
+(src/io/communicator.cc + include/singa/io/communicator.h, unverified —
+SURVEY.md §2.1/§5.8): ``Communicator`` with ``synch`` (all-reduce),
+``fusedSynch`` (bucketed), ``synchHalf`` (fp16-compressed), and top-K
+``sparsification`` with residual accumulation, NCCL-id bootstrap via MPI.
+
+TPU-native design:
+  * control plane: ``jax.distributed.initialize`` (single controller per
+    host over DCN) replaces MPI rank discovery / NCCL-id broadcast;
+  * data plane: XLA collectives over ICI — ``lax.psum`` / ``all_gather``
+    inside a ``shard_map`` over ``Mesh(devices, ('data',))`` replace
+    ncclAllReduce on the dedicated comm stream.  Stream/event ordering
+    (``Communicator::wait``) disappears: XLA's scheduler interleaves
+    collectives with compute (latency hiding), which is what the
+    reference's comm-stream + generator-overlap machinery hand-builds.
+
+Collective calls are only legal while tracing inside the mesh context
+(graph-mode training step); eager calls raise with guidance, since in a
+single-controller runtime per-rank eager execution does not exist.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["Communicator", "get_mesh", "initialize_distributed", "is_tracing"]
+
+_DEFAULT_AXIS = "data"
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, **kw):
+    """Multi-host bootstrap (reference: MPI init + NCCL-id broadcast)."""
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id, **kw)
+
+
+def get_mesh(num_devices=None, axis_name=_DEFAULT_AXIS, devices=None):
+    """1-D data-parallel mesh over all (or the first N) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def is_tracing(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+# Eager (outside shard_map) semantics: in a single-controller runtime the
+# eager path sees the FULL global batch on one device, so the correct
+# "collective" is the world-1 identity — an eager DistOpt step is exact
+# single-device training, and the parallelism only exists inside the
+# compiled (use_graph=True) step.  This also lets graph mode's first
+# warm-up iteration (which runs eagerly, like the reference's
+# build-while-run first graph iteration) execute DistOpt code unchanged.
+
+
+class Communicator:
+    """API-parity communicator; every method matching the reference's
+    operates on raw jax arrays *inside* the shard_map'd step."""
+
+    def __init__(self, mesh=None, axis_name=_DEFAULT_AXIS, num_devices=None):
+        self.axis_name = axis_name
+        self.mesh = mesh if mesh is not None else get_mesh(num_devices,
+                                                           axis_name)
+        self.world_size = int(np.prod([self.mesh.shape[a]
+                                       for a in self.mesh.axis_names]))
+        # single-controller: this process sees the whole mesh
+        self.global_rank = jax.process_index()
+        self.local_rank = 0
+        self.num_processes = jax.process_count()
+
+    # -- rank info inside the step ----------------------------------------
+    def rank_in_step(self):
+        try:
+            return lax.axis_index(self.axis_name)
+        except NameError:
+            return 0
+
+    def _in_step(self, arr) -> bool:
+        """True when tracing inside the shard_map'd step (axis bound)."""
+        if not is_tracing(arr):
+            return False
+        try:
+            lax.axis_index(self.axis_name)
+            return True
+        except NameError:
+            return False
+
+    # -- dense all-reduce (reference: Communicator::synch → ncclAllReduce)
+    def all_reduce(self, arr, average=False):
+        if not self._in_step(arr):
+            return arr  # eager / unsharded: world-1 identity (see above)
+        out = lax.psum(arr, self.axis_name)
+        return out / self.world_size if average else out
+
+    def synch(self, arr):
+        return self.all_reduce(arr, average=False)
+
+    # -- bucketed all-reduce (reference: fusedSynch over a fusion buffer
+    #    of `threshold` bytes) --------------------------------------------
+    def fused_synch(self, arrs, average=False):
+        """Concatenate many small grads, one psum, split back."""
+        if not arrs:
+            return []
+        if not self._in_step(arrs[0]):
+            return list(arrs)
+        shapes = [a.shape for a in arrs]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        flat = jnp.concatenate([a.reshape(-1) for a in arrs])
+        red = lax.psum(flat, self.axis_name)
+        if average:
+            red = red / self.world_size
+        out, off = [], 0
+        for s, n in zip(shapes, sizes):
+            out.append(red[off:off + n].reshape(s))
+            off += n
+        return out
+
+    # -- compressed sync (reference: synchHalf, fp16 over the wire;
+    #    bf16 is the TPU-native compressed format) ------------------------
+    def synch_half(self, arr, average=False):
+        if not self._in_step(arr):
+            return arr.astype(jnp.bfloat16).astype(arr.dtype)
+        red = lax.psum(arr.astype(jnp.bfloat16), self.axis_name)
+        red = red.astype(arr.dtype)
+        return red / self.world_size if average else red
+
+    def fused_synch_half(self, arrs, average=False):
+        if not arrs:
+            return []
+        if not self._in_step(arrs[0]):
+            return [a.astype(jnp.bfloat16).astype(a.dtype) for a in arrs]
+        shapes = [a.shape for a in arrs]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        flat = jnp.concatenate([a.reshape(-1) for a in arrs]).astype(jnp.bfloat16)
+        red = lax.psum(flat, self.axis_name).astype(arrs[0].dtype)
+        if average:
+            red = red / self.world_size
+        out, off = [], 0
+        for s, n in zip(shapes, sizes):
+            out.append(red[off:off + n].reshape(s))
+            off += n
+        return out
+
+    # -- sparse sync (reference: sparsification/topKSparsification with
+    #    residual accumulation).  TPU has no sparse all-reduce primitive
+    #    (SURVEY.md §5.8), so two designs are provided:
+    #      topK=True : all_gather of (indices, values) pairs — wire cost
+    #                  2*K*world, wins when K << size;
+    #      topK=False (threshold): dense masked psum — dynamic selection
+    #                  counts don't compile to static ICI transfers.
+    def sparse_all_reduce(self, arr, residual, spars=0.05, topK=True,
+                          average=False):
+        """Returns (synced, new_residual); both shaped like arr."""
+        in_step = self._in_step(arr)
+        acc = residual + arr
+        flat = acc.reshape(-1)
+        n = flat.shape[0]
+        if topK:
+            k = max(1, int(math.ceil(float(spars) * n)))
+            _, idx = lax.top_k(jnp.abs(flat), k)
+            vals = flat[idx]
+            contrib = jnp.zeros_like(flat).at[idx].set(vals)
+            if in_step:
+                # exchange the (idx, vals) pairs over ICI
+                all_idx = lax.all_gather(idx, self.axis_name)      # (W, k)
+                all_vals = lax.all_gather(vals, self.axis_name)    # (W, k)
+                summed = jnp.zeros_like(flat).at[all_idx.reshape(-1)].add(
+                    all_vals.reshape(-1))
+            else:
+                summed = contrib
+        else:
+            thr = jnp.asarray(spars, dtype=flat.dtype)
+            contrib = jnp.where(jnp.abs(flat) > thr, flat, 0.0)
+            summed = lax.psum(contrib, self.axis_name) if in_step else contrib
+        new_residual = (flat - contrib).reshape(arr.shape)
+        if average and in_step:
+            summed = summed / self.world_size
+        return summed.reshape(arr.shape), new_residual
+
+    # -- ordering (reference: event-sync of comm stream vs compute) -------
+    def wait(self):
+        """No-op: XLA's dependency graph orders collectives; there is no
+        separate comm stream to fence."""

@@ -1,0 +1,221 @@
+"""``DistOpt`` — data-parallel optimizer wrapper (reference:
+``python/singa/opt.py`` DistOpt over the NCCL Communicator, unverified —
+SURVEY.md §2.2/§3.3).  All five reference sync modes exist on ICI:
+
+  backward_and_update          dense all-reduce, small grads bucketed
+                               into a fusion buffer of ``threshold``
+                               elements (reference: fusedSynch)
+  backward_and_update_half     compressed sync (fp16 upstream → bf16,
+                               the TPU wire format)
+  backward_and_partial_update  round-robin: each step only 1/world of the
+                               params is synced (true 1/W wire cost — the
+                               collective sits inside a lax.cond)
+  backward_and_sparse_update   topK=True : top-K of (residual+grad),
+                               all_gather'd (idx,val) pairs;
+                               topK=False: |value|>threshold masked
+                               dense psum; residuals accumulate either way
+
+Per-rank state in a single-controller runtime: the reference lets each
+rank keep private residuals (and, in partial update, lets params drift
+between syncs).  Here params must stay replicated across the mesh, so
+per-rank divergence is held in explicitly *sharded* accumulator state of
+shape (world, ...param_shape) — partitioned over the mesh axis by the
+graph runner, so each rank reads and writes only its own slice, exactly
+like a private NCCL-rank buffer.  For partial update this reinterprets
+"params drift, then re-sync" as "grads accumulate per-rank, then the
+round-robin sync applies the psum'd accumulator" — same 1/W bandwidth,
+gradient-preserving, and well-defined with replicated params.
+
+The wrapper consumes the ``autograd.backward`` generator exactly like the
+reference (grads stream out reverse-topologically); under XLA the
+compute/communication overlap the reference builds by hand falls out of
+the latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import autograd, tensor
+from ..tensor import Tensor
+from .communicator import Communicator
+
+
+class DistOpt:
+    is_distributed = True
+
+    def __init__(self, opt, mesh=None, axis_name="data", num_devices=None,
+                 communicator=None, **unused_reference_args):
+        self.opt = opt
+        self.communicator = communicator if communicator is not None else \
+            Communicator(mesh=mesh, axis_name=axis_name,
+                         num_devices=num_devices)
+        self.world_size = self.communicator.world_size
+        self.global_rank = self.communicator.global_rank
+        self.local_rank = self.communicator.local_rank
+        self._residuals = {}  # param name -> residual Tensor (sparse mode)
+
+    # -- delegation so DistOpt quacks like the wrapped Optimizer ----------
+    @property
+    def step_counter(self):
+        return self.opt.step_counter
+
+    def step(self):
+        self.opt.step()
+
+    def _param_name(self, p):
+        return self.opt._param_name(p)
+
+    def apply(self, name, p, g):
+        self.opt.apply(name, p, g)
+
+    def update(self, param, grad):
+        """Single-param update with dense all-reduce (reference
+        DistOpt.update)."""
+        g = self.communicator.all_reduce(grad.data, average=True)
+        self.opt.update(param, tensor._wrap(g, param.device))
+
+    def state_tensors(self):
+        d = dict(self.opt.state_tensors())
+        for k, v in self._residuals.items():
+            d[f"__residual__{k}"] = v
+        return d
+
+    def get_states(self):
+        return {k: tensor.to_numpy(v) for k, v in self.state_tensors().items()}
+
+    def set_states(self, states):
+        res = {k[len("__residual__"):]: v for k, v in states.items()
+               if k.startswith("__residual__")}
+        rest = {k: v for k, v in states.items()
+                if not k.startswith("__residual__")}
+        self.opt.set_states(rest)
+        for k, v in res.items():
+            if k in self._residuals:
+                t = self._residuals[k]
+                import jax
+
+                t.data = jax.device_put(jnp.asarray(v), t.device.jax_device)
+            else:
+                self._residuals[k] = tensor.from_numpy(np.asarray(v))
+
+    def attach_model(self, model):
+        self.model = model
+
+    # -- mode 1: dense with fusion buffer ----------------------------------
+    def __call__(self, loss):
+        self.backward_and_update(loss)
+
+    def backward_and_update(self, loss, threshold=2 ** 21):
+        """Dense sync; grads smaller than ``threshold`` elements ride the
+        fusion buffer (reference default threshold is elements-based)."""
+        comm = self.communicator
+        bucket, pending = [], []
+        for p, g in autograd.backward(loss):
+            name = self._param_name(p)
+            if g.data.size < threshold:
+                bucket.append(g.data)
+                pending.append((name, p))
+                continue
+            synced = comm.all_reduce(g.data, average=True)
+            self.opt.apply(name, p, tensor._wrap(synced, p.device))
+        if bucket:
+            for (name, p), synced in zip(
+                    pending, comm.fused_synch(bucket, average=True)):
+                self.opt.apply(name, p, tensor._wrap(synced, p.device))
+        self.opt.step()
+
+    # -- mode 2: compressed ------------------------------------------------
+    def backward_and_update_half(self, loss, threshold=2 ** 21):
+        comm = self.communicator
+        bucket, pending = [], []
+        for p, g in autograd.backward(loss):
+            name = self._param_name(p)
+            if g.data.size < threshold:
+                bucket.append(g.data)
+                pending.append((name, p))
+                continue
+            synced = comm.synch_half(g.data, average=True)
+            self.opt.apply(name, p, tensor._wrap(synced, p.device))
+        if bucket:
+            for (name, p), synced in zip(
+                    pending, comm.fused_synch_half(bucket, average=True)):
+                self.opt.apply(name, p, tensor._wrap(synced, p.device))
+        self.opt.step()
+
+    # -- mode 3: round-robin partial sync ----------------------------------
+    def backward_and_partial_update(self, loss):
+        """Round-robin: param i syncs on steps where step ≡ i (mod world);
+        off-turn grads accumulate in the per-rank accumulator and are
+        folded in at the next sync, so wire cost is 1/world of dense sync
+        (the psum executes inside the taken lax.cond branch only)."""
+        import jax
+        from jax import lax
+
+        comm = self.communicator
+        W = self.world_size
+        step = self.opt.step_counter.data.astype(jnp.int32)
+        for i, (p, g) in enumerate(autograd.backward(loss)):
+            name = self._param_name(p)
+            r = self._residual_for(name, p)
+            r_loc, in_step = self._rank_slice(r, g)
+            acc = r_loc + g.data
+            if not in_step:
+                # eager / warm step: world-1 semantics — always "synced"
+                self._write_rank_slice(r, jnp.zeros_like(acc), in_step)
+                self.opt.apply(name, p, tensor._wrap(acc, p.device))
+                continue
+            sync_now = (step % W) == (i % W)
+
+            def do_sync(acc=acc):
+                return lax.psum(acc, comm.axis_name) / W, jnp.zeros_like(acc)
+
+            def skip(acc=acc):
+                return jnp.zeros_like(acc), acc
+
+            delta, new_res = lax.cond(sync_now, do_sync, skip)
+            self._write_rank_slice(r, new_res, in_step)
+            self.opt.apply(name, p, tensor._wrap(delta, p.device))
+        self.opt.step()
+
+    # -- modes 4/5: sparse with residual accumulation ----------------------
+    def backward_and_sparse_update(self, loss, spars=0.05, topK=True):
+        comm = self.communicator
+        for p, g in autograd.backward(loss):
+            name = self._param_name(p)
+            r = self._residual_for(name, p)
+            r_loc, in_step = self._rank_slice(r, g)
+            synced, new_res = comm.sparse_all_reduce(
+                g.data, r_loc, spars=spars, topK=topK, average=True)
+            self._write_rank_slice(r, new_res, in_step)
+            self.opt.apply(name, p, tensor._wrap(synced, p.device))
+        self.opt.step()
+
+    def _residual_for(self, name, p) -> Tensor:
+        """Per-rank accumulator: global shape (world, *param_shape).  The
+        graph runner shards dim 0 over the mesh, giving each rank a
+        private slice (the analogue of a per-rank NCCL-side buffer)."""
+        if name not in self._residuals:
+            self._residuals[name] = Tensor(
+                shape=(self.world_size,) + p.shape, dtype=p.data.dtype,
+                device=p.device, requires_grad=False)
+        t = self._residuals[name]
+        if t.device is not p.device:
+            t.to_device(p.device)
+        return t
+
+    def _rank_slice(self, r, g):
+        """Local residual slice + whether we are inside the sharded step.
+        Inside the step r.data is the (1, *shape) local shard; eagerly it
+        is the full (world, *shape) array (use rank 0's slice)."""
+        in_step = self.communicator._in_step(g.data)
+        return r.data[0], in_step
+
+    def _write_rank_slice(self, r, new_res, in_step):
+        if in_step:
+            r.data = new_res[None]
+        else:
+            # warm/eager step: all rank slices get the same value
+            r.data = jnp.broadcast_to(new_res[None],
+                                      (self.world_size,) + new_res.shape)

@@ -1,0 +1,101 @@
+"""CNN model zoo smoke + learning tests (reference: examples/cnn models,
+unverified)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import opt, tensor
+from singa_tpu import device as device_module
+from singa_tpu.models.cnn import CNN
+from singa_tpu.models.resnet import resnet18, resnet50
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+def _data(dev, n=4, c=1, hw=28, classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, c, hw, hw).astype(np.float32)
+    y = rng.randint(0, classes, (n,)).astype(np.int32)
+    return tensor.from_numpy(x, dev), tensor.from_numpy(y, dev)
+
+
+def test_cnn_trains_eager(dev):
+    m = CNN(num_classes=10, num_channels=1)
+    m.set_optimizer(opt.SGD(lr=0.02, momentum=0.9))
+    x, y = _data(dev, n=8)
+    m.compile([x], is_train=True, use_graph=False)
+    losses = [float(m(x, y)[1].data) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_cnn_graph_equals_eager(dev):
+    x, y = _data(dev, n=4)
+
+    def make():
+        dev.SetRandSeed(3)
+        m = CNN(num_classes=10, num_channels=1)
+        m.set_optimizer(opt.SGD(lr=0.01))
+        m.compile([x], is_train=True, use_graph=False)
+        return m
+
+    m1 = make()
+    m2 = make()
+    m2.graph_mode = True
+    from singa_tpu import model as model_mod
+
+    m2._graph_runner = model_mod._GraphRunner(m2)
+    m2.set_params({k: v.clone() for k, v in m1.get_params().items()})
+    for i in range(4):
+        _, l1 = m1(x, y)
+        _, l2 = m2(x, y)
+        np.testing.assert_allclose(float(l1.data), float(l2.data), rtol=5e-4,
+                                   err_msg=f"step {i}")
+
+
+def test_resnet18_forward_shape_and_step(dev):
+    m = resnet18(num_classes=10)
+    m.set_optimizer(opt.SGD(lr=0.01))
+    x, y = _data(dev, n=2, c=3, hw=32)
+    m.compile([x], is_train=True, use_graph=False)
+    out, loss = m(x, y)
+    assert out.shape == (2, 10)
+    assert np.isfinite(float(loss.data))
+    # BN running stats moved off their init during training
+    rm = [v for k, v in m.get_states().items() if k.endswith("running_mean")]
+    assert any(np.abs(tensor.to_numpy(t)).max() > 0 for t in rm)
+
+
+def test_resnet50_param_count(dev):
+    m = resnet50(num_classes=1000)
+    x, _ = _data(dev, n=1, c=3, hw=64, classes=1000)
+    m.compile([x], is_train=False, use_graph=False)
+    n_params = sum(int(np.prod(v.shape)) for v in m.get_params().values())
+    # torchvision resnet50: 25.557M params
+    assert abs(n_params - 25_557_032) / 25_557_032 < 0.01, n_params
+
+
+def test_resnet_eval_mode(dev):
+    m = resnet18(num_classes=10)
+    x, y = _data(dev, n=2, c=3, hw=32)
+    m.compile([x], is_train=True, use_graph=False)
+    m.set_optimizer(opt.SGD(lr=0.01))
+    m(x, y)
+    m.eval()
+    out = m(x)
+    assert out.shape == (2, 10)
+
+
+def test_xception_param_count(dev):
+    from singa_tpu.models.xceptionnet import Xception
+
+    m = Xception(num_classes=1000)
+    x, _ = _data(dev, n=1, c=3, hw=96, classes=1000)
+    m.compile([x], is_train=False, use_graph=False)
+    n_params = sum(int(np.prod(v.shape)) for v in m.get_params().values())
+    # reference Xception: 22,855,952 params
+    assert abs(n_params - 22_855_952) / 22_855_952 < 0.01, n_params

@@ -1,0 +1,170 @@
+"""Conv/BN/Pool fwd+bwd vs torch CPU goldens (reference strategy:
+test/python/test_operation.py compares against numpy/cudnn goldens,
+unverified; torch is an independent implementation available here)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, tensor
+from singa_tpu import device as device_module
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture
+def dev():
+    return device_module.get_default_device()
+
+
+@pytest.fixture(autouse=True)
+def _training():
+    autograd.set_training(True)
+    yield
+    autograd.set_training(False)
+
+
+def _param(arr, dev):
+    t = tensor.from_numpy(arr, dev)
+    t.requires_grad = True
+    t.stores_grad = True
+    return t
+
+
+def _t(arr):
+    return torch.tensor(arr, requires_grad=True)
+
+
+def test_conv2d_forward_backward_vs_torch(dev):
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w_np = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b_np = rng.randn(4).astype(np.float32)
+
+    from singa_tpu.ops import conv as conv_ops
+
+    x, w, b = _param(x_np, dev), _param(w_np, dev), _param(b_np, dev)
+    y = conv_ops.conv2d(x, w, b, stride=(2, 2), padding=(1, 1))
+    loss = autograd.reduce_sum(autograd.mul(y, y))
+    grads = dict(autograd.backward(loss))
+
+    tx, tw, tb = _t(x_np), _t(w_np), _t(b_np)
+    ty = torch.nn.functional.conv2d(tx, tw, tb, stride=2, padding=1)
+    (ty * ty).sum().backward()
+
+    np.testing.assert_allclose(tensor.to_numpy(y), ty.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(tensor.to_numpy(grads[x]), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(tensor.to_numpy(grads[w]), tw.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(tensor.to_numpy(grads[b]), tb.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_conv2d_grouped_vs_torch(dev):
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(2, 4, 6, 6).astype(np.float32)
+    w_np = rng.randn(8, 2, 3, 3).astype(np.float32)  # groups=2
+
+    from singa_tpu.ops import conv as conv_ops
+
+    x, w = _param(x_np, dev), _param(w_np, dev)
+    y = conv_ops.conv2d(x, w, None, stride=(1, 1), padding=(1, 1), group=2)
+    tx, tw = _t(x_np), _t(w_np)
+    ty = torch.nn.functional.conv2d(tx, tw, None, padding=1, groups=2)
+    np.testing.assert_allclose(tensor.to_numpy(y), ty.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_maxpool_vs_torch(dev):
+    rng = np.random.RandomState(2)
+    x_np = rng.randn(2, 3, 8, 8).astype(np.float32)
+
+    from singa_tpu.ops import pooling as pool_ops
+
+    x = _param(x_np, dev)
+    y = pool_ops.pooling2d(x, kernel=(2, 2), stride=(2, 2), is_max=True)
+    loss = autograd.reduce_sum(y)
+    grads = dict(autograd.backward(loss))
+
+    tx = _t(x_np)
+    ty = torch.nn.functional.max_pool2d(tx, 2, 2)
+    ty.sum().backward()
+    np.testing.assert_allclose(tensor.to_numpy(y), ty.detach().numpy(), rtol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(grads[x]), tx.grad.numpy(),
+                               rtol=1e-5)
+
+
+def test_avgpool_vs_torch(dev):
+    rng = np.random.RandomState(3)
+    x_np = rng.randn(2, 3, 8, 8).astype(np.float32)
+
+    from singa_tpu.ops import pooling as pool_ops
+
+    x = _param(x_np, dev)
+    y = pool_ops.pooling2d(x, kernel=(2, 2), stride=(2, 2), is_max=False)
+    tx = _t(x_np)
+    ty = torch.nn.functional.avg_pool2d(tx, 2, 2)
+    np.testing.assert_allclose(tensor.to_numpy(y), ty.detach().numpy(), rtol=1e-5)
+
+
+def test_batchnorm_train_vs_torch(dev):
+    rng = np.random.RandomState(4)
+    x_np = rng.randn(4, 3, 5, 5).astype(np.float32)
+    s_np = rng.rand(3).astype(np.float32) + 0.5
+    b_np = rng.randn(3).astype(np.float32)
+
+    from singa_tpu.ops import batchnorm as bn_ops
+
+    x, s, b = _param(x_np, dev), _param(s_np, dev), _param(b_np, dev)
+    rmean = tensor.from_numpy(np.zeros(3, np.float32), dev)
+    rvar = tensor.from_numpy(np.ones(3, np.float32), dev)
+    y = bn_ops.batchnorm2d(x, s, b, rmean, rvar, momentum=0.9, eps=1e-5)
+    loss = autograd.reduce_sum(autograd.mul(y, y))
+    grads = dict(autograd.backward(loss))
+
+    tx, ts, tb = _t(x_np), _t(s_np), _t(b_np)
+    ty = torch.nn.functional.batch_norm(
+        tx, torch.zeros(3), torch.ones(3), ts, tb, training=True, eps=1e-5)
+    (ty * ty).sum().backward()
+
+    np.testing.assert_allclose(tensor.to_numpy(y), ty.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(tensor.to_numpy(grads[x]), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(tensor.to_numpy(grads[s]), ts.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(tensor.to_numpy(grads[b]), tb.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    # running stats updated: r = 0.9*r + 0.1*batch
+    np.testing.assert_allclose(
+        tensor.to_numpy(rmean), 0.1 * x_np.mean((0, 2, 3)), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_eval_uses_running_stats(dev):
+    rng = np.random.RandomState(5)
+    x_np = rng.randn(2, 3, 4, 4).astype(np.float32)
+
+    from singa_tpu.ops import batchnorm as bn_ops
+
+    autograd.set_training(False)
+    x = tensor.from_numpy(x_np, dev)
+    s = tensor.from_numpy(np.ones(3, np.float32), dev)
+    b = tensor.from_numpy(np.zeros(3, np.float32), dev)
+    rmean = tensor.from_numpy(np.full(3, 0.5, np.float32), dev)
+    rvar = tensor.from_numpy(np.full(3, 2.0, np.float32), dev)
+    y = bn_ops.batchnorm2d(x, s, b, rmean, rvar)
+    expect = (x_np - 0.5) / np.sqrt(2.0 + 1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(y), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_same_padding(dev):
+    rng = np.random.RandomState(6)
+    x_np = rng.randn(1, 2, 7, 7).astype(np.float32)
+    w_np = rng.randn(3, 2, 3, 3).astype(np.float32)
+
+    from singa_tpu.ops import conv as conv_ops
+
+    x, w = _param(x_np, dev), _param(w_np, dev)
+    y = conv_ops.conv2d(x, w, None, stride=(1, 1), pad_mode="SAME_UPPER")
+    assert y.shape == (1, 3, 7, 7)
