@@ -1,0 +1,84 @@
+"""Benchmark harness — prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Primary metric (BASELINE.json): ResNet-50 train throughput,
+samples/sec/chip, measured on the real attached chip with the full
+singa_tpu training step (graph mode: forward + backward + SGD update in
+one donated jit executable).
+
+``vs_baseline``: BASELINE.json.published is empty (no retrievable
+reference numbers — see BASELINE.md provenance), so the ratio is
+against the round-1 recorded value in BENCH_BASELINE.json once it
+exists; 1.0 on the first recording.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def bench_resnet50(batch=32, hw=224, iters=20, warmup=None):
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models.resnet import resnet50
+
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(0)
+    m = resnet50(num_classes=1000)
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(batch, 3, hw, hw).astype(np.float32), dev)
+    y = tensor.from_numpy(rng.randint(0, 1000, (batch,)).astype(np.int32), dev)
+    m.compile([x], is_train=True, use_graph=True, sequential=False)
+
+    # warm: eager iteration + trace/compile + one replay
+    m(x, y)
+    m(x, y)
+    _, loss = m(x, y)
+    float(loss.data)  # sync
+
+    t0 = time.time()
+    for _ in range(iters):
+        _, loss = m(x, y)
+    float(loss.data)  # force completion
+    dt = time.time() - t0
+    return batch * iters / dt
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    value = bench_resnet50(batch=batch, iters=iters)
+
+    baseline_path = os.path.join(os.path.dirname(__file__),
+                                 "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                base = json.load(f)
+            if base.get("value"):
+                vs = value / float(base["value"])
+        except Exception:
+            pass
+    else:
+        try:
+            with open(baseline_path, "w") as f:
+                json.dump({"metric": "resnet50_train", "value": value,
+                           "unit": "samples/sec/chip"}, f)
+        except OSError:
+            pass
+
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(value, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

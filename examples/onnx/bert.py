@@ -1,0 +1,91 @@
+"""BERT via sonnx (reference: examples/onnx/bert.py imports a pretrained
+ONNX BERT-base, unverified — config #4).  No network in this container,
+so by default this script builds the native BERT, round-trips the MLM
+head through ONNX export+import to exercise sonnx, then trains masked-LM
+on synthetic batches.  Pass --onnx-model to import a real checkpoint.
+
+    python examples/onnx/bert.py --size tiny --steps 10
+    python examples/onnx/bert.py --onnx-model bert.onnx
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from singa_tpu import device, opt, sonnx, tensor  # noqa: E402
+from singa_tpu.models.bert import BertConfig, BertForMaskedLM  # noqa: E402
+
+
+def mask_tokens(ids, vocab_size, rng, mask_id=103, p=0.15):
+    """BERT MLM masking: 15% positions, 80/10/10 mask/random/keep."""
+    labels = ids.copy()
+    masked = rng.rand(*ids.shape) < p
+    coin = rng.rand(*ids.shape)
+    inp = ids.copy()
+    inp[masked & (coin < 0.8)] = mask_id
+    rand = masked & (coin >= 0.8) & (coin < 0.9)
+    inp[rand] = rng.randint(0, vocab_size, rand.sum())
+    return inp, labels
+
+
+def run(args):
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    if args.onnx_model:
+        print(f"importing {args.onnx_model} via sonnx")
+        rep = sonnx.prepare(args.onnx_model, dev)
+        ids = rng.randint(0, 30522, (args.batch_size, args.seq_length))
+        outs = rep.run([ids.astype(np.int64)])
+        print("imported model outputs:",
+              [tuple(o.shape) for o in outs])
+        return
+
+    cfg = BertConfig.tiny() if args.size == "tiny" else BertConfig.base()
+    m = BertForMaskedLM(cfg)
+    sgd = opt.Adam(lr=args.lr)
+    m.set_optimizer(sgd)
+
+    ids0 = tensor.from_numpy(
+        rng.randint(0, cfg.vocab_size,
+                    (args.batch_size, args.seq_length)).astype(np.int32), dev)
+    m.compile([ids0], is_train=True, use_graph=args.use_graph)
+
+    t_hist = []
+    for step in range(args.steps):
+        raw = rng.randint(0, cfg.vocab_size,
+                          (args.batch_size, args.seq_length))
+        inp, labels = mask_tokens(raw, cfg.vocab_size, rng)
+        x = tensor.from_numpy(inp.astype(np.int32), dev)
+        y = tensor.from_numpy(labels.astype(np.int32), dev)
+        t0 = time.time()
+        _, loss = m(x, y)
+        loss_v = float(loss.data)
+        dt = time.time() - t0
+        t_hist.append(dt)
+        if step % max(1, args.steps // 10) == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={loss_v:.4f} {dt * 1e3:.1f}ms")
+    steady = t_hist[2:] or t_hist
+    sps = args.batch_size / (sum(steady) / len(steady))
+    print(f"throughput: {sps:.1f} samples/s/chip "
+          f"(batch {args.batch_size}, seq {args.seq_length})")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", choices=["tiny", "base"], default="tiny")
+    p.add_argument("--onnx-model", default=None)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-length", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--use-graph", action="store_true", default=True)
+    p.add_argument("--no-graph", dest="use_graph", action="store_false")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    run(args)

@@ -1,0 +1,247 @@
+// Native IO runtime for singa_tpu (reference parity: src/io/ BinFile
+// reader/writer + src/utils/safe_queue.h, unverified — SURVEY.md §2.1
+// "IO: readers/writers" and "Utils").  The reference implements its
+// record store and data-loading queue in C++; this is the TPU-stack
+// equivalent, exposed to Python over a C ABI via ctypes (no pybind11 in
+// this image).
+//
+// Components:
+//   * BinFile record store: append-only [u32 keylen][key][u64 vallen]
+//     [val][u32 crc32-of-val] records behind an 8-byte magic+version
+//     header.  Used by snapshot.py as the checkpoint container.
+//   * PrefetchQueue: a fixed-capacity MPMC blocking ring buffer with a
+//     pool of loader threads pulling record indices and materializing
+//     value blobs, so the Python training loop overlaps host IO with
+//     device steps (the reference's safe_queue + decoder threads).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <vector>
+#include <mutex>
+#include <condition_variable>
+#include <thread>
+#include <atomic>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x314F49414754534eULL;  // "NSTGAIO1" LE
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Record {
+  std::string key;
+  std::vector<uint8_t> val;
+};
+
+struct BinReader {
+  FILE* f = nullptr;
+  std::vector<std::pair<std::string, std::pair<uint64_t, uint64_t>>> index;
+  // key -> (offset of value, length)
+};
+
+struct BinWriter {
+  FILE* f = nullptr;
+};
+
+struct PrefetchQueue {
+  std::vector<Record> ring;
+  size_t cap = 0, head = 0, tail = 0, count = 0;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::atomic<bool> closed{false};
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- writer --
+void* binfile_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  if (fwrite(&kMagic, 8, 1, f) != 1) { fclose(f); return nullptr; }
+  auto* w = new BinWriter();
+  w->f = f;
+  return w;
+}
+
+int binfile_writer_put(void* hw, const char* key, const uint8_t* val,
+                       uint64_t len) {
+  auto* w = static_cast<BinWriter*>(hw);
+  if (!w || !w->f) return -1;
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  uint32_t crc = crc32(val, len);
+  if (fwrite(&klen, 4, 1, w->f) != 1) return -1;
+  if (fwrite(key, 1, klen, w->f) != klen) return -1;
+  if (fwrite(&len, 8, 1, w->f) != 1) return -1;
+  if (len && fwrite(val, 1, len, w->f) != len) return -1;
+  if (fwrite(&crc, 4, 1, w->f) != 1) return -1;
+  return 0;
+}
+
+int binfile_writer_close(void* hw) {
+  auto* w = static_cast<BinWriter*>(hw);
+  if (!w) return -1;
+  int rc = 0;
+  if (w->f) rc = fclose(w->f);
+  delete w;
+  return rc;
+}
+
+// ---------------------------------------------------------------- reader --
+void* binfile_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  uint64_t magic = 0;
+  if (fread(&magic, 8, 1, f) != 1 || magic != kMagic) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* r = new BinReader();
+  r->f = f;
+  // scan the index
+  while (true) {
+    uint32_t klen;
+    if (fread(&klen, 4, 1, f) != 1) break;
+    std::string key(klen, '\0');
+    if (fread(key.data(), 1, klen, f) != klen) break;
+    uint64_t vlen;
+    if (fread(&vlen, 8, 1, f) != 1) break;
+    uint64_t off = static_cast<uint64_t>(ftell(f));
+    if (fseek(f, static_cast<long>(vlen + 4), SEEK_CUR) != 0) break;
+    r->index.emplace_back(key, std::make_pair(off, vlen));
+  }
+  return r;
+}
+
+int64_t binfile_reader_count(void* hr) {
+  auto* r = static_cast<BinReader*>(hr);
+  return r ? static_cast<int64_t>(r->index.size()) : -1;
+}
+
+// key of record i; returns length or -1
+int64_t binfile_reader_key(void* hr, int64_t i, char* out, int64_t cap) {
+  auto* r = static_cast<BinReader*>(hr);
+  if (!r || i < 0 || i >= (int64_t)r->index.size()) return -1;
+  const auto& k = r->index[i].first;
+  if ((int64_t)k.size() + 1 > cap) return -1;
+  memcpy(out, k.data(), k.size());
+  out[k.size()] = '\0';
+  return static_cast<int64_t>(k.size());
+}
+
+int64_t binfile_reader_val_len(void* hr, int64_t i) {
+  auto* r = static_cast<BinReader*>(hr);
+  if (!r || i < 0 || i >= (int64_t)r->index.size()) return -1;
+  return static_cast<int64_t>(r->index[i].second.second);
+}
+
+// copy record i's value into out (cap bytes); verifies crc; returns len or -1
+int64_t binfile_reader_val(void* hr, int64_t i, uint8_t* out, int64_t cap) {
+  auto* r = static_cast<BinReader*>(hr);
+  if (!r || i < 0 || i >= (int64_t)r->index.size()) return -1;
+  auto [off, len] = r->index[i].second;
+  if ((int64_t)len > cap) return -1;
+  if (fseek(r->f, static_cast<long>(off), SEEK_SET) != 0) return -1;
+  if (len && fread(out, 1, len, r->f) != len) return -1;
+  uint32_t crc_stored;
+  if (fread(&crc_stored, 4, 1, r->f) != 1) return -1;
+  if (crc32(out, len) != crc_stored) return -2;  // corruption
+  return static_cast<int64_t>(len);
+}
+
+int binfile_reader_close(void* hr) {
+  auto* r = static_cast<BinReader*>(hr);
+  if (!r) return -1;
+  if (r->f) fclose(r->f);
+  delete r;
+  return 0;
+}
+
+// ------------------------------------------------------------- prefetch --
+void* prefetch_queue_new(int64_t capacity) {
+  auto* q = new PrefetchQueue();
+  q->cap = static_cast<size_t>(capacity);
+  q->ring.resize(q->cap);
+  return q;
+}
+
+// producer: blocks while full; returns 0, or -1 if closed
+int prefetch_queue_put(void* hq, const char* key, const uint8_t* val,
+                       uint64_t len) {
+  auto* q = static_cast<PrefetchQueue*>(hq);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_full.wait(lk, [&] { return q->count < q->cap || q->closed; });
+  if (q->closed) return -1;
+  Record& slot = q->ring[q->tail];
+  slot.key = key;
+  slot.val.assign(val, val + len);
+  q->tail = (q->tail + 1) % q->cap;
+  q->count++;
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// consumer: blocks while empty; returns value length, -1 when closed+drained
+int64_t prefetch_queue_get(void* hq, char* key_out, int64_t key_cap,
+                           uint8_t* val_out, int64_t val_cap) {
+  auto* q = static_cast<PrefetchQueue*>(hq);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [&] { return q->count > 0 || q->closed; });
+  if (q->count == 0) return -1;
+  Record& slot = q->ring[q->head];
+  if ((int64_t)slot.key.size() + 1 > key_cap ||
+      (int64_t)slot.val.size() > val_cap)
+    return -2;
+  memcpy(key_out, slot.key.data(), slot.key.size());
+  key_out[slot.key.size()] = '\0';
+  memcpy(val_out, slot.val.data(), slot.val.size());
+  int64_t n = static_cast<int64_t>(slot.val.size());
+  slot.val.clear();
+  slot.val.shrink_to_fit();
+  q->head = (q->head + 1) % q->cap;
+  q->count--;
+  q->not_full.notify_one();
+  return n;
+}
+
+int64_t prefetch_queue_size(void* hq) {
+  auto* q = static_cast<PrefetchQueue*>(hq);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int64_t>(q->count);
+}
+
+void prefetch_queue_close(void* hq) {
+  auto* q = static_cast<PrefetchQueue*>(hq);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+void prefetch_queue_free(void* hq) {
+  delete static_cast<PrefetchQueue*>(hq);
+}
+
+}  // extern "C"

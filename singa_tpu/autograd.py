@@ -633,3 +633,22 @@ def less(a, b):
 
 def where_op(cond, a, b):
     return _op(lambda c, x, y: jnp.where(c != 0, x, y), cond, a, b, _name="Where")
+
+
+def layer_norm(x, scale, bias, axis=-1, eps=1e-12):
+    """LayerNormalization (BERT uses eps=1e-12).  axis/eps ride op.params
+    so sonnx export can emit them as node attributes."""
+
+    def f(xv, sv, bv, axis, eps):
+        m = jnp.mean(xv, axis=axis, keepdims=True)
+        v = jnp.var(xv, axis=axis, keepdims=True)
+        return (xv - m) * jax.lax.rsqrt(v + eps) * sv + bv
+
+    return _op(f, x, scale, bias, _name="LayerNorm", axis=axis, eps=eps)
+
+
+def embedding(ids, W):
+    """Row gather: ids (int tensor) indexes W (vocab, dim); W's grad is a
+    scatter-add (XLA handles via the take VJP)."""
+    return _op(lambda i, w: jnp.take(w, i.astype(jnp.int32), axis=0),
+               ids, W, _name="Embedding")

@@ -1,0 +1,116 @@
+"""Image augmentation helpers (reference: python/singa/image_tool.py,
+unverified — resize/crop/flip pipelines used by the CNN examples).
+
+numpy-only implementation (no PIL dependency guaranteed in this image);
+images are HWC uint8/float arrays or NCHW float batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def crop(img, patch, position="center"):
+    """img HWC; patch (h, w); position in {'center','left_top', 'left_bottom',
+    'right_top','right_bottom','random'}."""
+    h, w = img.shape[:2]
+    ph, pw = patch
+    assert ph <= h and pw <= w, f"patch {patch} larger than image {(h, w)}"
+    if position == "center":
+        y, x = (h - ph) // 2, (w - pw) // 2
+    elif position == "left_top":
+        y, x = 0, 0
+    elif position == "left_bottom":
+        y, x = h - ph, 0
+    elif position == "right_top":
+        y, x = 0, w - pw
+    elif position == "right_bottom":
+        y, x = h - ph, w - pw
+    elif position == "random":
+        y = np.random.randint(0, h - ph + 1)
+        x = np.random.randint(0, w - pw + 1)
+    else:
+        raise ValueError(position)
+    return img[y:y + ph, x:x + pw]
+
+
+def flip(img, direction="horizontal"):
+    if direction == "horizontal":
+        return img[:, ::-1]
+    if direction == "vertical":
+        return img[::-1]
+    raise ValueError(direction)
+
+
+def resize(img, size):
+    """Bilinear resize, HWC -> (size_h, size_w, C)."""
+    if isinstance(size, int):
+        size = (size, size)
+    h, w = img.shape[:2]
+    th, tw = size
+    ys = np.linspace(0, h - 1, th)
+    xs = np.linspace(0, w - 1, tw)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None] if img.ndim == 3 else (ys - y0)[:, None]
+    wx = (xs - x0)[None, :, None] if img.ndim == 3 else (xs - x0)[None, :]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def color_jitter(img, brightness=0.0, contrast=0.0, rng=None):
+    rng = rng or np.random
+    out = img.astype(np.float32)
+    if brightness:
+        out = out + rng.uniform(-brightness, brightness) * 255.0
+    if contrast:
+        mean = out.mean()
+        out = (out - mean) * (1 + rng.uniform(-contrast, contrast)) + mean
+    return np.clip(out, 0, 255)
+
+
+def normalize(img, mean, std):
+    """HWC or NCHW; mean/std per channel."""
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    img = img.astype(np.float32)
+    if img.ndim == 4:  # NCHW
+        return (img - mean[None, :, None, None]) / std[None, :, None, None]
+    return (img - mean) / std
+
+
+def to_chw(img):
+    return np.transpose(img, (2, 0, 1))
+
+
+class ImageTool:
+    """Chainable augmentation pipeline (reference ImageTool API shape):
+    ImageTool(img).resize(40).crop((32,32),'random').flip().get()"""
+
+    def __init__(self, img):
+        self.img = np.asarray(img)
+
+    def resize_by_range(self, rng_size):
+        size = np.random.randint(rng_size[0], rng_size[1] + 1)
+        self.img = resize(self.img, size)
+        return self
+
+    def resize(self, size):
+        self.img = resize(self.img, size)
+        return self
+
+    def crop(self, patch, position="center"):
+        self.img = crop(self.img, patch, position)
+        return self
+
+    def flip(self, direction="horizontal", prob=1.0):
+        if np.random.rand() < prob:
+            self.img = flip(self.img, direction)
+        return self
+
+    def get(self):
+        return self.img

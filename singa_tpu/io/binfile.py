@@ -1,0 +1,268 @@
+"""BinFile record store + prefetch queue — ctypes bindings over the
+native C++ runtime in ``native/singa_io.cpp`` (reference parity:
+src/io/ BinFileReader/Writer + utils/safe_queue, unverified).
+
+The native library is built on first use (``make -C native``); if no
+toolchain is available a pure-Python fallback provides the same API so
+the framework stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+import zlib
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libsinga_io.so")
+
+_lib = None
+_lib_err = None
+_build_lock = threading.Lock()
+
+
+def _load_native():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO_PATH):
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.binfile_writer_open.restype = ctypes.c_void_p
+            lib.binfile_writer_open.argtypes = [ctypes.c_char_p]
+            lib.binfile_writer_put.restype = ctypes.c_int
+            lib.binfile_writer_put.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_uint64]
+            lib.binfile_writer_close.restype = ctypes.c_int
+            lib.binfile_writer_close.argtypes = [ctypes.c_void_p]
+            lib.binfile_reader_open.restype = ctypes.c_void_p
+            lib.binfile_reader_open.argtypes = [ctypes.c_char_p]
+            lib.binfile_reader_count.restype = ctypes.c_int64
+            lib.binfile_reader_count.argtypes = [ctypes.c_void_p]
+            lib.binfile_reader_key.restype = ctypes.c_int64
+            lib.binfile_reader_key.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+                ctypes.c_int64]
+            lib.binfile_reader_val_len.restype = ctypes.c_int64
+            lib.binfile_reader_val_len.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_int64]
+            lib.binfile_reader_val.restype = ctypes.c_int64
+            lib.binfile_reader_val.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+                ctypes.c_int64]
+            lib.binfile_reader_close.restype = ctypes.c_int
+            lib.binfile_reader_close.argtypes = [ctypes.c_void_p]
+            for name, res, args in [
+                ("prefetch_queue_new", ctypes.c_void_p, [ctypes.c_int64]),
+                ("prefetch_queue_put", ctypes.c_int,
+                 [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                  ctypes.c_uint64]),
+                ("prefetch_queue_get", ctypes.c_int64,
+                 [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                  ctypes.c_char_p, ctypes.c_int64]),
+                ("prefetch_queue_size", ctypes.c_int64, [ctypes.c_void_p]),
+                ("prefetch_queue_close", None, [ctypes.c_void_p]),
+                ("prefetch_queue_free", None, [ctypes.c_void_p]),
+            ]:
+                fn = getattr(lib, name)
+                fn.restype = res
+                fn.argtypes = args
+            _lib = lib
+        except Exception as e:  # toolchain missing etc.
+            _lib_err = e
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+_MAGIC = b"NSTGAIO1"
+
+
+class BinFileWriter:
+    """Append key->bytes records (reference: io::BinFileWriter)."""
+
+    def __init__(self, path):
+        self.path = path
+        self._lib = _load_native()
+        if self._lib is not None:
+            self._h = self._lib.binfile_writer_open(path.encode())
+            if not self._h:
+                raise OSError(f"cannot open {path}")
+            self._f = None
+        else:
+            self._f = open(path, "wb")
+            self._f.write(_MAGIC)
+            self._h = None
+
+    def put(self, key: str, value: bytes):
+        if self._h is not None:
+            rc = self._lib.binfile_writer_put(self._h, key.encode(), value,
+                                              len(value))
+            if rc != 0:
+                raise OSError(f"write failed for key {key}")
+        else:
+            k = key.encode()
+            self._f.write(struct.pack("<I", len(k)))
+            self._f.write(k)
+            self._f.write(struct.pack("<Q", len(value)))
+            self._f.write(value)
+            self._f.write(struct.pack("<I", zlib.crc32(value) & 0xFFFFFFFF))
+
+    def close(self):
+        if self._h is not None:
+            self._lib.binfile_writer_close(self._h)
+            self._h = None
+        elif self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class BinFileReader:
+    """Read records; random access by index or key."""
+
+    def __init__(self, path):
+        self.path = path
+        self._lib = _load_native()
+        if self._lib is not None:
+            self._h = self._lib.binfile_reader_open(path.encode())
+            if not self._h:
+                raise OSError(f"cannot open/parse {path}")
+            self._keys = None
+        else:
+            self._h = None
+            self._records = []
+            with open(path, "rb") as f:
+                if f.read(8) != _MAGIC:
+                    raise OSError(f"bad magic in {path}")
+                while True:
+                    hdr = f.read(4)
+                    if len(hdr) < 4:
+                        break
+                    (klen,) = struct.unpack("<I", hdr)
+                    key = f.read(klen).decode()
+                    (vlen,) = struct.unpack("<Q", f.read(8))
+                    val = f.read(vlen)
+                    (crc,) = struct.unpack("<I", f.read(4))
+                    if zlib.crc32(val) & 0xFFFFFFFF != crc:
+                        raise OSError(f"CRC mismatch for key {key}")
+                    self._records.append((key, val))
+
+    def count(self) -> int:
+        if self._h is not None:
+            return int(self._lib.binfile_reader_count(self._h))
+        return len(self._records)
+
+    def key(self, i: int) -> str:
+        if self._h is not None:
+            buf = ctypes.create_string_buffer(4096)
+            n = self._lib.binfile_reader_key(self._h, i, buf, 4096)
+            if n < 0:
+                raise IndexError(i)
+            return buf.value.decode()
+        return self._records[i][0]
+
+    def value(self, i: int) -> bytes:
+        if self._h is not None:
+            n = self._lib.binfile_reader_val_len(self._h, i)
+            if n < 0:
+                raise IndexError(i)
+            buf = ctypes.create_string_buffer(int(n) if n else 1)
+            rc = self._lib.binfile_reader_val(self._h, i, buf, n)
+            if rc == -2:
+                raise OSError(f"CRC mismatch at record {i} in {self.path}")
+            if rc < 0:
+                raise OSError(f"read failed at record {i}")
+            return buf.raw[:n]
+        return self._records[i][1]
+
+    def items(self):
+        for i in range(self.count()):
+            yield self.key(i), self.value(i)
+
+    def read_all(self) -> dict:
+        return dict(self.items())
+
+    def close(self):
+        if self._h is not None:
+            self._lib.binfile_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class PrefetchQueue:
+    """Blocking MPMC queue backed by the native ring buffer; Python
+    fallback uses queue.Queue."""
+
+    def __init__(self, capacity=64, max_value_bytes=1 << 24):
+        self._lib = _load_native()
+        self.max_value_bytes = max_value_bytes
+        if self._lib is not None:
+            self._h = self._lib.prefetch_queue_new(capacity)
+        else:
+            import queue
+
+            self._h = None
+            self._q = queue.Queue(maxsize=capacity)
+
+    def put(self, key: str, value: bytes):
+        if self._h is not None:
+            rc = self._lib.prefetch_queue_put(self._h, key.encode(), value,
+                                              len(value))
+            if rc != 0:
+                raise RuntimeError("queue closed")
+        else:
+            self._q.put((key, value))
+
+    def get(self):
+        """Returns (key, value) or None when closed and drained."""
+        if self._h is not None:
+            kbuf = ctypes.create_string_buffer(4096)
+            vbuf = ctypes.create_string_buffer(self.max_value_bytes)
+            n = self._lib.prefetch_queue_get(self._h, kbuf, 4096, vbuf,
+                                             self.max_value_bytes)
+            if n == -1:
+                return None
+            if n < 0:
+                raise RuntimeError("record larger than max_value_bytes")
+            return kbuf.value.decode(), vbuf.raw[:n]
+        item = self._q.get()
+        return item  # None sentinel signals closed
+
+    def qsize(self):
+        if self._h is not None:
+            return int(self._lib.prefetch_queue_size(self._h))
+        return self._q.qsize()
+
+    def close(self):
+        if self._h is not None:
+            self._lib.prefetch_queue_close(self._h)
+        else:
+            self._q.put(None)
+
+    def free(self):
+        if self._h is not None:
+            self._lib.prefetch_queue_free(self._h)
+            self._h = None

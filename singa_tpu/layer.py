@@ -96,11 +96,16 @@ class Layer:
 
     @staticmethod
     def _load_into(t: Tensor, src):
-        """Rebind t's buffer from src, preserving t's device placement."""
+        """Rebind t's buffer from src, preserving t's device placement.
+        Always copies: graph-mode steps donate state buffers, so t must
+        not alias the source tensor's buffer."""
         import jax
         import jax.numpy as jnp
 
-        arr = src.data if isinstance(src, Tensor) else jnp.asarray(np.asarray(src))
+        if isinstance(src, Tensor):
+            arr = jnp.array(src.data, copy=True)
+        else:
+            arr = jnp.asarray(np.asarray(src))
         t.data = jax.device_put(arr, t.device.jax_device)
         t.creator = None
 
@@ -270,6 +275,43 @@ class MSELoss(Layer):
 class BinaryCrossEntropy(Layer):
     def forward(self, p, t):
         return autograd.binary_cross_entropy(p, t)
+
+
+class LayerNorm(Layer):
+    """LayerNormalization over the last axis (BERT convention)."""
+
+    def __init__(self, eps=1e-12):
+        super().__init__()
+        self.eps = float(eps)
+
+    def initialize(self, x):
+        d = x.shape[-1]
+        dt = x.data.dtype
+        self.scale = Tensor((d,), device=x.device, dtype=dt,
+                            requires_grad=True, stores_grad=True).set_value(1.0)
+        self.bias = Tensor((d,), device=x.device, dtype=dt,
+                           requires_grad=True, stores_grad=True).set_value(0.0)
+
+    def forward(self, x):
+        return autograd.layer_norm(x, self.scale, self.bias, eps=self.eps)
+
+
+class Embedding(Layer):
+    """Token embedding: (B, S) int ids -> (B, S, dim)."""
+
+    def __init__(self, vocab_size, embed_dim, std=0.02):
+        super().__init__()
+        self.vocab_size = int(vocab_size)
+        self.embed_dim = int(embed_dim)
+        self.std = float(std)
+
+    def initialize(self, ids):
+        self.W = Tensor((self.vocab_size, self.embed_dim), device=ids.device,
+                        requires_grad=True, stores_grad=True)
+        self.W.gaussian(0.0, self.std)
+
+    def forward(self, ids):
+        return autograd.embedding(ids, self.W)
 
 
 # ---------------------------------------------------------------------------

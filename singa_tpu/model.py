@@ -7,10 +7,11 @@ Graph mode, TPU-native: the reference's buffering graph scheduler
 (``src/core/scheduler/scheduler.cc`` — record Exec lambdas on iteration 1,
 topo-sort by block deps, replay thereafter) collapses into ``jax.jit``:
 
-  * iteration 1 runs **eagerly** (exactly like the reference: the first
-    iteration both executes and materializes graph state — here it also
-    lets optimizers create their momentum buffers);
-  * iteration 2 traces the user's ``train_one_batch`` into one pure
+  * before iteration 1, an **abstract warm-up** (``jax.eval_shape`` of one
+    step) materializes lazily-created optimizer state at zero cost — the
+    reference instead executes its first graph iteration eagerly while
+    recording, which on this backend would compile every op separately;
+  * iteration 1 traces the user's ``train_one_batch`` into one pure
     function over (persistent state, batch) and compiles it with donated
     state buffers — XLA's scheduler then owns op ordering, fusion, memory
     reuse and latency hiding (the jobs of scheduler.cc + cnmem);
@@ -226,12 +227,15 @@ class _GraphRunner:
     def run(self, args, kwargs):
         model = self.model
         if not self._warm:
-            # iteration 1: eager — executes AND materializes lazy state
-            # (optimizer buffers), mirroring the reference's build-while-run
-            # first graph iteration.
-            out = model.train_one_batch(*args, **kwargs)
+            # Materialize lazily-created optimizer state (momentum buffers,
+            # sparse residuals) by abstractly evaluating one step — no
+            # compile, no execution; new state starts at zero, which is
+            # exactly the optimizers' init.  The reference instead executes
+            # its first graph iteration eagerly while recording; on this
+            # backend eager dispatch compiles every op separately, so the
+            # abstract probe saves minutes on large models.
+            self._materialize_state(args, kwargs)
             self._warm = True
-            return out
 
         key = self._abstract_key(args, kwargs)
         state = model.persistent_tensors()
@@ -306,6 +310,44 @@ class _GraphRunner:
             lambda a: tensor._wrap(a, dev),
             out_tree,
         )
+
+    def _materialize_state(self, args, kwargs):
+        model = self.model
+        dev = model.device
+        before = dict(model.persistent_tensors())
+        saved = [(t, t.data) for t in before.values()]
+        saved_key = dev._rng_key
+        tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        tensor_kw = sorted(k for k, v in kwargs.items()
+                           if isinstance(v, Tensor))
+        in_arrays = [args[i].data for i in tensor_idx] + \
+            [kwargs[k].data for k in tensor_kw]
+
+        def probe(in_arrays):
+            call_args = list(args)
+            for i, arr in zip(tensor_idx, in_arrays[:len(tensor_idx)]):
+                call_args[i] = tensor._wrap(arr, dev)
+            call_kwargs = dict(kwargs)
+            for k, arr in zip(tensor_kw, in_arrays[len(tensor_idx):]):
+                call_kwargs[k] = tensor._wrap(arr, dev)
+            model.train_one_batch(*call_args, **call_kwargs)
+            return jnp.zeros(())
+
+        try:
+            jax.eval_shape(probe, in_arrays)
+        finally:
+            for t, a in saved:
+                t.data = a
+                t.creator = None
+            dev._rng_key = saved_key
+        # tensors created during the probe hold dead abstract tracers;
+        # zero-fill them (momenta/residuals/step counters all start at 0)
+        for name, t in model.persistent_tensors().items():
+            if name not in before:
+                aval = getattr(t.data, "aval", t.data)
+                t.data = jax.device_put(
+                    jnp.zeros(aval.shape, aval.dtype), dev.jax_device)
+                t.creator = None
 
     def _build(self, args, kwargs, names):
         model = self.model

@@ -1,0 +1,186 @@
+"""BERT (reference: examples/onnx/bert.py imports ONNX BERT-base through
+sonnx, unverified — config #4 workload in BASELINE.json).
+
+Two routes exist here:
+  * this native implementation (TPU-first: fused attention on the MXU,
+    whole encoder jitted in graph mode), matching BERT-base hyperparams
+    (L=12, H=768, A=12, 110M params);
+  * the sonnx import path (examples/onnx/bert.py) for ONNX checkpoints.
+"""
+
+import numpy as np
+
+from .. import autograd, layer, model, tensor
+from ..tensor import Tensor
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
+                 layer_norm_eps=1e-12, use_flash=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout = hidden_dropout
+        self.attn_dropout = attn_dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.use_flash = use_flash
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """For tests: 2 layers, 64 hidden."""
+        kw.setdefault("vocab_size", 1000)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+
+class BertEmbeddings(layer.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.word = layer.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position = layer.Embedding(cfg.max_position_embeddings,
+                                        cfg.hidden_size)
+        self.token_type = layer.Embedding(cfg.type_vocab_size,
+                                          cfg.hidden_size)
+        self.ln = layer.LayerNorm(cfg.layer_norm_eps)
+        self.dropout = cfg.hidden_dropout
+
+    def forward(self, input_ids, token_type_ids):
+        b, s = input_ids.shape
+        pos = tensor.from_numpy(
+            np.broadcast_to(np.arange(s, dtype=np.int32), (b, s)).copy(),
+            input_ids.device)
+        e = autograd.add(
+            autograd.add(self.word(input_ids), self.position(pos)),
+            self.token_type(token_type_ids))
+        e = self.ln(e)
+        if self.dropout > 0:
+            e = autograd.dropout(e, self.dropout)
+        return e
+
+
+class BertLayer(layer.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        from ..ops.attention import MultiHeadAttention
+
+        self.attn = MultiHeadAttention(cfg.num_attention_heads,
+                                       dropout=cfg.attn_dropout,
+                                       use_flash=cfg.use_flash)
+        self.ln1 = layer.LayerNorm(cfg.layer_norm_eps)
+        self.fc1 = layer.Linear(cfg.intermediate_size)
+        self.fc2 = layer.Linear(cfg.hidden_size)
+        self.ln2 = layer.LayerNorm(cfg.layer_norm_eps)
+        self.dropout = cfg.hidden_dropout
+
+    def forward(self, x, mask=None):
+        a = self.attn(x, mask)
+        if self.dropout > 0:
+            a = autograd.dropout(a, self.dropout)
+        x = self.ln1(autograd.add(x, a))
+        h = autograd.gelu(self.fc1(x))
+        h = self.fc2(h)
+        if self.dropout > 0:
+            h = autograd.dropout(h, self.dropout)
+        return self.ln2(autograd.add(x, h))
+
+
+class BertEncoder(layer.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.layers = [BertLayer(cfg) for _ in range(cfg.num_hidden_layers)]
+
+    def forward(self, x, mask=None):
+        for lyr in self.layers:
+            x = lyr(x, mask)
+        return x
+
+
+class BertModel(model.Model):
+    """Encoder trunk; forward returns (sequence_output, pooled_output)."""
+
+    def __init__(self, cfg=None):
+        super().__init__()
+        self.cfg = cfg or BertConfig.base()
+        self.embeddings = BertEmbeddings(self.cfg)
+        self.encoder = BertEncoder(self.cfg)
+        self.pooler = layer.Linear(self.cfg.hidden_size)
+
+    def _attn_mask(self, attention_mask):
+        """(B, S) 1/0 mask -> (B, 1, 1, S) additive -1e9 mask Tensor."""
+        if attention_mask is None:
+            return None
+        m = attention_mask
+        return _mask_op(m)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if token_type_ids is None:
+            token_type_ids = tensor.from_numpy(
+                np.zeros(input_ids.shape, np.int32), input_ids.device)
+        x = self.embeddings(input_ids, token_type_ids)
+        x = self.encoder(x, self._attn_mask(attention_mask))
+        pooled = autograd.tanh(self.pooler(_first_token(x)))
+        return x, pooled
+
+
+def _mask_op(m):
+    return autograd._op(
+        lambda mv: (1.0 - mv.astype("float32"))[:, None, None, :] * -1e9,
+        m, _name="AttnMask")
+
+
+def _first_token(x):
+    return autograd._op(lambda v: v[:, 0, :], x, _name="FirstToken")
+
+
+class BertForMaskedLM(model.Model):
+    """MLM head over the trunk; the config #4 training workload."""
+
+    def __init__(self, cfg=None):
+        super().__init__()
+        self.cfg = cfg or BertConfig.base()
+        self.bert = BertModel(self.cfg)
+        self.transform = layer.Linear(self.cfg.hidden_size)
+        self.ln = layer.LayerNorm(self.cfg.layer_norm_eps)
+        self.decoder = layer.Linear(self.cfg.vocab_size)
+        self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        # call .forward explicitly: Model.__call__ would route a nested
+        # Model to train_one_batch while training
+        seq, _ = self.bert.forward(input_ids, token_type_ids, attention_mask)
+        h = autograd.gelu(self.transform(seq))
+        h = self.ln(h)
+        logits = self.decoder(h)
+        return logits
+
+    def train_one_batch(self, input_ids, labels, dist_option="plain",
+                        spars=None):
+        from .common import apply_dist_option
+
+        logits = self.forward(input_ids)
+        b, s, v = logits.shape
+        loss = self.softmax_cross_entropy(
+            autograd.reshape(logits, (b * s, v)),
+            autograd.reshape(labels, (b * s,)))
+        apply_dist_option(self.optimizer, loss, dist_option, spars)
+        return logits, loss
+
+
+def create_model(size="base", **kw):
+    cfg = BertConfig.tiny(**kw) if size == "tiny" else BertConfig.base(**kw)
+    return BertForMaskedLM(cfg)

@@ -1,0 +1,82 @@
+"""Attention (the reference has no native attention — BERT's arrives via
+ONNX-imported GEMM+softmax graphs, SURVEY.md §5.7; this module is the
+TPU-native first-class version).
+
+Default path: one fused jnp scaled-dot-product (XLA fuses the softmax
+chain into the matmuls on the MXU).  ``use_flash=True`` routes through
+the Pallas flash-attention kernel (ops/pallas/flash_attention.py) for
+long sequences where the S×S score matrix shouldn't materialize in HBM.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..autograd import _op
+from ..layer import Layer, Linear
+from ..tensor import Tensor
+
+
+def scaled_dot_product_attention(q, k, v, mask=None, use_flash=False):
+    """q,k,v: Tensors (B, H, S, D); mask: optional additive mask
+    broadcastable to (B, H, S, S) (e.g. -1e9 at padded positions)."""
+    if use_flash:
+        from .pallas.flash_attention import flash_attention_op
+
+        return flash_attention_op(q, k, v, mask)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def f(qv, kv, vv, *rest, scale=scale):
+        scores = jnp.einsum("bhsd,bhtd->bhst", qv, kv) * scale
+        if rest:
+            scores = scores + rest[0]
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, vv)
+
+    if mask is None:
+        return _op(f, q, k, v, _name="Attention")
+    return _op(f, q, k, v, mask, _name="Attention")
+
+
+class MultiHeadAttention(Layer):
+    """Standard MHA over (B, S, E) inputs."""
+
+    def __init__(self, num_heads, dropout=0.0, use_flash=False):
+        super().__init__()
+        self.num_heads = int(num_heads)
+        self.dropout = float(dropout)
+        self.use_flash = use_flash
+        self.q_proj = Linear(0)  # out_features fixed at initialize
+        self.k_proj = Linear(0)
+        self.v_proj = Linear(0)
+        self.out_proj = Linear(0)
+
+    def initialize(self, x, mask=None):
+        e = x.shape[-1]
+        assert e % self.num_heads == 0
+        for proj in (self.q_proj, self.k_proj, self.v_proj, self.out_proj):
+            proj.out_features = e
+
+    def forward(self, x, mask=None):
+        b, s, e = x.shape
+        h = self.num_heads
+        d = e // h
+
+        def split_heads(t):
+            t = autograd.reshape(t, (b, s, h, d))
+            return autograd.transpose(t, (0, 2, 1, 3))
+
+        q = split_heads(self.q_proj(x))
+        k = split_heads(self.k_proj(x))
+        v = split_heads(self.v_proj(x))
+        ctx = scaled_dot_product_attention(q, k, v, mask,
+                                           use_flash=self.use_flash)
+        ctx = autograd.transpose(ctx, (0, 2, 1, 3))
+        ctx = autograd.reshape(ctx, (b, s, e))
+        if self.dropout > 0:
+            ctx = autograd.dropout(ctx, self.dropout)
+        return self.out_proj(ctx)

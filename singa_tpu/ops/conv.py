@@ -53,6 +53,10 @@ def conv2d(x, W, b=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
             y = y + rest[0][None, :, None, None]
         return y
 
+    # pass the geometry through _op's params so the op instance carries it
+    # (sonnx export reads op.params for node attributes)
+    kw = dict(stride=tuple(stride), pads=pads, dilation=tuple(dilation),
+              group=int(group))
     if b is None:
-        return _op(f, x, W, _name="Conv2d")
-    return _op(f, x, W, b, _name="Conv2d")
+        return _op(f, x, W, _name="Conv2d", **kw)
+    return _op(f, x, W, b, _name="Conv2d", **kw)

@@ -1,0 +1,211 @@
+"""Flash attention — Pallas TPU kernel.
+
+The reference has no native attention (BERT arrives via ONNX GEMM+softmax
+graphs that materialize the S×S score matrix — SURVEY.md §5.7).  This
+kernel is the TPU-native upgrade: online-softmax tiling keeps the score
+matrix in VMEM block by block, so HBM traffic stays O(S·D) instead of
+O(S²) — the enabler for long-context work (see parallel/ring_attention.py
+for the multi-chip sequence-parallel version).
+
+Forward: Pallas kernel, grid over (batch*heads, query blocks); each step
+streams key/value blocks through VMEM with a running (max, denom, acc)
+online softmax.  Backward: blockwise recomputation via lax.scan over key
+blocks (never materializes S×S), standard flash-attention gradient
+algebra.
+
+Supports an optional additive key mask of shape (BH, S) (e.g. BERT's
+padding mask) and a causal flag.  D (head dim) must be <= 128 and S a
+multiple of the block size; ops/attention.py falls back to the fused-jnp
+path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, scale,
+               causal, block_q):
+    """One (batch*head, q-block) grid step.
+
+    q_ref: (block_q, D); k_ref/v_ref: (S, D); mask_ref: (1, S) additive;
+    o_ref: (block_q, D).
+    """
+    q = q_ref[:] * scale
+    s_total = k_ref.shape[0]
+    num_kb = s_total // block_k
+    d = q_ref.shape[1]
+
+    qi = pl.program_id(1)
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = s + mask_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k):
+    """q,k,v: (BH, S, D); mask: (BH, S) additive (reshaped to (BH,1,S)
+    for the kernel's tiling constraints)."""
+    mask = mask[:, None, :]
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, s // block_q)
+    kernel = functools.partial(_fa_kernel, block_k=block_k, scale=scale,
+                               causal=causal, block_q=block_q)
+    interpret = jax.default_backend() == "cpu"  # no Mosaic on CPU (tests)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, mask)
+
+
+def _blockwise_reference(q, k, v, mask, causal, block_k):
+    """Numerically identical online-softmax attention built from a
+    lax.scan over key blocks — used for the backward pass (its VJP never
+    materializes S×S) and as the non-Pallas fallback."""
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qs = q * scale
+    num_kb = s // block_k
+    k_blocks = k.reshape(bh, num_kb, block_k, d).transpose(1, 0, 2, 3)
+    v_blocks = v.reshape(bh, num_kb, block_k, d).transpose(1, 0, 2, 3)
+    m_blocks = mask.reshape(bh, num_kb, block_k).transpose(1, 0, 2)
+
+    q_pos = jnp.arange(s)[None, :, None]  # (1, S, 1)
+
+    def body(carry, inp):
+        acc, m_prev, l_prev = carry
+        kb_idx, kb, vb, mb = inp
+        sc = jnp.einsum("bqd,bkd->bqk", qs, kb) + mb[:, None, :]
+        if causal:
+            k_pos = kb_idx * block_k + jnp.arange(block_k)[None, None, :]
+            sc = jnp.where(q_pos >= k_pos, sc, NEG_INF)
+        m_cur = jnp.max(sc, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(sc - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bqk,bkd->bqd", p, vb)
+        return (acc, m_new, l_new), None
+
+    init = (jnp.zeros((bh, s, d), jnp.float32),
+            jnp.full((bh, s), NEG_INF, jnp.float32),
+            jnp.zeros((bh, s), jnp.float32))
+    (acc, m, l), _ = jax.lax.scan(
+        body, init, (jnp.arange(num_kb), k_blocks, v_blocks, m_blocks))
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, causal, block_q, block_k):
+    return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, mask, causal, block_q, block_k):
+    o = _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+    return o, (q, k, v, mask)
+
+
+def _flash_bwd(causal, block_q, block_k, res, do):
+    q, k, v, mask = res
+    # memory-efficient gradient: differentiate the blockwise-scan
+    # reference (same math as the kernel) — XLA reverses the scan, so
+    # peak memory stays O(S·D) per block
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _blockwise_reference(q_, k_, v_, mask, causal,
+                                                block_k), q, k, v)
+    dq, dk, dv = vjp(do)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, mask=None, causal=False,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    force_reference=False):
+    """q,k,v: (B, H, S, D) raw jax arrays; mask: additive, broadcastable
+    to (B, H, S, S) but only key-mask shapes (B, 1, 1, S) are accepted by
+    the kernel path.  Returns (B, H, S, D)."""
+    b, h, s, d = q.shape
+    bh = b * h
+    qf = q.reshape(bh, s, d)
+    kf = k.reshape(bh, s, d)
+    vf = v.reshape(bh, s, d)
+    if mask is None:
+        mf = jnp.zeros((bh, s), q.dtype)
+    else:
+        if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+            mf = jnp.broadcast_to(mask[:, 0, 0, :], (b, s))
+            mf = jnp.repeat(mf, h, axis=0)
+        else:
+            force_reference = True
+            mf = None
+    use_kernel = (not force_reference and d <= 128 and
+                  s % block_q == 0 and s % block_k == 0)
+    if not use_kernel:
+        if mf is None:
+            # general mask: fall back to fused jnp with full mask
+            scale = 1.0 / math.sqrt(d)
+            sc = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale + mask
+            p = jax.nn.softmax(sc, axis=-1)
+            return jnp.einsum("bhst,bhtd->bhsd", p, v)
+        o = _blockwise_reference(qf, kf, vf, mf, causal, block_k)
+        return o.reshape(b, h, s, d)
+    o = _flash(qf, kf, vf, mf, causal, block_q, block_k)
+    return o.reshape(b, h, s, d)
+
+
+def flash_attention_op(q, k, v, mask=None, causal=False):
+    """Tensor-level autograd op (used by ops/attention.py)."""
+    from ...autograd import _op  # local import to avoid cycles
+
+    if mask is None:
+        return _op(lambda qv, kv, vv: flash_attention(qv, kv, vv,
+                                                      causal=causal),
+                   q, k, v, _name="FlashAttention")
+    return _op(lambda qv, kv, vv, mv: flash_attention(qv, kv, vv, mv,
+                                                      causal=causal),
+               q, k, v, mask, _name="FlashAttention")

@@ -1,0 +1,126 @@
+"""Fused LSTM recurrence — Pallas TPU kernel (north star: "LSTM char-RNN
+language model (cudnn_rnn → Pallas scan)").
+
+Design (the cuDNN trick, TPU-flavored):
+  * the input projection for ALL timesteps is one big MXU GEMM done
+    outside the kernel:  gx = x @ W_ih^T + b   with shape (T, B, 4H);
+  * the sequential part — h @ W_hh^T plus the gate nonlinearities —
+    runs inside ONE Pallas kernel that keeps h, c and W_hh resident in
+    VMEM across all T steps, so the recurrence never round-trips HBM
+    (the lax.scan version reloads W_hh's tile stream every step).
+
+Backward is the VJP of the lax.scan reference (identical math), so the
+kernel is a drop-in for training.  Gated: single layer, unidirectional,
+and (T·B·4H + 4H·H) floats must fit VMEM; ops/rnn.py falls back to the
+scan path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# conservative VMEM budget for inputs residing in the kernel (bytes)
+VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def fits_vmem(t, b, h, dtype_bytes=4):
+    need = (t * b * 4 * h      # gx
+            + 4 * h * h        # W_hh
+            + t * b * h        # y out
+            + 2 * b * h) * dtype_bytes
+    return need < VMEM_BUDGET
+
+
+def _lstm_kernel(gx_ref, whh_ref, h0_ref, c0_ref, y_ref, hN_ref, cN_ref):
+    """gx: (T, B, 4H); whh: (4H, H); h0/c0: (B, H); y: (T, B, H)."""
+    T = gx_ref.shape[0]
+    H = h0_ref.shape[1]
+
+    def step(t, carry):
+        h, c = carry
+        g = gx_ref[t] + jnp.dot(h, whh_ref[:].T,
+                                preferred_element_type=jnp.float32)
+        i = jax.nn.sigmoid(g[:, 0 * H:1 * H])
+        f = jax.nn.sigmoid(g[:, 1 * H:2 * H])
+        gg = jnp.tanh(g[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[:, 3 * H:4 * H])
+        c = f * c + i * gg
+        h = o * jnp.tanh(c)
+        y_ref[t] = h.astype(y_ref.dtype)
+        return (h, c)
+
+    h, c = jax.lax.fori_loop(0, T, step, (h0_ref[:], c0_ref[:]))
+    hN_ref[:] = h.astype(hN_ref.dtype)
+    cN_ref[:] = c.astype(cN_ref.dtype)
+
+
+def _pallas_recurrence(gx, w_hh, h0, c0):
+    T, B, G = gx.shape
+    H = h0.shape[1]
+    interpret = jax.default_backend() == "cpu"
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _lstm_kernel,
+        in_specs=[vmem, vmem, vmem, vmem],
+        out_specs=(vmem, vmem, vmem),
+        out_shape=(jax.ShapeDtypeStruct((T, B, H), gx.dtype),
+                   jax.ShapeDtypeStruct((B, H), gx.dtype),
+                   jax.ShapeDtypeStruct((B, H), gx.dtype)),
+        interpret=interpret,
+    )(gx, w_hh, h0, c0)
+
+
+def _scan_reference(gx, w_hh, h0, c0):
+    H = h0.shape[1]
+
+    def step(carry, g_t):
+        h, c = carry
+        g = g_t + h @ w_hh.T
+        i = jax.nn.sigmoid(g[:, 0 * H:1 * H])
+        f = jax.nn.sigmoid(g[:, 1 * H:2 * H])
+        gg = jnp.tanh(g[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[:, 3 * H:4 * H])
+        c = f * c + i * gg
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), ys = jax.lax.scan(step, (h0, c0), gx)
+    return ys, h, c
+
+
+@jax.custom_vjp
+def _lstm_recurrence(gx, w_hh, h0, c0):
+    return _pallas_recurrence(gx, w_hh, h0, c0)
+
+
+def _fwd(gx, w_hh, h0, c0):
+    out = _pallas_recurrence(gx, w_hh, h0, c0)
+    return out, (gx, w_hh, h0, c0)
+
+
+def _bwd(res, cts):
+    gx, w_hh, h0, c0 = res
+    _, vjp = jax.vjp(_scan_reference, gx, w_hh, h0, c0)
+    return vjp(cts)
+
+
+_lstm_recurrence.defvjp(_fwd, _bwd)
+
+
+def pallas_lstm(x, w_ih, w_hh, b, h0, c0, use_pallas=True):
+    """Full LSTM layer over time: x (T, B, I) -> (y (T, B, H), hN, cN).
+
+    w_ih: (4H, I), w_hh: (4H, H), b: (4H,) — the packed-handle slices
+    from ops/rnn.py (i,f,g,o gate order)."""
+    T, B, _ = x.shape
+    H = w_hh.shape[1]
+    # the parallel part: one big MXU GEMM over all timesteps
+    gx = jnp.einsum("tbi,gi->tbg", x, w_ih) + b
+    if use_pallas and fits_vmem(T, B, H):
+        return _lstm_recurrence(gx, w_hh, h0, c0)
+    return _scan_reference(gx, w_hh, h0, c0)

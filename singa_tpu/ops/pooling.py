@@ -18,8 +18,8 @@ from ..autograd import _op
 
 def pooling2d(x, kernel, stride, padding=(0, 0), is_max=True,
               pad_mode="NOTSET"):
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
+    """``padding`` is either per-dim symmetric ints or explicit (lo, hi)
+    pairs (the latter is what asymmetric ONNX pads import as)."""
     if pad_mode in ("SAME", "SAME_UPPER", "SAME_LOWER"):
         spatial = []
         for k in kernel:
@@ -28,21 +28,29 @@ def pooling2d(x, kernel, stride, padding=(0, 0), is_max=True,
             if pad_mode == "SAME_LOWER":
                 lo, hi = hi, lo
             spatial.append((lo, hi))
-        pads = ((0, 0), (0, 0)) + tuple(spatial)
     else:
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+        spatial = [tuple(p) if isinstance(p, (tuple, list)) else (p, p)
+                   for p in padding]
+    pads = ((0, 0), (0, 0)) + tuple(spatial)
+
+    # geometry rides op.params (sonnx export reads it — see autograd._op);
+    # pads_pairs carries the resolved (lo, hi) per spatial dim so export
+    # round-trips asymmetric SAME padding exactly
+    kw = dict(kernel=tuple(kernel), stride=tuple(stride),
+              pads_pairs=tuple(spatial))
 
     if is_max:
-        def f(xv):
+        def f(xv, kernel, stride, pads_pairs, pads=pads):
             return lax.reduce_window(
-                xv, -jnp.inf, lax.max, window, strides, pads)
+                xv, -jnp.inf, lax.max, (1, 1) + kernel, (1, 1) + stride, pads)
 
-        return _op(f, x, _name="MaxPool2d")
+        return _op(f, x, _name="MaxPool2d", **kw)
 
     wsize = float(np.prod(kernel))
 
-    def f(xv):
-        s = lax.reduce_window(xv, 0.0, lax.add, window, strides, pads)
+    def f(xv, kernel, stride, pads_pairs, pads=pads, wsize=wsize):
+        s = lax.reduce_window(xv, 0.0, lax.add, (1, 1) + kernel,
+                              (1, 1) + stride, pads)
         return s / wsize
 
-    return _op(f, x, _name="AvgPool2d")
+    return _op(f, x, _name="AvgPool2d", **kw)

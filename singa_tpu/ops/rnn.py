@@ -35,7 +35,7 @@ class RNNHandle:
     and the per-(layer, direction) slice offsets."""
 
     def __init__(self, input_size, hidden_size, num_layers=1, mode="lstm",
-                 bidirectional=False, dropout=0.0):
+                 bidirectional=False, dropout=0.0, use_pallas=False):
         assert mode in _GATES, f"unknown rnn mode {mode}"
         self.input_size = int(input_size)
         self.hidden_size = int(hidden_size)
@@ -44,6 +44,7 @@ class RNNHandle:
         self.bidirectional = bool(bidirectional)
         self.num_directions = 2 if bidirectional else 1
         self.dropout = float(dropout)
+        self.use_pallas = bool(use_pallas)
         self.slices = self._layout()
         self.weights_size = self._total
 
@@ -113,8 +114,13 @@ def _cell_fn(mode):
     return cell
 
 
-def _scan_direction(x, h0, c0, params, mode, reverse):
+def _scan_direction(x, h0, c0, params, mode, reverse, use_pallas=False):
     """x: (T, B, I) -> y: (T, B, H); returns (y, h_T, c_T)."""
+    if mode == "lstm" and not reverse and use_pallas:
+        from .pallas.lstm import pallas_lstm
+
+        return pallas_lstm(x, params["w_ih"], params["w_hh"],
+                           params["b_ih"] + params["b_hh"], h0, c0)
     cell = _cell_fn(mode)
     if mode == "gru":
         def f(carry, xt):
@@ -155,7 +161,8 @@ def rnn_forward(x, hx, cx, W, handle, batch_first=False):
             def f(xv, hv, cv, wv, l=l, d=d, idx=idx):
                 params = handle.unpack(wv, l, d)
                 y, hT, cT = _scan_direction(
-                    xv, hv[idx], cv[idx], params, mode, reverse=(d == 1))
+                    xv, hv[idx], cv[idx], params, mode, reverse=(d == 1),
+                    use_pallas=handle.use_pallas)
                 return y, hT, cT
 
             y, hT, cT = _Func(fn=f, name=f"RNN[l{l}d{d}]")(inp, hx, cx, W)
@@ -183,7 +190,8 @@ class _BaseRNN(Layer):
     mode = "vanilla_tanh"
 
     def __init__(self, hidden_size, num_layers=1, bidirectional=False,
-                 dropout=0.0, batch_first=False, return_sequences=True):
+                 dropout=0.0, batch_first=False, return_sequences=True,
+                 use_pallas=False):
         super().__init__()
         self.hidden_size = int(hidden_size)
         self.num_layers = int(num_layers)
@@ -191,13 +199,14 @@ class _BaseRNN(Layer):
         self.dropout = float(dropout)
         self.batch_first = bool(batch_first)
         self.return_sequences = return_sequences
+        self.use_pallas = bool(use_pallas)
         self.handle = None
 
     def initialize(self, x, hx=None, cx=None):
         input_size = x.shape[-1]
         self.handle = RNNHandle(
             input_size, self.hidden_size, self.num_layers, self.mode,
-            self.bidirectional, self.dropout)
+            self.bidirectional, self.dropout, use_pallas=self.use_pallas)
         self.W = self.handle.init_weights(x.device, x.data.dtype)
 
     def _zero_state(self, x):

@@ -58,9 +58,9 @@ def is_tracing(x) -> bool:
 # eager path sees the FULL global batch on one device, so the correct
 # "collective" is the world-1 identity — an eager DistOpt step is exact
 # single-device training, and the parallelism only exists inside the
-# compiled (use_graph=True) step.  This also lets graph mode's first
-# warm-up iteration (which runs eagerly, like the reference's
-# build-while-run first graph iteration) execute DistOpt code unchanged.
+# compiled (use_graph=True) step.  The same world-1 path serves graph
+# mode's abstract eval_shape warm-up probe (model._materialize_state),
+# where no mesh axis is bound.
 
 
 class Communicator:

@@ -1,0 +1,85 @@
+"""Ring attention — sequence/context parallelism over the mesh.
+
+The reference has NO long-context machinery (SURVEY.md §5.7: max sequence
+length is bounded by single-device memory).  This module is the TPU-native
+extension point the survey calls for: shard the sequence axis over a mesh
+('seq') axis, keep Q resident per chip, and rotate K/V blocks around the
+ICI ring with ``lax.ppermute`` while an online softmax accumulates — peak
+memory per chip is O(S_local · D) and the K/V transfers overlap with the
+per-block attention compute (XLA's latency-hiding scheduler pipelines the
+permute with the einsum).
+
+Use ``ring_self_attention`` inside an existing ``shard_map`` (arrays are
+per-rank blocks), or ``ring_attention_sharded`` to run over global arrays
+on a mesh directly.  Differentiable end-to-end (scan + ppermute have
+exact VJPs), so it serves training, not just inference.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_self_attention(q, k, v, axis_name, causal=False):
+    """Per-rank blocks inside shard_map: q,k,v (B, H, S_local, D).
+    Returns (B, H, S_local, D) — the attention of local queries against
+    the FULL (globally sharded) key/value sequence."""
+    axis_size = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qs = q * scale
+
+    q_pos = rank * s_loc + jnp.arange(s_loc)  # global positions (S_local,)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, t):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        # the K/V block currently held arrived from rank (rank - t) mod W
+        src = (rank - t) % axis_size
+        sc = jnp.einsum("bhsd,bhtd->bhst", qs, k_cur)
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            sc = jnp.where(mask[None, None], sc, NEG_INF)
+        m_cur = jnp.max(sc, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(sc - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhst,bhtd->bhsd", p, v_cur)
+        # rotate K/V one hop around the ICI ring
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m_new, l_new, k_next, v_next), None
+
+    init = (jnp.zeros((b, h, s_loc, d), jnp.float32),
+            jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s_loc), jnp.float32),
+            k, v)
+    (acc, m, l, _, _), _ = lax.scan(step, init, jnp.arange(axis_size))
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh=None, axis_name="seq",
+                           causal=False):
+    """Global arrays (B, H, S, D) with S sharded over ``axis_name``."""
+    if mesh is None:
+        devices = jax.devices()
+        mesh = Mesh(__import__("numpy").asarray(devices), (axis_name,))
+    spec = P(None, None, axis_name, None)
+
+    f = jax.shard_map(
+        lambda q_, k_, v_: ring_self_attention(q_, k_, v_, axis_name,
+                                               causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)

@@ -1,0 +1,84 @@
+"""``Snapshot`` key->tensor store (reference: ``src/io/snapshot.cc`` +
+``python/singa/snapshot.py``, unverified — SURVEY.md §3.5): the low-level
+checkpoint container under ``Model.save_states``.
+
+Storage is the native BinFile record store (native/singa_io.cpp via
+io/binfile.py) — each record is a small numpy header + raw buffer.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from . import tensor
+from .io.binfile import BinFileReader, BinFileWriter
+from .tensor import Tensor
+
+
+def _encode(arr: np.ndarray) -> bytes:
+    meta = json.dumps({"dtype": str(arr.dtype),
+                       "shape": list(arr.shape)}).encode()
+    return struct.pack("<I", len(meta)) + meta + \
+        np.ascontiguousarray(arr).tobytes()
+
+
+def _decode(blob: bytes) -> np.ndarray:
+    (mlen,) = struct.unpack("<I", blob[:4])
+    meta = json.loads(blob[4:4 + mlen].decode())
+    return np.frombuffer(blob[4 + mlen:], dtype=meta["dtype"]).reshape(
+        meta["shape"]).copy()
+
+
+class Snapshot:
+    """API parity with the reference: ``Snapshot(path, mode)`` where mode
+    is Snapshot.kWrite / Snapshot.kRead; ``write(key, tensor)``,
+    ``read()`` -> {key: Tensor}."""
+
+    kRead = 0
+    kWrite = 1
+
+    def __init__(self, path, mode=1, buffer_size=None, max_param_size=None):
+        self.path = path if path.endswith(".bin") else path + ".bin"
+        self.mode = mode
+        if mode == Snapshot.kWrite:
+            self._writer = BinFileWriter(self.path)
+            self._reader = None
+        else:
+            self._reader = BinFileReader(self.path)
+            self._writer = None
+
+    def write(self, key, t):
+        assert self._writer is not None, "snapshot opened for reading"
+        arr = tensor.to_numpy(t) if isinstance(t, Tensor) else np.asarray(t)
+        self._writer.put(key, _encode(arr))
+
+    # reference alias
+    Write = write
+
+    def read(self) -> dict:
+        assert self._reader is not None, "snapshot opened for writing"
+        return {k: tensor.from_numpy(_decode(v))
+                for k, v in self._reader.items()}
+
+    Read = read
+
+    def read_numpy(self) -> dict:
+        assert self._reader is not None
+        return {k: _decode(v) for k, v in self._reader.items()}
+
+    def done(self):
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+        if self._reader:
+            self._reader.close()
+            self._reader = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.done()

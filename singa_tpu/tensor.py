@@ -236,9 +236,16 @@ class Tensor:
         return self.copy_data(t)
 
     def clone(self):
+        """Deep copy (reference Tensor::Clone copies the buffer).  The
+        copy matters: graph-mode steps donate their state buffers to XLA,
+        so an aliased buffer would be invalidated by the donor's next
+        step."""
+        data = self.data
+        if not _is_tracing(data):
+            data = jnp.array(data, copy=True)
         t = Tensor(
             device=self.device,
-            data=self.data,
+            data=data,
             requires_grad=self.requires_grad,
             stores_grad=self.stores_grad,
         )

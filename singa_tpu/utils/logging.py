@@ -1,0 +1,71 @@
+"""Logging channels (reference: include/singa/utils/logging.h glog-style
+LOG/CHECK + src/utils/channel.cc named channels teeing to file/stderr,
+unverified — SURVEY.md §5.5)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_channels = {}
+_channel_dir = None
+_stderr_default = True
+
+
+def init_channel(argv0="singa_tpu", dir="", stderr=True):
+    """Reference: InitChannel — set the channel output directory."""
+    global _channel_dir, _stderr_default
+    _channel_dir = dir or None
+    _stderr_default = stderr
+    if _channel_dir:
+        os.makedirs(_channel_dir, exist_ok=True)
+
+
+def get_channel(name="global") -> logging.Logger:
+    """Named channel; logs to <dir>/<name>.log and/or stderr."""
+    if name in _channels:
+        return _channels[name]
+    logger = logging.getLogger(f"singa_tpu.{name}")
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    fmt = logging.Formatter(
+        "[%(asctime)s %(levelname).1s %(name)s] %(message)s", "%H:%M:%S")
+    if _stderr_default:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(fmt)
+        logger.addHandler(h)
+    if _channel_dir:
+        fh = logging.FileHandler(os.path.join(_channel_dir, f"{name}.log"))
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    if not logger.handlers:
+        logger.addHandler(logging.NullHandler())
+    _channels[name] = logger
+    return logger
+
+
+# glog-style checks (reference: CHECK/CHECK_EQ/... macros)
+def CHECK(cond, msg=""):
+    if not cond:
+        raise AssertionError(f"CHECK failed: {msg}")
+
+
+def CHECK_EQ(a, b, msg=""):
+    if a != b:
+        raise AssertionError(f"CHECK_EQ failed: {a!r} != {b!r} {msg}")
+
+
+def CHECK_GT(a, b, msg=""):
+    if not a > b:
+        raise AssertionError(f"CHECK_GT failed: {a!r} <= {b!r} {msg}")
+
+
+def CHECK_GE(a, b, msg=""):
+    if not a >= b:
+        raise AssertionError(f"CHECK_GE failed: {a!r} < {b!r} {msg}")
+
+
+def LOG(level="INFO", *args):
+    get_channel().log(getattr(logging, level, logging.INFO),
+                      " ".join(str(a) for a in args))

@@ -1,0 +1,61 @@
+"""Training metrics (SURVEY.md §5.5: step timer, samples/sec/chip — the
+BASELINE metric — and scaling-efficiency calculator; the reference
+computes these inline in example scripts)."""
+
+from __future__ import annotations
+
+import time
+
+
+class StepTimer:
+    """Tracks per-step wall time with warmup skipping (compile steps)."""
+
+    def __init__(self, skip_first=2):
+        self.skip_first = skip_first
+        self.times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        assert self._t0 is not None
+        self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+    @property
+    def steady(self):
+        return self.times[self.skip_first:] or self.times
+
+    def mean_step_seconds(self) -> float:
+        s = self.steady
+        return sum(s) / len(s) if s else float("nan")
+
+    def samples_per_sec(self, batch_size) -> float:
+        return batch_size / self.mean_step_seconds()
+
+    def samples_per_sec_per_chip(self, batch_size, num_chips=1) -> float:
+        return self.samples_per_sec(batch_size) / num_chips
+
+
+def scaling_efficiency(throughput_n_chips, throughput_1_chip, n_chips):
+    """(global throughput on n chips) / (n * single-chip throughput) —
+    the BASELINE.json >=90% target for DistOpt over ICI."""
+    return throughput_n_chips / (n_chips * throughput_1_chip)
+
+
+def accuracy(logits, labels):
+    import numpy as np
+
+    from .. import tensor
+
+    p = tensor.to_numpy(logits) if not isinstance(logits, np.ndarray) else logits
+    t = tensor.to_numpy(labels) if not isinstance(labels, np.ndarray) else labels
+    return float((p.argmax(-1) == t).mean())

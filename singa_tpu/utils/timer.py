@@ -1,0 +1,23 @@
+"""Timer (reference: include/singa/utils/timer.h, unverified)."""
+
+import time
+
+
+class Timer:
+    """t = Timer(); ...; t.elapsed() -> seconds.  Also a context manager."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def __enter__(self):
+        self.reset()
+        return self
+
+    def __exit__(self, *a):
+        self.seconds = self.elapsed()

@@ -1,0 +1,120 @@
+"""Attention tests: fused path, flash kernel (interpreter on CPU), ring
+attention on the 8-device mesh vs single-device reference."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from singa_tpu import autograd, opt, tensor
+from singa_tpu import device as device_module
+from singa_tpu.ops.attention import scaled_dot_product_attention
+from singa_tpu.ops.pallas.flash_attention import flash_attention
+from singa_tpu.parallel.ring_attention import ring_attention_sharded
+
+N_DEV = len(jax.devices())
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+def _ref(q, k, v, mask=None):
+    d = q.shape[-1]
+    sc = jnp.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(d)
+    if mask is not None:
+        sc = sc + mask
+    return jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(sc, -1), v)
+
+
+def _qkv(b=2, h=2, s=256, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+                 for _ in range(3))
+
+
+def test_flash_forward_matches_reference():
+    q, k, v = _qkv()
+    mask = np.zeros((2, 1, 1, 256), np.float32)
+    mask[:, :, :, 200:] = -1e9
+    o = flash_attention(q, k, v, jnp.asarray(mask))
+    r = _ref(q, k, v, jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=2e-3)
+
+
+def test_flash_causal_matches_reference():
+    q, k, v = _qkv(s=128)
+    o = flash_attention(q, k, v, causal=True)
+    cm = jnp.where(jnp.arange(128)[:, None] >= jnp.arange(128)[None, :],
+                   0.0, -1e30)[None, None]
+    r = _ref(q, k, v, cm)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=2e-3)
+
+
+def test_flash_gradients_match_reference():
+    q, k, v = _qkv(s=128)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref(q, k, v) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+
+def test_sdpa_op_taped(dev):
+    autograd.set_training(True)
+    try:
+        rng = np.random.RandomState(0)
+        mk = lambda: tensor.from_numpy(  # noqa: E731
+            rng.randn(1, 2, 8, 4).astype(np.float32), dev)
+        q, k, v = mk(), mk(), mk()
+        q.requires_grad = q.stores_grad = True
+        out = scaled_dot_product_attention(q, k, v)
+        loss = autograd.reduce_sum(autograd.mul(out, out))
+        grads = dict(autograd.backward(loss))
+        assert q in grads
+        assert grads[q].shape == q.shape
+    finally:
+        autograd.set_training(False)
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_ring_attention_matches_single_device():
+    s = 16 * N_DEV
+    q, k, v = _qkv(b=1, h=2, s=s, d=16, seed=3)
+    o_ring = ring_attention_sharded(q, k, v)
+    o_ref = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(o_ring), np.asarray(o_ref),
+                               atol=2e-4)
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_ring_attention_causal_matches():
+    s = 8 * N_DEV
+    q, k, v = _qkv(b=1, h=1, s=s, d=8, seed=4)
+    o_ring = ring_attention_sharded(q, k, v, causal=True)
+    cm = jnp.where(jnp.arange(s)[:, None] >= jnp.arange(s)[None, :],
+                   0.0, -1e30)[None, None]
+    np.testing.assert_allclose(np.asarray(o_ring), np.asarray(_ref(q, k, v, cm)),
+                               atol=2e-4)
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_ring_attention_differentiable():
+    s = 8 * N_DEV
+    q, k, v = _qkv(b=1, h=1, s=s, d=8, seed=5)
+    g_ring = jax.grad(lambda q: jnp.sum(ring_attention_sharded(q, k, v) ** 2))(q)
+    g_ref = jax.grad(lambda q: jnp.sum(_ref(q, k, v) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               atol=5e-4)

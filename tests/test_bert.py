@@ -1,0 +1,67 @@
+"""BERT model tests (config #4 workload)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import opt, tensor
+from singa_tpu import device as device_module
+from singa_tpu.models.bert import BertConfig, BertForMaskedLM, BertModel
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+def _batch(dev, cfg, b=2, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+    return (tensor.from_numpy(ids, dev), tensor.from_numpy(labels, dev))
+
+
+def test_bert_tiny_forward_shapes(dev):
+    cfg = BertConfig.tiny()
+    m = BertModel(cfg)
+    ids, _ = _batch(dev, cfg)
+    m.eval()
+    seq, pooled = m(ids)
+    assert seq.shape == (2, 16, cfg.hidden_size)
+    assert pooled.shape == (2, cfg.hidden_size)
+
+
+def test_bert_attention_mask_changes_output(dev):
+    cfg = BertConfig.tiny(hidden_dropout=0.0, attn_dropout=0.0)
+    m = BertModel(cfg)
+    ids, _ = _batch(dev, cfg)
+    m.eval()
+    seq_nomask, _ = m(ids)
+    mask = np.ones((2, 16), np.float32)
+    mask[:, 8:] = 0.0
+    seq_masked, _ = m(ids, attention_mask=tensor.from_numpy(mask, dev))
+    # masking the second half must change the first half's outputs
+    a = tensor.to_numpy(seq_nomask)[:, :8]
+    b = tensor.to_numpy(seq_masked)[:, :8]
+    assert not np.allclose(a, b)
+
+
+def test_bert_mlm_trains_graph_mode(dev):
+    cfg = BertConfig.tiny(hidden_dropout=0.0, attn_dropout=0.0)
+    m = BertForMaskedLM(cfg)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    ids, labels = _batch(dev, cfg, b=4, s=12)
+    m.compile([ids], is_train=True, use_graph=True)
+    losses = [float(m(ids, labels)[1].data) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_base_param_count(dev):
+    cfg = BertConfig.base()
+    m = BertForMaskedLM(cfg)
+    ids, _ = _batch(dev, cfg, b=1, s=8)
+    m.compile([ids], is_train=False, use_graph=False)
+    n = sum(int(np.prod(v.shape)) for v in m.bert.get_params().values())
+    # BERT-base trunk: ~109.48M params (embeddings + 12 layers + pooler)
+    assert abs(n - 109_482_240) / 109_482_240 < 0.01, n

@@ -1,0 +1,175 @@
+"""sonnx ONNX import/export tests (reference: test/python/test_onnx.py +
+test_onnx_backend.py, unverified)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, opt, sonnx, tensor
+from singa_tpu import device as device_module
+from singa_tpu.io import onnx_pb
+from singa_tpu.models.mlp import MLP
+from singa_tpu.models.cnn import CNN
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+def test_onnx_pb_roundtrip():
+    """Wire-format serialize -> parse identity for every message type."""
+    w = onnx_pb.TensorProto.from_numpy(
+        np.arange(12, dtype=np.float32).reshape(3, 4), "w")
+    node = onnx_pb.NodeProto(
+        op_type="Gemm", name="g0", input=["x", "w"], output=["y"],
+        attribute=[onnx_pb.AttributeProto.make("alpha", 2.0),
+                   onnx_pb.AttributeProto.make("transB", 1),
+                   onnx_pb.AttributeProto.make("pads", [1, 2, 1, 2]),
+                   onnx_pb.AttributeProto.make("mode", "test")])
+    g = onnx_pb.GraphProto(
+        name="g", node=[node], initializer=[w],
+        input=[onnx_pb.ValueInfoProto("x", onnx_pb.FLOAT, [2, 3])],
+        output=[onnx_pb.ValueInfoProto("y", onnx_pb.FLOAT, [2, 4])])
+    m = onnx_pb.ModelProto(graph=g)
+    blob = m.serialize()
+    m2 = onnx_pb.ModelProto.parse(blob)
+    assert m2.producer_name == "singa_tpu"
+    n2 = m2.graph.node[0]
+    assert n2.op_type == "Gemm" and n2.input == ["x", "w"]
+    a = n2.attrs()
+    assert a["alpha"] == pytest.approx(2.0)
+    assert a["transB"] == 1
+    assert a["pads"] == [1, 2, 1, 2]
+    assert a["mode"] == "test"
+    np.testing.assert_array_equal(
+        m2.graph.initializer[0].to_numpy(),
+        np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert m2.graph.input[0].shape == [2, 3]
+
+
+def test_mlp_export_import_roundtrip(dev, tmp_path):
+    m = MLP(data_size=6, perceptron_size=8, num_classes=3)
+    x = tensor.from_numpy(np.random.RandomState(0).randn(4, 6).astype(np.float32), dev)
+    m.compile([x], is_train=False, use_graph=False)
+    native = tensor.to_numpy(m.forward(x))
+
+    proto = sonnx.to_onnx(m, [x])
+    path = str(tmp_path / "mlp.onnx")
+    sonnx.save(proto, path)
+
+    rep = sonnx.prepare(path, dev)
+    (out,) = rep.run([x])
+    np.testing.assert_allclose(tensor.to_numpy(out), native, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_cnn_export_import_roundtrip(dev, tmp_path):
+    m = CNN(num_classes=10, num_channels=1)
+    x = tensor.from_numpy(
+        np.random.RandomState(1).randn(2, 1, 28, 28).astype(np.float32), dev)
+    m.compile([x], is_train=False, use_graph=False)
+    native = tensor.to_numpy(m.forward(x))
+
+    proto = sonnx.to_onnx(m, [x])
+    path = str(tmp_path / "cnn.onnx")
+    sonnx.save(proto, path)
+    rep = sonnx.prepare(path, dev)
+    (out,) = rep.run([x])
+    np.testing.assert_allclose(tensor.to_numpy(out), native, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_imported_model_is_trainable(dev, tmp_path):
+    """SONNXModel: import an exported MLP and train it (reference
+    SONNXModel semantics — imported graphs are differentiable)."""
+    m = MLP(data_size=6, perceptron_size=8, num_classes=3)
+    x = tensor.from_numpy(np.random.RandomState(0).randn(16, 6).astype(np.float32), dev)
+    y = tensor.from_numpy(np.random.RandomState(0).randint(0, 3, (16,)).astype(np.int32), dev)
+    m.compile([x], is_train=False, use_graph=False)
+    proto = sonnx.to_onnx(m, [x])
+
+    class Trainable(sonnx.SONNXModel):
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = autograd.softmax_cross_entropy(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    tm = Trainable(proto, dev)
+    tm.set_optimizer(opt.SGD(lr=0.1))
+    tm.train(True)
+    losses = [float(tm.train_one_batch(x, y)[1].data) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_unsupported_op_reports_name(dev):
+    g = onnx_pb.GraphProto(
+        name="g",
+        node=[onnx_pb.NodeProto(op_type="FancyOp", input=["x"], output=["y"])],
+        input=[onnx_pb.ValueInfoProto("x", onnx_pb.FLOAT, [1])],
+        output=[onnx_pb.ValueInfoProto("y", onnx_pb.FLOAT, [1])])
+    rep = sonnx.prepare(onnx_pb.ModelProto(graph=g), dev)
+    with pytest.raises(NotImplementedError, match="FancyOp"):
+        rep.run([np.zeros((1,), np.float32)])
+
+
+def test_handlers_cover_bert_oplist():
+    """Ops appearing in a standard BERT-base ONNX graph must all have
+    handlers."""
+    bert_ops = ["Add", "Cast", "Concat", "Constant", "ConstantOfShape",
+                "Div", "Erf", "Gather", "Identity", "MatMul", "Mul",
+                "Pow", "ReduceMean", "Reshape", "Shape", "Slice",
+                "Softmax", "Sqrt", "Sub", "Tanh", "Transpose",
+                "Unsqueeze", "Where", "Expand", "Equal",
+                "LayerNormalization", "Gemm"]
+    missing = [o for o in bert_ops if o not in sonnx._ONNX_OPS]
+    assert not missing, missing
+
+
+def test_layernorm_export_preserves_eps(dev, tmp_path):
+    """Exported LayerNormalization must carry epsilon/axis attributes and
+    import back with the same numerics."""
+    from singa_tpu import layer, model
+
+    class M(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.ln = layer.LayerNorm(eps=1e-12)
+
+        def forward(self, x):
+            return self.ln(x)
+
+    m = M()
+    x = tensor.from_numpy(
+        np.random.RandomState(0).randn(2, 8).astype(np.float32) * 100, dev)
+    m.compile([x], is_train=False, use_graph=False)
+    native = tensor.to_numpy(m.forward(x))
+    proto = sonnx.to_onnx(m, [x])
+    ln_nodes = [n for n in proto.graph.node
+                if n.op_type == "LayerNormalization"]
+    assert ln_nodes and ln_nodes[0].attrs()["epsilon"] == pytest.approx(1e-12)
+    rep = sonnx.prepare(proto, dev)
+    (out,) = rep.run([x])
+    np.testing.assert_allclose(tensor.to_numpy(out), native, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_same_pool_export_roundtrip(dev, tmp_path):
+    """SAME pooling with asymmetric effective pads must round-trip."""
+    from singa_tpu import autograd as ag
+    from singa_tpu.ops import pooling as pool_ops
+
+    x_np = np.random.RandomState(2).randn(1, 1, 5, 5).astype(np.float32)
+    x = tensor.from_numpy(x_np, dev)
+    ag.set_training(True)
+    try:
+        y = pool_ops.pooling2d(x, kernel=(2, 2), stride=(2, 2),
+                               is_max=True, pad_mode="SAME_UPPER")
+        assert y.shape == (1, 1, 3, 3)
+        op = y.creator
+        pairs = op.params["pads_pairs"]
+        assert pairs == ((0, 1), (0, 1))
+    finally:
+        ag.set_training(False)
