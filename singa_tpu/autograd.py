@@ -96,9 +96,14 @@ class Operation:
         dev = xs[0].device if xs else get_default_device()
         if training:
             self.y_id2idx = {id(y): i for i, y in enumerate(ys)}
+            # creator is recorded unconditionally while training (the
+            # reference tapes every op; requires_grad only gates gradient
+            # flow).  This keeps export (sonnx frontend creator-walk)
+            # working for grad-free graphs; backward() already stops at
+            # src edges whose op has requires_grad=False.
             outs = tuple(
                 Tensor(device=dev, data=y, requires_grad=self.requires_grad,
-                       creator=self if self.requires_grad else None)
+                       creator=self)
                 for y in ys
             )
         else:
@@ -161,8 +166,8 @@ def backward(y, dy=None):
     generator contract consumed by ``opt.DistOpt`` (SURVEY.md §3.3).
     """
     assert isinstance(y, Tensor), "backward target must be a Tensor"
-    if y.creator is None:
-        return
+    if y.creator is None or not y.creator.requires_grad:
+        return  # no grad flows anywhere (creator taped only for export)
     if dy is None:
         dy = jnp.ones(y.shape, dtype=y.data.dtype)
     else:
@@ -233,7 +238,11 @@ class _Func(Operation):
             g = lambda *a: f(*a, **p)  # noqa: E731
         else:
             g = f
-        if training:
+        # vjp residuals pin input activations in device memory, so only
+        # pay for them when some input actually requires grad (the tape
+        # still records the op for export; backward() never descends
+        # into requires_grad=False ops).
+        if training and self.requires_grad:
             y, self.grad_fn = jax.vjp(g, *xs)
             # remember multi-output avals so unconsumed outputs can get
             # zero cotangents in backward

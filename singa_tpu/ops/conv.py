@@ -15,29 +15,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..autograd import _op
-
-
-def _resolve_padding(pad_mode, padding, kernel, dilation):
-    if pad_mode in ("SAME_UPPER", "SAME_LOWER", "SAME"):
-        pads = []
-        for k, d in zip(kernel, dilation):
-            eff = d * (k - 1)
-            lo = eff // 2
-            hi = eff - lo
-            if pad_mode == "SAME_LOWER":
-                lo, hi = hi, lo
-            pads.append((lo, hi))
-        return tuple(pads)
-    if pad_mode == "VALID":
-        return ((0, 0), (0, 0))
-    return tuple((p, p) for p in padding)
+from .padding import resolve as _resolve_padding
 
 
 def conv2d(x, W, b=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
            group=1, pad_mode="NOTSET"):
-    """NCHW conv; W is OIHW (O = out channels, I = in/group)."""
+    """NCHW conv; W is OIHW (O = out channels, I = in/group).
+
+    ``padding`` accepts per-dim symmetric ints or explicit (lo, hi)
+    pairs (asymmetric ONNX pads import as the latter); SAME modes are
+    resolved ONNX-style from input size + stride (ops/padding.py).
+    """
     kernel = W.shape[2:]
-    pads = _resolve_padding(pad_mode, padding, kernel, dilation)
+    pads = _resolve_padding(pad_mode, padding, x.shape[2:], kernel,
+                            stride, dilation)
 
     def f(xv, wv, *rest, stride=tuple(stride), pads=pads,
           dilation=tuple(dilation), group=int(group)):

@@ -14,23 +14,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..autograd import _op
+from .padding import resolve as _resolve_padding
 
 
 def pooling2d(x, kernel, stride, padding=(0, 0), is_max=True,
               pad_mode="NOTSET"):
     """``padding`` is either per-dim symmetric ints or explicit (lo, hi)
-    pairs (the latter is what asymmetric ONNX pads import as)."""
-    if pad_mode in ("SAME", "SAME_UPPER", "SAME_LOWER"):
-        spatial = []
-        for k in kernel:
-            lo = (k - 1) // 2
-            hi = (k - 1) - lo
-            if pad_mode == "SAME_LOWER":
-                lo, hi = hi, lo
-            spatial.append((lo, hi))
-    else:
-        spatial = [tuple(p) if isinstance(p, (tuple, list)) else (p, p)
-                   for p in padding]
+    pairs (the latter is what asymmetric ONNX pads import as); SAME
+    modes are resolved ONNX-style from input size + stride."""
+    spatial = _resolve_padding(pad_mode, padding, x.shape[2:], kernel,
+                               stride)
     pads = ((0, 0), (0, 0)) + tuple(spatial)
 
     # geometry rides op.params (sonnx export reads it — see autograd._op);

@@ -13,6 +13,8 @@ ordinary singa_tpu autograd ops, so a prepared model can be wrapped in
 
 from __future__ import annotations
 
+import contextvars
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -31,6 +33,20 @@ from .autograd import _op
 
 def _np(t):
     return tensor.to_numpy(t) if isinstance(t, Tensor) else np.asarray(t)
+
+
+# device of the SingaRep currently executing run() — consulted by handlers
+# that materialize new tensors (Constant/Shape/Range/...), so imported
+# graphs run wholly on the rep's device (ADVICE r01: from_numpy without a
+# device committed constants to the default CPU device and broke jitted
+# TPU execution).  A ContextVar so concurrent run()s on different
+# threads each see their own rep's device.
+_REP_DEVICE = contextvars.ContextVar("sonnx_rep_device", default=None)
+
+
+def _rep_device():
+    d = _REP_DEVICE.get()
+    return d if d is not None else get_default_device()
 
 
 class SingaRep:
@@ -65,18 +81,24 @@ class SingaRep:
             for k, v in zip(graph_inputs, inputs):
                 env[k] = v if isinstance(v, Tensor) else \
                     tensor.from_numpy(np.asarray(v), self.device)
-        for node in self.graph.node:
-            handler = _ONNX_OPS.get(node.op_type)
-            if handler is None:
-                raise NotImplementedError(
-                    f"ONNX op {node.op_type!r} is not supported by sonnx")
-            args = [env[i] if i else None for i in node.input]
-            outs = handler(node, args)
-            if not isinstance(outs, (list, tuple)):
-                outs = [outs]
-            for name, out in zip(node.output, outs):
-                if name:
-                    env[name] = out
+        # constants created by handlers land on the rep's device, not
+        # the default
+        token = _REP_DEVICE.set(self.device)
+        try:
+            for node in self.graph.node:
+                handler = _ONNX_OPS.get(node.op_type)
+                if handler is None:
+                    raise NotImplementedError(
+                        f"ONNX op {node.op_type!r} is not supported by sonnx")
+                args = [env[i] if i else None for i in node.input]
+                outs = handler(node, args)
+                if not isinstance(outs, (list, tuple)):
+                    outs = [outs]
+                for name, out in zip(node.output, outs):
+                    if name:
+                        env[name] = out
+        finally:
+            _REP_DEVICE.reset(token)
         return [env[n] for n in self.output_names]
 
 
@@ -168,11 +190,11 @@ def _h_conv(node, args):
     dil = a.get("dilations", [1] * len(kernel))
     group = a.get("group", 1)
     auto_pad = a.get("auto_pad", "NOTSET")
-    assert pads[:len(kernel)] == pads[len(kernel):], \
-        "asymmetric ONNX pads unsupported"
+    n = len(kernel)
+    pairs = tuple((pads[i], pads[i + n]) for i in range(n))
     return conv_ops.conv2d(args[0], args[1],
                            args[2] if len(args) > 2 else None,
-                           stride=tuple(strides), padding=tuple(pads[:2]),
+                           stride=tuple(strides), padding=pairs,
                            dilation=tuple(dil), group=group,
                            pad_mode=auto_pad)
 
@@ -321,8 +343,7 @@ def _h_reduce(fn):
 def _h_constant(node, args):
     t = node.attrs()["value"]
     arr = t.to_numpy()
-    out = tensor.from_numpy(arr)
-    return out
+    return tensor.from_numpy(arr, _rep_device())
 
 
 def _h_constant_of_shape(node, args):
@@ -330,11 +351,12 @@ def _h_constant_of_shape(node, args):
     value = node.attrs().get("value")
     fill = value.to_numpy().reshape(-1)[0] if value is not None else 0.0
     arr = np.full(shape, fill)
-    return tensor.from_numpy(arr)
+    return tensor.from_numpy(arr, _rep_device())
 
 
 def _h_shape(node, args):
-    return tensor.from_numpy(np.asarray(args[0].shape, np.int64))
+    return tensor.from_numpy(np.asarray(args[0].shape, np.int64),
+                             _rep_device())
 
 
 def _h_expand(node, args):
@@ -379,7 +401,7 @@ def _h_onehot(node, args):
 
 def _h_range(node, args):
     start, limit, delta = (float(_np(a).reshape(-1)[0]) for a in args[:3])
-    return tensor.from_numpy(np.arange(start, limit, delta))
+    return tensor.from_numpy(np.arange(start, limit, delta), _rep_device())
 
 
 def _h_tile(node, args):
@@ -394,9 +416,34 @@ def _h_pad(node, args):
         pads = _static_ints(args[1])
     n = len(pads) // 2
     pad_width = tuple((pads[i], pads[i + n]) for i in range(n))
-    value = a.get("value", 0.0)
-    return _op(lambda x: jnp.pad(x, pad_width, constant_values=value),
-               args[0], _name="Pad")
+    mode = a.get("mode", "constant")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    # negative pads are legal ONNX (crop that edge): apply them as a
+    # slice, keep only non-negative widths for jnp.pad
+    pos = tuple((max(lo, 0), max(hi, 0)) for lo, hi in pad_width)
+    crop = tuple(
+        slice(-lo if lo < 0 else None, hi if hi < 0 else None)
+        for lo, hi in pad_width)
+    has_neg = any(lo < 0 or hi < 0 for lo, hi in pad_width)
+
+    def apply(x, padder):
+        if has_neg:
+            x = x[crop]
+        return padder(x)
+
+    if mode == "constant":
+        # opset>=11 carries the pad value as the third input; earlier
+        # opsets as the 'value' attribute.
+        value = a.get("value", 0.0)
+        if len(args) > 2 and args[2] is not None:
+            value = float(_np(args[2]).reshape(-1)[0])
+        return _op(lambda x: apply(x, lambda v: jnp.pad(
+            v, pos, constant_values=value)), args[0], _name="Pad")
+    if mode in ("reflect", "edge"):
+        return _op(lambda x: apply(x, lambda v: jnp.pad(v, pos, mode=mode)),
+                   args[0], _name="Pad")
+    raise NotImplementedError(f"ONNX Pad mode {mode!r} is not supported")
 
 
 def _h_global_avg_pool(node, args):

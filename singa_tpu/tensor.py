@@ -168,7 +168,10 @@ class Tensor:
         return _wrap(jnp.squeeze(self.data, axis), self.device)
 
     def reset_like(self, t: "Tensor"):
-        self.data = jnp.zeros(t.shape, dtype=t.data.dtype)
+        z = jnp.zeros(t.shape, dtype=t.data.dtype)
+        if not _is_tracing(z):
+            z = jax.device_put(z, self.device.jax_device)
+        self.data = z
         return self
 
     def as_type(self, dtype):
@@ -186,7 +189,10 @@ class Tensor:
         return self
 
     def to_host(self):
-        return self.to_device(device_module.get_default_device())
+        """Move to host CPU (reference Tensor::ToHost) — explicitly a
+        CppCPU, not the mutable default device, which may itself be an
+        accelerator after set_default_device(tpu)."""
+        return self.to_device(device_module.CppCPU())
 
     # -- fills / random ----------------------------------------------------
     def set_value(self, x, inplace=True):
