@@ -1,15 +1,19 @@
 """Benchmark harness — prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Primary metric (BASELINE.json): ResNet-50 train throughput,
 samples/sec/chip, measured on the real attached chip with the full
 singa_tpu training step (graph mode: forward + backward + SGD update in
-one donated jit executable).
+one donated jit executable), bf16 mixed precision (amp policy — fp32
+master params, bf16 MXU compute).  The same line carries the second
+BASELINE workload (BERT-base masked-LM train throughput, S=512) and
+model-FLOPs-utilization (MFU) for both, computed from the compiled
+step's XLA cost analysis against the chip's bf16 peak.
 
 ``vs_baseline``: BASELINE.json.published is empty (no retrievable
 reference numbers — see BASELINE.md provenance), so the ratio is
-against the round-1 recorded value in BENCH_BASELINE.json once it
-exists; 1.0 on the first recording.
+against the round-1 recorded value in BENCH_BASELINE.json (ResNet-50,
+fp32, batch 32: 1052.2 samples/s/chip).
 """
 
 import json
@@ -19,39 +23,129 @@ import time
 
 import numpy as np
 
+# bf16 peak matmul throughput per chip, by device_kind substring
+_PEAK_BF16 = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v4", 275e12), ("v6", 918e12),
+]
 
-def bench_resnet50(batch=32, hw=224, iters=20, warmup=None):
-    from singa_tpu import device, opt, tensor
-    from singa_tpu.models.resnet import resnet50
 
-    dev = device.create_tpu_device(0)
-    dev.SetRandSeed(0)
-    m = resnet50(num_classes=1000)
-    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+def _peak_flops():
+    import jax
 
-    rng = np.random.RandomState(0)
-    x = tensor.from_numpy(rng.randn(batch, 3, hw, hw).astype(np.float32), dev)
-    y = tensor.from_numpy(rng.randint(0, 1000, (batch,)).astype(np.int32), dev)
-    m.compile([x], is_train=True, use_graph=True, sequential=False)
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
 
+
+def _step_flops(m):
+    """FLOPs of one compiled training step, from XLA cost analysis."""
+    try:
+        for _, cost in m._graph_runner.cost_tables():
+            c = cost[0] if isinstance(cost, (list, tuple)) else cost
+            f = c.get("flops")
+            if f:
+                return float(f)
+    except Exception:
+        pass
+    return None
+
+
+def _timed_loop(m, x, y, iters):
     # warm: eager iteration + trace/compile + one replay
     m(x, y)
     m(x, y)
     _, loss = m(x, y)
     float(loss.data)  # sync
-
     t0 = time.time()
     for _ in range(iters):
         _, loss = m(x, y)
-    float(loss.data)  # force completion
+    lv = float(loss.data)  # force completion
     dt = time.time() - t0
-    return batch * iters / dt
+    assert np.isfinite(lv), f"loss diverged: {lv}"
+    return dt
+
+
+def bench_resnet50(batch=128, hw=224, iters=20, bf16=True):
+    from singa_tpu import amp, device, opt, tensor
+    from singa_tpu.models.resnet import resnet50
+
+    amp.enable(bf16)
+    try:
+        dev = device.create_tpu_device(0)
+        dev.SetRandSeed(0)
+        m = resnet50(num_classes=1000)
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+
+        rng = np.random.RandomState(0)
+        x = tensor.from_numpy(
+            rng.randn(batch, 3, hw, hw).astype(np.float32), dev)
+        y = tensor.from_numpy(
+            rng.randint(0, 1000, (batch,)).astype(np.int32), dev)
+        m.compile([x], is_train=True, use_graph=True, sequential=False)
+        dt = _timed_loop(m, x, y, iters)
+        return batch * iters / dt, _step_flops(m), iters / dt
+    finally:
+        amp.enable(False)
+
+
+def bench_bert(batch=8, seqlen=512, iters=10, bf16=True):
+    """BERT-base masked-LM training step (the second BASELINE workload)."""
+    from singa_tpu import amp, device, opt, tensor
+    from singa_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    amp.enable(bf16)
+    try:
+        dev = device.create_tpu_device(0)
+        dev.SetRandSeed(0)
+        cfg = BertConfig.base()
+        cfg.max_position_embeddings = seqlen
+        m = BertForMaskedLM(cfg)
+        m.set_optimizer(opt.SGD(lr=1e-4, momentum=0.9))
+
+        rng = np.random.RandomState(0)
+        ids = tensor.from_numpy(
+            rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32),
+            dev)
+        labels = tensor.from_numpy(
+            rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32),
+            dev)
+        m.compile([ids], is_train=True, use_graph=True, sequential=False)
+        dt = _timed_loop(m, ids, labels, iters)
+        return batch * iters / dt, _step_flops(m), iters / dt
+    finally:
+        amp.enable(False)
 
 
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
-    value = bench_resnet50(batch=batch, iters=iters)
+    bert_batch = int(os.environ.get("BENCH_BERT_BATCH", "8"))
+    bf16 = os.environ.get("BENCH_BF16", "1") != "0"
+
+    resnet_tp, resnet_flops, resnet_sps = bench_resnet50(
+        batch=batch, iters=iters, bf16=bf16)
+
+    bert_tp = None
+    try:
+        bert_tp, bert_flops, bert_sps = bench_bert(
+            batch=bert_batch, bf16=bf16)
+    except Exception as e:  # record the resnet number even if bert trips
+        sys.stderr.write(f"bench_bert failed: {e}\n")
+        bert_flops = bert_sps = None
+
+    # MFU is only reported for bf16 runs: the denominator is the chip's
+    # bf16 peak, and TPUs execute fp32 matmuls as multi-pass bf16 so an
+    # fp32 "peak" denominator would be fiction.
+    peak = _peak_flops() if bf16 else None
+
+    def mfu(flops, steps_per_sec):
+        if flops and steps_per_sec and peak:
+            return round(flops * steps_per_sec / peak, 4)
+        return None
 
     baseline_path = os.path.join(os.path.dirname(__file__),
                                  "BENCH_BASELINE.json")
@@ -61,22 +155,23 @@ def main():
             with open(baseline_path) as f:
                 base = json.load(f)
             if base.get("value"):
-                vs = value / float(base["value"])
+                vs = resnet_tp / float(base["value"])
         except Exception:
-            pass
-    else:
-        try:
-            with open(baseline_path, "w") as f:
-                json.dump({"metric": "resnet50_train", "value": value,
-                           "unit": "samples/sec/chip"}, f)
-        except OSError:
             pass
 
     print(json.dumps({
         "metric": "resnet50_train_throughput",
-        "value": round(value, 2),
+        "value": round(resnet_tp, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 4),
+        "bert_train_throughput": round(bert_tp, 2) if bert_tp else None,
+        "resnet50_mfu": mfu(resnet_flops, resnet_sps),
+        "bert_mfu": mfu(bert_flops, bert_sps),
+        "mfu_denominator": "bf16_peak" if peak else None,
+        "bf16": bf16,
+        "batch": batch,
+        "bert_batch": bert_batch,
+        "seqlen": 512,
     }))
 
 

@@ -5,13 +5,10 @@
 """
 
 import argparse
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
-sys.path.insert(0, __file__.rsplit("/train_cnn.py", 1)[0])
 
 from singa_tpu import device, opt, tensor  # noqa: E402
 import data as data_mod  # noqa: E402

@@ -11,13 +11,10 @@ jax.distributed control plane).  All five reference sync modes:
 """
 
 import argparse
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
-sys.path.insert(0, __file__.rsplit("/train_dist.py", 1)[0])
 
 
 def run(args):

@@ -6,12 +6,10 @@ dataset, exactly mirroring the reference script's flow:
 """
 
 import argparse
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
 from singa_tpu import device, opt, tensor  # noqa: E402
 from singa_tpu.models.mlp import MLP  # noqa: E402

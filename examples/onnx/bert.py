@@ -9,12 +9,10 @@ on synthetic batches.  Pass --onnx-model to import a real checkpoint.
 """
 
 import argparse
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
 from singa_tpu import device, opt, sonnx, tensor  # noqa: E402
 from singa_tpu.models.bert import BertConfig, BertForMaskedLM  # noqa: E402

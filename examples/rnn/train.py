@@ -6,12 +6,10 @@ is this repository's own documentation.
 """
 
 import argparse
-import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
 from singa_tpu import device, opt, tensor  # noqa: E402
 from singa_tpu.models.char_rnn import CharRNN, one_hot  # noqa: E402

@@ -4,6 +4,7 @@ JAX/XLA/Pallas.  See SURVEY.md for the reference layer map this package
 rebuilds and README.md for the design stance.
 """
 
+from . import amp  # noqa: F401
 from . import config  # noqa: F401
 from .config import VERSION as __version__  # noqa: F401
 

@@ -1,0 +1,75 @@
+"""Mixed-precision policy — TPU-native bf16 compute with fp32 master
+params.
+
+The reference's half-precision story is wire-only (fp16 gradient
+compression in ``Communicator::synchHalf``, SURVEY.md §2.1); compute
+stays fp32 because V100-era cuDNN fp16 needs loss scaling and per-op
+opt-in.  On TPU the natural equivalent is **bf16 compute**: same
+exponent range as fp32 (no loss scaling needed), 2x MXU issue rate and
+half the HBM traffic.  Policy (the standard one):
+
+  * params stay fp32 ("master weights"; the optimizer already updates
+    in fp32 — see ``opt.Optimizer._assign``);
+  * MXU ops (conv / matmul / gemm) cast their inputs to bf16, so
+    activations flow bf16 between layers;
+  * normalization statistics and the softmax-cross-entropy loss are
+    computed in fp32 (bf16's 8-bit mantissa is too coarse for
+    variance/log-sum-exp);
+  * gradients come back through the cast nodes as fp32 for fp32 params
+    (jax.vjp of ``convert_element_type`` restores the input dtype), so
+    optimizer state and the DistOpt wire path are unchanged.
+
+Enable globally with ``amp.enable()`` (or ``set_compute_dtype``); graph
+mode picks it up at the next (re)compile since the flag is read at trace
+time.  Off by default — numerics match the reference's fp32 exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_compute_dtype = None  # None => full fp32 (policy off)
+
+
+def enable(on=True):
+    """Turn bf16 mixed-precision compute on/off."""
+    set_compute_dtype(jnp.bfloat16 if on else None)
+
+
+def set_compute_dtype(dtype):
+    global _compute_dtype
+    if dtype in (None, "float32", jnp.float32):
+        _compute_dtype = None
+    else:
+        _compute_dtype = jnp.dtype(dtype)
+
+
+def compute_dtype():
+    """The MXU compute dtype, or None when the policy is off."""
+    return _compute_dtype
+
+
+def enabled() -> bool:
+    return _compute_dtype is not None
+
+
+def param_dtype(activation_dtype):
+    """Dtype for a parameter created from an activation of the given
+    dtype: under amp, bf16 activations still get fp32 master params."""
+    if _compute_dtype is not None and \
+            jnp.dtype(activation_dtype) == _compute_dtype:
+        return jnp.float32
+    return activation_dtype
+
+
+def cast_in(*arrays):
+    """Cast MXU-op inputs to the compute dtype (no-op when off).
+    Integer arrays pass through untouched."""
+    if _compute_dtype is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    out = tuple(
+        a.astype(_compute_dtype)
+        if a is not None and jnp.issubdtype(a.dtype, jnp.floating) else a
+        for a in arrays
+    )
+    return out if len(out) != 1 else out[0]

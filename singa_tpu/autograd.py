@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import amp
 from .tensor import Tensor, _wrap, _raw
 from .device import get_default_device
 
@@ -84,10 +85,13 @@ class Operation:
                     # Dummy (param array rebound by opt.update since last
                     # step) is replaced.
                     x.creator = Dummy(x)
-                if x.requires_grad:
-                    self.src.append((x.creator, id(x.data), x, x.stores_grad))
-                else:
-                    self.src.append((None, id(x.data), None, False))
+                # the creator edge is recorded for no-grad inputs too so
+                # the sonnx export walk can traverse grad-free graphs;
+                # backward() still never descends into them (it only
+                # enqueues src ops with requires_grad=True) and never
+                # yields them (stores_grad=False)
+                self.src.append((x.creator, id(x.data), x,
+                                 x.stores_grad if x.requires_grad else False))
             self.requires_grad = any(x.requires_grad for x in xs)
         ys = self.forward(*[x.data for x in xs])
         single = not isinstance(ys, tuple)
@@ -395,26 +399,31 @@ def maximum(a, b):
 
 
 def matmul(a, b):
-    """Reference: autograd.Matmul → cuBLAS GEMM; here lax dot on the MXU."""
-    return _op(jnp.matmul, a, b, _name="Matmul")
+    """Reference: autograd.Matmul → cuBLAS GEMM; here lax dot on the MXU
+    (bf16 inputs under the amp policy)."""
+    return _op(lambda u, v: jnp.matmul(*amp.cast_in(u, v)), a, b,
+               _name="Matmul")
 
 
 def add_bias(x, b, axis=0):
-    """Reference: autograd.AddBias (bias add over rows/cols of a matrix)."""
+    """Reference: autograd.AddBias (bias add over rows/cols of a matrix).
+    The bias follows x's dtype so bf16 activations stay bf16 under amp."""
     if axis == 0:
-        return _op(lambda v, w: v + w, x, b, _name="AddBias")
-    return _op(lambda v, w: v + w[:, None], x, b, _name="AddBias")
+        return _op(lambda v, w: v + w.astype(v.dtype), x, b, _name="AddBias")
+    return _op(lambda v, w: v + w.astype(v.dtype)[:, None], x, b,
+               _name="AddBias")
 
 
 def gemm(A, B, C=None, alpha=1.0, beta=1.0, transA=False, transB=False):
     """ONNX-style Gemm (reference autograd.Gemm)."""
 
     def f(a, b, *rest, alpha=alpha, beta=beta, transA=transA, transB=transB):
+        a, b = amp.cast_in(a, b)
         a = a.T if transA else a
         b = b.T if transB else b
         y = alpha * jnp.matmul(a, b)
         if rest:
-            y = y + beta * rest[0]
+            y = y + beta * amp.cast_in(rest[0])
         return y
 
     if C is None:
@@ -538,14 +547,17 @@ class _SoftMaxCrossEntropy(Operation):
     Loss = mean over batch of CE(softmax(logits), target)."""
 
     def forward(self, x, t):
-        logp = jax.nn.log_softmax(x, axis=-1)
+        # log-sum-exp in fp32 regardless of the amp compute dtype
+        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
         t1h = _to_one_hot(t, x.shape)
-        self._saved = (jnp.exp(logp), t1h)
+        self._saved = (jnp.exp(logp), t1h, x.dtype)
         return -jnp.sum(t1h * logp) / x.shape[0]
 
     def backward(self, dy):
-        p, t1h = self._saved
-        return (dy * (p - t1h) / p.shape[0], None)
+        p, t1h, xdt = self._saved
+        dx = dy * (p - t1h) / p.shape[0]
+        # cotangent must carry the logits' dtype so upstream vjps match
+        return (dx.astype(xdt), None)
 
 
 def _to_one_hot(t, logits_shape):
@@ -649,9 +661,12 @@ def layer_norm(x, scale, bias, axis=-1, eps=1e-12):
     so sonnx export can emit them as node attributes."""
 
     def f(xv, sv, bv, axis, eps):
-        m = jnp.mean(xv, axis=axis, keepdims=True)
-        v = jnp.var(xv, axis=axis, keepdims=True)
-        return (xv - m) * jax.lax.rsqrt(v + eps) * sv + bv
+        # statistics in fp32 (bf16 variance is too coarse under amp)
+        xf = xv.astype(jnp.float32)
+        m = jnp.mean(xf, axis=axis, keepdims=True)
+        v = jnp.var(xf, axis=axis, keepdims=True)
+        y = (xf - m) * jax.lax.rsqrt(v + eps) * sv + bv
+        return y.astype(xv.dtype)
 
     return _op(f, x, scale, bias, _name="LayerNorm", axis=axis, eps=eps)
 

@@ -250,6 +250,51 @@ def enable_tensor_graph(enable: bool = True):
     get_default_device().EnableGraph(enable)
 
 
+# ---------------------------------------------------------------------------
+# Memory-pool API shims (reference: src/core/memory/memory.cc — CnMemPool /
+# CudaMemPool device allocators, SURVEY.md §2.1 Memory-pool row: "no-op
+# shim (XLA owns HBM); keep API for source compat").  Scripts that
+# construct a pool and pass it to device creation keep working; the pool
+# only tracks what it was asked for, since allocation itself belongs to
+# the XLA client.
+# ---------------------------------------------------------------------------
+
+
+class DeviceMemPool:
+    """API-compat allocator shim; XLA's client owns real HBM."""
+
+    def __init__(self, init_size_mb: int = 256, max_size_mb: int = 0):
+        self.init_size_mb = int(init_size_mb)
+        self.max_size_mb = int(max_size_mb)
+        self._outstanding = 0  # bytes "allocated" through the shim API
+
+    def Malloc(self, size: int) -> int:
+        self._outstanding += int(size)
+        return 0  # opaque handle; nothing real to hand out
+
+    def Free(self, ptr: int, size: int = 0) -> None:
+        self._outstanding = max(0, self._outstanding - int(size))
+
+    def GetMemUsage(self):
+        """(free, total) in bytes, from the live backend when it reports
+        memory stats, else (0, 0) like a CPU pool."""
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            total = stats.get("bytes_limit", 0)
+            used = stats.get("bytes_in_use", 0)
+            return (total - used, total)
+        except Exception:
+            return (0, 0)
+
+
+class CnMemPool(DeviceMemPool):
+    """Reference cnmem-backed pool name, kept for source compat."""
+
+
+class CudaMemPool(DeviceMemPool):
+    """Reference CUDA pool name, kept for source compat."""
+
+
 def device_query(dev_id: int = 0, verbose: bool = False):
     devs = jax.devices()
     info = {

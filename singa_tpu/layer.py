@@ -15,6 +15,7 @@ import math
 
 import numpy as np
 
+from . import amp
 from . import autograd, initializer, tensor
 from .tensor import Tensor
 
@@ -157,12 +158,12 @@ class Linear(Layer):
         in_features = x.shape[-1]
         self.W = Tensor(
             (in_features, self.out_features), device=x.device,
-            dtype=x.data.dtype, requires_grad=True, stores_grad=True,
+            dtype=amp.param_dtype(x.data.dtype), requires_grad=True, stores_grad=True,
         )
         initializer.xavier(self.W)
         if self.bias:
             self.b = Tensor(
-                (self.out_features,), device=x.device, dtype=x.data.dtype,
+                (self.out_features,), device=x.device, dtype=amp.param_dtype(x.data.dtype),
                 requires_grad=True, stores_grad=True,
             )
             self.b.set_value(0.0)
@@ -286,7 +287,7 @@ class LayerNorm(Layer):
 
     def initialize(self, x):
         d = x.shape[-1]
-        dt = x.data.dtype
+        dt = amp.param_dtype(x.data.dtype)
         self.scale = Tensor((d,), device=x.device, dtype=dt,
                             requires_grad=True, stores_grad=True).set_value(1.0)
         self.bias = Tensor((d,), device=x.device, dtype=dt,
@@ -341,14 +342,14 @@ class Conv2d(Layer):
         in_channels = x.shape[1]
         assert in_channels % self.group == 0
         w_shape = (self.nb_kernels, in_channels // self.group) + self.kernel_size
-        self.W = Tensor(w_shape, device=x.device, dtype=x.data.dtype,
+        self.W = Tensor(w_shape, device=x.device, dtype=amp.param_dtype(x.data.dtype),
                         requires_grad=True, stores_grad=True)
         # reference init: he-style scaled gaussian over receptive field
         std = math.sqrt(2.0 / (w_shape[1] * np.prod(self.kernel_size) + self.nb_kernels))
         self.W.gaussian(0.0, std)
         if self.bias:
             self.b = Tensor((self.nb_kernels,), device=x.device,
-                            dtype=x.data.dtype, requires_grad=True,
+                            dtype=amp.param_dtype(x.data.dtype), requires_grad=True,
                             stores_grad=True)
             self.b.set_value(0.0)
 
@@ -376,7 +377,7 @@ class BatchNorm2d(Layer):
 
     def initialize(self, x):
         c = x.shape[1]
-        dt = x.data.dtype
+        dt = amp.param_dtype(x.data.dtype)
         self.scale = Tensor((c,), device=x.device, dtype=dt,
                             requires_grad=True, stores_grad=True).set_value(1.0)
         self.bias = Tensor((c,), device=x.device, dtype=dt,

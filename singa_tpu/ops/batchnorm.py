@@ -26,19 +26,22 @@ def batchnorm2d(x, scale, bias, running_mean, running_var,
     reference's convention).  Eval: normalize by running stats."""
     if autograd.training:
         axes = (0, 2, 3)
-        bm = jnp.mean(x.data, axes)
-        bv = jnp.var(x.data, axes)
+        xf32 = x.data.astype(jnp.float32)  # stats in fp32 under amp
+        bm = jnp.mean(xf32, axes)
+        bv = jnp.var(xf32, axes)
         running_mean.data = (momentum * running_mean.data
                              + (1.0 - momentum) * jax.lax.stop_gradient(bm))
         running_var.data = (momentum * running_var.data
                             + (1.0 - momentum) * jax.lax.stop_gradient(bv))
 
         def f(xv, sv, bv_, eps=eps):
-            m = jnp.mean(xv, (0, 2, 3), keepdims=True)
-            v = jnp.var(xv, (0, 2, 3), keepdims=True)
+            xf = xv.astype(jnp.float32)
+            m = jnp.mean(xf, (0, 2, 3), keepdims=True)
+            v = jnp.var(xf, (0, 2, 3), keepdims=True)
             inv = jax.lax.rsqrt(v + eps)
-            return (xv - m) * inv * sv[None, :, None, None] \
+            y = (xf - m) * inv * sv[None, :, None, None] \
                 + bv_[None, :, None, None]
+            return y.astype(xv.dtype)
 
         return _op(f, x, scale, bias, _name="BatchNorm2d")
 
@@ -46,8 +49,10 @@ def batchnorm2d(x, scale, bias, running_mean, running_var,
     rv = running_var.data
 
     def f(xv, sv, bv_, rm=rm, rv=rv, eps=eps):
+        xf = xv.astype(jnp.float32)
         inv = jax.lax.rsqrt(rv + eps)[None, :, None, None]
-        return (xv - rm[None, :, None, None]) * inv * sv[None, :, None, None] \
+        y = (xf - rm[None, :, None, None]) * inv * sv[None, :, None, None] \
             + bv_[None, :, None, None]
+        return y.astype(xv.dtype)
 
     return _op(f, x, scale, bias, _name="BatchNorm2dEval")

@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from .. import amp
 from ..autograd import _op
 from .padding import resolve as _resolve_padding
 
@@ -32,6 +33,7 @@ def conv2d(x, W, b=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
 
     def f(xv, wv, *rest, stride=tuple(stride), pads=pads,
           dilation=tuple(dilation), group=int(group)):
+        xv, wv = amp.cast_in(xv, wv)  # bf16 on the MXU under amp
         y = lax.conv_general_dilated(
             xv, wv,
             window_strides=stride,
@@ -41,7 +43,7 @@ def conv2d(x, W, b=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
         )
         if rest:
-            y = y + rest[0][None, :, None, None]
+            y = y + amp.cast_in(rest[0])[None, :, None, None]
         return y
 
     # pass the geometry through _op's params so the op instance carries it

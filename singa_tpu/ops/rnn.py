@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import autograd
+from .. import amp
 from ..autograd import _Func
 from ..layer import Layer
 from ..tensor import Tensor
@@ -207,7 +208,7 @@ class _BaseRNN(Layer):
         self.handle = RNNHandle(
             input_size, self.hidden_size, self.num_layers, self.mode,
             self.bidirectional, self.dropout, use_pallas=self.use_pallas)
-        self.W = self.handle.init_weights(x.device, x.data.dtype)
+        self.W = self.handle.init_weights(x.device, amp.param_dtype(x.data.dtype))
 
     def _zero_state(self, x):
         B = x.shape[0] if self.batch_first else x.shape[1]

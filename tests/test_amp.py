@@ -1,0 +1,112 @@
+"""bf16 mixed-precision policy tests (singa_tpu.amp).
+
+The reference has no compute-precision policy (fp16 exists only on the
+gradient wire, SURVEY.md §2.1 Communicator row); amp is the TPU-native
+extension: bf16 MXU compute, fp32 master params, fp32 statistics.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from singa_tpu import amp, autograd, device as device_module, opt, tensor
+from singa_tpu.models.cnn import CNN
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+@pytest.fixture
+def bf16():
+    amp.enable()
+    try:
+        yield
+    finally:
+        amp.enable(False)
+
+
+def test_policy_flag_roundtrip():
+    assert not amp.enabled()
+    amp.enable()
+    assert amp.enabled() and amp.compute_dtype() == jnp.bfloat16
+    amp.enable(False)
+    assert not amp.enabled() and amp.compute_dtype() is None
+
+
+def test_matmul_runs_bf16_params_stay_fp32(dev, bf16):
+    a = tensor.from_numpy(np.ones((4, 8), np.float32), dev)
+    b = tensor.from_numpy(np.ones((8, 2), np.float32), dev)
+    y = autograd.matmul(a, b)
+    assert y.data.dtype == jnp.bfloat16
+    assert a.data.dtype == jnp.float32  # inputs untouched
+
+
+def test_cnn_trains_one_step_bf16(dev, bf16):
+    m = CNN(num_classes=10, num_channels=1)
+    sgd = opt.SGD(lr=0.01, momentum=0.9)
+    m.set_optimizer(sgd)
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(4, 1, 28, 28).astype(np.float32), dev)
+    y = tensor.from_numpy(rng.randint(0, 10, (4,)).astype(np.int32), dev)
+    m.compile([x], is_train=True, use_graph=True, sequential=False)
+    m(x, y)
+    out, loss = m(x, y)
+    lv = float(loss.data)
+    assert np.isfinite(lv) and 0 < lv < 3 * np.log(10)
+    # loss is computed in fp32, params stay fp32 masters
+    assert loss.data.dtype == jnp.float32
+    for name, p in m.get_params().items():
+        assert p.data.dtype == jnp.float32, name
+
+
+def test_bf16_close_to_fp32_loss(dev):
+    """One CNN training step under amp must track the fp32 loss to bf16
+    tolerance (the policy changes precision, not math)."""
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(4, 1, 28, 28).astype(np.float32)
+    y_np = rng.randint(0, 10, (4,)).astype(np.int32)
+
+    def one_loss():
+        dev2 = device_module.get_default_device()
+        dev2.SetRandSeed(0)
+        m = CNN(num_classes=10, num_channels=1)
+        m.set_optimizer(opt.SGD(lr=0.01))
+        x = tensor.from_numpy(x_np, dev2)
+        y = tensor.from_numpy(y_np, dev2)
+        m.compile([x], is_train=True, use_graph=True, sequential=False)
+        _, loss = m(x, y)
+        return float(loss.data)
+
+    ref = one_loss()
+    amp.enable()
+    try:
+        got = one_loss()
+    finally:
+        amp.enable(False)
+    assert abs(got - ref) / max(abs(ref), 1e-6) < 0.05, (got, ref)
+
+
+def test_norm_stats_fp32_under_amp(dev, bf16):
+    """LayerNorm on a bf16 input keeps bf16 output but fp32-accurate
+    statistics (variance of large-mean data underflows in bf16)."""
+    rng = np.random.RandomState(2)
+    x_np = (8.0 + rng.randn(4, 64)).astype(np.float32)
+    # quantize to bf16 grid first so the comparison isolates the op's
+    # internal statistics precision from input rounding
+    x_np = np.asarray(jnp.asarray(x_np, jnp.bfloat16), np.float32)
+    x = tensor.from_numpy(x_np, dev)
+    s = tensor.from_numpy(np.ones(64, np.float32), dev)
+    b = tensor.from_numpy(np.zeros(64, np.float32), dev)
+    xb = tensor._wrap(x.data.astype(jnp.bfloat16), dev)
+    y = autograd.layer_norm(xb, s, b)
+    assert y.data.dtype == jnp.bfloat16
+    got = np.asarray(y.data, dtype=np.float32)
+    m = x_np.mean(axis=-1, keepdims=True)
+    v = x_np.var(axis=-1, keepdims=True)
+    want = (x_np - m) / np.sqrt(v + 1e-12)
+    # bf16 output rounding only — stats did not collapse
+    np.testing.assert_allclose(got, want, atol=0.15)

@@ -1,0 +1,77 @@
+"""Debug/NaN mode (SURVEY.md §5.2) + multi-host control-plane smoke
+(SURVEY.md §5.8) + memory-pool shim (SURVEY.md §2.1)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, config, device as device_module, tensor
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+def test_debug_mode_raises_on_nan(dev):
+    """config.debug(True) -> a NaN-producing op raises at the op
+    (jax_debug_nans), instead of poisoning training silently."""
+    config.debug(True)
+    try:
+        x = tensor.from_numpy(np.array([-1.0], np.float32), dev)
+        with pytest.raises(FloatingPointError):
+            y = autograd.log(x)
+            float(y.data)
+    finally:
+        config.debug(False)
+    # off again: same op quietly yields nan (reference behavior)
+    y = autograd.log(tensor.from_numpy(np.array([-1.0], np.float32), dev))
+    assert np.isnan(tensor.to_numpy(y)).all()
+    assert not config.debug_enabled()
+
+
+def test_mem_pool_shim():
+    pool = device_module.CnMemPool(init_size_mb=128)
+    pool.Malloc(1024)
+    free, total = pool.GetMemUsage()
+    assert free >= 0 and total >= 0
+    pool.Free(0, 1024)
+    assert pool._outstanding == 0
+    assert isinstance(device_module.CudaMemPool(), device_module.DeviceMemPool)
+
+
+def test_initialize_distributed_single_process_smoke():
+    """The DCN bootstrap line is live code: initialize_distributed with a
+    1-process world starts the coordinator and serves process_count=1.
+    Runs in a subprocess because jax.distributed.initialize must precede
+    backend init (this pytest process already initialized its backend)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = textwrap.dedent(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from singa_tpu.parallel.communicator import initialize_distributed
+        initialize_distributed("127.0.0.1:{port}", num_processes=1,
+                               process_id=0)
+        assert jax.process_count() == 1, jax.process_count()
+        assert jax.process_index() == 0
+        import jax.numpy as jnp
+        assert float(jnp.sum(jnp.ones(4))) == 4.0
+        jax.distributed.shutdown()
+        print("dist-smoke-ok")
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dist-smoke-ok" in proc.stdout

@@ -321,3 +321,22 @@ def test_negative_pads_crop(dev):
     pads = tensor.from_numpy(np.array([0, -1, 0, -1], np.int64), dev)
     (out,) = rep.run({"x": tensor.from_numpy(x_np, dev), "pads": pads})
     np.testing.assert_array_equal(tensor.to_numpy(out), x_np[:, 1:3])
+
+
+def test_export_grad_free_graph(dev, tmp_path):
+    """Export must work when no tensor requires grad (frozen model):
+    the tape records creator edges for no-grad inputs too."""
+    m = MLP(data_size=6, perceptron_size=8, num_classes=3)
+    x = tensor.from_numpy(
+        np.random.RandomState(5).randn(4, 6).astype(np.float32), dev)
+    m.compile([x], is_train=False, use_graph=False)
+    for p in m.get_params().values():
+        p.requires_grad = False
+        p.stores_grad = False
+    native = tensor.to_numpy(m.forward(x))
+
+    proto = sonnx.to_onnx(m, [x])
+    rep = sonnx.prepare(proto, dev)
+    (out,) = rep.run([x])
+    np.testing.assert_allclose(tensor.to_numpy(out), native, rtol=1e-5,
+                               atol=1e-6)
