@@ -92,7 +92,7 @@ def bench_resnet50(batch=128, hw=224, iters=20, bf16=True):
         amp.enable(False)
 
 
-def bench_bert(batch=8, seqlen=512, iters=10, bf16=True):
+def bench_bert(batch=16, seqlen=512, iters=10, bf16=True):
     """BERT-base masked-LM training step (the second BASELINE workload)."""
     from singa_tpu import amp, device, opt, tensor
     from singa_tpu.models.bert import BertConfig, BertForMaskedLM
@@ -123,7 +123,7 @@ def bench_bert(batch=8, seqlen=512, iters=10, bf16=True):
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
-    bert_batch = int(os.environ.get("BENCH_BERT_BATCH", "8"))
+    bert_batch = int(os.environ.get("BENCH_BERT_BATCH", "16"))
     bf16 = os.environ.get("BENCH_BF16", "1") != "0"
 
     resnet_tp, resnet_flops, resnet_sps = bench_resnet50(

@@ -2,6 +2,11 @@
 
     python examples/cnn/train_cnn.py cnn mnist --use-graph
     python examples/cnn/train_cnn.py resnet18 cifar10 --epochs 2
+
+``--binfile DIR`` routes the training data through the on-disk BinFile
+record store + threaded prefetching DataLoader (native C++ MPMC queue
+when built, pure-Python fallback otherwise) instead of in-memory numpy —
+the reference's reader->decoder->safe_queue pipeline, end to end.
 """
 
 import argparse
@@ -48,16 +53,35 @@ def run(args):
     tx = tensor.Tensor((batch, spec["channels"], spec["size"], spec["size"]), dev)
     m.compile([tx], is_train=True, use_graph=args.use_graph, sequential=False)
 
+    loader = None
+    if args.binfile:
+        import os
+
+        from singa_tpu.io import loader as loader_mod
+
+        os.makedirs(args.binfile, exist_ok=True)
+        path = os.path.join(args.binfile, f"{args.data}_train.bin")
+        if not os.path.exists(path):
+            loader_mod.write_dataset(path, x_tr[:n_train], y_tr[:n_train])
+            print(f"wrote BinFile dataset: {path}")
+        loader = loader_mod.DataLoader(path, batch_size=batch, shuffle=True,
+                                       num_workers=2, seed=args.seed)
+
     for epoch in range(args.epochs):
         m.train()
         t0 = time.time()
         tot_loss, correct, seen = 0.0, 0, 0
-        for i in range(0, n_train, batch):
-            xb = tensor.from_numpy(x_tr[i:i + batch], dev)
-            yb = tensor.from_numpy(y_tr[i:i + batch], dev)
+        if loader is not None:
+            batches = ((xb_np, yb_np) for xb_np, yb_np in loader)
+        else:
+            batches = ((x_tr[i:i + batch], y_tr[i:i + batch])
+                       for i in range(0, n_train, batch))
+        for xb_np, yb_np in batches:
+            xb = tensor.from_numpy(np.ascontiguousarray(xb_np), dev)
+            yb = tensor.from_numpy(np.ascontiguousarray(yb_np), dev)
             out, loss = m(xb, yb)
             tot_loss += float(loss.data)
-            correct += int((tensor.to_numpy(out).argmax(-1) == y_tr[i:i + batch]).sum())
+            correct += int((tensor.to_numpy(out).argmax(-1) == yb_np).sum())
             seen += batch
         dt = time.time() - t0
         print(f"epoch {epoch}: loss={tot_loss / (seen // batch):.4f} "
@@ -91,5 +115,8 @@ if __name__ == "__main__":
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--n-train", type=int, default=512)
     p.add_argument("--n-val", type=int, default=128)
+    p.add_argument("--binfile", metavar="DIR", default=None,
+                   help="write/read training data through a BinFile "
+                        "record store + prefetching DataLoader")
     args = p.parse_args()
     run(args)

@@ -1,0 +1,60 @@
+"""JPEG image codec (reference: src/io/jpg_encoder.cc / jpg_decoder.cc
+over libjpeg via opencv, unverified — SURVEY.md §2.1 IO row).
+
+PIL-backed: encode an HWC uint8 numpy array to JPEG bytes and back.
+PIL ships with this environment; if it is ever absent the codec raises a
+clear ImportError at first use (the rest of singa_tpu.io has no image
+dependency — BinFile/Text stores carry raw arrays fine without it).
+"""
+
+from __future__ import annotations
+
+import io as _io
+
+import numpy as np
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "singa_tpu.io.image needs Pillow for JPEG encode/decode; "
+            "store raw arrays via io.loader/binfile instead") from e
+    return Image
+
+
+class JPGEncoder:
+    """numpy HWC uint8 (or HW grayscale) -> JPEG bytes."""
+
+    def __init__(self, quality=95):
+        self.quality = int(quality)
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        Image = _pil()
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype != np.uint8:
+            raise ValueError(f"JPEG encode expects uint8, got {arr.dtype}")
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=self.quality)
+        return buf.getvalue()
+
+    Encode = encode
+
+
+class JPGDecoder:
+    """JPEG bytes -> numpy HWC uint8 (RGB) or HW (grayscale)."""
+
+    def decode(self, blob: bytes) -> np.ndarray:
+        Image = _pil()
+        return np.asarray(Image.open(_io.BytesIO(blob)))
+
+    Decode = decode
+
+
+def encode_jpg(arr, quality=95) -> bytes:
+    return JPGEncoder(quality).encode(arr)
+
+
+def decode_jpg(blob) -> np.ndarray:
+    return JPGDecoder().decode(blob)

@@ -65,7 +65,12 @@ class Model(layer.Layer):
         self._graph_runner = None
         self.dist = False
         # distributed output reassembly: "auto" (scalars -> cross-replica
-        # mean, others -> merge per-rank batch) or "stack" (raw (W, ...))
+        # mean, others -> merge per-rank batch), "stack" (raw (W, ...)),
+        # or a list/tuple of per-output leaf specs from
+        # {"mean", "concat", "stack"} matching the flattened structure of
+        # train_one_batch's return value — the explicit form for outputs
+        # that are neither scalars nor batch-leading (e.g. RNN hidden
+        # states shaped (L, B/W, H), which "auto" would merge wrongly)
         self.dist_outputs = "auto"
 
     # -- reference API -----------------------------------------------------
@@ -297,15 +302,37 @@ class _GraphRunner:
             # cross-replica mean (the global loss); everything else is
             # treated as batch-leading and the first two dims merge,
             # (W, B/W, ...) -> (B, ...).  Outputs that are neither (e.g.
-            # RNN hidden states shaped (L, B/W, H)) reassemble wrongly
-            # under this rule — set model.dist_outputs = "stack" to
-            # receive the raw (W, ...) per-rank stack instead.
-            def unstack(a):
-                if a.ndim == 1:
-                    return jnp.mean(a)
+            # RNN hidden states shaped (L, B/W, H)) need the explicit
+            # per-leaf spec form of model.dist_outputs ("mean" /
+            # "concat" / "stack" per flattened output), or "stack" for
+            # the raw (W, ...) per-rank stacks.
+            def merge(a):
                 return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
 
-            out_tree = jax.tree.map(unstack, out_tree)
+            def unstack_auto(a):
+                return jnp.mean(a) if a.ndim == 1 else merge(a)
+
+            if isinstance(model.dist_outputs, (list, tuple)):
+                leaves, treedef = jax.tree.flatten(out_tree)
+                specs = list(model.dist_outputs)
+                if len(specs) != len(leaves):
+                    raise ValueError(
+                        f"dist_outputs has {len(specs)} specs but "
+                        f"train_one_batch returned {len(leaves)} outputs")
+                applied = []
+                for spec, a in zip(specs, leaves):
+                    if spec == "mean":
+                        applied.append(jnp.mean(a, axis=0))
+                    elif spec == "concat":
+                        applied.append(merge(a))
+                    elif spec == "stack":
+                        applied.append(a)
+                    else:
+                        raise ValueError(f"unknown dist_outputs spec "
+                                         f"{spec!r}")
+                out_tree = jax.tree.unflatten(treedef, applied)
+            else:
+                out_tree = jax.tree.map(unstack_auto, out_tree)
         return jax.tree.map(
             lambda a: tensor._wrap(a, dev),
             out_tree,

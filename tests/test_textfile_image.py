@@ -1,0 +1,75 @@
+"""TextFile reader/writer + JPEG codec (reference: src/io/*.cc readers,
+SURVEY.md §2.1 IO row)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu.io.textfile import TextFileReader, TextFileWriter
+
+
+def test_textfile_roundtrip(tmp_path):
+    p = str(tmp_path / "t.txt")
+    with TextFileWriter(p) as w:
+        w.put("hello world")
+        w.put("line\nwith\nnewlines")
+        w.put("back\\slash")
+        w.Write("reference-verb")
+    with TextFileReader(p) as r:
+        assert r.count() == 4
+        assert r.key(1) == "1"
+        assert r.value(0) == "hello world"
+        assert r.value(1) == "line\nwith\nnewlines"
+        assert r.value(2) == "back\\slash"
+        assert r.value(3) == "reference-verb"
+        items = list(r.items())
+        assert items[0] == ("0", "hello world")
+
+
+def test_textfile_sequential_read(tmp_path):
+    p = str(tmp_path / "t.txt")
+    with TextFileWriter(p) as w:
+        for i in range(3):
+            w.put(f"v{i}")
+    r = TextFileReader(p)
+    got = []
+    while True:
+        kv = r.Read()
+        if kv is None:
+            break
+        got.append(kv)
+    assert got == [("0", "v0"), ("1", "v1"), ("2", "v2")]
+    r.SeekToFirst()
+    assert r.Read() == ("0", "v0")
+
+
+def test_textfile_append(tmp_path):
+    p = str(tmp_path / "t.txt")
+    with TextFileWriter(p) as w:
+        w.put("a")
+    with TextFileWriter(p, append=True) as w:
+        w.put("b")
+    with TextFileReader(p) as r:
+        assert [v for _, v in r.items()] == ["a", "b"]
+
+
+def test_jpg_codec_roundtrip():
+    pil = pytest.importorskip("PIL")  # noqa: F841
+    from singa_tpu.io.image import decode_jpg, encode_jpg
+
+    rng = np.random.RandomState(0)
+    # smooth gradient image so JPEG loss stays small
+    g = np.linspace(0, 255, 32, dtype=np.uint8)
+    img = np.stack([np.tile(g, (32, 1))] * 3, axis=-1)
+    blob = encode_jpg(img, quality=95)
+    assert blob[:2] == b"\xff\xd8"  # JPEG SOI marker
+    back = decode_jpg(blob)
+    assert back.shape == img.shape and back.dtype == np.uint8
+    assert np.abs(back.astype(int) - img.astype(int)).mean() < 3.0
+
+    # grayscale path
+    blob2 = encode_jpg(np.tile(g, (32, 1)))
+    back2 = decode_jpg(blob2)
+    assert back2.shape == (32, 32)
+
+    with pytest.raises(ValueError):
+        encode_jpg(rng.randn(8, 8, 3).astype(np.float32))
