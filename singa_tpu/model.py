@@ -64,6 +64,12 @@ class Model(layer.Layer):
         self.sequential = False
         self._graph_runner = None
         self.dist = False
+        # GSPMD model-parallel plan (parallel/sharding.ShardingPlan):
+        # when set, graph mode jits the step over globally-shaped arrays
+        # laid out per the plan (tp/sp/pp/ep + dp), letting XLA's SPMD
+        # partitioner insert the collectives.  Orthogonal to `dist`
+        # (the reference-parity shard_map DistOpt path).
+        self.sharding_plan = None
         # distributed output reassembly: "auto" (scalars -> cross-replica
         # mean, others -> merge per-rank batch), "stack" (raw (W, ...)),
         # or a list/tuple of per-output leaf specs from
@@ -133,9 +139,30 @@ class Model(layer.Layer):
     def eval(self):
         self.train(False)
 
+    def set_sharding_plan(self, plan):
+        """Attach a parallel.sharding.ShardingPlan; requires graph mode
+        (GSPMD layouts only exist inside the compiled step).  Mutually
+        exclusive with DistOpt's shard_map path."""
+        if plan is not None and self.dist:
+            raise ValueError(
+                "sharding_plan and DistOpt are mutually exclusive: DistOpt "
+                "runs the reference-parity shard_map data-parallel path; "
+                "with a plan, use a plain optimizer — data parallelism "
+                "comes from the mesh's 'data' axis")
+        self.sharding_plan = plan
+        if self._graph_runner is not None:
+            # executables traced without the plan (or with another plan)
+            # have the wrong layouts baked in
+            self._graph_runner.clear()
+
     def set_optimizer(self, optimizer):
+        dist = getattr(optimizer, "is_distributed", False)
+        if dist and self.sharding_plan is not None:
+            raise ValueError(
+                "sharding_plan and DistOpt are mutually exclusive (see "
+                "set_sharding_plan); use a plain optimizer with a plan")
         self._optimizer = optimizer
-        self.dist = getattr(optimizer, "is_distributed", False)
+        self.dist = dist
 
     @property
     def optimizer(self):
@@ -200,10 +227,12 @@ class _GraphRunner:
     def __init__(self, model: Model):
         self.model = model
         self._compiled = {}  # key -> (jit_fn, state_names)
+        self._plan_layouts = {}  # key -> (names, state/in/rng shardings)
         self._warm = False
 
     def clear(self):
         self._compiled.clear()
+        self._plan_layouts.clear()
         self._warm = False
 
     def cost_tables(self):
@@ -251,7 +280,46 @@ class _GraphRunner:
         in_arrays = [a.data for a in args if isinstance(a, Tensor)]
         in_arrays += [v.data for k, v in sorted(kwargs.items())
                       if isinstance(v, Tensor)]
-        if model.dist:
+        if model.sharding_plan is not None and not model.dist:
+            # GSPMD path: lay out state + inputs per the plan; XLA's SPMD
+            # partitioner inserts every collective (dp grad psum, tp
+            # all-reduce pairs, ep all-to-all); only ring attention and
+            # the pipeline use explicit shard_map collectives.
+            plan = model.sharding_plan
+            if plan.input_specs is None:
+                # "auto" input layout shards dim 0 over data; reject
+                # non-divisible batches instead of silently replicating
+                # (explicit input_specs is the override for genuinely
+                # non-batch-leading inputs)
+                dp = plan.axis_size("data")
+                for a in in_arrays:
+                    if a.ndim >= 1 and a.shape[0] % dp != 0:
+                        raise ValueError(
+                            f"input dim 0 ({a.shape[0]}) not divisible by "
+                            f"data-axis size {dp}; pass "
+                            f"ShardingPlan(input_specs=...) for non-batch "
+                            f"inputs")
+            layout = self._plan_layouts.get(key)
+            if layout is None or layout[0] != names:
+                param_specs = {
+                    n: s for n, t in model.get_params().items()
+                    if (s := getattr(t, "partition_spec", None)) is not None
+                }
+                layout = (names, [
+                    plan.sharding(plan.spec_for_state(n, t, param_specs))
+                    for n, t in zip(names, tensors)
+                ], [
+                    plan.sharding(plan.spec_for_input(a, i))
+                    for i, a in enumerate(in_arrays)
+                ], plan.sharding(P()))
+                self._plan_layouts[key] = layout
+            _, state_sh, in_sh, rep = layout
+            state_arrays = [jax.device_put(t.data, s)
+                            for t, s in zip(tensors, state_sh)]
+            state_arrays.append(jax.device_put(dev._rng_key, rep))
+            in_arrays = [jax.device_put(a, s)
+                         for a, s in zip(in_arrays, in_sh)]
+        elif model.dist:
             # replicate state over the mesh, shard batch inputs on dim 0
             from jax.sharding import NamedSharding
 
@@ -280,22 +348,37 @@ class _GraphRunner:
                             for t in tensors]
             state_arrays.append(jax.device_put(dev._rng_key, dev.jax_device))
 
-        if key not in self._compiled or self._compiled[key][1] != names:
-            fn = self._build(args, kwargs, names)
-            cost = None
-            try:
-                compiled = fn.lower(state_arrays, in_arrays).compile()
-                cost = compiled.cost_analysis()
-                fn = compiled
-            except Exception:
-                pass  # fall back to on-demand jit compile
-            self._compiled[key] = (fn, names, cost)
-        fn = self._compiled[key][0]
-        new_state, out_tree = fn(state_arrays, in_arrays)
+        if model.sharding_plan is not None and not model.dist:
+            # activate the plan while tracing so constrain() ops pin
+            # GSPMD layouts (they are identity outside planned traces)
+            from .parallel.sharding import _PlanActive
+            trace_ctx = _PlanActive()
+        else:
+            import contextlib
+            trace_ctx = contextlib.nullcontext()
+        with trace_ctx:
+            if key not in self._compiled or self._compiled[key][1] != names:
+                fn = self._build(args, kwargs, names)
+                cost = None
+                try:
+                    compiled = fn.lower(state_arrays, in_arrays).compile()
+                    cost = compiled.cost_analysis()
+                    fn = compiled
+                except Exception:
+                    pass  # fall back to on-demand jit compile
+                self._compiled[key] = (fn, names, cost)
+            fn = self._compiled[key][0]
+            new_state, out_tree = fn(state_arrays, in_arrays)
         for t, a in zip(tensors, new_state[:-1]):
             t.data = a
             t.creator = None
         dev._rng_key = new_state[-1]
+        if model.dist or model.sharding_plan is not None:
+            # the step returns the PRNG key replicated over the mesh;
+            # re-commit it to the device's own chip so later EAGER rng
+            # use (e.g. initializing another model) doesn't propagate
+            # multi-device placement
+            dev._rng_key = jax.device_put(dev._rng_key, dev.jax_device)
         if model.dist and model.dist_outputs != "stack":
             # Outputs come back stacked per-rank (see _build).  The "auto"
             # reassembly contract: per-rank scalars, now (W,), become the

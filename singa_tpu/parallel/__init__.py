@@ -1,3 +1,17 @@
-"""Distributed training — the rebuild of the reference's NCCL/MPI
-``Communicator`` (src/io/communicator.cc, unverified) on ICI/DCN
-collectives via jax mesh + shard_map."""
+"""Distributed training & model parallelism.
+
+Two complementary paths:
+
+  * reference-parity data parallelism — the rebuild of the NCCL/MPI
+    ``Communicator`` (src/io/communicator.cc, unverified) on ICI/DCN
+    collectives via mesh + shard_map (communicator.py, dist_opt.py);
+  * TPU-native model parallelism the reference never had — a named
+    multi-axis mesh with GSPMD sharding plans (sharding.py), Megatron
+    tensor parallelism (tensor_parallel.py), and ring-attention
+    sequence parallelism (ring_attention.py).
+"""
+
+from .sharding import (  # noqa: F401
+    AXES, DATA, EXPERT, MODEL, PIPE, SEQ,
+    ShardingPlan, constrain, create_mesh,
+)

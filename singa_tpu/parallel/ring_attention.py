@@ -27,10 +27,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def ring_self_attention(q, k, v, axis_name, causal=False):
+def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None):
     """Per-rank blocks inside shard_map: q,k,v (B, H, S_local, D).
     Returns (B, H, S_local, D) — the attention of local queries against
-    the FULL (globally sharded) key/value sequence."""
+    the FULL (globally sharded) key/value sequence.
+
+    ``kv_mask``: optional additive mask over KEY positions, shaped
+    (B, 1, 1, S_local) per rank (the sequence-sharded slice of a padding
+    mask like BERT's (B,1,1,S) -1e9 mask).  It rotates around the ring
+    with its K/V block, so every query applies the right slice."""
     axis_size = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
@@ -42,10 +47,12 @@ def ring_self_attention(q, k, v, axis_name, causal=False):
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def step(carry, t):
-        acc, m_prev, l_prev, k_cur, v_cur = carry
+        acc, m_prev, l_prev, k_cur, v_cur, mask_cur = carry
         # the K/V block currently held arrived from rank (rank - t) mod W
         src = (rank - t) % axis_size
         sc = jnp.einsum("bhsd,bhtd->bhst", qs, k_cur)
+        if mask_cur is not None:
+            sc = sc + mask_cur
         if causal:
             k_pos = src * s_loc + jnp.arange(s_loc)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -56,16 +63,20 @@ def ring_self_attention(q, k, v, axis_name, causal=False):
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum("bhst,bhtd->bhsd", p, v_cur)
-        # rotate K/V one hop around the ICI ring
+        # rotate K/V (and the key mask) one hop around the ICI ring
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (acc, m_new, l_new, k_next, v_next), None
+        mask_next = (None if mask_cur is None
+                     else lax.ppermute(mask_cur, axis_name, perm))
+        return (acc, m_new, l_new, k_next, v_next, mask_next), None
 
     init = (jnp.zeros((b, h, s_loc, d), jnp.float32),
             jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
             jnp.zeros((b, h, s_loc), jnp.float32),
-            k, v)
-    (acc, m, l, _, _), _ = lax.scan(step, init, jnp.arange(axis_size))
+            k, v, kv_mask)
+    (acc, m, l, *_), _ = lax.scan(step, init, jnp.arange(axis_size))
+    # fully-masked rows (l == 0) normalize to 0, not NaN
+    l = jnp.where(l == 0.0, 1.0, l)
     return (acc / l[..., None]).astype(q.dtype)
 
 

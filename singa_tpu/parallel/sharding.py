@@ -1,0 +1,203 @@
+"""Multi-axis device mesh + sharding plan — the model-parallel layer of the
+framework.
+
+The reference (apache/singa, SURVEY.md §2.3) ships exactly one parallelism
+strategy: synchronous data parallelism over NCCL (``opt.DistOpt``), rebuilt
+here as the shard_map path in ``model._GraphRunner``.  This module is the
+TPU-native generalization the survey leaves as the designed extension
+point: a named ``jax.sharding.Mesh`` over up to five axes —
+
+  * ``data``   — batch (data parallelism; grads psum'd by XLA)
+  * ``model``  — tensor parallelism (Megatron-style column/row sharding,
+                 see parallel/tensor_parallel.py)
+  * ``seq``    — sequence/context parallelism (ring attention over ICI,
+                 parallel/ring_attention.py)
+  * ``pipe``   — pipeline parallelism (GPipe microbatching over ppermute)
+  * ``expert`` — expert parallelism (MoE all-to-all dispatch)
+
+— plus a ``ShardingPlan`` that maps every persistent state tensor and
+batch input to a ``PartitionSpec``.  The execution model is GSPMD: the
+training step is jitted ONCE over globally-shaped arrays whose shardings
+are set by ``device_put`` + in-graph ``with_sharding_constraint``; XLA's
+SPMD partitioner inserts the all-reduce / all-gather / reduce-scatter /
+all-to-all collectives over ICI.  This is deliberately NOT a translation
+of the reference's NCCL calls: explicit collectives appear only where the
+partitioner cannot infer them (ring attention's ppermute, the pipeline's
+stage rotation).
+
+Composes with the tape autograd: parameters carry a ``partition_spec``
+attribute; activations are constrained through ``constrain()``, a taped
+op (identity in eager mode, ``lax.with_sharding_constraint`` while the
+graph-mode step is being traced with a plan active).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import autograd
+
+__all__ = [
+    "DATA", "MODEL", "SEQ", "PIPE", "EXPERT", "AXES",
+    "create_mesh", "ShardingPlan", "constrain", "plan_active",
+]
+
+DATA = "data"
+MODEL = "model"
+SEQ = "seq"
+PIPE = "pipe"
+EXPERT = "expert"
+AXES = (DATA, MODEL, SEQ, PIPE, EXPERT)
+
+# True while a graph-mode step is being traced under a ShardingPlan;
+# constrain() is the identity otherwise (eager compile-time dummy
+# forwards run on one device where a mesh constraint is meaningless).
+_plan_active = False
+
+
+def plan_active() -> bool:
+    return _plan_active
+
+
+class _PlanActive:
+    """Context manager the graph runner wraps its trace in."""
+
+    def __enter__(self):
+        global _plan_active
+        self._prev = _plan_active
+        _plan_active = True
+
+    def __exit__(self, *exc):
+        global _plan_active
+        _plan_active = self._prev
+        return False
+
+
+def create_mesh(dp=1, tp=1, sp=1, pp=1, ep=1, devices=None) -> Mesh:
+    """Mesh over ``(data, model, seq, pipe, expert)`` axes (size-1 axes are
+    kept: sharding over a singleton axis is a no-op, and keeping every
+    name means every PartitionSpec in the framework is always valid).
+
+    On a real slice, axis order is layout: the trailing axes vary fastest
+    over the device list, so put the heaviest-communication axis (model/
+    seq — activation-sized collectives every layer) innermost where
+    neighbours share an ICI link, and data (one gradient all-reduce per
+    step) outermost, possibly over DCN.
+    """
+    sizes = dict(dp=int(dp), tp=int(tp), sp=int(sp), pp=int(pp), ep=int(ep))
+    n = math.prod(sizes.values())
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh dp*tp*sp*pp*ep={n} needs {n} devices, have "
+            f"{len(devices)} — provision a virtual CPU mesh via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            f"(tests/conftest.py) or shrink the mesh")
+    arr = np.asarray(devices[:n]).reshape(
+        sizes["dp"], sizes["tp"], sizes["sp"], sizes["pp"], sizes["ep"])
+    return Mesh(arr, (DATA, MODEL, SEQ, PIPE, EXPERT))
+
+
+class ShardingPlan:
+    """Maps persistent state + batch inputs to shardings over a mesh.
+
+    Parameter specs come from (highest priority first):
+      1. the tensor's own ``partition_spec`` attribute (set by the
+         parallel layers in tensor_parallel / moe / pipeline);
+      2. ``rules``: ordered ``(regex, PartitionSpec)`` pairs matched
+         against the state name;
+      3. replicated ``P()``.
+
+    Optimizer slots (``__opt__{param}:{slot}``) inherit their parameter's
+    spec — a momentum buffer is laid out exactly like its weight, which
+    is what makes the optimizer update fully local (no collective in the
+    update, like the reference's per-GPU DistOpt update after allreduce).
+
+    ``shard_inputs``: batch arrays are sharded ``data`` on dim 0 and —
+    when the mesh has a real seq axis and the array looks like (B, S,
+    ...) tokens — ``seq`` on dim 1.  Override per-model via
+    ``input_specs`` (list matched positionally against the step's tensor
+    inputs).
+    """
+
+    def __init__(self, mesh: Mesh, rules=(), input_specs=None,
+                 shard_seq_inputs=True):
+        self.mesh = mesh
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.input_specs = input_specs
+        self.shard_seq_inputs = bool(shard_seq_inputs)
+
+    # -- mesh facts --------------------------------------------------------
+    def axis_size(self, name) -> int:
+        return self.mesh.shape.get(name, 1)
+
+    @property
+    def world(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def sharding(self, spec) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    # -- state -------------------------------------------------------------
+    def spec_for_state(self, name, t, param_specs=None) -> P:
+        spec = getattr(t, "partition_spec", None)
+        if spec is not None:
+            return spec
+        base = name
+        if name.startswith("__opt__"):
+            base = name[len("__opt__"):].rsplit(":", 1)[0]
+            if param_specs and base in param_specs:
+                return param_specs[base]
+        for pat, s in self.rules:
+            if pat.search(base):
+                return s
+        return P()
+
+    # -- inputs ------------------------------------------------------------
+    def spec_for_input(self, arr, index) -> P:
+        if self.input_specs is not None:
+            return self.input_specs[index]
+        if arr.ndim == 0:
+            return P()
+        dims = [None] * arr.ndim
+        if arr.shape[0] % self.axis_size(DATA) == 0:
+            dims[0] = DATA
+        if (self.shard_seq_inputs and arr.ndim >= 2
+                and self.axis_size(SEQ) > 1
+                and arr.shape[1] % self.axis_size(SEQ) == 0):
+            dims[1] = SEQ
+        return P(*dims)
+
+    # -- activation spec helper (used by the parallel layers) --------------
+    def act_spec(self, ndim, model_last=False, seq_dim=1) -> P:
+        """(B, ..., E)-shaped activation: data on dim 0, seq on ``seq_dim``
+        (when the mesh shards sequences), model on the last dim when the
+        activation is the output of a column-parallel projection."""
+        dims = [None] * ndim
+        dims[0] = DATA
+        if ndim >= 3 and self.axis_size(SEQ) > 1 and seq_dim < ndim - 1:
+            dims[seq_dim] = SEQ
+        if model_last:
+            dims[-1] = MODEL
+        return P(*dims)
+
+
+def constrain(x, plan: ShardingPlan, spec) -> "autograd.Tensor":
+    """Taped sharding-constraint op: identity in eager mode, a GSPMD
+    layout pin while a planned graph step is being traced.  The VJP of
+    with_sharding_constraint is with_sharding_constraint — gradients
+    respect the same layout, so e.g. a column-parallel weight's grad is
+    born sharded and never materializes replicated."""
+    if not _plan_active:
+        return x
+    ns = plan.sharding(spec)
+    return autograd._op(
+        lambda v: jax.lax.with_sharding_constraint(v, ns),
+        x, _name="ShardConstraint")

@@ -1,0 +1,323 @@
+"""Tensor parallelism — Megatron-style sharded transformer layers.
+
+The reference has NO tensor parallelism (SURVEY.md §2.3: data-parallel
+DistOpt is its only modern strategy); this is the TPU-native extension
+the survey marks as the ``('data','model')`` mesh-axis design point.
+
+Execution model (see parallel/sharding.py): parameters carry
+``PartitionSpec``s over the ``model`` axis; the jitted step runs under
+GSPMD, which turns the annotated einsums into local matmuls + the
+canonical Megatron collectives —
+
+  * ``ColumnParallelLinear``  W:(in, out/model) — activations leave
+    sharded on the feature dim, no communication;
+  * ``RowParallelLinear``     W:(in/model, out) — consumes feature-
+    sharded activations, XLA inserts the all-reduce (psum over
+    ``model``) that closes the pair;
+  * attention: heads sharded over ``model`` (column q/k/v + row output
+    projection ⇒ exactly one all-reduce per attention block);
+  * MLP: column fc1 + row fc2 ⇒ one all-reduce per MLP block;
+  * ``VocabParallelEmbedding``: table rows sharded over ``model``; the
+    sharded gather lowers to a one-hot matmul + psum on TPU.
+
+Everything also runs UNSHARDED (plan=None or eager mode): the layers
+degrade to their serial equivalents, so one model definition serves
+single-chip and multi-chip.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .. import amp, autograd, initializer
+from ..layer import Layer
+from ..tensor import Tensor
+from . import sharding
+from .sharding import DATA, MODEL, SEQ, P, ShardingPlan, constrain
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelMLP", "ParallelMHA", "ParallelTransformerBlock",
+]
+
+
+class ColumnParallelLinear(Layer):
+    """y = x W + b with W's OUTPUT dim sharded over ``model``.
+
+    ``gather_output=False`` (default) leaves y sharded on its last dim —
+    feed it to a RowParallelLinear or another column-sharded consumer."""
+
+    def __init__(self, out_features, plan: ShardingPlan | None = None,
+                 bias=True, gather_output=False):
+        super().__init__()
+        self.out_features = int(out_features)
+        self.plan = plan
+        self.bias = bool(bias)
+        self.gather_output = bool(gather_output)
+
+    def initialize(self, x):
+        in_features = x.shape[-1]
+        dt = amp.param_dtype(x.data.dtype)
+        self.W = Tensor((in_features, self.out_features), device=x.device,
+                        dtype=dt, requires_grad=True, stores_grad=True)
+        initializer.xavier(self.W)
+        self.W.partition_spec = P(None, MODEL)
+        if self.bias:
+            self.b = Tensor((self.out_features,), device=x.device, dtype=dt,
+                            requires_grad=True, stores_grad=True)
+            self.b.set_value(0.0)
+            self.b.partition_spec = P(MODEL)
+
+    def forward(self, x):
+        y = autograd.matmul(x, self.W)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        if self.plan is not None:
+            spec = self.plan.act_spec(len(y.shape),
+                                      model_last=not self.gather_output)
+            y = constrain(y, self.plan, spec)
+        return y
+
+
+class RowParallelLinear(Layer):
+    """y = x W + b with W's INPUT dim sharded over ``model``; closes a
+    column-parallel pair — XLA emits the single psum here."""
+
+    def __init__(self, out_features, plan: ShardingPlan | None = None,
+                 bias=True):
+        super().__init__()
+        self.out_features = int(out_features)
+        self.plan = plan
+        self.bias = bool(bias)
+
+    def initialize(self, x):
+        in_features = x.shape[-1]
+        dt = amp.param_dtype(x.data.dtype)
+        self.W = Tensor((in_features, self.out_features), device=x.device,
+                        dtype=dt, requires_grad=True, stores_grad=True)
+        initializer.xavier(self.W)
+        self.W.partition_spec = P(MODEL, None)
+        if self.bias:
+            # bias is applied AFTER the reduction — replicated
+            self.b = Tensor((self.out_features,), device=x.device, dtype=dt,
+                            requires_grad=True, stores_grad=True)
+            self.b.set_value(0.0)
+
+    def forward(self, x):
+        y = autograd.matmul(x, self.W)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        if self.plan is not None:
+            y = constrain(y, self.plan,
+                          self.plan.act_spec(len(y.shape), model_last=False))
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table with vocab rows sharded over ``model``."""
+
+    def __init__(self, vocab_size, embed_dim,
+                 plan: ShardingPlan | None = None, std=0.02):
+        super().__init__()
+        self.vocab_size = int(vocab_size)
+        self.embed_dim = int(embed_dim)
+        self.plan = plan
+        self.std = float(std)
+
+    def initialize(self, ids):
+        self.W = Tensor((self.vocab_size, self.embed_dim), device=ids.device,
+                        requires_grad=True, stores_grad=True)
+        self.W.gaussian(0.0, self.std)
+        self.W.partition_spec = P(MODEL, None)
+
+    def forward(self, ids):
+        e = autograd.embedding(ids, self.W)
+        if self.plan is not None:
+            e = constrain(e, self.plan, self.plan.act_spec(len(e.shape)))
+        return e
+
+
+class ParallelMLP(Layer):
+    """Transformer FFN: column fc1 → activation → row fc2 (one psum)."""
+
+    def __init__(self, hidden, intermediate, plan: ShardingPlan | None = None,
+                 activation="gelu"):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(intermediate, plan)
+        self.fc2 = RowParallelLinear(hidden, plan)
+        self.activation = activation
+
+    def forward(self, x):
+        h = self.fc1(x)
+        h = getattr(autograd, self.activation)(h)
+        return self.fc2(h)
+
+
+class ParallelMHA(Layer):
+    """Multi-head attention with heads sharded over ``model``.
+
+    q/k/v projections are column-parallel (head dim ⊂ feature dim, so the
+    per-head split is a local reshape of the sharded feature axis); the
+    output projection is row-parallel.  With a real ``seq`` mesh axis and
+    ``seq_parallel=True``, the score/value contraction runs as ring
+    attention (parallel/ring_attention.py) over the ICI ring — activations
+    stay sharded (B@data, H@model, S@seq, D) end to end, so max sequence
+    length scales with the seq-axis size (the long-context design the
+    reference lacks, SURVEY.md §5.7)."""
+
+    def __init__(self, num_heads, plan: ShardingPlan | None = None,
+                 dropout=0.0, seq_parallel=None, causal=False):
+        super().__init__()
+        self.num_heads = int(num_heads)
+        self.plan = plan
+        self.dropout = float(dropout)
+        self.causal = bool(causal)
+        if seq_parallel is None:
+            seq_parallel = plan is not None and plan.axis_size(SEQ) > 1
+        self.seq_parallel = bool(seq_parallel)
+        self.q_proj = ColumnParallelLinear(0, plan)
+        self.k_proj = ColumnParallelLinear(0, plan)
+        self.v_proj = ColumnParallelLinear(0, plan)
+        self.out_proj = RowParallelLinear(0, plan)
+        if plan is not None and self.num_heads % plan.axis_size(MODEL) != 0:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by model-axis "
+                f"size {plan.axis_size(MODEL)}")
+
+    def initialize(self, x, mask=None):
+        e = x.shape[-1]
+        if e % self.num_heads != 0:
+            raise ValueError(
+                f"embed dim {e} not divisible by num_heads {self.num_heads}")
+        for proj in (self.q_proj, self.k_proj, self.v_proj, self.out_proj):
+            proj.out_features = e
+
+    def _heads_spec(self):
+        # (B, H, S, D): batch@data, heads@model, seq@seq when ring
+        return P(DATA, MODEL, SEQ if self.seq_parallel else None, None)
+
+    def forward(self, x, mask=None):
+        b, s, e = x.shape
+        h = self.num_heads
+        d = e // h
+        plan = self.plan
+
+        def split_heads(t):
+            t = autograd.reshape(t, (b, s, h, d))
+            t = autograd.transpose(t, (0, 2, 1, 3))
+            if plan is not None:
+                t = constrain(t, plan, self._heads_spec())
+            return t
+
+        q = split_heads(self.q_proj(x))
+        k = split_heads(self.k_proj(x))
+        v = split_heads(self.v_proj(x))
+
+        if self.seq_parallel and plan is not None \
+                and sharding.plan_active():
+            ctx = _ring_attention_op(q, k, v, mask, plan, self.causal)
+        else:
+            ctx = _sdpa(q, k, v, mask, self.causal)
+        ctx = autograd.transpose(ctx, (0, 2, 1, 3))
+        ctx = autograd.reshape(ctx, (b, s, e))
+        if plan is not None:
+            ctx = constrain(ctx, plan,
+                            plan.act_spec(3, model_last=True))
+        if self.dropout > 0:
+            ctx = autograd.dropout(ctx, self.dropout)
+        return self.out_proj(ctx)
+
+
+class ParallelTransformerBlock(Layer):
+    """Pre-LN transformer block from the parallel pieces: exactly two
+    psums over ``model`` per block (attention out-proj + MLP fc2)."""
+
+    def __init__(self, num_heads, intermediate, plan=None, dropout=0.0,
+                 causal=False, eps=1e-5):
+        super().__init__()
+        from ..layer import LayerNorm
+
+        self.ln1 = LayerNorm(eps)
+        self.attn = ParallelMHA(num_heads, plan, dropout=dropout,
+                                causal=causal)
+        self.ln2 = LayerNorm(eps)
+        self.mlp = None  # needs hidden size; built at initialize
+        self._intermediate = int(intermediate)
+        self._plan = plan
+        self._dropout = float(dropout)
+
+    def initialize(self, x, mask=None):
+        hidden = x.shape[-1]
+        self.mlp = ParallelMLP(hidden, self._intermediate, self._plan)
+
+    def forward(self, x, mask=None):
+        a = self.attn(self.ln1(x), mask)
+        if self._dropout > 0:
+            a = autograd.dropout(a, self._dropout)
+        x = autograd.add(x, a)
+        m = self.mlp(self.ln2(x))
+        if self._dropout > 0:
+            m = autograd.dropout(m, self._dropout)
+        return autograd.add(x, m)
+
+
+# ---------------------------------------------------------------------------
+# attention kernels (taped)
+# ---------------------------------------------------------------------------
+
+def _sdpa(q, k, v, mask, causal):
+    """Plain scaled-dot-product attention (B,H,S,D); heads may be sharded
+    — the einsums are head-local so GSPMD keeps them collective-free."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def f(qv, kv, vv, *rest):
+        sc = jnp.einsum("bhsd,bhtd->bhst", qv, kv) * scale
+        if rest:
+            sc = sc + rest[0]
+        if causal:
+            s_, t_ = sc.shape[-2:]
+            cm = jnp.tril(jnp.ones((s_, t_), bool))
+            sc = jnp.where(cm[None, None], sc, -1e30)
+        p = jnp.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return jnp.einsum("bhst,bhtd->bhsd", p, vv)
+
+    xs = (q, k, v) if mask is None else (q, k, v, mask)
+    return autograd._op(f, *xs, _name="TPAttention")
+
+
+def _ring_attention_op(q, k, v, mask, plan, causal):
+    """Ring attention as a taped op: shard_map over the FULL mesh with
+    (B@data, H@model, S@seq, D) blocks; the K/V ring rotates over the
+    ``seq`` axis only (lax.ppermute — the one collective XLA cannot
+    infer).  Differentiable end-to-end (scan+ppermute have exact VJPs).
+
+    ``mask`` (optional): a (B, 1, 1, S) additive key-padding mask; its
+    key dim is sequence-sharded and rotates around the ring with K/V.
+    Masks with a query dim (full (B,H,S,S) biases) are not expressible
+    blockwise here — use seq_parallel=False for those."""
+    import jax
+
+    from .ring_attention import ring_self_attention
+
+    spec = P(DATA, MODEL, SEQ, None)
+    if mask is not None:
+        if mask.shape[-2] != 1:
+            raise NotImplementedError(
+                "ring attention supports key-padding masks (B,1,1,S); "
+                "per-query masks need seq_parallel=False")
+        mspec = P(DATA, None, None, SEQ)
+        f = jax.shard_map(
+            lambda q_, k_, v_, m_: ring_self_attention(
+                q_, k_, v_, SEQ, causal=causal, kv_mask=m_),
+            mesh=plan.mesh, in_specs=(spec, spec, spec, mspec),
+            out_specs=spec, check_vma=False)
+        return autograd._op(f, q, k, v, mask, _name="RingAttention")
+    f = jax.shard_map(
+        lambda q_, k_, v_: ring_self_attention(q_, k_, v_, SEQ,
+                                               causal=causal),
+        mesh=plan.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return autograd._op(f, q, k, v, _name="RingAttention")
