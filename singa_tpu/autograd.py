@@ -390,6 +390,12 @@ def pow(a, b):  # noqa: A001
     return _op(jnp.power, a, b, _name="Pow")
 
 
+def mul_scalar(a, s):
+    """a * python-scalar s (reference: autograd.mul with a scalar arg —
+    the scalar is closed over, not taped)."""
+    return _op(lambda v: v * s, a, _name="MulScalar")
+
+
 def minimum(a, b):
     return _op(jnp.minimum, a, b, _name="Min")
 

@@ -1,0 +1,194 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh
+axis.
+
+The reference has no MoE (SURVEY.md §2.3: expert parallelism "not
+required for parity"); this is the TPU-native extension point.  Design
+follows the GShard/Switch formulation, which is exactly the shape the
+XLA SPMD partitioner was built around:
+
+  * token-choice top-k gating with a static per-expert capacity
+    (C = ceil(k·N/E·capacity_factor)) — static shapes, no dynamic
+    gather/scatter, everything tiles onto the MXU;
+  * dispatch/combine are one-hot einsums ``(N,E,C)×(N,D)→(E,C,D)``; with
+    tokens sharded over ``data`` and experts sharded over ``expert``,
+    GSPMD lowers these contractions to the all-to-all exchange the
+    reference-era frameworks hand-code with NCCL;
+  * expert FFNs are a single batched einsum over the (E, …) leading dim,
+    sharded ``P(expert, …)`` — each chip runs only its resident experts;
+  * the standard load-balance auxiliary loss (mean fraction·probability
+    product, scaled by E²) is exposed as ``last_aux_loss`` for the model
+    to add to its objective — it flows gradients into the router.
+
+Tokens over capacity are dropped (their combine weight is zero and the
+residual path carries them), matching Switch-Transformer semantics.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import amp, autograd
+from ..layer import Layer
+from ..tensor import Tensor
+from . import sharding
+from .sharding import EXPERT, P, ShardingPlan
+
+__all__ = ["MoEFFN"]
+
+
+def _top2_dispatch(probs, capacity):
+    """GShard top-2 token-choice routing.
+
+    probs: (N, E) router softmax.  Returns (dispatch, combine, aux):
+    dispatch (N, E, C) 0/1, combine (N, E, C) gate-weighted, aux scalar
+    load-balance loss.
+    """
+    n, e = probs.shape
+    idx1 = jnp.argmax(probs, axis=-1)                       # (N,)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=probs.dtype)      # (N, E)
+    gate1 = jnp.sum(probs * mask1, axis=-1)                 # (N,)
+
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=probs.dtype)
+    gate2 = jnp.sum(probs2 * mask2, axis=-1)
+
+    # load-balance aux loss (GShard eq. 4 / Switch §2.2): fraction of
+    # first-choice tokens per expert × mean router prob, scaled by E so
+    # a uniform router gives exactly 1.0 (same convention as top-1)
+    frac = jnp.mean(mask1, axis=0)                          # (E,)
+    pmean = jnp.mean(probs, axis=0)                         # (E,)
+    aux = jnp.sum(frac * pmean) * e
+
+    # positions within each expert: first choices fill first
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1        # (N, E)
+    count1 = jnp.sum(mask1, axis=0, keepdims=True)          # (1, E)
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2 + count1) * mask2
+
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    # renormalize the two gates over what survived
+    g1 = gate1 * jnp.sum(keep1, axis=-1)
+    g2 = gate2 * jnp.sum(keep2, axis=-1)
+    denom = g1 + g2
+    denom = jnp.where(denom <= 0.0, 1.0, denom)
+    g1, g2 = g1 / denom, g2 / denom
+
+    pos1_idx = jnp.sum(pos1, axis=-1).astype(jnp.int32)     # (N,)
+    pos2_idx = jnp.sum(pos2, axis=-1).astype(jnp.int32)
+    cap1 = jax.nn.one_hot(pos1_idx, capacity, dtype=probs.dtype)
+    cap2 = jax.nn.one_hot(pos2_idx, capacity, dtype=probs.dtype)
+
+    d1 = keep1[:, :, None] * cap1[:, None, :]               # (N, E, C)
+    d2 = keep2[:, :, None] * cap2[:, None, :]
+    dispatch = d1 + d2
+    combine = g1[:, None, None] * d1 + g2[:, None, None] * d2
+    return dispatch, combine, aux
+
+
+def _top1_dispatch(probs, capacity):
+    """Switch-Transformer top-1 routing."""
+    n, e = probs.shape
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=probs.dtype)
+    gate1 = jnp.sum(probs * mask1, axis=-1)
+
+    frac = jnp.mean(mask1, axis=0)
+    pmean = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac * pmean) * e
+
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    keep1 = mask1 * (pos1 < capacity)
+    pos1_idx = jnp.sum(pos1, axis=-1).astype(jnp.int32)
+    cap1 = jax.nn.one_hot(pos1_idx, capacity, dtype=probs.dtype)
+    dispatch = keep1[:, :, None] * cap1[:, None, :]
+    combine = gate1[:, None, None] * dispatch
+    return dispatch, combine, aux
+
+
+class MoEFFN(Layer):
+    """Drop-in replacement for a transformer FFN: E expert MLPs with
+    top-k routing; experts sharded over the ``expert`` mesh axis.
+
+    After ``forward``, ``last_aux_loss`` holds the taped load-balance
+    loss — add ``aux_weight * moe.last_aux_loss`` to the training
+    objective (see tests/test_moe.py::MoEModel for the wiring)."""
+
+    def __init__(self, num_experts, intermediate,
+                 plan: ShardingPlan | None = None, top_k=2,
+                 capacity_factor=1.25, activation="gelu"):
+        super().__init__()
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 (Switch) or 2 (GShard)")
+        self.num_experts = int(num_experts)
+        self.intermediate = int(intermediate)
+        self.plan = plan
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.activation = activation
+        self.last_aux_loss = None
+
+    def initialize(self, x):
+        d = x.shape[-1]
+        e, f = self.num_experts, self.intermediate
+        dt = amp.param_dtype(x.data.dtype)
+        dev = x.device
+
+        def param(shape, std, spec):
+            t = Tensor(shape, device=dev, dtype=dt, requires_grad=True,
+                       stores_grad=True)
+            t.gaussian(0.0, std)
+            t.partition_spec = spec
+            return t
+
+        # router stays replicated (tiny); experts shard over `expert`
+        self.Wg = param((d, e), 1.0 / math.sqrt(d), P())
+        self.W1 = param((e, d, f), math.sqrt(2.0 / d), P(EXPERT, None, None))
+        self.b1 = param((e, f), 0.0, P(EXPERT, None))
+        self.W2 = param((e, f, d), math.sqrt(2.0 / f), P(EXPERT, None, None))
+        self.b2 = param((e, d), 0.0, P(EXPERT, None))
+
+    def _capacity(self, n):
+        return max(1, int(math.ceil(
+            self.top_k * n / self.num_experts * self.capacity_factor)))
+
+    def forward(self, x):
+        b, s, d = x.shape
+        n = b * s
+        cap = self._capacity(n)
+        plan = self.plan
+        act = getattr(jax.nn, self.activation)
+        route = _top2_dispatch if self.top_k == 2 else _top1_dispatch
+
+        def f(xv, wg, w1, b1, w2, b2):
+            xt = xv.reshape(n, d)
+            # route in fp32 — bf16 cumsum positions go wrong past 256
+            probs = jax.nn.softmax(
+                (xt @ wg.astype(xt.dtype)).astype(jnp.float32), axis=-1)
+            dispatch, combine, aux = route(probs, cap)
+            dispatch = dispatch.astype(xt.dtype)
+            combine = combine.astype(xt.dtype)
+            # dispatch: tokens -> (E, C, D); GSPMD turns this into the
+            # data->expert all-to-all when N@data and E@expert
+            ein = jnp.einsum("nec,nd->ecd", dispatch, xt)
+            if plan is not None and sharding.plan_active():
+                ein = jax.lax.with_sharding_constraint(
+                    ein, plan.sharding(P(EXPERT, None, None)))
+            h = act(jnp.einsum("ecd,edf->ecf", ein, w1) + b1[:, None, :])
+            out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+            if plan is not None and sharding.plan_active():
+                out = jax.lax.with_sharding_constraint(
+                    out, plan.sharding(P(EXPERT, None, None)))
+            # combine: (E, C, D) -> tokens (the reverse all-to-all)
+            y = jnp.einsum("nec,ecd->nd", combine, out)
+            return y.reshape(b, s, d), aux.astype(jnp.float32)
+
+        y, aux = autograd._op(
+            f, x, self.Wg, self.W1, self.b1, self.W2, self.b2,
+            _name="MoEFFN")
+        self.last_aux_loss = aux
+        return y

@@ -1,0 +1,242 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the ``pipe``
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); this is the
+TPU-native extension.  Design (the shard_map+ppermute pattern, not a
+torch-style stage-process translation):
+
+  * the L identical transformer blocks' parameters are STACKED with a
+    leading layer dim sharded ``P(pipe, ...)`` — each chip holds the
+    weights of its L/P resident layers and scans over them locally;
+  * the global batch splits into M microbatches; activations flow
+    stage-to-stage via ``lax.ppermute`` one ICI hop forward per tick,
+    M + P - 1 ticks total (bubble fraction (P-1)/(M+P-1));
+  * the whole schedule is a ``lax.scan`` inside one ``shard_map`` —
+    jax.vjp differentiates it end-to-end, and the reverse pass is
+    automatically the reverse pipeline (ppermute's transpose is the
+    backward hop);
+  * the last stage's outputs are masked-psum'd over ``pipe`` so every
+    rank returns the same global result (cheap: activations, not
+    params).
+
+Composes with data parallelism (microbatch dim sharded over ``data``);
+interleaving tensor parallelism inside a stage is left as the
+documented next extension (the block body would use the ``model`` axis
+inside this same shard_map).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import amp, autograd
+from ..layer import Layer
+from ..tensor import Tensor
+from .sharding import DATA, PIPE, P, ShardingPlan
+
+__all__ = ["gpipe_spmd", "PipelinedTransformer"]
+
+
+def gpipe_spmd(stage_fn, stage_params, x_mb, axis_name=PIPE):
+    """Run the GPipe schedule inside a shard_map.
+
+    stage_fn(local_params, x) -> y        (shape-preserving)
+    stage_params: pytree of per-rank arrays (this stage's layers)
+    x_mb: (M, mb, ...) microbatched input, identical on every pipe rank
+    Returns (M, mb, ...) outputs of the LAST stage, replicated over
+    ``axis_name`` via a masked psum.
+    """
+    world = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    m_count = x_mb.shape[0]
+    ticks = m_count + world - 1
+    fwd = [(i, i + 1) for i in range(world - 1)]  # no wraparound
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 pulls microbatch t (clamped; masked out when t >= M)
+        x0 = lax.dynamic_index_in_dim(
+            x_mb, jnp.minimum(t, m_count - 1), axis=0, keepdims=False)
+        x0 = jnp.where(t < m_count, x0, jnp.zeros_like(x0))
+        x_in = jnp.where(rank == 0, x0, buf)
+        y = stage_fn(stage_params, x_in)
+        # one hop forward; rank 0 receives zeros (uses x_mb instead)
+        buf_next = lax.ppermute(y, axis_name, fwd) if world > 1 else y
+        # last stage emits microbatch m = t - (world - 1)
+        m_idx = t - (world - 1)
+        emit = jnp.logical_and(rank == world - 1, m_idx >= 0)
+        slot = jnp.clip(m_idx, 0, m_count - 1)
+        outs = lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(emit,
+                      y,
+                      lax.dynamic_index_in_dim(outs, slot, 0,
+                                               keepdims=False)),
+            slot, axis=0)
+        return (buf_next, outs), None
+
+    zero_buf = jnp.zeros_like(
+        lax.dynamic_index_in_dim(x_mb, 0, 0, keepdims=False))
+    zero_out = jnp.zeros_like(x_mb)
+    (_, outs), _ = lax.scan(tick, (zero_buf, zero_out),
+                            jnp.arange(ticks))
+    # broadcast the last stage's buffer to every rank
+    mask = (rank == world - 1).astype(outs.dtype)
+    return lax.psum(outs * mask, axis_name)
+
+
+def _block_apply(lp, h, num_heads, causal, eps):
+    """One pre-LN transformer block in pure jnp over a param dict
+    (a single layer's slice of the stacked pipeline params)."""
+    mb, s, d = h.shape
+    hd = d // num_heads
+
+    def ln(x, g, b):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+    x = ln(h, lp["ln1_g"], lp["ln1_b"])
+    q = (x @ lp["wq"] + lp["bq"]).reshape(mb, s, num_heads, hd)
+    k = (x @ lp["wk"] + lp["bk"]).reshape(mb, s, num_heads, hd)
+    v = (x @ lp["wv"] + lp["bv"]).reshape(mb, s, num_heads, hd)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    sc = jnp.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(hd)
+    if causal:
+        cm = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(cm[None, None], sc, -1e30)
+    p = jax.nn.softmax(sc, axis=-1)
+    ctx = jnp.einsum("bhst,bhtd->bhsd", p, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(mb, s, d)
+    h = h + ctx @ lp["wo"] + lp["bo"]
+    x = ln(h, lp["ln2_g"], lp["ln2_b"])
+    f = jax.nn.gelu(x @ lp["w1"] + lp["b1"])
+    return h + f @ lp["w2"] + lp["b2"]
+
+
+_PARAM_ORDER = ("ln1_g", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv",
+                "wo", "bo", "ln2_g", "ln2_b", "w1", "b1", "w2", "b2")
+
+
+class PipelinedTransformer(Layer):
+    """L pre-LN transformer blocks executed as a GPipe pipeline over the
+    ``pipe`` mesh axis (plain sequential scan when plan is None or
+    pipe=1 — one definition serves single-chip and pipelined runs).
+
+    Parameters are stacked (L, ...) tensors sharded P(pipe, ...); inside
+    the shard_map each rank lax.scans over its resident L/P layers.
+    """
+
+    def __init__(self, num_layers, num_heads, intermediate,
+                 plan: ShardingPlan | None = None, num_microbatches=None,
+                 causal=True, eps=1e-5):
+        super().__init__()
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.intermediate = int(intermediate)
+        self.plan = plan
+        self.causal = bool(causal)
+        self.eps = float(eps)
+        pp = 1 if plan is None else plan.axis_size(PIPE)
+        if self.num_layers % pp != 0:
+            raise ValueError(
+                f"num_layers {self.num_layers} not divisible by pipe-axis "
+                f"size {pp}")
+        self.num_microbatches = (int(num_microbatches)
+                                 if num_microbatches else 2 * pp)
+
+    def initialize(self, x):
+        d = x.shape[-1]
+        f = self.intermediate
+        ll = self.num_layers
+        dt = amp.param_dtype(x.data.dtype)
+        dev = x.device
+
+        def param(shape, std, ones=False):
+            t = Tensor((ll,) + shape, device=dev, dtype=dt,
+                       requires_grad=True, stores_grad=True)
+            if ones:
+                t.set_value(1.0)
+            elif std > 0:
+                t.gaussian(0.0, std)
+            t.partition_spec = P(*([PIPE] + [None] * len(shape)))
+            return t
+
+        sd = 0.02
+        self.ln1_g = param((d,), 0, ones=True)
+        self.ln1_b = param((d,), 0)
+        self.wq = param((d, d), sd)
+        self.bq = param((d,), 0)
+        self.wk = param((d, d), sd)
+        self.bk = param((d,), 0)
+        self.wv = param((d, d), sd)
+        self.bv = param((d,), 0)
+        self.wo = param((d, d), sd)
+        self.bo = param((d,), 0)
+        self.ln2_g = param((d,), 0, ones=True)
+        self.ln2_b = param((d,), 0)
+        self.w1 = param((d, f), sd)
+        self.b1 = param((f,), 0)
+        self.w2 = param((f, d), sd)
+        self.b2 = param((d,), 0)
+
+    def _stage_fn(self):
+        nh, causal, eps = self.num_heads, self.causal, self.eps
+
+        def stage(local_params, x):
+            def one_layer(h, lp):
+                return _block_apply(lp, h, nh, causal, eps), None
+
+            y, _ = lax.scan(one_layer, x, local_params)
+            return y
+
+        return stage
+
+    def forward(self, x):
+        from . import sharding as shd
+
+        b, s, d = x.shape
+        params = [getattr(self, n) for n in _PARAM_ORDER]
+        plan = self.plan
+        pipelined = (plan is not None and plan.axis_size(PIPE) > 1
+                     and shd.plan_active())
+        stage = self._stage_fn()
+
+        if not pipelined:
+            def serial(xv, *ps):
+                lp = dict(zip(_PARAM_ORDER, ps))
+                return stage(lp, xv)
+
+            return autograd._op(serial, x, *params, _name="TransformerStack")
+
+        m_count = self.num_microbatches
+        if b % m_count != 0:
+            raise ValueError(
+                f"batch {b} not divisible by num_microbatches {m_count}")
+        mb = b // m_count
+        dp = plan.axis_size(DATA)
+        if mb % dp != 0:
+            raise ValueError(
+                f"microbatch {mb} not divisible by data-axis size {dp}")
+
+        pspec = [P(*([PIPE] + [None] * (t.data.ndim - 1))) for t in params]
+        xspec = P(None, DATA, None, None)  # (M, mb@data, S, D)
+
+        def run(xv, *ps):
+            x_mb = xv.reshape(m_count, mb, s, d)
+
+            def inner(x_mb_, *ps_):
+                lp = dict(zip(_PARAM_ORDER, ps_))
+                return gpipe_spmd(stage, lp, x_mb_, PIPE)
+
+            y = jax.shard_map(
+                inner, mesh=plan.mesh,
+                in_specs=(xspec,) + tuple(pspec),
+                out_specs=xspec, check_vma=False)(x_mb, *ps)
+            return y.reshape(b, s, d)
+
+        return autograd._op(run, x, *params, _name="GPipe")

@@ -235,7 +235,8 @@ class ParallelTransformerBlock(Layer):
     psums over ``model`` per block (attention out-proj + MLP fc2)."""
 
     def __init__(self, num_heads, intermediate, plan=None, dropout=0.0,
-                 causal=False, eps=1e-5):
+                 causal=False, eps=1e-5, moe_experts=None, moe_top_k=2,
+                 moe_capacity_factor=1.25):
         super().__init__()
         from ..layer import LayerNorm
 
@@ -247,10 +248,26 @@ class ParallelTransformerBlock(Layer):
         self._intermediate = int(intermediate)
         self._plan = plan
         self._dropout = float(dropout)
+        self._moe = (None if moe_experts is None
+                     else (int(moe_experts), int(moe_top_k),
+                           float(moe_capacity_factor)))
 
     def initialize(self, x, mask=None):
         hidden = x.shape[-1]
-        self.mlp = ParallelMLP(hidden, self._intermediate, self._plan)
+        if self._moe is not None:
+            from .moe import MoEFFN
+
+            e, k, cf = self._moe
+            self.mlp = MoEFFN(e, self._intermediate, self._plan,
+                              top_k=k, capacity_factor=cf)
+        else:
+            self.mlp = ParallelMLP(hidden, self._intermediate, self._plan)
+
+    @property
+    def aux_loss(self):
+        """Taped MoE load-balance loss from the last forward (None for a
+        dense block)."""
+        return getattr(self.mlp, "last_aux_loss", None)
 
     def forward(self, x, mask=None):
         a = self.attn(self.ln1(x), mask)

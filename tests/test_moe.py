@@ -1,0 +1,135 @@
+"""MoE expert parallelism: routing math, dense equivalence, EP-sharded
+vs serial equivalence, capacity drops, aux-loss gradient flow."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from singa_tpu import autograd, layer, model, opt, tensor
+from singa_tpu.parallel import sharding as shd
+from singa_tpu.parallel.moe import MoEFFN, _top1_dispatch, _top2_dispatch
+
+B, S, D, E, F = 2, 8, 16, 4, 32
+
+
+def test_top2_dispatch_shapes_and_gates():
+    rng = np.random.RandomState(0)
+    probs = jax.nn.softmax(jnp.asarray(rng.randn(10, E)), -1)
+    cap = 8
+    dispatch, combine, aux = _top2_dispatch(probs, cap)
+    assert dispatch.shape == (10, E, cap)
+    # every token dispatched to exactly 2 slots, combine weights sum to 1
+    np.testing.assert_allclose(np.asarray(dispatch.sum((1, 2))), 2.0)
+    np.testing.assert_allclose(np.asarray(combine.sum((1, 2))), 1.0,
+                               rtol=1e-5)
+    # each (expert, slot) used at most once
+    assert float(dispatch.sum(0).max()) <= 1.0 + 1e-6
+    assert float(aux) > 0
+
+
+def test_top1_capacity_drops():
+    # all tokens prefer expert 0; capacity 2 → only 2 survive
+    probs = jnp.tile(jnp.asarray([[0.9, 0.1]]), (6, 1))
+    dispatch, combine, aux = _top1_dispatch(probs, 2)
+    assert float(dispatch.sum()) == 2.0
+    # dropped tokens have zero combine weight
+    np.testing.assert_allclose(np.asarray(combine.sum((1, 2))),
+                               [0.9, 0.9, 0, 0, 0, 0], rtol=1e-6)
+
+
+def _dense_ffn(x, w1, b1, w2, b2):
+    h = jax.nn.gelu(x @ w1 + b1)
+    return h @ w2 + b2
+
+
+def test_top2_identical_experts_equals_dense():
+    """With identical experts and ample capacity, renormalized top-2
+    gates sum to 1, so the MoE output equals the shared expert's FFN."""
+    rng = np.random.RandomState(1)
+    x = tensor.from_numpy(rng.randn(B, S, D).astype(np.float32))
+    m = MoEFFN(E, F, plan=None, top_k=2, capacity_factor=4.0)
+    y = m(x)
+    # overwrite with identical experts
+    w1 = rng.randn(D, F).astype(np.float32) * 0.1
+    b1 = rng.randn(F).astype(np.float32) * 0.1
+    w2 = rng.randn(F, D).astype(np.float32) * 0.1
+    b2 = rng.randn(D).astype(np.float32) * 0.1
+    m.W1.copy_from_numpy(np.tile(w1, (E, 1, 1)))
+    m.b1.copy_from_numpy(np.tile(b1, (E, 1)))
+    m.W2.copy_from_numpy(np.tile(w2, (E, 1, 1)))
+    m.b2.copy_from_numpy(np.tile(b2, (E, 1)))
+    y = m(x)
+    ref = _dense_ffn(tensor.to_numpy(x), w1, b1, w2, b2)
+    np.testing.assert_allclose(tensor.to_numpy(y), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+class MoEModel(model.Model):
+    def __init__(self, plan=None, aux_weight=0.01):
+        super().__init__()
+        self.proj = layer.Linear(D)
+        self.moe = MoEFFN(E, F, plan=plan, top_k=2, capacity_factor=4.0)
+        self.head = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+        self.aux_weight = aux_weight
+
+    def forward(self, x):
+        h = self.moe(self.proj(x))
+        return self.head(autograd.reduce_mean(h, axes=(1,), keepdims=False))
+
+    def train_one_batch(self, x, y):
+        logits = self.forward(x)
+        loss = self.loss_fn(logits, y)
+        aux = self.moe.last_aux_loss
+        total = autograd.add(loss,
+                             autograd.mul_scalar(aux, self.aux_weight))
+        self.optimizer(total)
+        return logits, total
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(B, S, D).astype(np.float32)
+    y = rng.randint(0, 4, size=(B,)).astype(np.int32)
+    return x, y
+
+
+def test_ep_sharded_matches_serial():
+    mesh = shd.create_mesh(dp=2, ep=4)
+    plan = shd.ShardingPlan(mesh)
+
+    serial = MoEModel(plan=None)
+    par = MoEModel(plan=plan)
+    par.set_sharding_plan(plan)
+    for m in (serial, par):
+        x, y = _data()
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.compile([tensor.from_numpy(x)], is_train=True, use_graph=True)
+    par.set_states({k: tensor.to_numpy(v)
+                    for k, v in serial.get_states().items()})
+
+    for i in range(2):
+        x, y = _data(seed=i)
+        _, ls = serial(tensor.from_numpy(x), tensor.from_numpy(y))
+        _, lp = par(tensor.from_numpy(x), tensor.from_numpy(y))
+        np.testing.assert_allclose(
+            float(tensor.to_numpy(lp)), float(tensor.to_numpy(ls)),
+            rtol=2e-4)
+    for k, vs in serial.get_states().items():
+        np.testing.assert_allclose(
+            tensor.to_numpy(par.get_states()[k]), tensor.to_numpy(vs),
+            rtol=2e-3, atol=2e-4, err_msg=k)
+
+
+def test_aux_loss_trains_router():
+    """The aux loss must flow gradients into the router weights."""
+    m = MoEModel(plan=None, aux_weight=0.1)
+    x, y = _data()
+    m.set_optimizer(opt.SGD(lr=0.5))
+    m.compile([tensor.from_numpy(x)], is_train=True, use_graph=False)
+    wg0 = tensor.to_numpy(m.moe.Wg).copy()
+    m(tensor.from_numpy(x), tensor.from_numpy(y))
+    assert not np.allclose(tensor.to_numpy(m.moe.Wg), wg0), \
+        "router weights unchanged — aux/main loss not reaching Wg"
