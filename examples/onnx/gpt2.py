@@ -1,0 +1,96 @@
+"""GPT-2 via sonnx (reference: examples/onnx/gpt2.py imports a pretrained
+ONNX GPT-2, unverified — SURVEY.md §2.4's ONNX model zoo).  No network in
+this container, so by default this script builds the native GPT-2,
+round-trips it through ONNX export+import (decomposed causal attention,
+tied lm_head), checks the imported logits match, then trains causal-LM
+on synthetic batches and samples a continuation.  Pass --onnx-model to
+import a real checkpoint instead.
+
+    python examples/onnx/gpt2.py --size tiny --steps 10
+    python examples/onnx/gpt2.py --onnx-model gpt2.onnx
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+from singa_tpu import device, opt, sonnx, tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+
+def run(args):
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    if args.onnx_model:
+        print(f"importing {args.onnx_model} via sonnx")
+        rep = sonnx.prepare(args.onnx_model, dev)
+        ids = rng.randint(0, 50257, (args.batch_size, args.seq_length))
+        outs = rep.run([ids.astype(np.int64)])
+        print("imported model outputs:", [tuple(o.shape) for o in outs])
+        return
+
+    cfg = (GPT2Config.tiny(dropout=0.0) if args.size == "tiny"
+           else getattr(GPT2Config, args.size)())
+    m = GPT2LMHead(cfg)
+    m.set_optimizer(opt.Adam(lr=args.lr))
+
+    ids0 = tensor.from_numpy(
+        rng.randint(0, cfg.vocab_size,
+                    (args.batch_size, args.seq_length)).astype(np.int32),
+        dev)
+    m.compile([ids0], is_train=True, use_graph=args.use_graph)
+
+    # -- ONNX roundtrip: exported graph must reproduce native logits ----
+    m.eval()
+    native = tensor.to_numpy(m.forward(ids0))
+    rep = sonnx.prepare(sonnx.to_onnx(m, [ids0]), dev)
+    imported = tensor.to_numpy(rep.run([tensor.to_numpy(ids0)])[0])
+    err = float(np.abs(native - imported).max())
+    print(f"onnx roundtrip: max |native - imported| = {err:.2e}")
+    assert err < 1e-3, "ONNX roundtrip diverged"
+    m.train(True)
+
+    # -- synthetic causal-LM training -----------------------------------
+    t_hist = []
+    for step in range(args.steps):
+        raw = rng.randint(0, cfg.vocab_size,
+                          (args.batch_size, args.seq_length + 1))
+        x = tensor.from_numpy(raw[:, :-1].astype(np.int32), dev)
+        y = tensor.from_numpy(raw[:, 1:].astype(np.int32), dev)
+        t0 = time.time()
+        _, loss = m(x, y)
+        loss_v = float(loss.data)
+        dt = time.time() - t0
+        t_hist.append(dt)
+        if step % max(1, args.steps // 10) == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={loss_v:.4f} {dt * 1e3:.1f}ms")
+    steady = t_hist[2:] or t_hist
+    sps = args.batch_size / (sum(steady) / len(steady))
+    print(f"throughput: {sps:.1f} samples/s/chip "
+          f"(batch {args.batch_size}, seq {args.seq_length})")
+
+    out = m.generate(np.arange(8) % cfg.vocab_size,
+                     max_new_tokens=args.gen_tokens, temperature=0.8,
+                     rng=rng)
+    print(f"sampled continuation ({args.gen_tokens} new tokens):",
+          out.tolist())
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", choices=["tiny", "small", "medium"],
+                   default="tiny")
+    p.add_argument("--onnx-model", default=None)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-length", type=int, default=64)
+    p.add_argument("--gen-tokens", type=int, default=16)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--use-graph", action="store_true", default=True)
+    p.add_argument("--no-graph", dest="use_graph", action="store_false")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    run(args)

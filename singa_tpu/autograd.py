@@ -392,8 +392,8 @@ def pow(a, b):  # noqa: A001
 
 def mul_scalar(a, s):
     """a * python-scalar s (reference: autograd.mul with a scalar arg —
-    the scalar is closed over, not taped)."""
-    return _op(lambda v: v * s, a, _name="MulScalar")
+    the scalar rides op.params, not the tape, so sonnx can export it)."""
+    return _op(lambda v, s: v * s, a, _name="MulScalar", s=float(s))
 
 
 def minimum(a, b):

@@ -181,9 +181,13 @@ class TensorProto:
 
     @classmethod
     def from_numpy(cls, arr: np.ndarray, name=""):
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr)
+        # NB: ascontiguousarray promotes 0-d to (1,) — keep the true
+        # shape for dims (scalar initializers matter: a Gather with a
+        # 0-d index drops the axis, with a (1,) index it doesn't)
+        data = np.ascontiguousarray(arr)
         return cls(name=name, dims=list(arr.shape),
-                   data_type=NP_TO_DTYPE[arr.dtype], raw_data=arr.tobytes())
+                   data_type=NP_TO_DTYPE[arr.dtype], raw_data=data.tobytes())
 
 
 @dataclass

@@ -1,3 +1,3 @@
 """Model zoo mirroring the reference's ``examples/*/model/`` trees
 (SURVEY.md §2.4): MLP, CNN, AlexNet, ResNet, XceptionNet, char-RNN LSTM,
-BERT."""
+BERT, GPT-2 (incl. a tensor/sequence/expert-parallel GPT-MoE variant)."""

@@ -1,0 +1,190 @@
+"""GPT-2 — decoder-only causal LM.
+
+Reference parity note: upstream SINGA ships GPT-2 only as an
+ONNX-imported example (examples/onnx/gpt2.py, unverified — SURVEY.md
+§2.4 lists the ONNX model zoo); like models/bert.py, this is the
+TPU-native first-class implementation, and examples/onnx/gpt2.py
+round-trips it through sonnx.
+
+TPU-first design:
+  * the whole decoder is one jitted graph-mode step (fused causal
+    attention on the MXU);
+  * fully parallel-aware: pass a ``ShardingPlan`` and the blocks become
+    Megatron tensor-parallel (+ ring-attention sequence-parallel) via
+    parallel/tensor_parallel.py; ``moe_every`` turns every Nth MLP into
+    an expert-parallel GShard MoE (parallel/moe.py) — a GPT-MoE;
+  * ``tie_weights=True`` (GPT-2 convention) reuses the token embedding
+    as the LM head through a taped transpose-matmul.
+"""
+
+import numpy as np
+
+from .. import autograd, layer, model, tensor
+from ..tensor import Tensor
+
+
+class GPT2Config:
+    def __init__(self, vocab_size=50257, n_positions=1024, n_embd=768,
+                 n_layer=12, n_head=12, n_inner=None, dropout=0.1,
+                 layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
+                 moe_experts=8, moe_top_k=2, moe_aux_weight=0.01):
+        self.vocab_size = vocab_size
+        self.n_positions = n_positions
+        self.n_embd = n_embd
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.n_inner = n_inner or 4 * n_embd
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.tie_weights = tie_weights
+        # MoE: every Nth block's MLP becomes a MoEFFN (None = dense)
+        self.moe_every = moe_every
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_aux_weight = moe_aux_weight
+
+    @classmethod
+    def small(cls, **kw):
+        """GPT-2 small (124M)."""
+        return cls(**kw)
+
+    @classmethod
+    def medium(cls, **kw):
+        kw.setdefault("n_embd", 1024)
+        kw.setdefault("n_layer", 24)
+        kw.setdefault("n_head", 16)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """For tests: 2 layers, 64 hidden."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("n_positions", 128)
+        kw.setdefault("n_embd", 64)
+        kw.setdefault("n_layer", 2)
+        kw.setdefault("n_head", 4)
+        kw.setdefault("n_inner", 128)
+        return cls(**kw)
+
+
+class GPT2Model(model.Model):
+    """Decoder trunk: wte + wpe -> pre-LN causal blocks -> final LN."""
+
+    def __init__(self, cfg=None, plan=None):
+        super().__init__()
+        from ..parallel.tensor_parallel import (
+            ParallelTransformerBlock, VocabParallelEmbedding)
+
+        self.cfg = cfg or GPT2Config.small()
+        self.plan = plan
+        c = self.cfg
+        self.wte = VocabParallelEmbedding(c.vocab_size, c.n_embd, plan)
+        self.wpe = layer.Embedding(c.n_positions, c.n_embd, std=0.01)
+        self.blocks = []
+        for i in range(c.n_layer):
+            moe = (c.moe_every is not None
+                   and (i + 1) % c.moe_every == 0)
+            self.blocks.append(ParallelTransformerBlock(
+                c.n_head, c.n_inner, plan, dropout=c.dropout, causal=True,
+                eps=c.layer_norm_eps,
+                moe_experts=c.moe_experts if moe else None,
+                moe_top_k=c.moe_top_k))
+        self.ln_f = layer.LayerNorm(c.layer_norm_eps)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = tensor.from_numpy(
+            np.broadcast_to(np.arange(s, dtype=np.int32), (b, s)).copy(),
+            input_ids.device)
+        x = autograd.add(self.wte(input_ids), self.wpe(pos))
+        if self.cfg.dropout > 0:
+            x = autograd.dropout(x, self.cfg.dropout)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+    def aux_losses(self):
+        """Taped MoE load-balance losses from the last forward."""
+        return [blk.aux_loss for blk in self.blocks
+                if blk.aux_loss is not None]
+
+
+class GPT2LMHead(model.Model):
+    """Causal-LM head; the training workload (next-token prediction)."""
+
+    def __init__(self, cfg=None, plan=None):
+        super().__init__()
+        self.cfg = cfg or GPT2Config.small()
+        self.plan = plan
+        self.transformer = GPT2Model(self.cfg, plan)
+        if not self.cfg.tie_weights:
+            from ..parallel.tensor_parallel import ColumnParallelLinear
+
+            self.lm_head = ColumnParallelLinear(
+                self.cfg.vocab_size, plan, bias=False, gather_output=True)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, input_ids):
+        h = self.transformer.forward(input_ids)
+        if self.cfg.tie_weights:
+            # logits = h @ wte^T (GPT-2 weight tying); with a plan the
+            # vocab-sharded table makes this a column-parallel matmul
+            wt = autograd.transpose(self.transformer.wte.W, (1, 0))
+            logits = autograd.matmul(h, wt)
+        else:
+            logits = self.lm_head(h)
+        return logits
+
+    def train_one_batch(self, input_ids, labels):
+        """labels: next-token ids, same shape as input_ids (callers pass
+        ids shifted by one; positions to ignore use label -1)."""
+        logits = self.forward(input_ids)
+        b, s, v = logits.shape
+        loss = self.loss_fn(
+            autograd.reshape(logits, (b * s, v)),
+            autograd.reshape(labels, (b * s,)))
+        for aux in self.transformer.aux_losses():
+            loss = autograd.add(
+                loss, autograd.mul_scalar(aux, self.cfg.moe_aux_weight))
+        self.optimizer(loss)
+        return logits, loss
+
+    # -- sampling (fixed-shape, jit-friendly: full-context forward per
+    #    emitted token, like examples/rnn's fixed-shape sampling) --------
+    def generate(self, prompt_ids, max_new_tokens=20, temperature=1.0,
+                 rng=None):
+        """Greedy/temperature sampling. prompt_ids: np.ndarray (S0,)."""
+        was_training = self.training
+        self.eval()
+        try:
+            ids = list(np.asarray(prompt_ids).tolist())
+            ctx = self.cfg.n_positions
+            dev = self.transformer.wte.W.device  # follow the params
+            for _ in range(max_new_tokens):
+                live = ids[-ctx:]
+                # causal attention ignores positions to the RIGHT, so a
+                # fixed-size right-padded window keeps the forward shape
+                # static (one compile for the whole generation) and the
+                # logits at index len(live)-1 are exact
+                window = np.zeros((1, ctx), np.int32)
+                window[0, :len(live)] = live
+                x = tensor.from_numpy(window, dev)
+                logits = self.forward(x)
+                last = tensor.to_numpy(logits)[0, len(live) - 1]
+                if temperature <= 0:
+                    nxt = int(np.argmax(last))
+                else:
+                    p = np.exp((last - last.max()) / temperature)
+                    p /= p.sum()
+                    r = rng or np.random
+                    nxt = int(r.choice(len(p), p=p))
+                ids.append(nxt)
+            return np.asarray(ids, np.int32)
+        finally:
+            if was_training:
+                self.train(True)
+
+
+def create_model(size="small", plan=None, **kw):
+    cfg = getattr(GPT2Config, size)(**kw)
+    return GPT2LMHead(cfg, plan)

@@ -30,16 +30,18 @@ def scaled_dot_product_attention(q, k, v, mask=None, use_flash=False):
         return flash_attention_op(q, k, v, mask)
     scale = 1.0 / math.sqrt(q.shape[-1])
 
-    def f(qv, kv, vv, *rest, scale=scale):
+    def f(qv, kv, vv, *rest, scale):
         scores = jnp.einsum("bhsd,bhtd->bhst", qv, kv) * scale
         if rest:
             scores = scores + rest[0]
         probs = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhst,bhtd->bhsd", probs, vv)
 
+    # scale rides op.params so the sonnx frontend can decompose the
+    # fused op into MatMul/Mul/Softmax nodes (sonnx._decompose_attention)
     if mask is None:
-        return _op(f, q, k, v, _name="Attention")
-    return _op(f, q, k, v, mask, _name="Attention")
+        return _op(f, q, k, v, _name="Attention", scale=scale)
+    return _op(f, q, k, v, mask, _name="Attention", scale=scale)
 
 
 class MultiHeadAttention(Layer):

@@ -286,10 +286,11 @@ class ParallelTransformerBlock(Layer):
 
 def _sdpa(q, k, v, mask, causal):
     """Plain scaled-dot-product attention (B,H,S,D); heads may be sharded
-    — the einsums are head-local so GSPMD keeps them collective-free."""
+    — the einsums are head-local so GSPMD keeps them collective-free.
+    scale/causal ride op.params for sonnx's decomposed export."""
     scale = 1.0 / math.sqrt(q.shape[-1])
 
-    def f(qv, kv, vv, *rest):
+    def f(qv, kv, vv, *rest, scale, causal):
         sc = jnp.einsum("bhsd,bhtd->bhst", qv, kv) * scale
         if rest:
             sc = sc + rest[0]
@@ -302,7 +303,8 @@ def _sdpa(q, k, v, mask, causal):
         return jnp.einsum("bhst,bhtd->bhsd", p, vv)
 
     xs = (q, k, v) if mask is None else (q, k, v, mask)
-    return autograd._op(f, *xs, _name="TPAttention")
+    return autograd._op(f, *xs, _name="TPAttention", scale=scale,
+                        causal=causal)
 
 
 def _ring_attention_op(q, k, v, mask, plan, causal):

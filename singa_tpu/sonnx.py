@@ -61,12 +61,28 @@ class SingaRep:
         self.device = device
         self.weights = weights  # name -> Tensor (initializers, trainable)
         self.output_names = outputs or [v.name for v in graph.output]
+        # Constant nodes evaluate once here, NOT per run(): they are
+        # frozen values (baked causal masks, attention scales, shapes) —
+        # never trainable, and hoisting them avoids a host->device
+        # transfer every forward
+        self._consts = {}
+        token = _REP_DEVICE.set(device)
+        try:
+            for node in graph.node:
+                if node.op_type == "Constant" and node.output:
+                    t = _ONNX_OPS["Constant"](node, [])
+                    t.requires_grad = False
+                    t.stores_grad = False
+                    self._consts[node.output[0]] = t
+        finally:
+            _REP_DEVICE.reset(token)
 
     def params(self):
         return self.weights
 
     def run(self, inputs):
         env = dict(self.weights)
+        env.update(self._consts)
         graph_inputs = [v.name for v in self.graph.input
                         if v.name not in self.weights]
         if isinstance(inputs, dict):
@@ -86,6 +102,9 @@ class SingaRep:
         token = _REP_DEVICE.set(self.device)
         try:
             for node in self.graph.node:
+                if node.op_type == "Constant" and node.output \
+                        and node.output[0] in self._consts:
+                    continue  # pre-evaluated at prepare time
                 handler = _ONNX_OPS.get(node.op_type)
                 if handler is None:
                     raise NotImplementedError(
@@ -532,7 +551,92 @@ _EXPORT_OPS = {
     "AvgPool2d": "AveragePool", "BatchNorm2d": "BatchNormalization",
     "Flatten": "Flatten", "Reshape": "Reshape", "Transpose": "Transpose",
     "Concat": "Concat", "Identity": "Identity", "Erf": "Erf",
-    "LayerNorm": "LayerNormalization",
+    "LayerNorm": "LayerNormalization", "_Dropout": "Dropout",
+}
+
+
+# -- decomposed export of fused TPU-native ops ------------------------------
+# The MXU-fused ops (attention, embedding gather, BERT's mask builders)
+# have no single ONNX node; they export as small subgraphs of standard
+# ONNX ops that the backend re-imports (roundtrips tested for BERT and
+# GPT-2 in tests/test_sonnx_transformers.py).  Each decomposer receives
+# an _Emit helper bound to the graph being built and must name its final
+# output(s) f"{op.name}_out{i}" so downstream consumers resolve.
+
+def _dec_attention(op, in_names, emit, out_name):
+    """Fused (q,k,v[,mask]) attention -> Transpose/MatMul/Mul/(Add)/
+    Softmax/MatMul.  The causal variant bakes a static (S,T) additive
+    mask (shapes are concrete at export time)."""
+    p = getattr(op, "params", {}) or {}
+    scale = float(p.get("scale", 1.0))
+    causal = bool(p.get("causal", False))
+    q_t, k_t = op.src[0][2], op.src[1][2]
+    s, t = q_t.shape[-2], k_t.shape[-2]
+    u = emit.uniq("Attention")
+    kt = f"{u}_kT"
+    emit.node("Transpose", [in_names[1]], [kt], perm=[0, 1, 3, 2])
+    sc = f"{u}_scores"
+    emit.node("MatMul", [in_names[0], kt], [sc])
+    cur = f"{u}_scaled"
+    emit.node("Mul", [sc, emit.const(f"const_scale_{float(scale)!r}",
+                                     np.float32(scale))], [cur])
+    if len(in_names) > 3:
+        nxt = f"{u}_masked"
+        emit.node("Add", [cur, in_names[3]], [nxt])
+        cur = nxt
+    if causal:
+        cm = np.where(np.tril(np.ones((s, t), bool)), 0.0,
+                      -1e9).astype(np.float32)
+        nxt = f"{u}_causal"
+        # shape-keyed name: every layer shares ONE mask constant
+        emit.node("Add", [cur, emit.const(f"const_causal_{s}x{t}", cm)],
+                  [nxt])
+        cur = nxt
+    pr = f"{u}_probs"
+    emit.node("Softmax", [cur], [pr], axis=-1)
+    emit.node("MatMul", [pr, in_names[2]], [out_name])
+
+
+def _dec_embedding(op, in_names, emit, out_name):
+    """embedding(ids, W) -> Gather(W, ids) (input order swapped)."""
+    emit.node("Gather", [in_names[1], in_names[0]], [out_name], axis=0)
+
+
+def _dec_attn_mask(op, in_names, emit, out_name):
+    """BERT (1-m)*-1e9 [:,None,None,:] -> Sub/Mul/Unsqueeze."""
+    u = emit.uniq("AttnMask")
+    t1, t2 = f"{u}_inv", f"{u}_scaled"
+    emit.node("Sub", [emit.const("const_one_f32", np.float32(1.0)),
+                      in_names[0]], [t1])
+    emit.node("Mul", [t1, emit.const("const_neg1e9_f32",
+                                     np.float32(-1e9))], [t2])
+    # opset >= 13: axes is an INPUT, not an attribute
+    emit.node("Unsqueeze",
+              [t2, emit.const("const_axes_1_2",
+                              np.asarray([1, 2], np.int64))], [out_name])
+
+
+def _dec_first_token(op, in_names, emit, out_name):
+    """x[:, 0, :] -> Gather(x, 0, axis=1) (scalar index drops the axis)."""
+    emit.node("Gather",
+              [in_names[0],
+               emit.const("const_idx0_i64", np.asarray(0, np.int64))],
+              [out_name], axis=1)
+
+
+def _dec_mul_scalar(op, in_names, emit, out_name):
+    s = float((getattr(op, "params", {}) or {}).get("s", 1.0))
+    emit.node("Mul", [in_names[0], emit.const(f"const_scalar_{s!r}",
+                                              np.float32(s))], [out_name])
+
+
+_EXPORT_DECOMPOSE = {
+    "Attention": _dec_attention,
+    "TPAttention": _dec_attention,
+    "Embedding": _dec_embedding,
+    "AttnMask": _dec_attn_mask,
+    "FirstToken": _dec_first_token,
+    "MulScalar": _dec_mul_scalar,
 }
 
 
@@ -558,11 +662,49 @@ def to_onnx(m, inputs, model_name="singa_model"):
     initializers = []
     seen_ops = {}
     name_ctr = [0]
+    op_unames = {}
 
     def tensor_name(arr_id, op, idx):
-        return f"{op.name}_out{idx}"
+        # ops created via autograd._op(_name=...) share their base name
+        # across instances; qualify per op INSTANCE or value names
+        # collide (e.g. every Reshape would emit "Reshape_out0")
+        if id(op) not in op_unames:
+            op_unames[id(op)] = f"{op.name}_{len(op_unames)}"
+        return f"{op_unames[id(op)]}_out{idx}"
 
     exported_params = set()
+
+    class _Emit:
+        """Graph-building helper handed to _EXPORT_DECOMPOSE entries."""
+
+        def uniq(self, base):
+            name_ctr[0] += 1
+            return f"{base}_{name_ctr[0]}"
+
+        def node(self, op_type, ins, outs, **attrs):
+            n = NodeProto(op_type=op_type,
+                          name=f"{op_type}_{self.uniq('n')}",
+                          input=list(ins), output=list(outs))
+            for k, v in attrs.items():
+                n.attribute.append(AttributeProto.make(k, v))
+            nodes.append(n)
+            return n
+
+        def const(self, name, arr):
+            """Emit a value as a Constant NODE (not an initializer):
+            initializers are what backends treat as trainable weights —
+            a baked causal mask or attention scale must never receive
+            gradient updates.  Deduped by name, so shape-keyed names
+            (const_causal_SxT, const_shape_...) are shared across the
+            graph."""
+            if name not in exported_params:
+                exported_params.add(name)
+                self.node("Constant", [], [name],
+                          value=TensorProto.from_numpy(np.asarray(arr),
+                                                       name))
+            return name
+
+    emit = _Emit()
 
     def visit(op):
         if id(op) in seen_ops:
@@ -598,10 +740,21 @@ def to_onnx(m, inputs, model_name="singa_model"):
                     "requires_grad=False); mark it requires_grad or feed it "
                     "as a model input")
         base = op.name.split("#")[0]
+        if base in _EXPORT_DECOMPOSE:
+            _EXPORT_DECOMPOSE[base](op, in_names, emit,
+                                    tensor_name(None, op, 0))
+            return
         onnx_type = _EXPORT_OPS.get(base)
         if onnx_type is None:
             raise NotImplementedError(
                 f"export of op {base!r} not supported by sonnx frontend")
+        if base == "Reshape":
+            # ONNX Reshape takes the target shape as a second (int64)
+            # input, not an attribute
+            shape = tuple((getattr(op, "params", {}) or {}).get("shape"))
+            in_names.append(emit.const(
+                "const_shape_" + "_".join(str(s) for s in shape),
+                np.asarray(shape, np.int64)))
         out_names = [tensor_name(None, op, i) for i in range(len(op.y_id2idx))]
         node = NodeProto(op_type=onnx_type, name=f"{base}_{name_ctr[0]}",
                          input=in_names, output=out_names)
@@ -638,6 +791,11 @@ def to_onnx(m, inputs, model_name="singa_model"):
                 "epsilon", float(p.get("eps", 1e-5))))
             node.attribute.append(AttributeProto.make(
                 "axis", int(p.get("axis", -1))))
+        elif base == "_Dropout":
+            # opset >= 12: ratio is an INPUT, not an attribute
+            r = float(getattr(op, "ratio", 0.5))
+            node.input.append(emit.const(f"const_scalar_{r!r}",
+                                         np.float32(r)))
         nodes.append(node)
 
     out_infos = []
@@ -656,7 +814,7 @@ def to_onnx(m, inputs, model_name="singa_model"):
                        shape=list(t.shape))
         for i, t in enumerate(inputs)
     ]
-    in_infos += [ValueInfoProto(name=t.name, elem_type=onnx_pb.FLOAT,
+    in_infos += [ValueInfoProto(name=t.name, elem_type=t.data_type,
                                 shape=list(t.dims))
                  for t in initializers]
     g = GraphProto(name=model_name, node=nodes, initializer=initializers,

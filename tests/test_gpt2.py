@@ -1,0 +1,100 @@
+"""GPT-2: shapes, weight tying, training convergence, parallel plan
+(tp/sp + MoE blocks), generation."""
+
+import numpy as np
+
+from singa_tpu import tensor, opt
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.parallel import sharding as shd
+
+B, S = 4, 16
+
+
+def _cfg(**kw):
+    kw.setdefault("dropout", 0.0)
+    return GPT2Config.tiny(**kw)
+
+
+def _batch(cfg, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    # next-token labels: shift left, last position predicts ids[0]
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    return ids, labels
+
+
+def test_forward_shapes_and_param_count():
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    ids, _ = _batch(cfg)
+    x = tensor.from_numpy(ids)
+    m.compile([x], is_train=False, use_graph=False)
+    logits = m.forward(x)
+    assert logits.shape == (B, S, cfg.vocab_size)
+    # weight tying: no separate lm_head param; untied adds vocab*embd
+    tied_n = sum(np.prod(t.shape) for t in m.get_params().values())
+    m2 = GPT2LMHead(_cfg(tie_weights=False))
+    m2.compile([x], is_train=False, use_graph=False)
+    untied_n = sum(np.prod(t.shape) for t in m2.get_params().values())
+    assert untied_n - tied_n == cfg.vocab_size * cfg.n_embd
+
+
+def test_trains_graph_mode():
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    ids, labels = _batch(cfg)
+    x = tensor.from_numpy(ids)
+    m.compile([x], is_train=True, use_graph=True)
+    losses = []
+    for i in range(15):
+        _, loss = m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        losses.append(float(tensor.to_numpy(loss)))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_tied_head_gradient_reaches_embedding():
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    m.set_optimizer(opt.SGD(lr=0.5))
+    ids, labels = _batch(cfg)
+    x = tensor.from_numpy(ids)
+    m.compile([x], is_train=True, use_graph=False)
+    w0 = tensor.to_numpy(m.transformer.wte.W).copy()
+    m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+    assert not np.allclose(tensor.to_numpy(m.transformer.wte.W), w0)
+
+
+def test_parallel_gpt_moe_matches_serial():
+    """dp2 x tp2 x sp2 GPT with a MoE block == serial twin."""
+    cfg = _cfg(moe_every=2, moe_experts=4)
+    mesh = shd.create_mesh(dp=2, tp=2, sp=2)
+    plan = shd.ShardingPlan(mesh)
+
+    serial = GPT2LMHead(cfg)
+    par = GPT2LMHead(cfg, plan=plan)
+    par.set_sharding_plan(plan)
+    ids, labels = _batch(cfg)
+    for m in (serial, par):
+        m.set_optimizer(opt.SGD(lr=0.05))
+        m.compile([tensor.from_numpy(ids)], is_train=True, use_graph=True)
+    par.set_states({k: tensor.to_numpy(v)
+                    for k, v in serial.get_states().items()})
+    for i in range(2):
+        ids, labels = _batch(cfg, seed=i)
+        _, ls = serial(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        _, lp = par(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        np.testing.assert_allclose(float(tensor.to_numpy(lp)),
+                                   float(tensor.to_numpy(ls)), rtol=3e-4)
+
+
+def test_generate():
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    ids, _ = _batch(cfg)
+    m.compile([tensor.from_numpy(ids)], is_train=False, use_graph=False)
+    out = m.generate(np.asarray([1, 2, 3]), max_new_tokens=5,
+                     temperature=0.0)
+    assert out.shape == (8,)
+    assert (out[:3] == [1, 2, 3]).all()
+    assert ((0 <= out) & (out < cfg.vocab_size)).all()
