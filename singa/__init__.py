@@ -1,0 +1,68 @@
+"""``singa`` — drop-in import alias for :mod:`singa_tpu`.
+
+The reference framework is imported as ``from singa import tensor,
+device, opt, autograd, layer, model, sonnx``.  This alias makes those
+lines — and any-depth forms like ``import singa.io.onnx_pb`` — resolve
+to the SAME module objects as ``singa_tpu`` (a meta-path finder aliases
+``singa.*`` onto ``singa_tpu.*`` in sys.modules; no re-export stubs, no
+second execution), so isinstance checks and module-level state behave
+as one package.  A reference training script ports by changing only its
+device-creation line, and even that is optional: singa_tpu.device
+aliases ``create_cuda_gpu(_on)`` to the TPU device for source compat.
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+import singa_tpu as _st
+
+__version__ = _st.__version__
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Hands the already-imported singa_tpu module object to the import
+    system instead of executing the file a second time."""
+
+    def __init__(self, mod):
+        self._mod = mod
+
+    def create_module(self, spec):
+        return self._mod
+
+    def exec_module(self, module):
+        pass  # already executed under its singa_tpu.* name
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith("singa."):
+            return None
+        real = "singa_tpu." + fullname[len("singa."):]
+        try:
+            mod = importlib.import_module(real)
+        except ImportError:
+            return None
+        spec = importlib.util.spec_from_loader(fullname, _AliasLoader(mod))
+        if getattr(mod, "__path__", None) is not None:
+            spec.submodule_search_locations = list(mod.__path__)
+        return spec
+
+
+sys.meta_path.insert(0, _AliasFinder())
+
+
+def __getattr__(name):
+    # serves `from singa import tensor` lazily; routes through the
+    # finder so sys.modules['singa.tensor'] is the singa_tpu module
+    if name.startswith("_"):
+        raise AttributeError(name)
+    return importlib.import_module(f"singa.{name}")
+
+
+def __dir__():
+    import pkgutil
+
+    subs = [m.name for m in pkgutil.iter_modules(_st.__path__)]
+    return sorted(set(globals()) | set(subs))

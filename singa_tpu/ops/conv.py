@@ -19,20 +19,37 @@ from ..autograd import _op
 from .padding import resolve as _resolve_padding
 
 
-def conv2d(x, W, b=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
+def conv2d(x, W, b=None, stride=1, padding=0, dilation=1,
            group=1, pad_mode="NOTSET"):
-    """NCHW conv; W is OIHW (O = out channels, I = in/group).
+    """N-dimensional conv over channels-first layout (NC + spatial; the
+    2-D case is the reference's NCHW/OIHW).  The name keeps the
+    reference API; the rank comes from the input, so ONNX Conv imports
+    with 1-D or 3-D kernels route through the same op (sonnx._h_conv).
 
     ``padding`` accepts per-dim symmetric ints or explicit (lo, hi)
     pairs (asymmetric ONNX pads import as the latter); SAME modes are
     resolved ONNX-style from input size + stride (ops/padding.py).
     """
     kernel = W.shape[2:]
+    n = len(kernel)
+    assert x.shape[2:] and len(x.shape[2:]) == n, (
+        f"input rank {len(x.shape)} does not match kernel rank {n + 2}")
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    if not isinstance(padding, (tuple, list)):
+        padding = (padding,) * n
+    assert len(padding) == n, (
+        f"expected {n} padding entries (ints or (lo,hi) pairs), "
+        f"got {padding}")
     pads = _resolve_padding(pad_mode, padding, x.shape[2:], kernel,
                             stride, dilation)
+    # channels-first numeric dim spec for any spatial rank
+    spec = (0, 1) + tuple(range(2, 2 + n))
+    dnums = lax.ConvDimensionNumbers(lhs_spec=spec, rhs_spec=spec,
+                                     out_spec=spec)
 
-    def f(xv, wv, *rest, stride=tuple(stride), pads=pads,
-          dilation=tuple(dilation), group=int(group)):
+    def f(xv, wv, *rest, stride=stride, pads=pads, dilation=dilation,
+          group=int(group)):
         xv, wv = amp.cast_in(xv, wv)  # bf16 on the MXU under amp
         y = lax.conv_general_dilated(
             xv, wv,
@@ -40,16 +57,23 @@ def conv2d(x, W, b=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
             padding=pads,
             rhs_dilation=dilation,
             feature_group_count=group,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=dnums,
         )
         if rest:
-            y = y + amp.cast_in(rest[0])[None, :, None, None]
+            bshape = (1, -1) + (1,) * n
+            y = y + amp.cast_in(rest[0]).reshape(bshape)
         return y
 
     # pass the geometry through _op's params so the op instance carries it
     # (sonnx export reads op.params for node attributes)
-    kw = dict(stride=tuple(stride), pads=pads, dilation=tuple(dilation),
-              group=int(group))
+    kw = dict(stride=stride, pads=pads, dilation=dilation, group=int(group))
     if b is None:
         return _op(f, x, W, _name="Conv2d", **kw)
     return _op(f, x, W, b, _name="Conv2d", **kw)
+
+
+def _tup(v, n):
+    if isinstance(v, (tuple, list)):
+        assert len(v) == n, f"expected {n} values, got {v}"
+        return tuple(int(s) for s in v)
+    return (int(v),) * n

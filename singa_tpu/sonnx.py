@@ -772,8 +772,9 @@ def to_onnx(m, inputs, model_name="singa_model"):
             node.attribute.append(AttributeProto.make(
                 "strides", list(p.get("stride", (1, 1)))))
             pads = p.get("pads", ((0, 0), (0, 0)))
+            # ONNX layout: all lows then all highs, any spatial rank
             node.attribute.append(AttributeProto.make(
-                "pads", [pads[0][0], pads[1][0], pads[0][1], pads[1][1]]))
+                "pads", [pr[0] for pr in pads] + [pr[1] for pr in pads]))
             node.attribute.append(AttributeProto.make(
                 "dilations", list(p.get("dilation", (1, 1)))))
             node.attribute.append(AttributeProto.make(

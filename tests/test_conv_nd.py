@@ -1,0 +1,94 @@
+"""N-D convolution: 1-D/3-D forward vs torch golden, gradients, and
+ONNX Conv import at non-2-D ranks (VERDICT r01 missing #6: conv import
+hardcoded 2-D)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, tensor
+from singa_tpu.ops import conv as conv_ops
+from singa_tpu.io.onnx_pb import (AttributeProto, GraphProto, ModelProto,
+                                  NodeProto, TensorProto, ValueInfoProto)
+from singa_tpu.io import onnx_pb
+from singa_tpu import sonnx
+
+torch = pytest.importorskip("torch")
+
+
+def _t(a):
+    return tensor.from_numpy(a)
+
+
+@pytest.mark.parametrize("ndim,stride,pad,dil", [
+    (1, 1, 0, 1), (1, 2, 1, 1), (1, 1, 2, 2),
+    (3, 1, 0, 1), (3, 2, 1, 1),
+])
+def test_convnd_matches_torch(ndim, stride, pad, dil):
+    rng = np.random.RandomState(0)
+    spatial_x = {1: (16,), 3: (6, 7, 8)}[ndim]
+    spatial_k = {1: (4,), 3: (3, 2, 3)}[ndim]
+    x = rng.randn(2, 3, *spatial_x).astype(np.float32)
+    w = rng.randn(5, 3, *spatial_k).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+
+    y = conv_ops.conv2d(_t(x), _t(w), _t(b), stride=(stride,) * ndim,
+                        padding=(pad,) * ndim, dilation=(dil,) * ndim)
+    fn = {1: torch.nn.functional.conv1d,
+          3: torch.nn.functional.conv3d}[ndim]
+    ref = fn(torch.from_numpy(x), torch.from_numpy(w),
+             torch.from_numpy(b), stride=stride, padding=pad,
+             dilation=dil).numpy()
+    np.testing.assert_allclose(tensor.to_numpy(y), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv1d_gradients_flow():
+    rng = np.random.RandomState(1)
+    x = _t(rng.randn(2, 3, 12).astype(np.float32))
+    w = tensor.Tensor((4, 3, 5))
+    w.gaussian(0, 0.1)
+    w.requires_grad = w.stores_grad = True
+    autograd.set_training(True)
+    try:
+        y = conv_ops.conv2d(x, w, None, stride=(1,), padding=(2,),
+                            dilation=(1,))
+        loss = autograd.reduce_sum(autograd.mul(y, y), axes=None)
+        grads = {id(p): g for p, g in autograd.backward(loss)}
+        assert id(w) in grads
+        assert grads[id(w)].shape == w.shape
+    finally:
+        autograd.set_training(False)
+
+
+@pytest.mark.parametrize("ndim", [1, 3])
+def test_onnx_conv_import_nd(ndim):
+    """Hand-built ONNX Conv node at rank != 2 imports and matches."""
+    rng = np.random.RandomState(2)
+    spatial_x = {1: (10,), 3: (5, 6, 4)}[ndim]
+    spatial_k = {1: (3,), 3: (2, 3, 2)}[ndim]
+    x = rng.randn(1, 2, *spatial_x).astype(np.float32)
+    w = rng.randn(3, 2, *spatial_k).astype(np.float32)
+
+    node = NodeProto(op_type="Conv", name="c", input=["x", "w"],
+                     output=["y"])
+    node.attribute.append(AttributeProto.make(
+        "kernel_shape", list(spatial_k)))
+    node.attribute.append(AttributeProto.make(
+        "pads", [1] * ndim + [1] * ndim))
+    node.attribute.append(AttributeProto.make("strides", [1] * ndim))
+    g = GraphProto(
+        name="g", node=[node],
+        initializer=[TensorProto.from_numpy(w, "w")],
+        input=[ValueInfoProto(name="x", elem_type=onnx_pb.FLOAT,
+                              shape=list(x.shape)),
+               ValueInfoProto(name="w", elem_type=onnx_pb.FLOAT,
+                              shape=list(w.shape))],
+        output=[ValueInfoProto(name="y", elem_type=onnx_pb.FLOAT,
+                               shape=[])])
+    rep = sonnx.prepare(ModelProto(graph=g))
+    out = tensor.to_numpy(rep.run([x])[0])
+
+    fn = {1: torch.nn.functional.conv1d,
+          3: torch.nn.functional.conv3d}[ndim]
+    ref = fn(torch.from_numpy(x), torch.from_numpy(w), padding=1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,88 @@
+"""The `singa` drop-in alias: reference import lines work unchanged and
+resolve to the same module objects as singa_tpu."""
+
+import numpy as np
+
+
+def test_reference_import_lines():
+    from singa import autograd, device, layer, model, opt, tensor  # noqa
+
+    import singa_tpu
+
+    import singa_tpu.tensor as st_tensor
+
+    assert tensor is st_tensor  # identity, not a copy
+
+
+def test_submodule_import_form():
+    import singa.sonnx as s1
+    import singa_tpu.sonnx as s2
+
+    assert s1 is s2
+
+
+def test_nested_submodule_identity():
+    """Any-depth imports must alias, not re-execute (module copies would
+    break isinstance across the two spellings)."""
+    import singa.io.onnx_pb as a
+    import singa_tpu.io.onnx_pb as b
+
+    assert a is b
+    assert a.TensorProto is b.TensorProto
+
+    import singa.models.gpt2 as g1
+    import singa_tpu.models.gpt2 as g2
+
+    assert g1 is g2
+
+
+def test_convnd_scalar_defaults():
+    """conv2d's scalar geometry defaults broadcast to the input rank."""
+    from singa_tpu import tensor
+    from singa_tpu.ops import conv as conv_ops
+
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(1, 2, 10).astype(np.float32))
+    w = tensor.from_numpy(rng.randn(3, 2, 3).astype(np.float32))
+    y = conv_ops.conv2d(x, w)  # no geometry args at all
+    assert y.shape == (1, 3, 8)
+
+
+def test_reference_style_script_runs():
+    """The reference MLP recipe, written with `singa` imports, trains."""
+    from singa import device, layer, model, opt, tensor
+    from singa import autograd
+
+    dev = device.create_cuda_gpu()  # source-compat alias -> TPU/CPU dev
+    dev.SetRandSeed(0)
+
+    class MLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(16)
+            self.relu = layer.ReLU()
+            self.fc2 = layer.Linear(2)
+            self.loss = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 4).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int32)
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    x0 = tensor.from_numpy(xs, dev)
+    m.compile([x0], is_train=True, use_graph=True)
+    losses = []
+    for _ in range(20):
+        _, loss = m(tensor.from_numpy(xs, dev),
+                    tensor.from_numpy(ys, dev))
+        losses.append(float(tensor.to_numpy(loss)))
+    assert losses[-1] < losses[0]
