@@ -41,9 +41,15 @@ class _AliasFinder(importlib.abc.MetaPathFinder):
             return None
         real = "singa_tpu." + fullname[len("singa."):]
         try:
-            mod = importlib.import_module(real)
-        except ImportError:
+            exists = importlib.util.find_spec(real) is not None
+        except ModuleNotFoundError:
+            exists = False  # a parent package doesn't exist
+        if not exists:
             return None
+        # the module exists: a failure HERE is a real bug inside it and
+        # must propagate with its own traceback, not be masked as
+        # "No module named singa.X"
+        mod = importlib.import_module(real)
         spec = importlib.util.spec_from_loader(fullname, _AliasLoader(mod))
         if getattr(mod, "__path__", None) is not None:
             spec.submodule_search_locations = list(mod.__path__)
@@ -58,7 +64,16 @@ def __getattr__(name):
     # finder so sys.modules['singa.tensor'] is the singa_tpu module
     if name.startswith("_"):
         raise AttributeError(name)
-    return importlib.import_module(f"singa.{name}")
+    try:
+        return importlib.import_module(f"singa.{name}")
+    except ModuleNotFoundError as e:
+        # PEP 562: missing attributes must raise AttributeError so
+        # hasattr()/getattr(default) keep working — but only translate
+        # "module does not exist"; real failures inside an existing
+        # module propagate from the finder above
+        if e.name in (f"singa.{name}", f"singa_tpu.{name}"):
+            raise AttributeError(name) from None
+        raise
 
 
 def __dir__():

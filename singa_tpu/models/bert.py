@@ -49,9 +49,15 @@ class BertConfig:
 
 
 class BertEmbeddings(layer.Layer):
-    def __init__(self, cfg):
+    def __init__(self, cfg, plan=None):
         super().__init__()
-        self.word = layer.Embedding(cfg.vocab_size, cfg.hidden_size)
+        if plan is not None:
+            from ..parallel.tensor_parallel import VocabParallelEmbedding
+
+            self.word = VocabParallelEmbedding(cfg.vocab_size,
+                                               cfg.hidden_size, plan)
+        else:
+            self.word = layer.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.position = layer.Embedding(cfg.max_position_embeddings,
                                         cfg.hidden_size)
         self.token_type = layer.Embedding(cfg.type_vocab_size,
@@ -74,16 +80,36 @@ class BertEmbeddings(layer.Layer):
 
 
 class BertLayer(layer.Layer):
-    def __init__(self, cfg):
-        super().__init__()
-        from ..ops.attention import MultiHeadAttention
+    """Post-LN encoder block.  With a ShardingPlan the projections are
+    Megatron column/row-parallel and attention runs head-sharded (ring
+    attention over `seq` when the mesh shards sequences) — the same
+    state names either way, so checkpoints move between layouts."""
 
-        self.attn = MultiHeadAttention(cfg.num_attention_heads,
-                                       dropout=cfg.attn_dropout,
-                                       use_flash=cfg.use_flash)
+    def __init__(self, cfg, plan=None):
+        super().__init__()
+        if plan is not None:
+            from ..parallel.tensor_parallel import (
+                ColumnParallelLinear, ParallelMHA, RowParallelLinear)
+
+            if cfg.use_flash:
+                raise ValueError(
+                    "use_flash + ShardingPlan is not supported: the "
+                    "Pallas flash kernel is single-device; sequence "
+                    "sharding already bounds attention memory (ring "
+                    "attention), so drop use_flash for parallel runs")
+            self.attn = ParallelMHA(cfg.num_attention_heads, plan,
+                                    dropout=cfg.attn_dropout)
+            self.fc1 = ColumnParallelLinear(cfg.intermediate_size, plan)
+            self.fc2 = RowParallelLinear(cfg.hidden_size, plan)
+        else:
+            from ..ops.attention import MultiHeadAttention
+
+            self.attn = MultiHeadAttention(cfg.num_attention_heads,
+                                           dropout=cfg.attn_dropout,
+                                           use_flash=cfg.use_flash)
+            self.fc1 = layer.Linear(cfg.intermediate_size)
+            self.fc2 = layer.Linear(cfg.hidden_size)
         self.ln1 = layer.LayerNorm(cfg.layer_norm_eps)
-        self.fc1 = layer.Linear(cfg.intermediate_size)
-        self.fc2 = layer.Linear(cfg.hidden_size)
         self.ln2 = layer.LayerNorm(cfg.layer_norm_eps)
         self.dropout = cfg.hidden_dropout
 
@@ -100,9 +126,10 @@ class BertLayer(layer.Layer):
 
 
 class BertEncoder(layer.Layer):
-    def __init__(self, cfg):
+    def __init__(self, cfg, plan=None):
         super().__init__()
-        self.layers = [BertLayer(cfg) for _ in range(cfg.num_hidden_layers)]
+        self.layers = [BertLayer(cfg, plan)
+                       for _ in range(cfg.num_hidden_layers)]
 
     def forward(self, x, mask=None):
         for lyr in self.layers:
@@ -113,11 +140,11 @@ class BertEncoder(layer.Layer):
 class BertModel(model.Model):
     """Encoder trunk; forward returns (sequence_output, pooled_output)."""
 
-    def __init__(self, cfg=None):
+    def __init__(self, cfg=None, plan=None):
         super().__init__()
         self.cfg = cfg or BertConfig.base()
-        self.embeddings = BertEmbeddings(self.cfg)
-        self.encoder = BertEncoder(self.cfg)
+        self.embeddings = BertEmbeddings(self.cfg, plan)
+        self.encoder = BertEncoder(self.cfg, plan)
         self.pooler = layer.Linear(self.cfg.hidden_size)
 
     def _attn_mask(self, attention_mask):
@@ -150,13 +177,19 @@ def _first_token(x):
 class BertForMaskedLM(model.Model):
     """MLM head over the trunk; the config #4 training workload."""
 
-    def __init__(self, cfg=None):
+    def __init__(self, cfg=None, plan=None):
         super().__init__()
         self.cfg = cfg or BertConfig.base()
-        self.bert = BertModel(self.cfg)
+        self.bert = BertModel(self.cfg, plan)
         self.transform = layer.Linear(self.cfg.hidden_size)
         self.ln = layer.LayerNorm(self.cfg.layer_norm_eps)
-        self.decoder = layer.Linear(self.cfg.vocab_size)
+        if plan is not None:
+            from ..parallel.tensor_parallel import ColumnParallelLinear
+
+            self.decoder = ColumnParallelLinear(self.cfg.vocab_size, plan,
+                                                gather_output=True)
+        else:
+            self.decoder = layer.Linear(self.cfg.vocab_size)
         self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
@@ -181,6 +214,6 @@ class BertForMaskedLM(model.Model):
         return logits, loss
 
 
-def create_model(size="base", **kw):
+def create_model(size="base", plan=None, **kw):
     cfg = BertConfig.tiny(**kw) if size == "tiny" else BertConfig.base(**kw)
-    return BertForMaskedLM(cfg)
+    return BertForMaskedLM(cfg, plan)

@@ -65,3 +65,30 @@ def test_bert_base_param_count(dev):
     n = sum(int(np.prod(v.shape)) for v in m.bert.get_params().values())
     # BERT-base trunk: ~109.48M params (embeddings + 12 layers + pooler)
     assert abs(n - 109_482_240) / 109_482_240 < 0.01, n
+
+
+def test_bert_parallel_plan_matches_serial(dev):
+    """dp2 x tp2 x sp2 BERT == serial BERT (same state names, so a
+    checkpoint moves between layouts)."""
+    from singa_tpu.parallel import sharding as shd
+    from singa_tpu import tensor as T
+
+    cfg = BertConfig.tiny(hidden_dropout=0.0, attn_dropout=0.0)
+    mesh = shd.create_mesh(dp=2, tp=2, sp=2)
+    plan = shd.ShardingPlan(mesh)
+
+    serial = BertForMaskedLM(cfg)
+    par = BertForMaskedLM(cfg, plan=plan)
+    par.set_sharding_plan(plan)
+    ids, labels = _batch(dev, cfg, b=4, s=8)
+    for m in (serial, par):
+        m.set_optimizer(opt.SGD(lr=0.05))
+        m.compile([ids], is_train=True, use_graph=True)
+    assert set(serial.get_states()) == set(par.get_states())
+    par.set_states({k: T.to_numpy(v)
+                    for k, v in serial.get_states().items()})
+    for _ in range(2):
+        _, ls = serial(ids, labels)
+        _, lp = par(ids, labels)
+        np.testing.assert_allclose(float(T.to_numpy(lp)),
+                                   float(T.to_numpy(ls)), rtol=3e-4)
