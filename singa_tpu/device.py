@@ -53,9 +53,12 @@ _lock = threading.Lock()
 
 
 def _accelerator_devices():
-    """All non-CPU jax devices, falling back to CPU when none exist."""
-    accel = [d for d in jax.devices() if d.platform != "cpu"]
-    return accel if accel else jax.devices()
+    """Non-CPU jax devices THIS process can address (multi-host runtimes
+    list every host's devices in jax.devices(); eager placement must
+    stay on local chips), falling back to local CPU when none exist."""
+    local = jax.local_devices()
+    accel = [d for d in local if d.platform != "cpu"]
+    return accel if accel else local
 
 
 class Device:
@@ -154,7 +157,8 @@ class CppCPU(Device):
     """Host CPU device (reference: src/core/device/cpp_cpu.cc, unverified)."""
 
     def __init__(self, dev_id: int = -1):
-        cpus = [d for d in jax.devices("cpu")] if _has_cpu_backend() else jax.devices()
+        cpus = ([d for d in jax.local_devices(backend="cpu")]
+                if _has_cpu_backend() else jax.local_devices())
         idx = 0 if dev_id < 0 else dev_id % len(cpus)
         super().__init__(dev_id, cpus[idx], "kCpp")
 

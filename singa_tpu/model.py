@@ -228,12 +228,12 @@ class _GraphRunner:
         self.model = model
         self._compiled = {}  # key -> (jit_fn, state_names)
         self._plan_layouts = {}  # key -> (names, state/in/rng shardings)
-        self._warm = False
+        self._warm_keys = set()  # step signatures already state-probed
 
     def clear(self):
         self._compiled.clear()
         self._plan_layouts.clear()
-        self._warm = False
+        self._warm_keys.clear()
 
     def cost_tables(self):
         """XLA cost analysis per compiled step (feeds
@@ -260,18 +260,21 @@ class _GraphRunner:
 
     def run(self, args, kwargs):
         model = self.model
-        if not self._warm:
+        key = self._abstract_key(args, kwargs)
+        if key not in self._warm_keys:
             # Materialize lazily-created optimizer state (momentum buffers,
             # sparse residuals) by abstractly evaluating one step — no
             # compile, no execution; new state starts at zero, which is
             # exactly the optimizers' init.  The reference instead executes
             # its first graph iteration eagerly while recording; on this
             # backend eager dispatch compiles every op separately, so the
-            # abstract probe saves minutes on large models.
+            # abstract probe saves minutes on large models.  Keyed per
+            # step signature: a later call with a DIFFERENT dist-option
+            # kwarg creates NEW optimizer state (e.g. sparse residuals)
+            # that must be materialized too, or it would be left holding
+            # dead tracers from its first trace.
             self._materialize_state(args, kwargs)
-            self._warm = True
-
-        key = self._abstract_key(args, kwargs)
+            self._warm_keys.add(key)
         state = model.persistent_tensors()
         names = list(state.keys())
         tensors = [state[n] for n in names]
@@ -325,24 +328,37 @@ class _GraphRunner:
 
             comm = model._optimizer.communicator
             mesh, axis = comm.mesh, comm.axis_name
-            for a in in_arrays:
-                if a.ndim >= 1 and a.shape[0] % comm.world_size != 0:
-                    raise ValueError(
-                        f"global batch dim {a.shape[0]} not divisible by "
-                        f"world size {comm.world_size}")
-            rep = NamedSharding(mesh, P())
-            ranked = NamedSharding(mesh, P(axis))
-            state_arrays = [
-                jax.device_put(t.data,
-                               ranked if "__residual__" in n else rep)
-                for n, t in zip(names, tensors)
-            ]
-            state_arrays.append(jax.device_put(dev._rng_key, rep))
-            in_arrays = [
-                jax.device_put(
-                    a, NamedSharding(mesh, P(axis) if a.ndim >= 1 else P()))
-                for a in in_arrays
-            ]
+            nproc = jax.process_count()
+            if nproc == 1:
+                for a in in_arrays:
+                    if a.ndim >= 1 and a.shape[0] % comm.world_size != 0:
+                        raise ValueError(
+                            f"global batch dim {a.shape[0]} not divisible "
+                            f"by world size {comm.world_size}")
+                rep = NamedSharding(mesh, P())
+                ranked = NamedSharding(mesh, P(axis))
+                state_arrays = [
+                    jax.device_put(t.data,
+                                   ranked if "__residual__" in n else rep)
+                    for n, t in zip(names, tensors)
+                ]
+                state_arrays.append(jax.device_put(dev._rng_key, rep))
+                in_arrays = [
+                    jax.device_put(
+                        a,
+                        NamedSharding(mesh, P(axis) if a.ndim >= 1 else P()))
+                    for a in in_arrays
+                ]
+            else:
+                # MULTI-HOST (reference: each MPI rank feeds its own
+                # shard): inputs are this process's LOCAL batch; state
+                # is broadcast from process 0 (the reference's MPI
+                # bcast) into one global replicated array.  After step 1
+                # the state is already global (outputs of the global
+                # step) and passes through untouched.
+                state_arrays, in_arrays = self._globalize_multihost(
+                    mesh, axis, names, tensors, in_arrays, dev,
+                    check=key not in self._compiled)
         else:
             state_arrays = [jax.device_put(t.data, dev.jax_device)
                             for t in tensors]
@@ -377,8 +393,13 @@ class _GraphRunner:
             # the step returns the PRNG key replicated over the mesh;
             # re-commit it to the device's own chip so later EAGER rng
             # use (e.g. initializing another model) doesn't propagate
-            # multi-device placement
-            dev._rng_key = jax.device_put(dev._rng_key, dev.jax_device)
+            # multi-device placement.  Multi-host: the global replicated
+            # array isn't device_puttable directly — its value is any
+            # local shard.
+            k = dev._rng_key
+            if isinstance(k, jax.Array) and not k.is_fully_addressable:
+                k = np.asarray(k.addressable_shards[0].data)
+            dev._rng_key = jax.device_put(k, dev.jax_device)
         if model.dist and model.dist_outputs != "stack":
             # Outputs come back stacked per-rank (see _build).  The "auto"
             # reassembly contract: per-rank scalars, now (W,), become the
@@ -420,6 +441,100 @@ class _GraphRunner:
             lambda a: tensor._wrap(a, dev),
             out_tree,
         )
+
+    @staticmethod
+    def _globalize_multihost(mesh, axis, names, tensors, in_arrays, dev,
+                             check):
+        """Lift process-local arrays to global arrays over the
+        multi-host mesh (jax.distributed runtime).
+
+        Replicated state is BROADCAST from process 0 (the reference's
+        MPI bcast of initial params / NCCL id): hosts whose local init
+        diverged — a checkpoint loaded on one host, host-dependent
+        seeds — start consistent instead of silently training on
+        per-shard-different 'replicated' values.  Per-rank sharded
+        state (DistOpt residuals, global shape (W, ...)): each host
+        contributes the row blocks of ITS devices per the mesh's
+        device order.  Batch inputs: the local batch becomes this
+        host's slice of the global batch dim.
+
+        ``check``: on a new step signature, first verify every host
+        shows the same input shapes — a ragged final batch would
+        otherwise compile per-host-different programs and deadlock in
+        the collectives with no diagnostic."""
+        from jax.experimental import multihost_utils as mh
+
+        pid = jax.process_index()
+
+        if check:
+            digest = np.zeros(64, np.int64)
+            flat = [d for a in in_arrays
+                    for d in (a.ndim, *a.shape)][:63]
+            digest[0] = len(flat)
+            digest[1:1 + len(flat)] = flat
+            gathered = mh.process_allgather(digest)  # (nproc, 64)
+            if not (gathered == gathered[0]).all():
+                raise ValueError(
+                    "multi-host input shapes disagree across processes "
+                    f"(shape digests: {gathered.tolist()}); every host "
+                    "must feed the same LOCAL batch shape each step — "
+                    "drop or pad the ragged final batch")
+
+        def is_global(a):
+            return (isinstance(a, jax.Array)
+                    and len(a.sharding.device_set) == mesh.devices.size)
+
+        # rows of a (W, ...) per-rank array owned by this host, in the
+        # mesh's device order (host_local_array_to_global_array stitches
+        # shards in that order)
+        my_dev_idx = [i for i, d in enumerate(mesh.devices.flat)
+                      if d.process_index == pid]
+        assert my_dev_idx == list(range(my_dev_idx[0],
+                                        my_dev_idx[-1] + 1)), (
+            "this process's devices are not contiguous in the mesh; "
+            "build the data axis in process order")
+
+        state_arrays = []
+        for n, t in zip(names, tensors):
+            a = t.data
+            if is_global(a):
+                state_arrays.append(a)
+                continue
+            host = np.asarray(a)
+            if "__residual__" in n:
+                per_dev = host.shape[0] // mesh.devices.size
+                host = host[my_dev_idx[0] * per_dev:
+                            (my_dev_idx[-1] + 1) * per_dev]
+                spec = P(axis)
+            else:
+                host = mh.broadcast_one_to_all(host)
+                spec = P()
+            state_arrays.append(
+                mh.host_local_array_to_global_array(host, mesh, spec))
+        key = dev._rng_key
+        state_arrays.append(
+            key if is_global(key) else
+            mh.host_local_array_to_global_array(
+                np.asarray(mh.broadcast_one_to_all(np.asarray(key))),
+                mesh, P()))
+        n_local = jax.local_device_count()
+        global_in = []
+        for a in in_arrays:
+            if is_global(a):
+                global_in.append(a)
+                continue
+            if a.ndim >= 1:
+                if a.shape[0] % n_local != 0:
+                    raise ValueError(
+                        f"local batch dim {a.shape[0]} not divisible by "
+                        f"local device count {n_local}")
+                spec = P(axis)
+            else:
+                spec = P()
+            global_in.append(
+                mh.host_local_array_to_global_array(np.asarray(a), mesh,
+                                                    spec))
+        return state_arrays, global_in
 
     def _materialize_state(self, args, kwargs):
         model = self.model

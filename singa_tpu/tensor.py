@@ -400,6 +400,14 @@ def from_numpy(np_array, device=None, requires_grad=False) -> Tensor:
 
 def to_numpy(t) -> np.ndarray:
     arr = _raw(t)
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        # multi-host: a cross-process sharded array (e.g. DistOpt
+        # residuals after a step) needs a collective fetch.  SPMD
+        # lockstep: every process calls to_numpy at the same point, so
+        # the allgather is safe.
+        from jax.experimental import multihost_utils as mh
+
+        return np.asarray(mh.process_allgather(arr, tiled=True))
     return np.asarray(jax.device_get(arr))
 
 

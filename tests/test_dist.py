@@ -244,3 +244,45 @@ def test_dist_bn_running_stats_pmeaned(dev):
     assert rm
     arr = tensor.to_numpy(rm[0])
     assert np.all(np.isfinite(arr)) and np.abs(arr).max() > 0
+
+
+def test_dist_option_switch_after_compile(dev):
+    """Switching dist-option mid-training (plain -> sparse) creates new
+    optimizer state AFTER the first warm-up; that state must be
+    materialized per step signature, not left holding dead tracers
+    (regression: _GraphRunner warmed only once)."""
+    from singa_tpu.models.common import apply_dist_option
+    import singa_tpu.layer as L
+
+    class Net(__import__("singa_tpu.model", fromlist=["Model"]).Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = L.Linear(4)
+            self.ce = L.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc(x)
+
+        def train_one_batch(self, x, y, dist_option="plain", spars=None):
+            out = self.forward(x)
+            loss = self.ce(out, y)
+            apply_dist_option(self.optimizer, loss, dist_option, spars)
+            return out, loss
+
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(8, 6).astype(np.float32), dev)
+    y = tensor.from_numpy(rng.randint(0, 4, 8).astype(np.int32), dev)
+    m = Net()
+    m.set_optimizer(DistOpt(opt.SGD(lr=0.1)))
+    m.compile([x], is_train=True, use_graph=True)
+    l0 = float(tensor.to_numpy(m(x, y)[1]))
+    # mode switch: creates sparse residual state post-compile
+    for _ in range(2):
+        _, loss = m(x, y, dist_option="sparseTopK", spars=0.2)
+    l1 = float(tensor.to_numpy(loss))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    res = [k for k in m.persistent_tensors() if "__residual__" in k]
+    assert res
+    for k in res:
+        arr = tensor.to_numpy(m.persistent_tensors()[k])
+        assert np.all(np.isfinite(arr))
