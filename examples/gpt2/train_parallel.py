@@ -1,0 +1,111 @@
+"""GPT-2 training over a five-axis mesh — the model-parallel showcase
+(the reference has no model parallelism at all, SURVEY.md §2.3; its
+closest entry point is examples/cnn/train_mpi.py's data-parallel
+launch, unverified).
+
+One definition serves every layout: pick axis sizes, get Megatron
+tensor parallelism (tp), ring-attention sequence parallelism (sp),
+GShard MoE expert parallelism (--moe-every + ep), all composed with
+data parallelism (dp) — XLA's SPMD partitioner inserts the
+collectives.  Self-provisions a virtual CPU mesh on a 1-chip box.
+
+    python examples/gpt2/train_parallel.py --dp 2 --tp 2 --sp 2 \\
+        --force-cpu-devices 8 --steps 10
+    python examples/gpt2/train_parallel.py --dp 2 --ep 4 --moe-every 1 \\
+        --force-cpu-devices 8
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def run(args):
+    if args.force_cpu_devices:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{args.force_cpu_devices}").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.parallel import sharding as shd
+
+    if args.coordinator:
+        from singa_tpu.parallel.communicator import initialize_distributed
+
+        initialize_distributed(args.coordinator, args.num_processes,
+                               args.process_id)
+
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(args.seed)
+
+    world = args.dp * args.tp * args.sp * args.ep
+    mesh = shd.create_mesh(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep)
+    plan = shd.ShardingPlan(mesh)
+    print(f"mesh: dp={args.dp} tp={args.tp} sp={args.sp} ep={args.ep} "
+          f"({world} devices, backend={jax.devices()[0].platform})")
+
+    cfg = (GPT2Config.tiny(dropout=args.dropout,
+                           moe_every=args.moe_every,
+                           moe_experts=args.ep if args.moe_every else 8)
+           if args.size == "tiny"
+           else getattr(GPT2Config, args.size)(
+               dropout=args.dropout, moe_every=args.moe_every))
+    m = GPT2LMHead(cfg, plan=plan)
+    m.set_sharding_plan(plan)
+    m.set_optimizer(opt.Adam(lr=args.lr))
+
+    rng = np.random.RandomState(args.seed)
+    b, s = args.batch_size, args.seq_length
+    if b % args.dp or s % args.sp:
+        raise SystemExit(f"batch {b} %% dp or seq {s} %% sp != 0")
+    ids0 = tensor.from_numpy(
+        rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32))
+    m.compile([ids0], is_train=True, use_graph=True)
+
+    t_hist = []
+    for step in range(args.steps):
+        raw = rng.randint(0, cfg.vocab_size, (b, s + 1))
+        x = tensor.from_numpy(raw[:, :-1].astype(np.int32))
+        y = tensor.from_numpy(raw[:, 1:].astype(np.int32))
+        t0 = time.time()
+        _, loss = m(x, y)
+        lv = float(tensor.to_numpy(loss))
+        dt = time.time() - t0
+        t_hist.append(dt)
+        if step % max(1, args.steps // 10) == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={lv:.4f} {dt * 1e3:.1f}ms")
+    steady = t_hist[2:] or t_hist
+    print(f"throughput: {b / (sum(steady) / len(steady)):.1f} samples/s "
+          f"(global batch {b}, seq {s}, {world} devices)")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", choices=["tiny", "small", "medium"],
+                   default="tiny")
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--moe-every", type=int, default=None)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-length", type=int, default=32)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--force-cpu-devices", type=int, default=None)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    args = p.parse_args()
+    run(args)
