@@ -494,7 +494,11 @@ _ONNX_OPS = {
     "Identity": _handle_unary(lambda x: x),
     "Floor": _handle_unary(jnp.floor),
     "Ceil": _handle_unary(jnp.ceil),
-    "Gelu": _handle_unary(lambda x: __import__("jax").nn.gelu(x)),
+    # ONNX Gelu default is the EXACT erf form (approximate="none");
+    # jax.nn.gelu defaults to tanh — honor the attribute
+    "Gelu": lambda node, args: autograd.gelu(
+        args[0],
+        approximate=node.attrs().get("approximate", "none") == "tanh"),
     "LeakyRelu": lambda node, args: autograd.leakyrelu(
         args[0], node.attrs().get("alpha", 0.01)),
     "Elu": lambda node, args: autograd.elu(
@@ -792,6 +796,10 @@ def to_onnx(m, inputs, model_name="singa_model"):
                 "epsilon", float(p.get("eps", 1e-5))))
             node.attribute.append(AttributeProto.make(
                 "axis", int(p.get("axis", -1))))
+        elif base == "Gelu":
+            node.attribute.append(AttributeProto.make(
+                "approximate",
+                "tanh" if p.get("approximate", True) else "none"))
         elif base == "_Dropout":
             # opset >= 12: ratio is an INPUT, not an attribute
             r = float(getattr(op, "ratio", 0.5))
@@ -820,7 +828,14 @@ def to_onnx(m, inputs, model_name="singa_model"):
                  for t in initializers]
     g = GraphProto(name=model_name, node=nodes, initializer=initializers,
                    input=in_infos, output=out_infos)
-    return ModelProto(graph=g)
+    # opset 20: the earliest version covering everything this frontend
+    # emits (Gelu + its `approximate` attribute landed in 20; Unsqueeze
+    # axes-as-input needs 13, Dropout ratio-as-input needs 12)
+    m = ModelProto(graph=g)
+    for o in m.opset_import:
+        if not o.domain:
+            o.version = 20
+    return m
 
 
 class SingaFrontend:

@@ -1,0 +1,258 @@
+"""Node-level ONNX conformance sweep — every op handler in
+sonnx._ONNX_OPS gets at least one single-node graph executed against a
+numpy golden (the stand-in for the reference's onnx.backend.test run,
+SURVEY.md §4: no `onnx` package exists in this container, so the suite
+is vendored).
+
+A completeness guard asserts no supported op is missing from the sweep,
+so newly added handlers fail CI until they get a conformance case.
+"""
+
+import numpy as np
+import pytest
+
+from singa_tpu import sonnx, tensor
+from singa_tpu.io import onnx_pb
+from singa_tpu.io.onnx_pb import (AttributeProto, GraphProto, ModelProto,
+                                  NodeProto, TensorProto, ValueInfoProto)
+
+rng = np.random.RandomState(0)
+
+
+def _run_node(op_type, inputs, attrs=None, n_out=1, initializers=()):
+    """Build a 1-node graph; feed ``inputs`` (dict name->array); return
+    list of output numpy arrays."""
+    in_names = list(inputs)
+    node = NodeProto(op_type=op_type, name="n0",
+                     input=in_names + [t.name for t in initializers],
+                     output=[f"out{i}" for i in range(n_out)])
+    for k, v in (attrs or {}).items():
+        node.attribute.append(AttributeProto.make(k, v))
+    g = GraphProto(
+        name="g", node=[node], initializer=list(initializers),
+        input=[ValueInfoProto(name=k, elem_type=onnx_pb.FLOAT,
+                              shape=list(np.asarray(v).shape))
+               for k, v in inputs.items()] +
+              [ValueInfoProto(name=t.name, elem_type=t.data_type,
+                              shape=list(t.dims)) for t in initializers],
+        output=[ValueInfoProto(name=f"out{i}", elem_type=onnx_pb.FLOAT,
+                               shape=[]) for i in range(n_out)])
+    rep = sonnx.prepare(ModelProto(graph=g))
+    outs = rep.run([np.asarray(v) for v in inputs.values()])
+    return [tensor.to_numpy(o) for o in outs]
+
+
+def _init(arr, name):
+    return TensorProto.from_numpy(np.asarray(arr), name)
+
+
+A = rng.randn(2, 3).astype(np.float32)
+B = rng.randn(2, 3).astype(np.float32)
+POS = np.abs(A) + 0.5
+X4 = rng.randn(1, 2, 6, 6).astype(np.float32)
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis, keepdims=True))
+    return e / e.sum(axis, keepdims=True)
+
+
+# op -> (callable building (inputs, attrs, initializers, golden_list))
+CASES = {
+    "Abs": lambda: ({"x": A}, {}, (), [np.abs(A)]),
+    "Add": lambda: ({"a": A, "b": B}, {}, (), [A + B]),
+    "Sub": lambda: ({"a": A, "b": B}, {}, (), [A - B]),
+    "Mul": lambda: ({"a": A, "b": B}, {}, (), [A * B]),
+    "Div": lambda: ({"a": A, "b": POS}, {}, (), [A / POS]),
+    "Pow": lambda: ({"a": POS, "b": np.float32(2.0) * np.ones_like(A)},
+                    {}, (), [POS ** 2]),
+    "MatMul": lambda: ({"a": A, "b": B.T.copy()}, {}, (), [A @ B.T]),
+    "Max": lambda: ({"a": A, "b": B}, {}, (), [np.maximum(A, B)]),
+    "Min": lambda: ({"a": A, "b": B}, {}, (), [np.minimum(A, B)]),
+    "Equal": lambda: ({"a": A, "b": A.copy()}, {}, (),
+                      [np.ones_like(A, bool)]),
+    "Greater": lambda: ({"a": A, "b": B}, {}, (), [A > B]),
+    "Less": lambda: ({"a": A, "b": B}, {}, (), [A < B]),
+    "Relu": lambda: ({"x": A}, {}, (), [np.maximum(A, 0)]),
+    "Sigmoid": lambda: ({"x": A}, {}, (), [1 / (1 + np.exp(-A))]),
+    "Tanh": lambda: ({"x": A}, {}, (), [np.tanh(A)]),
+    "Exp": lambda: ({"x": A}, {}, (), [np.exp(A)]),
+    "Log": lambda: ({"x": POS}, {}, (), [np.log(POS)]),
+    "Sqrt": lambda: ({"x": POS}, {}, (), [np.sqrt(POS)]),
+    "Neg": lambda: ({"x": A}, {}, (), [-A]),
+    "Reciprocal": lambda: ({"x": POS}, {}, (), [1.0 / POS]),
+    "Identity": lambda: ({"x": A}, {}, (), [A]),
+    "Floor": lambda: ({"x": A * 3}, {}, (), [np.floor(A * 3)]),
+    "Ceil": lambda: ({"x": A * 3}, {}, (), [np.ceil(A * 3)]),
+    "Erf": lambda: ({"x": A}, {}, (),
+                    [np.vectorize(__import__("math").erf)(A)
+                     .astype(np.float32)]),
+    "Gelu": lambda: ({"x": A}, {}, (),
+                     [(A * 0.5 * (1 + np.vectorize(
+                         __import__("math").erf)(A / np.sqrt(2))))
+                      .astype(np.float32)]),
+    "LeakyRelu": lambda: ({"x": A}, {"alpha": 0.1}, (),
+                          [np.where(A > 0, A, 0.1 * A)]),
+    "Elu": lambda: ({"x": A}, {"alpha": 1.0}, (),
+                    [np.where(A > 0, A, np.exp(A) - 1)]),
+    "Selu": lambda: ({"x": A}, {}, (),
+                     [np.where(A > 0, 1.0507009873554805 * A,
+                               1.0507009873554805 * 1.6732632423543772
+                               * (np.exp(A) - 1)).astype(np.float32)]),
+    "Softplus": lambda: ({"x": A}, {}, (),
+                         [np.log1p(np.exp(A)).astype(np.float32)]),
+    "Softmax": lambda: ({"x": A}, {"axis": -1}, (), [_softmax(A, -1)]),
+    "Clip": lambda: ({"x": A}, {"min": -0.5, "max": 0.5}, (),
+                     [np.clip(A, -0.5, 0.5)]),
+    "Cast": lambda: ({"x": A}, {"to": onnx_pb.INT32}, (),
+                     [A.astype(np.int32)]),
+    "Gemm": lambda: ({"a": A, "b": B.T.copy(),
+                      "c": rng.randn(2, 2).astype(np.float32)},
+                     {"alpha": 2.0, "beta": 0.5}, (), None),
+    "Flatten": lambda: ({"x": X4}, {"axis": 1}, (),
+                        [X4.reshape(1, -1)]),
+    "Reshape": lambda: ({"x": A}, {}, (_init([3, 2], "shp"),),
+                        [A.reshape(3, 2)]),
+    "Transpose": lambda: ({"x": A}, {"perm": [1, 0]}, (), [A.T]),
+    "Concat": lambda: ({"a": A, "b": B}, {"axis": 1}, (),
+                       [np.concatenate([A, B], 1)]),
+    "Squeeze": lambda: ({"x": A[None]}, {"axes": [0]}, (), [A]),
+    "Unsqueeze": lambda: ({"x": A}, {"axes": [0]}, (), [A[None]]),
+    "Gather": lambda: ({"x": A}, {"axis": 1},
+                       (_init(np.asarray([2, 0], np.int64), "idx"),),
+                       [A[:, [2, 0]]]),
+    "Slice": lambda: ({"x": A}, {},
+                      (_init([0], "st"), _init([2], "en"),
+                       _init([1], "ax")),
+                      [A[:, 0:2]]),
+    "Split": lambda: ({"x": A}, {"axis": 1, "split": [1, 2]}, (), None),
+    "Shape": lambda: ({"x": A}, {}, (),
+                      [np.asarray(A.shape, np.int32)]),
+    "Expand": lambda: ({"x": A[:, :1]}, {},
+                       (_init(np.asarray([2, 3], np.int64), "shp"),),
+                       [np.broadcast_to(A[:, :1], (2, 3))]),
+    "Tile": lambda: ({"x": A}, {},
+                     (_init(np.asarray([2, 1], np.int64), "reps"),),
+                     [np.tile(A, (2, 1))]),
+    "Pad": lambda: ({"x": A}, {},
+                    (_init(np.asarray([0, 1, 0, 1], np.int64), "pads"),),
+                    [np.pad(A, ((0, 0), (1, 1)))]),
+    "Where": lambda: ({"c": (A > 0), "a": A, "b": B}, {}, (),
+                      [np.where(A > 0, A, B)]),
+    "OneHot": lambda: ({"idx": np.asarray([0, 2], np.float32)}, {},
+                       (_init(np.asarray(3, np.int64), "depth"),
+                        _init(np.asarray([0.0, 1.0], np.float32), "vals")),
+                       [np.eye(3, dtype=np.float32)[[0, 2]]]),
+    "Range": lambda: ({}, {},
+                      (_init(np.asarray(0, np.float32), "st"),
+                       _init(np.asarray(6, np.float32), "en"),
+                       _init(np.asarray(2, np.float32), "dl")),
+                      [np.arange(0, 6, 2, dtype=np.float32)]),
+    "Constant": lambda: ({}, {"value": _init(A, "v")}, (), [A]),
+    "ConstantOfShape": lambda: ({}, {"value": _init(
+        np.asarray([7.0], np.float32), "v")},
+        (_init(np.asarray([2, 2], np.int64), "shp"),),
+        [np.full((2, 2), 7.0, np.float32)]),
+    "ReduceMean": lambda: ({"x": A}, {"axes": [1], "keepdims": 0}, (),
+                           [A.mean(1)]),
+    "ReduceSum": lambda: ({"x": A}, {"axes": [1], "keepdims": 0}, (),
+                          [A.sum(1)]),
+    "ReduceMax": lambda: ({"x": A}, {"axes": [1], "keepdims": 0}, (),
+                          [A.max(1)]),
+    "ReduceMin": lambda: ({"x": A}, {"axes": [1], "keepdims": 0}, (),
+                          [A.min(1)]),
+    "Dropout": lambda: ({"x": A}, {"ratio": 0.5}, (), [A]),  # inference
+    "Conv": lambda: ({"x": X4}, {"kernel_shape": [3, 3],
+                                 "pads": [1, 1, 1, 1]},
+                     (_init(rng.randn(4, 2, 3, 3).astype(np.float32),
+                            "w"),), None),
+    "MaxPool": lambda: ({"x": X4}, {"kernel_shape": [2, 2],
+                                    "strides": [2, 2]}, (), None),
+    "AveragePool": lambda: ({"x": X4}, {"kernel_shape": [2, 2],
+                                        "strides": [2, 2]}, (), None),
+    "GlobalAveragePool": lambda: ({"x": X4}, {}, (),
+                                  [X4.mean((2, 3), keepdims=True)]),
+    "BatchNormalization": lambda: (
+        {"x": X4}, {"epsilon": 1e-5},
+        (_init(np.ones(2, np.float32), "s"),
+         _init(np.zeros(2, np.float32), "b"),
+         _init(np.zeros(2, np.float32), "m"),
+         _init(np.ones(2, np.float32), "v")),
+        [X4 / np.sqrt(1 + 1e-5)]),
+    "LayerNormalization": lambda: (
+        {"x": A}, {"epsilon": 1e-5, "axis": -1},
+        (_init(np.ones(3, np.float32), "s"),
+         _init(np.zeros(3, np.float32), "b")),
+        [(A - A.mean(-1, keepdims=True))
+         / np.sqrt(A.var(-1, keepdims=True) + 1e-5)]),
+}
+
+def test_sweep_covers_every_supported_op():
+    missing = set(sonnx._ONNX_OPS) - set(CASES)
+    assert not missing, f"ops without a conformance case: {sorted(missing)}"
+
+
+def test_gelu_tanh_attribute_and_export_roundtrip():
+    """Both Gelu flavors import per the attribute, and export carries
+    the flavor (ONNX default is exact erf; ours is tanh unless asked)."""
+    import math
+
+    exact = _run_node("Gelu", {"x": A}, {"approximate": "none"})[0]
+    tanh = _run_node("Gelu", {"x": A}, {"approximate": "tanh"})[0]
+    erf_golden = (A * 0.5 * (1 + np.vectorize(math.erf)(A / np.sqrt(2)))
+                  ).astype(np.float32)
+    np.testing.assert_allclose(exact, erf_golden, rtol=2e-4, atol=1e-5)
+    assert np.abs(tanh - exact).max() > 1e-6  # genuinely different paths
+
+    # export writes the attribute and declares opset 20
+    from singa_tpu import autograd, layer, model
+
+    class G(model.Model):
+        def forward(self, x):
+            return autograd.gelu(x)
+
+        def train_one_batch(self, x):  # pragma: no cover
+            raise NotImplementedError
+
+    m = G()
+    x = tensor.from_numpy(A)
+    m.compile([x], is_train=False, use_graph=False)
+    proto = sonnx.to_onnx(m, [x])
+    assert any(o.version == 20 for o in proto.opset_import
+               if not o.domain)
+    gelu_nodes = [n for n in proto.graph.node if n.op_type == "Gelu"]
+    assert len(gelu_nodes) == 1
+    attrs = gelu_nodes[0].attrs()
+    assert attrs["approximate"] == "tanh"  # autograd.gelu default
+    rep = sonnx.prepare(proto)
+    out = tensor.to_numpy(rep.run([A])[0])
+    ref = tensor.to_numpy(m.forward(x))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("op", sorted(CASES))
+def test_onnx_node_conformance(op):
+    inputs, attrs, inits, golden = CASES[op]()
+    n_out = 2 if op == "Split" else 1
+    outs = _run_node(op, inputs, attrs, n_out=n_out, initializers=inits)
+
+    if golden is None and op == "Split":
+        golden = [np.asarray(A[:, :1]), np.asarray(A[:, 1:])]
+    elif golden is None and op == "Gemm":
+        golden = [2.0 * (A @ B.T) + 0.5 * np.asarray(inputs["c"])]
+    elif golden is None:
+        torch = pytest.importorskip("torch")
+        tx = {k: torch.from_numpy(np.asarray(v).copy())
+              for k, v in inputs.items()}
+        if op == "Conv":
+            w = torch.from_numpy(inits[0].to_numpy())
+            golden = [torch.nn.functional.conv2d(tx["x"], w,
+                                                 padding=1).numpy()]
+        elif op == "MaxPool":
+            golden = [torch.nn.functional.max_pool2d(tx["x"], 2).numpy()]
+        elif op == "AveragePool":
+            golden = [torch.nn.functional.avg_pool2d(tx["x"], 2).numpy()]
+    for got, want in zip(outs, golden):
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=2e-4, atol=1e-5, err_msg=op)
