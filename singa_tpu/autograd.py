@@ -271,6 +271,25 @@ def _op(fn, *xs, _name=None, **params):
     return _Func(fn=fn, name=_name, **params)(*xs)
 
 
+def checkpoint_op(fn, *xs, _name=None, **params):
+    """Like ``_op`` but rematerialized: ``jax.checkpoint`` makes the VJP
+    recompute the op's internals in backward instead of storing its
+    residuals — HBM traded for FLOPs (the lever the reference lacks;
+    its graph scheduler can only reorder, not recompute).  Apply to
+    big fused bodies (attention, MoE dispatch, whole pipeline stages)
+    where residuals dominate activation memory."""
+    if params:
+        wrapped = jax.checkpoint(lambda *a: fn(*a, **params))
+    else:
+        wrapped = jax.checkpoint(fn)
+    op = _Func(fn=wrapped, name=_name)
+    y = op(*xs)
+    # keep the kwargs visible on the op instance for sonnx export
+    # (already pre-bound into the checkpointed fn, so not re-passed)
+    op.params = dict(params)
+    return y
+
+
 # ---------------------------------------------------------------------------
 # Functional API (mirrors reference autograd module functions)
 # ---------------------------------------------------------------------------

@@ -19,7 +19,7 @@ class BertConfig:
                  num_hidden_layers=12, num_attention_heads=12,
                  intermediate_size=3072, max_position_embeddings=512,
                  type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
-                 layer_norm_eps=1e-12, use_flash=False):
+                 layer_norm_eps=1e-12, use_flash=False, remat=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -31,6 +31,7 @@ class BertConfig:
         self.attn_dropout = attn_dropout
         self.layer_norm_eps = layer_norm_eps
         self.use_flash = use_flash
+        self.remat = remat  # jax.checkpoint'd attention backward
 
     @classmethod
     def base(cls, **kw):
@@ -98,7 +99,8 @@ class BertLayer(layer.Layer):
                     "sharding already bounds attention memory (ring "
                     "attention), so drop use_flash for parallel runs")
             self.attn = ParallelMHA(cfg.num_attention_heads, plan,
-                                    dropout=cfg.attn_dropout)
+                                    dropout=cfg.attn_dropout,
+                                    remat=cfg.remat)
             self.fc1 = ColumnParallelLinear(cfg.intermediate_size, plan)
             self.fc2 = RowParallelLinear(cfg.hidden_size, plan)
         else:
@@ -106,7 +108,8 @@ class BertLayer(layer.Layer):
 
             self.attn = MultiHeadAttention(cfg.num_attention_heads,
                                            dropout=cfg.attn_dropout,
-                                           use_flash=cfg.use_flash)
+                                           use_flash=cfg.use_flash,
+                                           remat=cfg.remat)
             self.fc1 = layer.Linear(cfg.intermediate_size)
             self.fc2 = layer.Linear(cfg.hidden_size)
         self.ln1 = layer.LayerNorm(cfg.layer_norm_eps)

@@ -27,7 +27,8 @@ class GPT2Config:
     def __init__(self, vocab_size=50257, n_positions=1024, n_embd=768,
                  n_layer=12, n_head=12, n_inner=None, dropout=0.1,
                  layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
-                 moe_experts=8, moe_top_k=2, moe_aux_weight=0.01):
+                 moe_experts=8, moe_top_k=2, moe_aux_weight=0.01,
+                 remat=False):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -42,6 +43,9 @@ class GPT2Config:
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_aux_weight = moe_aux_weight
+        # remat: recompute attention internals in backward
+        # (jax.checkpoint) — memory for FLOPs on long sequences
+        self.remat = remat
 
     @classmethod
     def small(cls, **kw):
@@ -88,7 +92,7 @@ class GPT2Model(model.Model):
                 c.n_head, c.n_inner, plan, dropout=c.dropout, causal=True,
                 eps=c.layer_norm_eps,
                 moe_experts=c.moe_experts if moe else None,
-                moe_top_k=c.moe_top_k))
+                moe_top_k=c.moe_top_k, remat=c.remat))
         self.ln_f = layer.LayerNorm(c.layer_norm_eps)
 
     def forward(self, input_ids):

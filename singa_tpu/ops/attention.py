@@ -21,9 +21,12 @@ from ..layer import Layer, Linear
 from ..tensor import Tensor
 
 
-def scaled_dot_product_attention(q, k, v, mask=None, use_flash=False):
+def scaled_dot_product_attention(q, k, v, mask=None, use_flash=False,
+                                 remat=False):
     """q,k,v: Tensors (B, H, S, D); mask: optional additive mask
-    broadcastable to (B, H, S, S) (e.g. -1e9 at padded positions)."""
+    broadcastable to (B, H, S, S) (e.g. -1e9 at padded positions).
+    ``remat=True`` recomputes the S x S score/prob tensors in backward
+    (jax.checkpoint) instead of keeping them resident."""
     if use_flash:
         from .pallas.flash_attention import flash_attention_op
 
@@ -39,19 +42,22 @@ def scaled_dot_product_attention(q, k, v, mask=None, use_flash=False):
 
     # scale rides op.params so the sonnx frontend can decompose the
     # fused op into MatMul/Mul/Softmax nodes (sonnx._decompose_attention)
+    apply = autograd.checkpoint_op if remat else _op
     if mask is None:
-        return _op(f, q, k, v, _name="Attention", scale=scale)
-    return _op(f, q, k, v, mask, _name="Attention", scale=scale)
+        return apply(f, q, k, v, _name="Attention", scale=scale)
+    return apply(f, q, k, v, mask, _name="Attention", scale=scale)
 
 
 class MultiHeadAttention(Layer):
     """Standard MHA over (B, S, E) inputs."""
 
-    def __init__(self, num_heads, dropout=0.0, use_flash=False):
+    def __init__(self, num_heads, dropout=0.0, use_flash=False,
+                 remat=False):
         super().__init__()
         self.num_heads = int(num_heads)
         self.dropout = float(dropout)
         self.use_flash = use_flash
+        self.remat = bool(remat)
         self.q_proj = Linear(0)  # out_features fixed at initialize
         self.k_proj = Linear(0)
         self.v_proj = Linear(0)
@@ -76,7 +82,8 @@ class MultiHeadAttention(Layer):
         k = split_heads(self.k_proj(x))
         v = split_heads(self.v_proj(x))
         ctx = scaled_dot_product_attention(q, k, v, mask,
-                                           use_flash=self.use_flash)
+                                           use_flash=self.use_flash,
+                                           remat=self.remat)
         ctx = autograd.transpose(ctx, (0, 2, 1, 3))
         ctx = autograd.reshape(ctx, (b, s, e))
         if self.dropout > 0:

@@ -120,7 +120,7 @@ class MoEFFN(Layer):
 
     def __init__(self, num_experts, intermediate,
                  plan: ShardingPlan | None = None, top_k=2,
-                 capacity_factor=1.25, activation="gelu"):
+                 capacity_factor=1.25, activation="gelu", remat=False):
         super().__init__()
         if top_k not in (1, 2):
             raise ValueError("top_k must be 1 (Switch) or 2 (GShard)")
@@ -130,6 +130,7 @@ class MoEFFN(Layer):
         self.top_k = int(top_k)
         self.capacity_factor = float(capacity_factor)
         self.activation = activation
+        self.remat = bool(remat)  # recompute dispatch/experts in bwd
         self.last_aux_loss = None
 
     def initialize(self, x):
@@ -187,7 +188,8 @@ class MoEFFN(Layer):
             y = jnp.einsum("nec,ecd->nd", combine, out)
             return y.reshape(b, s, d), aux.astype(jnp.float32)
 
-        y, aux = autograd._op(
+        apply = autograd.checkpoint_op if self.remat else autograd._op
+        y, aux = apply(
             f, x, self.Wg, self.W1, self.b1, self.W2, self.b2,
             _name="MoEFFN")
         self.last_aux_loss = aux

@@ -133,7 +133,7 @@ class PipelinedTransformer(Layer):
 
     def __init__(self, num_layers, num_heads, intermediate,
                  plan: ShardingPlan | None = None, num_microbatches=None,
-                 causal=True, eps=1e-5):
+                 causal=True, eps=1e-5, remat=False):
         super().__init__()
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
@@ -141,6 +141,11 @@ class PipelinedTransformer(Layer):
         self.plan = plan
         self.causal = bool(causal)
         self.eps = float(eps)
+        # remat: recompute each block in backward (jax.checkpoint per
+        # scanned layer) — the standard transformer memory recipe;
+        # composes with the pipeline (backward ticks recompute their
+        # stage's blocks)
+        self.remat = bool(remat)
         pp = 1 if plan is None else plan.axis_size(PIPE)
         if self.num_layers % pp != 0:
             raise ValueError(
@@ -187,9 +192,15 @@ class PipelinedTransformer(Layer):
     def _stage_fn(self):
         nh, causal, eps = self.num_heads, self.causal, self.eps
 
+        def body(lp, h):
+            return _block_apply(lp, h, nh, causal, eps)
+
+        if self.remat:
+            body = jax.checkpoint(body)
+
         def stage(local_params, x):
             def one_layer(h, lp):
-                return _block_apply(lp, h, nh, causal, eps), None
+                return body(lp, h), None
 
             y, _ = lax.scan(one_layer, x, local_params)
             return y

@@ -168,12 +168,14 @@ class ParallelMHA(Layer):
     reference lacks, SURVEY.md §5.7)."""
 
     def __init__(self, num_heads, plan: ShardingPlan | None = None,
-                 dropout=0.0, seq_parallel=None, causal=False):
+                 dropout=0.0, seq_parallel=None, causal=False,
+                 remat=False):
         super().__init__()
         self.num_heads = int(num_heads)
         self.plan = plan
         self.dropout = float(dropout)
         self.causal = bool(causal)
+        self.remat = bool(remat)
         if seq_parallel is None:
             seq_parallel = plan is not None and plan.axis_size(SEQ) > 1
         self.seq_parallel = bool(seq_parallel)
@@ -219,7 +221,7 @@ class ParallelMHA(Layer):
                 and sharding.plan_active():
             ctx = _ring_attention_op(q, k, v, mask, plan, self.causal)
         else:
-            ctx = _sdpa(q, k, v, mask, self.causal)
+            ctx = _sdpa(q, k, v, mask, self.causal, remat=self.remat)
         ctx = autograd.transpose(ctx, (0, 2, 1, 3))
         ctx = autograd.reshape(ctx, (b, s, e))
         if plan is not None:
@@ -236,13 +238,13 @@ class ParallelTransformerBlock(Layer):
 
     def __init__(self, num_heads, intermediate, plan=None, dropout=0.0,
                  causal=False, eps=1e-5, moe_experts=None, moe_top_k=2,
-                 moe_capacity_factor=1.25):
+                 moe_capacity_factor=1.25, remat=False):
         super().__init__()
         from ..layer import LayerNorm
 
         self.ln1 = LayerNorm(eps)
         self.attn = ParallelMHA(num_heads, plan, dropout=dropout,
-                                causal=causal)
+                                causal=causal, remat=remat)
         self.ln2 = LayerNorm(eps)
         self.mlp = None  # needs hidden size; built at initialize
         self._intermediate = int(intermediate)
@@ -251,6 +253,7 @@ class ParallelTransformerBlock(Layer):
         self._moe = (None if moe_experts is None
                      else (int(moe_experts), int(moe_top_k),
                            float(moe_capacity_factor)))
+        self._remat = bool(remat)
 
     def initialize(self, x, mask=None):
         hidden = x.shape[-1]
@@ -259,7 +262,8 @@ class ParallelTransformerBlock(Layer):
 
             e, k, cf = self._moe
             self.mlp = MoEFFN(e, self._intermediate, self._plan,
-                              top_k=k, capacity_factor=cf)
+                              top_k=k, capacity_factor=cf,
+                              remat=self._remat)
         else:
             self.mlp = ParallelMLP(hidden, self._intermediate, self._plan)
 
@@ -284,10 +288,11 @@ class ParallelTransformerBlock(Layer):
 # attention kernels (taped)
 # ---------------------------------------------------------------------------
 
-def _sdpa(q, k, v, mask, causal):
+def _sdpa(q, k, v, mask, causal, remat=False):
     """Plain scaled-dot-product attention (B,H,S,D); heads may be sharded
     — the einsums are head-local so GSPMD keeps them collective-free.
-    scale/causal ride op.params for sonnx's decomposed export."""
+    scale/causal ride op.params for sonnx's decomposed export; remat
+    recomputes the S x S tensors in backward (jax.checkpoint)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
 
     def f(qv, kv, vv, *rest, scale, causal):
@@ -303,8 +308,9 @@ def _sdpa(q, k, v, mask, causal):
         return jnp.einsum("bhst,bhtd->bhsd", p, vv)
 
     xs = (q, k, v) if mask is None else (q, k, v, mask)
-    return autograd._op(f, *xs, _name="TPAttention", scale=scale,
-                        causal=causal)
+    apply = autograd.checkpoint_op if remat else autograd._op
+    return apply(f, *xs, _name="TPAttention", scale=scale,
+                 causal=causal)
 
 
 def _ring_attention_op(q, k, v, mask, plan, causal):
