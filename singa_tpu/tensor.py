@@ -398,6 +398,13 @@ def from_numpy(np_array, device=None, requires_grad=False) -> Tensor:
     return t
 
 
+def to_host(t):
+    """Host COPY of t (reference: module-level tensor.to_host clones
+    then moves — the input keeps its device; only the method form
+    migrates in place)."""
+    return t.clone().to_host()
+
+
 def to_numpy(t) -> np.ndarray:
     arr = _raw(t)
     if isinstance(arr, jax.Array) and not arr.is_fully_addressable:

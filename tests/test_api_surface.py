@@ -1,0 +1,145 @@
+"""Reference API surface contract: the names a SINGA user's script
+calls must exist with callable shapes (SURVEY.md §2.2 tables; the
+`singa` alias makes these the literal import lines of upstream
+examples).  This is a regression fence — removing or renaming any of
+these breaks source compatibility silently otherwise."""
+
+import inspect
+
+
+def _has(mod, names):
+    missing = [n for n in names if not hasattr(mod, n)]
+    assert not missing, f"{mod.__name__} missing: {missing}"
+
+
+def test_module_to_host_is_a_copy():
+    """Reference semantics: tensor.to_host(t) clones — t keeps its
+    device; only the METHOD t.to_host() migrates in place."""
+    import numpy as np
+
+    from singa import tensor
+
+    t = tensor.from_numpy(np.ones((2, 2), np.float32))
+    dev_before = t.device
+    h = tensor.to_host(t)
+    assert h is not t
+    assert t.device is dev_before
+    np.testing.assert_array_equal(tensor.to_numpy(h),
+                                  tensor.to_numpy(t))
+
+
+def test_tensor_api():
+    from singa import tensor
+
+    _has(tensor, [
+        "Tensor", "from_numpy", "to_numpy", "einsum", "reshape",
+        "transpose", "add", "sub", "eltwise_mult", "div", "mult", "axpy",
+        "sum", "average", "softmax", "relu", "sigmoid", "tanh", "exp",
+        "log", "abs", "pow", "lt", "le", "gt", "ge",
+        "add_column", "add_row", "mult_column", "mult_row",
+        "sum_columns", "sum_rows", "bernoulli", "gaussian", "uniform",
+        "concatenate", "copy_data_to_from", "to_host",
+    ])
+
+
+def test_device_api():
+    from singa import device
+
+    _has(device, [
+        "create_tpu_device", "create_tpu_devices", "get_default_device",
+        "set_default_device", "CppCPU", "TpuDevice", "device_query",
+        # source-compat aliases for reference scripts
+        "create_cuda_gpu", "create_cuda_gpu_on", "create_cuda_gpus",
+    ])
+
+
+def test_autograd_api():
+    from singa import autograd
+
+    _has(autograd, [
+        "Operation", "Dummy", "backward", "set_training",
+        "relu", "sigmoid", "tanh", "gelu", "softmax", "matmul", "gemm",
+        "add", "sub", "mul", "div", "reshape", "transpose", "cat",
+        "flatten", "dropout", "softmax_cross_entropy", "cross_entropy",
+        "mse_loss", "mul_scalar", "checkpoint_op", "embedding",
+        "layer_norm",
+    ])
+
+
+def test_layer_api():
+    from singa import layer
+
+    _has(layer, [
+        "Layer", "Linear", "Conv2d", "BatchNorm2d", "Pooling2d",
+        "MaxPool2d", "AvgPool2d", "ReLU", "Flatten", "Dropout",
+        "LayerNorm", "Embedding", "LSTM", "GRU", "RNN",
+        "MultiHeadAttention", "SoftMaxCrossEntropy",
+    ])
+
+
+def test_model_api():
+    from singa import model
+
+    m = model.Model
+    for meth in ("compile", "train_one_batch", "forward", "set_optimizer",
+                 "save_states", "load_states", "train", "eval",
+                 "set_sharding_plan"):
+        assert callable(getattr(m, meth, None)), meth
+
+
+def test_opt_api():
+    from singa import opt
+
+    _has(opt, ["Optimizer", "SGD", "RMSProp", "AdaGrad", "Adam",
+               "DistOpt", "Constant", "ExponentialDecay", "StepDecay"])
+    sig = inspect.signature(opt.SGD.__init__)
+    for p in ("lr", "momentum", "nesterov", "weight_decay", "dampening"):
+        assert p in sig.parameters, p
+
+
+def test_sonnx_api():
+    from singa import sonnx
+
+    _has(sonnx, ["prepare", "to_onnx", "save", "load", "SingaBackend",
+                 "SingaFrontend", "SingaRep", "SONNXModel"])
+
+
+def test_parallel_api():
+    from singa import parallel
+
+    _has(parallel, ["create_mesh", "ShardingPlan", "DATA", "MODEL",
+                    "SEQ", "PIPE", "EXPERT", "constrain"])
+    from singa.parallel import communicator, dist_opt, moe, pipeline
+    from singa.parallel import ring_attention, tensor_parallel
+
+    _has(communicator, ["Communicator", "initialize_distributed",
+                        "get_mesh"])
+    _has(dist_opt, ["DistOpt"])
+    _has(moe, ["MoEFFN"])
+    _has(pipeline, ["PipelinedTransformer", "gpipe_spmd"])
+    _has(ring_attention, ["ring_self_attention", "ring_attention_sharded"])
+    _has(tensor_parallel, [
+        "ColumnParallelLinear", "RowParallelLinear",
+        "VocabParallelEmbedding", "ParallelMHA", "ParallelMLP",
+        "ParallelTransformerBlock"])
+
+
+def test_models_zoo():
+    from singa_tpu.models import (alexnet, bert, char_rnn, cnn, gpt2,  # noqa
+                                  mlp, resnet, xceptionnet)
+
+    from singa_tpu.models.resnet import (resnet18, resnet34, resnet50,
+                                         resnet101, resnet152)
+    from singa_tpu.models.bert import BertForMaskedLM, BertModel
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+
+def test_snapshot_and_io():
+    from singa import snapshot
+
+    _has(snapshot, ["Snapshot"])
+    from singa.io import binfile, image, loader, onnx_pb, textfile
+
+    _has(binfile, ["BinFileReader", "BinFileWriter"])
+    _has(textfile, ["TextFileReader", "TextFileWriter"])
+    _has(loader, ["DataLoader", "write_dataset"])
