@@ -388,6 +388,11 @@ def from_numpy(np_array, device=None, requires_grad=False) -> Tensor:
     np_array = np.asarray(np_array)
     if np_array.dtype == np.float64:
         np_array = np_array.astype(np.float32)
+    elif np_array.dtype == np.int64:
+        # jax runs x32: jnp would truncate to int32 anyway, but via the
+        # Tensor(dtype=int64) path that emits a per-call UserWarning;
+        # downcast explicitly like float64 -> float32 above
+        np_array = np_array.astype(np.int32)
     t = Tensor(
         shape=np_array.shape,
         device=device,
