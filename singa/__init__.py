@@ -27,12 +27,19 @@ class _AliasLoader(importlib.abc.Loader):
 
     def __init__(self, mod):
         self._mod = mod
+        self._real_spec = mod.__spec__
 
     def create_module(self, spec):
         return self._mod
 
     def exec_module(self, module):
-        pass  # already executed under its singa_tpu.* name
+        # already executed under its singa_tpu.* name; the import system
+        # just overwrote module.__spec__ with the singa.* alias spec —
+        # restore the original so the shared module object keeps its
+        # singa_tpu identity (relative imports check
+        # __package__ == __spec__.parent; reload/spec-keyed tooling use
+        # __spec__.name).  sys.modules keeps the alias entry regardless.
+        module.__spec__ = self._real_spec
 
 
 class _AliasFinder(importlib.abc.MetaPathFinder):

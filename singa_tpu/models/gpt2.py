@@ -18,6 +18,7 @@ TPU-first design:
 """
 
 import numpy as np
+import jax.numpy as jnp
 
 from .. import autograd, layer, model, tensor
 from ..tensor import Tensor
@@ -28,7 +29,7 @@ class GPT2Config:
                  n_layer=12, n_head=12, n_inner=None, dropout=0.1,
                  layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
                  moe_experts=8, moe_top_k=2, moe_aux_weight=0.01,
-                 remat=False):
+                 moe_groups=None, remat=False):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -43,6 +44,9 @@ class GPT2Config:
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_aux_weight = moe_aux_weight
+        # routing-group override (default: plan's data-axis size); lets
+        # a serial model reproduce a sharded run's grouped routing
+        self.moe_groups = moe_groups
         # remat: recompute attention internals in backward
         # (jax.checkpoint) — memory for FLOPs on long sequences
         self.remat = remat
@@ -92,7 +96,8 @@ class GPT2Model(model.Model):
                 c.n_head, c.n_inner, plan, dropout=c.dropout, causal=True,
                 eps=c.layer_norm_eps,
                 moe_experts=c.moe_experts if moe else None,
-                moe_top_k=c.moe_top_k, remat=c.remat))
+                moe_top_k=c.moe_top_k, moe_groups=c.moe_groups,
+                remat=c.remat))
         self.ln_f = layer.LayerNorm(c.layer_norm_eps)
 
     def forward(self, input_ids):
@@ -141,12 +146,23 @@ class GPT2LMHead(model.Model):
 
     def train_one_batch(self, input_ids, labels):
         """labels: next-token ids, same shape as input_ids (callers pass
-        ids shifted by one; positions to ignore use label -1)."""
+        ids shifted by one; positions to ignore use label -1 — their
+        loss AND gradient are zero, and the mean is taken over valid
+        (label >= 0) positions only, standard ignore_index semantics)."""
         logits = self.forward(input_ids)
         b, s, v = logits.shape
         loss = self.loss_fn(
             autograd.reshape(logits, (b * s, v)),
             autograd.reshape(labels, (b * s,)))
+        # _SoftMaxCrossEntropy zeroes ignored rows (one_hot(-1) is all
+        # zeros) but divides by ALL rows; rescale so the mean is over
+        # valid positions, else reported loss (and effective lr) shrinks
+        # with the ignore fraction
+        scale = autograd._op(
+            lambda lab: (b * s) / jnp.maximum(jnp.sum(
+                (lab.reshape(-1) >= 0).astype(jnp.float32)), 1.0),
+            labels, _name="IgnoreIndexScale")
+        loss = autograd.mul(loss, scale)
         for aux in self.transformer.aux_losses():
             loss = autograd.add(
                 loss, autograd.mul_scalar(aux, self.cfg.moe_aux_weight))

@@ -34,9 +34,44 @@ __all__ = ["Communicator", "get_mesh", "initialize_distributed", "is_tracing"]
 _DEFAULT_AXIS = "data"
 
 
+def _wait_for_coordinator(address, timeout):
+    """Bounded TCP probe of the rank-0 coordinator.  jax's coordination
+    client LOG(FATAL)s (process abort, no Python exception) when
+    registration times out, so reachability is checked HERE first to
+    turn "coordinator never came up" into a clean, catchable error —
+    the failure-detection behavior the reference gets from MPI's
+    startup handshake (SURVEY.md §5.3/§5.8)."""
+    import socket
+    import time
+
+    host, _, port = str(address).rpartition(":")
+    host = host.strip("[]")  # bracketed IPv6 form "[::1]:1234"
+    if not host or not port.isdigit():
+        return  # unparseable address: let jax's own validation report it
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=2):
+                return
+        except OSError:
+            time.sleep(0.5)
+    raise ConnectionError(
+        f"coordinator {address} unreachable after {timeout:.0f}s: check "
+        f"that the process_id=0 task is up and the address/port are "
+        f"correct")
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None, **kw):
-    """Multi-host bootstrap (reference: MPI init + NCCL-id broadcast)."""
+    """Multi-host bootstrap (reference: MPI init + NCCL-id broadcast).
+
+    Accepts jax.distributed.initialize kwargs; ``initialization_timeout``
+    (seconds, default 300) also bounds the pre-flight coordinator
+    reachability probe on non-zero ranks, which raises ConnectionError
+    instead of letting the coordination client abort the process."""
+    if coordinator_address and process_id not in (None, 0):
+        _wait_for_coordinator(coordinator_address,
+                              kw.get("initialization_timeout", 300))
     jax.distributed.initialize(coordinator_address, num_processes,
                                process_id, **kw)
 

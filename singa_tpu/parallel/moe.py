@@ -6,18 +6,25 @@ required for parity"); this is the TPU-native extension point.  Design
 follows the GShard/Switch formulation, which is exactly the shape the
 XLA SPMD partitioner was built around:
 
-  * token-choice top-k gating with a static per-expert capacity
-    (C = ceil(k·N/E·capacity_factor)) — static shapes, no dynamic
-    gather/scatter, everything tiles onto the MXU;
-  * dispatch/combine are one-hot einsums ``(N,E,C)×(N,D)→(E,C,D)``; with
-    tokens sharded over ``data`` and experts sharded over ``expert``,
-    GSPMD lowers these contractions to the all-to-all exchange the
-    reference-era frameworks hand-code with NCCL;
-  * expert FFNs are a single batched einsum over the (E, …) leading dim,
-    sharded ``P(expert, …)`` — each chip runs only its resident experts;
+  * tokens are split into G groups with the group dim sharded over
+    ``data`` (GShard's "groups = data shards"): routing and capacity are
+    per-group (C = ceil(k·N/G/E·capacity_factor)), so dispatch/expert
+    buffers shaped (G,E,C,D) shard ``P(data, expert, …)`` and both the
+    buffers and the expert FLOPs SCALE DOWN with the data axis instead
+    of being redundantly replicated on every data rank;
+  * token-choice top-k gating with a static per-group per-expert
+    capacity — static shapes, no dynamic gather/scatter, everything
+    tiles onto the MXU;
+  * dispatch/combine are one-hot einsums ``(G,n,E,C)×(G,n,D)→(G,E,C,D)``;
+    with groups on ``data`` and experts on ``expert``, GSPMD lowers the
+    expert-dim resharding to the all-to-all exchange the reference-era
+    frameworks hand-code with NCCL;
+  * expert FFNs are a single batched einsum over the (G, E, …) leading
+    dims — each chip runs only its resident experts on its groups;
   * the standard load-balance auxiliary loss (mean fraction·probability
-    product, scaled by E²) is exposed as ``last_aux_loss`` for the model
-    to add to its objective — it flows gradients into the router.
+    product, scaled by E so a uniform router scores 1.0) is exposed as
+    ``last_aux_loss`` for the model to add to its objective — it flows
+    gradients into the router.
 
 Tokens over capacity are dropped (their combine weight is zero and the
 residual path carries them), matching Switch-Transformer semantics.
@@ -63,10 +70,14 @@ def _top2_dispatch(probs, capacity):
     pmean = jnp.mean(probs, axis=0)                         # (E,)
     aux = jnp.sum(frac * pmean) * e
 
-    # positions within each expert: first choices fill first
+    # positions within each expert: first choices fill first; second
+    # choices start after the SURVIVING first choices (min(count1, C)) —
+    # offsetting by the raw count would strand free capacity slots
+    # behind dropped first-choice overflow
     pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1        # (N, E)
     count1 = jnp.sum(mask1, axis=0, keepdims=True)          # (1, E)
-    pos2 = (jnp.cumsum(mask2, axis=0) - mask2 + count1) * mask2
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2
+            + jnp.minimum(count1, capacity)) * mask2
 
     keep1 = mask1 * (pos1 < capacity)
     keep2 = mask2 * (pos2 < capacity)
@@ -120,7 +131,8 @@ class MoEFFN(Layer):
 
     def __init__(self, num_experts, intermediate,
                  plan: ShardingPlan | None = None, top_k=2,
-                 capacity_factor=1.25, activation="gelu", remat=False):
+                 capacity_factor=1.25, activation="gelu", remat=False,
+                 groups=None):
         super().__init__()
         if top_k not in (1, 2):
             raise ValueError("top_k must be 1 (Switch) or 2 (GShard)")
@@ -131,6 +143,10 @@ class MoEFFN(Layer):
         self.capacity_factor = float(capacity_factor)
         self.activation = activation
         self.remat = bool(remat)  # recompute dispatch/experts in bwd
+        # routing-group count: default = plan's data-axis size (1 without
+        # a plan); explicit override lets a serial oracle reproduce a
+        # sharded run's grouped-routing math exactly
+        self.groups = None if groups is None else int(groups)
         self.last_aux_loss = None
 
     def initialize(self, x):
@@ -153,40 +169,63 @@ class MoEFFN(Layer):
         self.W2 = param((e, f, d), math.sqrt(2.0 / f), P(EXPERT, None, None))
         self.b2 = param((e, d), 0.0, P(EXPERT, None))
 
-    def _capacity(self, n):
+    def _num_groups(self, n):
+        """Groups = data-axis size (GShard): routing is per-group and the
+        group dim shards over ``data``, so expert buffers/FLOPs scale
+        with dp.  Plan-less (single-chip) use runs one global group."""
+        if self.groups is not None:
+            g = self.groups
+        elif self.plan is None:
+            return 1
+        else:
+            g = self.plan.axis_size(sharding.DATA)
+        if n % g != 0:
+            raise ValueError(
+                f"MoE token count {n} not divisible by data-axis size {g}")
+        return g
+
+    def _capacity(self, n_per_group):
         return max(1, int(math.ceil(
-            self.top_k * n / self.num_experts * self.capacity_factor)))
+            self.top_k * n_per_group / self.num_experts
+            * self.capacity_factor)))
 
     def forward(self, x):
         b, s, d = x.shape
         n = b * s
-        cap = self._capacity(n)
+        g = self._num_groups(n)
+        nl = n // g  # tokens per group
+        cap = self._capacity(nl)
         plan = self.plan
         act = getattr(jax.nn, self.activation)
-        route = _top2_dispatch if self.top_k == 2 else _top1_dispatch
+        route = jax.vmap(_top2_dispatch if self.top_k == 2
+                         else _top1_dispatch, in_axes=(0, None))
+
+        def constrain(a, spec):
+            if plan is not None and sharding.plan_active():
+                return jax.lax.with_sharding_constraint(
+                    a, plan.sharding(spec))
+            return a
 
         def f(xv, wg, w1, b1, w2, b2):
-            xt = xv.reshape(n, d)
+            xt = xv.reshape(g, nl, d)
+            xt = constrain(xt, P(sharding.DATA, None, None))
             # route in fp32 — bf16 cumsum positions go wrong past 256
             probs = jax.nn.softmax(
                 (xt @ wg.astype(xt.dtype)).astype(jnp.float32), axis=-1)
-            dispatch, combine, aux = route(probs, cap)
+            dispatch, combine, aux = route(probs, cap)   # (G,n,E,C) ×2, (G,)
             dispatch = dispatch.astype(xt.dtype)
             combine = combine.astype(xt.dtype)
-            # dispatch: tokens -> (E, C, D); GSPMD turns this into the
-            # data->expert all-to-all when N@data and E@expert
-            ein = jnp.einsum("nec,nd->ecd", dispatch, xt)
-            if plan is not None and sharding.plan_active():
-                ein = jax.lax.with_sharding_constraint(
-                    ein, plan.sharding(P(EXPERT, None, None)))
-            h = act(jnp.einsum("ecd,edf->ecf", ein, w1) + b1[:, None, :])
-            out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
-            if plan is not None and sharding.plan_active():
-                out = jax.lax.with_sharding_constraint(
-                    out, plan.sharding(P(EXPERT, None, None)))
-            # combine: (E, C, D) -> tokens (the reverse all-to-all)
-            y = jnp.einsum("nec,ecd->nd", combine, out)
-            return y.reshape(b, s, d), aux.astype(jnp.float32)
+            # dispatch: tokens -> (G, E, C, D); resharding E onto the
+            # expert axis is the data->expert all-to-all under GSPMD
+            ein = jnp.einsum("gnec,gnd->gecd", dispatch, xt)
+            ein = constrain(ein, P(sharding.DATA, EXPERT, None, None))
+            h = act(jnp.einsum("gecd,edf->gecf", ein, w1)
+                    + b1[None, :, None, :])
+            out = jnp.einsum("gecf,efd->gecd", h, w2) + b2[None, :, None, :]
+            out = constrain(out, P(sharding.DATA, EXPERT, None, None))
+            # combine: (G, E, C, D) -> tokens (the reverse all-to-all)
+            y = jnp.einsum("gnec,gecd->gnd", combine, out)
+            return y.reshape(b, s, d), jnp.mean(aux).astype(jnp.float32)
 
         apply = autograd.checkpoint_op if self.remat else autograd._op
         y, aux = apply(

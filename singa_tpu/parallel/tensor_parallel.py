@@ -238,7 +238,7 @@ class ParallelTransformerBlock(Layer):
 
     def __init__(self, num_heads, intermediate, plan=None, dropout=0.0,
                  causal=False, eps=1e-5, moe_experts=None, moe_top_k=2,
-                 moe_capacity_factor=1.25, remat=False):
+                 moe_capacity_factor=1.25, moe_groups=None, remat=False):
         super().__init__()
         from ..layer import LayerNorm
 
@@ -252,7 +252,7 @@ class ParallelTransformerBlock(Layer):
         self._dropout = float(dropout)
         self._moe = (None if moe_experts is None
                      else (int(moe_experts), int(moe_top_k),
-                           float(moe_capacity_factor)))
+                           float(moe_capacity_factor), moe_groups))
         self._remat = bool(remat)
 
     def initialize(self, x, mask=None):
@@ -260,9 +260,9 @@ class ParallelTransformerBlock(Layer):
         if self._moe is not None:
             from .moe import MoEFFN
 
-            e, k, cf = self._moe
+            e, k, cf, g = self._moe
             self.mlp = MoEFFN(e, self._intermediate, self._plan,
-                              top_k=k, capacity_factor=cf,
+                              top_k=k, capacity_factor=cf, groups=g,
                               remat=self._remat)
         else:
             self.mlp = ParallelMLP(hidden, self._intermediate, self._plan)

@@ -90,6 +90,42 @@ def test_dist_output_reassembly(dev):
     assert np.isfinite(float(loss.data))
 
 
+def test_dist_ambiguous_output_raises(dev):
+    """A non-batch-leading output (e.g. an (L, B/W, H) RNN state) must
+    ERROR under "auto" reassembly with a fix-it message, not silently
+    merge the wrong dims (round-2 verdict); an explicit per-leaf spec
+    list handles it."""
+    from singa_tpu import autograd
+
+    L, H = 3, 6
+
+    class StatefulMLP(_DistMLP):
+        def train_one_batch(self, x, y):
+            out, loss = super().train_one_batch(x, y)
+            b = x.shape[0]
+            # fabricate a layer-major (L, b, H) state from the logits
+            state = autograd.reshape(
+                autograd.matmul(out, tensor.from_numpy(
+                    np.ones((4, L * H), np.float32), x.device)),
+                (b, L, H))
+            state = autograd.transpose(state, (1, 0, 2))
+            return out, loss, state
+
+    dev.SetRandSeed(5)
+    m = StatefulMLP()
+    m.set_optimizer(DistOpt(opt.SGD(lr=0.05)))
+    x, y = _data(dev, n=16)
+    m.compile([x], is_train=True, use_graph=True)
+    with pytest.raises(ValueError, match="dist_outputs"):
+        m(x, y)
+    # the explicit spec list reassembles it correctly
+    m.dist_outputs = ["concat", "mean", "stack"]
+    out, loss, state = m(x, y)
+    assert out.shape == (16, 4)
+    assert loss.shape == ()
+    assert state.shape == (N_DEV, L, 16 // N_DEV, H)
+
+
 def test_dist_bad_batch_divisibility(dev):
     m = _make(dev, DistOpt(opt.SGD(lr=0.05)))
     x, y = _data(dev, n=32)

@@ -53,6 +53,33 @@ def test_trains_graph_mode():
     assert losses[-1] < losses[0] - 0.5, losses
 
 
+def test_ignore_index_mean_over_valid_positions():
+    """label -1 positions contribute zero loss AND the mean divides by
+    the valid count (standard ignore_index semantics) — a half-ignored
+    batch must NOT report half the loss."""
+    cfg = _cfg()
+    ids, labels = _batch(cfg)
+    x = tensor.from_numpy(ids)
+
+    def loss_with(lab):
+        from singa_tpu import device as device_module
+        device_module.get_default_device().SetRandSeed(0)
+        m = GPT2LMHead(cfg)
+        m.set_optimizer(opt.SGD(lr=0.0))
+        m.compile([x], is_train=True, use_graph=False)
+        _, loss = m(tensor.from_numpy(ids), tensor.from_numpy(lab))
+        return float(tensor.to_numpy(loss))
+
+    full = loss_with(labels)
+    half = labels.copy()
+    half[:, S // 2:] = -1  # ignore the second half of every row
+    got = loss_with(half)
+    # at init the per-position CE is ~uniform (~log V), so the mean over
+    # the valid half must track the full mean, not half of it
+    assert abs(got - full) < 0.35 * full, (got, full)
+    assert got > 0.6 * full, (got, full)
+
+
 def test_tied_head_gradient_reaches_embedding():
     cfg = _cfg()
     m = GPT2LMHead(cfg)
@@ -66,8 +93,9 @@ def test_tied_head_gradient_reaches_embedding():
 
 
 def test_parallel_gpt_moe_matches_serial():
-    """dp2 x tp2 x sp2 GPT with a MoE block == serial twin."""
-    cfg = _cfg(moe_every=2, moe_experts=4)
+    """dp2 x tp2 x sp2 GPT with a MoE block == serial twin (the serial
+    oracle pins moe_groups=2 to reproduce the plan's grouped routing)."""
+    cfg = _cfg(moe_every=2, moe_experts=4, moe_groups=2)
     mesh = shd.create_mesh(dp=2, tp=2, sp=2)
     plan = shd.ShardingPlan(mesh)
 

@@ -1,0 +1,140 @@
+"""Accuracy-target end-to-end tests: "trains" must mean "LEARNS a
+known-learnable task to a threshold", not "loss moved" (round-2
+verdict; SURVEY.md §4 golden-value philosophy).  One deterministic
+synthetic task per model family, thresholds far above chance, runtimes
+kept modest (CPU-mesh CI)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, device as device_module, layer, model, \
+    opt, tensor
+
+
+@pytest.fixture
+def dev():
+    d = device_module.get_default_device()
+    d.SetRandSeed(0)
+    return d
+
+
+def _accuracy(m, x, y):
+    m.eval()
+    try:
+        logits = m(x)
+        pred = np.argmax(tensor.to_numpy(logits), axis=-1)
+        return float(np.mean(pred == tensor.to_numpy(y)))
+    finally:
+        m.train()
+
+
+def _two_spirals(n_per_class=250, noise=0.06, seed=0):
+    """The classic non-linearly-separable 2-class benchmark: two
+    interleaved spirals.  A linear model caps at ~50%; an MLP that
+    actually learns exceeds 95%."""
+    rng = np.random.RandomState(seed)
+    t = np.sqrt(rng.rand(n_per_class)) * 3 * np.pi
+    xs, ys = [], []
+    for cls, phase in ((0, 0.0), (1, np.pi)):
+        r = t
+        x = np.stack([r * np.cos(t + phase), r * np.sin(t + phase)],
+                     axis=1) / (3 * np.pi)
+        x += rng.randn(*x.shape) * noise
+        xs.append(x)
+        ys.append(np.full(n_per_class, cls))
+    x = np.concatenate(xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.int32)
+    idx = rng.permutation(len(x))
+    return x[idx], y[idx]
+
+
+def test_mlp_two_spirals_over_95(dev):
+    from singa_tpu.models.mlp import MLP
+
+    x_np, y_np = _two_spirals()
+    x = tensor.from_numpy(x_np, dev)
+    y = tensor.from_numpy(y_np, dev)
+    m = MLP(data_size=2, perceptron_size=64, num_classes=2)
+    m.set_optimizer(opt.SGD(lr=0.2, momentum=0.9))
+    m.compile([x], is_train=True, use_graph=True)
+    for _ in range(1500):
+        m(x, y)
+    acc = _accuracy(m, x, y)
+    assert acc > 0.95, f"two-spirals accuracy {acc:.3f} <= 0.95"
+
+
+def _shape_images(n=256, hw=16, seed=0):
+    """4-class synthetic vision task: which quadrant holds the bright
+    blob.  Translation-invariant conv features solve it; chance is 25%."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 1, hw, hw).astype(np.float32) * 0.3
+    y = rng.randint(0, 4, n).astype(np.int32)
+    h = hw // 2
+    for i, cls in enumerate(y):
+        r0 = (cls // 2) * h + rng.randint(0, h - 4)
+        c0 = (cls % 2) * h + rng.randint(0, h - 4)
+        x[i, 0, r0:r0 + 4, c0:c0 + 4] += 2.5
+    return x, y
+
+
+def test_cnn_quadrant_task_over_90(dev):
+    class TinyCNN(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.conv = layer.Conv2d(8, 3, stride=2, padding=1)
+            self.relu = layer.ReLU()
+            self.pool = layer.MaxPool2d(2, 2)
+            self.flat = layer.Flatten()
+            self.fc = layer.Linear(4)
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc(self.flat(self.pool(self.relu(self.conv(x)))))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    x_np, y_np = _shape_images()
+    x = tensor.from_numpy(x_np, dev)
+    y = tensor.from_numpy(y_np, dev)
+    m = TinyCNN()
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    m.compile([x], is_train=True, use_graph=True)
+    for _ in range(60):
+        m(x, y)
+    acc = _accuracy(m, x, y)
+    assert acc > 0.90, f"quadrant-task accuracy {acc:.3f} <= 0.90"
+
+
+def test_charrnn_perplexity_bound(dev):
+    """char-RNN on a fixed periodic corpus: a model that learns the
+    repetition drives per-char perplexity far below the uniform-vocab
+    baseline (|V|); threshold 2.0 is unreachable without learning the
+    sequence structure."""
+    from singa_tpu.models.char_rnn import CharRNN, one_hot
+
+    corpus = ("the quick brown fox jumps over the lazy dog. " * 8)
+    chars = sorted(set(corpus))
+    vocab = len(chars)
+    c2i = {c: i for i, c in enumerate(chars)}
+    ids = np.array([c2i[c] for c in corpus], np.int32)
+
+    T, B = 32, 8
+    starts = np.arange(B) * 37 % (len(ids) - T - 1)
+    x_ids = np.stack([ids[s:s + T] for s in starts])
+    y_ids = np.stack([ids[s + 1:s + T + 1] for s in starts])
+
+    x = tensor.from_numpy(one_hot(x_ids, vocab), dev)
+    y = tensor.from_numpy(y_ids, dev)
+    m = CharRNN(vocab_size=vocab, hidden_size=64, num_layers=1,
+                seq_length=T)
+    m.set_optimizer(opt.Adam(lr=5e-3))
+    m.compile([x], is_train=True, use_graph=True)
+    loss = None
+    for _ in range(150):
+        _, loss = m(x, y)
+    ppl = float(np.exp(tensor.to_numpy(loss)))
+    assert ppl < 2.0, f"char-RNN perplexity {ppl:.2f} >= 2.0 (|V|={vocab})"

@@ -113,6 +113,24 @@ def test_save_load_states_roundtrip(tmp_path, dev):
     assert np.isfinite(float(loss.data))
 
 
+def test_optimizer_swap_after_compile_recompiles(dev):
+    """Swapping the optimizer after graph compile must clear the cached
+    executable (lr is a trace-time constant): a stale replay would keep
+    applying the OLD lr."""
+    m = _make(dev, use_graph=True)
+    x, y = _data(dev)
+    m(x, y)
+    m(x, y)  # compiled, lr=0.05 baked in
+    m.set_optimizer(opt.SGD(lr=0.0))  # freeze: zero lr
+    before = {k: tensor.to_numpy(v).copy()
+              for k, v in m.get_params().items()}
+    m(x, y)
+    for k, v in m.get_params().items():
+        np.testing.assert_array_equal(
+            tensor.to_numpy(v), before[k],
+            err_msg=f"{k} changed under lr=0 — stale executable replay")
+
+
 def test_param_naming_hierarchical(dev):
     m = _make(dev, use_graph=False)
     names = set(m.get_params().keys())

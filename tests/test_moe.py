@@ -67,10 +67,11 @@ def test_top2_identical_experts_equals_dense():
 
 
 class MoEModel(model.Model):
-    def __init__(self, plan=None, aux_weight=0.01):
+    def __init__(self, plan=None, aux_weight=0.01, groups=None):
         super().__init__()
         self.proj = layer.Linear(D)
-        self.moe = MoEFFN(E, F, plan=plan, top_k=2, capacity_factor=4.0)
+        self.moe = MoEFFN(E, F, plan=plan, top_k=2, capacity_factor=4.0,
+                          groups=groups)
         self.head = layer.Linear(4)
         self.loss_fn = layer.SoftMaxCrossEntropy()
         self.aux_weight = aux_weight
@@ -100,7 +101,9 @@ def test_ep_sharded_matches_serial():
     mesh = shd.create_mesh(dp=2, ep=4)
     plan = shd.ShardingPlan(mesh)
 
-    serial = MoEModel(plan=None)
+    # serial oracle pins groups=2 to reproduce the plan's grouped
+    # (GShard groups-on-data) routing math exactly
+    serial = MoEModel(plan=None, groups=2)
     par = MoEModel(plan=plan)
     par.set_sharding_plan(plan)
     for m in (serial, par):

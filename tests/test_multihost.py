@@ -144,6 +144,195 @@ def _single_process_reference():
     return losses
 
 
+_WORKER_RESUME = textwrap.dedent("""
+    import json, os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    pid = int(sys.argv[1]); port = sys.argv[2]; ckpt = sys.argv[3]
+    from singa_tpu.parallel.communicator import initialize_distributed
+    initialize_distributed(f"127.0.0.1:{port}", num_processes=2,
+                           process_id=pid)
+
+    import numpy as np
+    from singa_tpu import layer, model, opt, tensor
+    from singa_tpu import device as device_mod
+    from singa_tpu.parallel.communicator import Communicator
+    from singa_tpu.parallel.dist_opt import DistOpt
+
+    class Net(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(16)
+            self.relu = layer.ReLU()
+            self.fc2 = layer.Linear(4)
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    def make(seed):
+        device_mod.get_default_device().SetRandSeed(seed)
+        m = Net()
+        m.set_optimizer(DistOpt(opt.SGD(lr=0.1),
+                                communicator=Communicator()))
+        m.compile([tensor.from_numpy(lx)], is_train=True,
+                  use_graph=True)
+        return m
+
+    rng = np.random.RandomState(0)
+    gx = rng.randn(16, 8).astype(np.float32)
+    gy = rng.randint(0, 4, 16).astype(np.int32)
+    lx, ly = gx[8 * pid:8 * pid + 8], gy[8 * pid:8 * pid + 8]
+
+    m = make(seed=0)
+    losses = []
+    for _ in range(2):
+        _, loss = m(tensor.from_numpy(lx), tensor.from_numpy(ly))
+        losses.append(float(tensor.to_numpy(loss)))
+    # rank 0 writes the checkpoint (save_states gathers global state
+    # with collective to_numpy on BOTH ranks; only rank 0 persists)
+    states = {k: tensor.to_numpy(v) for k, v in m.get_states().items()}
+    if pid == 0:
+        m.save_states(ckpt)
+    # barrier so rank 1 can't read a half-written file
+    from jax.experimental import multihost_utils as mh
+    mh.sync_global_devices("ckpt_written")
+
+    # resume: FRESH divergently-seeded model on both ranks; load must
+    # restore exact training state before continuing
+    m2 = make(seed=100 + pid)
+    m2.load_states(ckpt)
+    for _ in range(2):
+        _, loss = m2(tensor.from_numpy(lx), tensor.from_numpy(ly))
+        losses.append(float(tensor.to_numpy(loss)))
+    print("RESULT " + json.dumps({"pid": pid, "losses": losses}),
+          flush=True)
+""")
+
+
+_WORKER_NO_COORD = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from singa_tpu.parallel.communicator import initialize_distributed
+    try:
+        initialize_distributed(f"127.0.0.1:{sys.argv[1]}",
+                               num_processes=2, process_id=1,
+                               initialization_timeout=6)
+    except ConnectionError as e:
+        assert "unreachable" in str(e)
+        print("CLEAN_ERROR " + type(e).__name__, flush=True)
+        sys.exit(17)
+    sys.exit(0)
+""")
+
+
+def test_coordinator_unreachable_times_out_cleanly():
+    """A worker whose coordinator never comes up must fail with a clean
+    timeout error, not hang forever (reference failure-detection
+    parity, SURVEY.md §5.3/§5.8)."""
+    port = _free_port()  # nothing listens here
+    p = subprocess.Popen(
+        [sys.executable, "-c", _WORKER_NO_COORD, str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    out, _ = p.communicate(timeout=120)
+    assert p.returncode == 17, f"expected clean timeout exit:\n{out[-2000:]}"
+    assert "CLEAN_ERROR" in out
+
+
+def test_two_process_checkpoint_resume_matches_oracle(tmp_path):
+    """Rank 0 checkpoints mid-training; both ranks resume into FRESH
+    divergently-seeded models; the continued losses must match the
+    single-process oracle's save/load cycle exactly."""
+    port = _free_port()
+    ckpt = str(tmp_path / "mh.ckpt")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER_RESUME, str(i), str(port),
+             ckpt],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}, results
+    np.testing.assert_allclose(results[0]["losses"],
+                               results[1]["losses"], rtol=1e-6)
+
+    # oracle: one process, 4 devices, same save/load cycle
+    ref = _single_process_resume_reference(str(tmp_path / "sp.ckpt"))
+    np.testing.assert_allclose(results[0]["losses"], ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def _single_process_resume_reference(ckpt):
+    from singa_tpu import layer, model, opt, tensor
+    from singa_tpu import device as device_mod
+    from singa_tpu.parallel.communicator import Communicator
+    from singa_tpu.parallel.dist_opt import DistOpt
+
+    class Net(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(16)
+            self.relu = layer.ReLU()
+            self.fc2 = layer.Linear(4)
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    rng = np.random.RandomState(0)
+    gx = rng.randn(16, 8).astype(np.float32)
+    gy = rng.randint(0, 4, 16).astype(np.int32)
+
+    def make(seed):
+        device_mod.get_default_device().SetRandSeed(seed)
+        m = Net()
+        m.set_optimizer(DistOpt(opt.SGD(lr=0.1),
+                                communicator=Communicator(num_devices=4)))
+        m.compile([tensor.from_numpy(gx)], is_train=True, use_graph=True)
+        return m
+
+    m = make(seed=0)
+    losses = []
+    for _ in range(2):
+        _, loss = m(tensor.from_numpy(gx), tensor.from_numpy(gy))
+        losses.append(float(tensor.to_numpy(loss)))
+    m.save_states(ckpt)
+    m2 = make(seed=55)
+    m2.load_states(ckpt)
+    for _ in range(2):
+        _, loss = m2(tensor.from_numpy(gx), tensor.from_numpy(gy))
+        losses.append(float(tensor.to_numpy(loss)))
+    return losses
+
+
 def test_two_process_distopt_matches_single_process(tmp_path):
     port = _free_port()
     procs = [
