@@ -1,0 +1,76 @@
+"""Long-context GPT-2 training — the single-chip long-sequence recipe
+(SURVEY.md §5.7; the reference has no long-context story at all).
+
+Two levers compose:
+  * ``--attn flash``: Pallas online-softmax attention — HBM O(S·D)
+    instead of the fused path's O(S²) score matrices (which OOM first
+    as S grows; LONGCTX.json records the measured crossover on v5e);
+  * ``--remat``: ``jax.checkpoint`` on the attention/MLP bodies —
+    recompute instead of storing residuals.
+
+For sequences beyond one chip's HBM, switch to ring attention over a
+``seq`` mesh axis (examples/gpt2/train_parallel.py --sp).
+
+    python examples/gpt2/train_longctx.py --seqlen 2048 --attn flash \\
+        --remat --steps 5
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def run(args):
+    from singa_tpu import amp, device, opt, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    if args.bf16:
+        amp.enable(True)
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(0)
+    cfg = GPT2Config(
+        vocab_size=args.vocab, n_positions=args.seqlen,
+        n_embd=args.embd, n_layer=args.layers,
+        n_head=args.heads, dropout=0.0,
+        attn_impl=args.attn, remat=args.remat)
+    m = GPT2LMHead(cfg)
+    m.set_optimizer(opt.Adam(lr=args.lr))
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, cfg.vocab_size,
+                         (args.batch, args.seqlen)).astype(np.int32)
+    labels_np = np.roll(ids_np, -1, axis=1).astype(np.int32)
+    ids = tensor.from_numpy(ids_np, dev)
+    labels = tensor.from_numpy(labels_np, dev)
+    m.compile([ids], is_train=True, use_graph=True)
+
+    tokens = args.batch * args.seqlen
+    for step in range(args.steps):
+        t0 = time.time()
+        _, loss = m(ids, labels)
+        lv = float(tensor.to_numpy(loss))
+        dt = time.time() - t0
+        print(f"step {step}: loss={lv:.4f} "
+              f"({tokens / dt:,.0f} tokens/s{' incl. compile' if step == 0 else ''})")
+    stats = dev.jax_device.memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use")
+    if peak:
+        print(f"peak HBM: {peak / 2**30:.2f} GiB")
+    assert np.isfinite(lv)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqlen", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--embd", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--attn", choices=["fused", "flash"], default="flash")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--bf16", action="store_true", default=True)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--steps", type=int, default=5)
+    run(ap.parse_args())

@@ -198,6 +198,7 @@ class AttributeProto:
     i: int = 0
     s: bytes = b""
     t: "TensorProto | None" = None
+    g: "GraphProto | None" = None  # If/Loop/Scan subgraph bodies
     floats: list = field(default_factory=list)
     ints: list = field(default_factory=list)
     strings: list = field(default_factory=list)
@@ -217,6 +218,8 @@ class AttributeProto:
                 a.s = bytes(val)
             elif fnum == 5:
                 a.t = TensorProto.parse(val)
+            elif fnum == 6:
+                a.g = GraphProto.parse(val)
             elif fnum == 7:
                 if wtype == 5:
                     a.floats.append(struct.unpack("<f", val)[0])
@@ -241,6 +244,8 @@ class AttributeProto:
             _emit(out, 4, 2, self.s)
         elif self.type == ATTR_TENSOR:
             _emit(out, 5, 2, self.t.serialize())
+        elif self.type == ATTR_GRAPH:
+            _emit(out, 6, 2, self.g.serialize())
         elif self.type == ATTR_FLOATS:
             for v in self.floats:
                 _emit(out, 7, 5, struct.pack("<f", v))
@@ -256,7 +261,8 @@ class AttributeProto:
     def value(self):
         return {
             ATTR_FLOAT: self.f, ATTR_INT: self.i, ATTR_STRING: self.s.decode(),
-            ATTR_TENSOR: self.t, ATTR_FLOATS: list(self.floats),
+            ATTR_TENSOR: self.t, ATTR_GRAPH: self.g,
+            ATTR_FLOATS: list(self.floats),
             ATTR_INTS: list(self.ints),
             ATTR_STRINGS: [s.decode() for s in self.strings],
         }.get(self.type)
@@ -274,6 +280,8 @@ class AttributeProto:
             a.type, a.s = ATTR_STRING, value.encode()
         elif isinstance(value, TensorProto):
             a.type, a.t = ATTR_TENSOR, value
+        elif isinstance(value, GraphProto):
+            a.type, a.g = ATTR_GRAPH, value
         elif isinstance(value, (list, tuple)):
             if value and isinstance(value[0], float):
                 a.type, a.floats = ATTR_FLOATS, list(value)

@@ -29,7 +29,7 @@ class GPT2Config:
                  n_layer=12, n_head=12, n_inner=None, dropout=0.1,
                  layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
                  moe_experts=8, moe_top_k=2, moe_aux_weight=0.01,
-                 moe_groups=None, remat=False):
+                 moe_groups=None, remat=False, attn_impl="fused"):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -50,6 +50,10 @@ class GPT2Config:
         # remat: recompute attention internals in backward
         # (jax.checkpoint) — memory for FLOPs on long sequences
         self.remat = remat
+        # attn_impl: "fused" (S x S scores in HBM) or "flash" (Pallas
+        # online-softmax, O(S·D) HBM) — measured crossover in
+        # LONGCTX.json
+        self.attn_impl = attn_impl
 
     @classmethod
     def small(cls, **kw):
@@ -97,7 +101,7 @@ class GPT2Model(model.Model):
                 eps=c.layer_norm_eps,
                 moe_experts=c.moe_experts if moe else None,
                 moe_top_k=c.moe_top_k, moe_groups=c.moe_groups,
-                remat=c.remat))
+                remat=c.remat, use_flash=c.attn_impl == "flash"))
         self.ln_f = layer.LayerNorm(c.layer_norm_eps)
 
     def forward(self, input_ids):

@@ -9,9 +9,19 @@ for the multi-chip sequence-parallel version).
 
 Forward: Pallas kernel, grid over (batch*heads, query blocks); each step
 streams key/value blocks through VMEM with a running (max, denom, acc)
-online softmax.  Backward: blockwise recomputation via lax.scan over key
-blocks (never materializes S×S), standard flash-attention gradient
-algebra.
+online softmax.  Backward: blockwise via jax.vjp of the lax.scan
+reference — which XLA reverses by SAVING per-step residuals, i.e. the
+backward is O(S²) memory, not O(S·D).
+
+**Measured status (LONGCTX.json, v5e, round 3): demoted from the
+training path.**  The XLA fused path beats this kernel on throughput at
+every S in {512..4096} (kernel ~5% MFU under xprof) and, because of the
+scan-reversal residuals, on training memory too; the production
+long-context lever is ``remat=True`` on the fused path (only
+fused+remat survives S=8192 on one chip).  The kernel's O(S·D) FORWARD
+remains useful for inference and as the Pallas exemplar; a competitive
+training story needs true flash backward kernels (dq/dk/dv with block
+recomputation in-kernel).
 
 Supports an optional additive key mask of shape (BH, S) (e.g. BERT's
 padding mask) and a causal flag.  D (head dim) must be <= 128 and S a
@@ -170,6 +180,15 @@ def flash_attention(q, k, v, mask=None, causal=False,
     to (B, H, S, S) but only key-mask shapes (B, 1, 1, S) are accepted by
     the kernel path.  Returns (B, H, S, D)."""
     b, h, s, d = q.shape
+    # the Mosaic kernel keeps the STRICT original-block divisibility
+    # guard (arbitrary clamped blocks would violate TPU tile alignment);
+    # unaligned/short S falls back to the blockwise reference, whose
+    # block only needs to divide S — shrink it to S when it doesn't
+    kernel_ok = s % block_q == 0 and s % block_k == 0
+    if s % block_k != 0 or block_k > s:
+        block_k = s
+    if block_q > s:
+        block_q = s
     bh = b * h
     qf = q.reshape(bh, s, d)
     kf = k.reshape(bh, s, d)
@@ -183,8 +202,7 @@ def flash_attention(q, k, v, mask=None, causal=False,
         else:
             force_reference = True
             mf = None
-    use_kernel = (not force_reference and d <= 128 and
-                  s % block_q == 0 and s % block_k == 0)
+    use_kernel = not force_reference and d <= 128 and kernel_ok
     if not use_kernel:
         if mf is None:
             # general mask: fall back to fused jnp with full mask
@@ -198,14 +216,27 @@ def flash_attention(q, k, v, mask=None, causal=False,
     return o.reshape(b, h, s, d)
 
 
-def flash_attention_op(q, k, v, mask=None, causal=False):
-    """Tensor-level autograd op (used by ops/attention.py)."""
-    from ...autograd import _op  # local import to avoid cycles
+def flash_attention_op(q, k, v, mask=None, causal=False, remat=False):
+    """Tensor-level autograd op (used by ops/attention.py and the
+    tensor_parallel flash path).
 
+    Recorded as ``TPAttention`` with the same ``scale``/``causal``
+    params as the fused path: the kernel computes the identical math
+    (scale = 1/sqrt(D) internally), so sonnx's decomposed attention
+    export covers flash-built models too.  ``remat`` wraps the op in
+    jax.checkpoint for API symmetry with the fused path (measured
+    neutral here — the flash backward's scan-reversal residuals, not
+    the forward's, dominate; see LONGCTX.json)."""
+    from ...autograd import _op, checkpoint_op  # local import, no cycles
+
+    apply = checkpoint_op if remat else _op
+    scale = 1.0 / math.sqrt(q.shape[-1])
     if mask is None:
-        return _op(lambda qv, kv, vv: flash_attention(qv, kv, vv,
-                                                      causal=causal),
-                   q, k, v, _name="FlashAttention")
-    return _op(lambda qv, kv, vv, mv: flash_attention(qv, kv, vv, mv,
-                                                      causal=causal),
-               q, k, v, mask, _name="FlashAttention")
+        return apply(
+            lambda qv, kv, vv, scale, causal: flash_attention(
+                qv, kv, vv, causal=causal),
+            q, k, v, _name="TPAttention", scale=scale, causal=causal)
+    return apply(
+        lambda qv, kv, vv, mv, scale, causal: flash_attention(
+            qv, kv, vv, mv, causal=causal),
+        q, k, v, mask, _name="TPAttention", scale=scale, causal=causal)

@@ -33,7 +33,14 @@ _GATES = {"lstm": 4, "gru": 3, "vanilla_tanh": 1, "vanilla_relu": 1}
 
 class RNNHandle:
     """Parity stand-in for CudnnRNNHandle: computes the packed weight size
-    and the per-(layer, direction) slice offsets."""
+    and the per-(layer, direction) slice offsets.
+
+    ``use_pallas`` switches the LSTM cell between the lax.scan path and
+    the Pallas fused-cell kernel.  Default False is measurement-backed
+    (round 3, real v5e, char-RNN shape B64/T100/H256/L2, 5-window
+    medians): scan 9554 vs Pallas 9506 samples/s — a statistical tie,
+    so the simpler path stays default (BENCH_BASELINE.json
+    workload_notes)."""
 
     def __init__(self, input_size, hidden_size, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, use_pallas=False):

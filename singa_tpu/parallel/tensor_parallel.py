@@ -169,13 +169,14 @@ class ParallelMHA(Layer):
 
     def __init__(self, num_heads, plan: ShardingPlan | None = None,
                  dropout=0.0, seq_parallel=None, causal=False,
-                 remat=False):
+                 remat=False, use_flash=False):
         super().__init__()
         self.num_heads = int(num_heads)
         self.plan = plan
         self.dropout = float(dropout)
         self.causal = bool(causal)
         self.remat = bool(remat)
+        self.use_flash = bool(use_flash)
         if seq_parallel is None:
             seq_parallel = plan is not None and plan.axis_size(SEQ) > 1
         self.seq_parallel = bool(seq_parallel)
@@ -221,7 +222,8 @@ class ParallelMHA(Layer):
                 and sharding.plan_active():
             ctx = _ring_attention_op(q, k, v, mask, plan, self.causal)
         else:
-            ctx = _sdpa(q, k, v, mask, self.causal, remat=self.remat)
+            ctx = _sdpa(q, k, v, mask, self.causal, remat=self.remat,
+                        use_flash=self.use_flash)
         ctx = autograd.transpose(ctx, (0, 2, 1, 3))
         ctx = autograd.reshape(ctx, (b, s, e))
         if plan is not None:
@@ -238,13 +240,15 @@ class ParallelTransformerBlock(Layer):
 
     def __init__(self, num_heads, intermediate, plan=None, dropout=0.0,
                  causal=False, eps=1e-5, moe_experts=None, moe_top_k=2,
-                 moe_capacity_factor=1.25, moe_groups=None, remat=False):
+                 moe_capacity_factor=1.25, moe_groups=None, remat=False,
+                 use_flash=False):
         super().__init__()
         from ..layer import LayerNorm
 
         self.ln1 = LayerNorm(eps)
         self.attn = ParallelMHA(num_heads, plan, dropout=dropout,
-                                causal=causal, remat=remat)
+                                causal=causal, remat=remat,
+                                use_flash=use_flash)
         self.ln2 = LayerNorm(eps)
         self.mlp = None  # needs hidden size; built at initialize
         self._intermediate = int(intermediate)
@@ -288,11 +292,19 @@ class ParallelTransformerBlock(Layer):
 # attention kernels (taped)
 # ---------------------------------------------------------------------------
 
-def _sdpa(q, k, v, mask, causal, remat=False):
+def _sdpa(q, k, v, mask, causal, remat=False, use_flash=False):
     """Plain scaled-dot-product attention (B,H,S,D); heads may be sharded
     — the einsums are head-local so GSPMD keeps them collective-free.
     scale/causal ride op.params for sonnx's decomposed export; remat
-    recomputes the S x S tensors in backward (jax.checkpoint)."""
+    recomputes the S x S tensors in backward (jax.checkpoint);
+    use_flash routes to the Pallas online-softmax kernel, whose HBM
+    footprint is O(S·D) instead of O(S²) (the long-context lever —
+    see LONGCTX.json for the measured crossover)."""
+    if use_flash:
+        from ..ops.pallas.flash_attention import flash_attention_op
+
+        return flash_attention_op(q, k, v, mask, causal=causal,
+                                  remat=remat)
     scale = 1.0 / math.sqrt(q.shape[-1])
 
     def f(qv, kv, vv, *rest, scale, causal):

@@ -16,6 +16,7 @@ from __future__ import annotations
 import contextvars
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from . import autograd, layer, model, tensor
@@ -101,24 +102,171 @@ class SingaRep:
         # the default
         token = _REP_DEVICE.set(self.device)
         try:
-            for node in self.graph.node:
-                if node.op_type == "Constant" and node.output \
-                        and node.output[0] in self._consts:
-                    continue  # pre-evaluated at prepare time
-                handler = _ONNX_OPS.get(node.op_type)
-                if handler is None:
-                    raise NotImplementedError(
-                        f"ONNX op {node.op_type!r} is not supported by sonnx")
-                args = [env[i] if i else None for i in node.input]
-                outs = handler(node, args)
-                if not isinstance(outs, (list, tuple)):
-                    outs = [outs]
-                for name, out in zip(node.output, outs):
-                    if name:
-                        env[name] = out
+            _exec_nodes(self.graph.node, env,
+                        skip_consts=set(self._consts))
         finally:
             _REP_DEVICE.reset(token)
         return [env[n] for n in self.output_names]
+
+
+def _exec_nodes(nodes, env, skip_consts=()):
+    """Walk nodes in graph order, updating ``env`` (name -> Tensor).
+    Shared by SingaRep.run and the If/Loop subgraph handlers — ONNX
+    subgraphs capture outer-scope names, so control-flow ops execute
+    their bodies against a CHILD copy of the enclosing env (ONNX spec:
+    outer names visible, inner bindings don't leak)."""
+    for node in nodes:
+        if node.op_type == "Constant" and node.output \
+                and node.output[0] in skip_consts:
+            continue  # pre-evaluated at prepare time
+        if node.op_type in ("If", "Loop"):
+            outs = _exec_control_flow(node, env)
+        else:
+            handler = _ONNX_OPS.get(node.op_type)
+            if handler is None:
+                raise NotImplementedError(
+                    f"ONNX op {node.op_type!r} is not supported by sonnx")
+            args = [env[i] if i else None for i in node.input]
+            outs = handler(node, args)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for name, out in zip(node.output, outs):
+            if name:
+                env[name] = out
+
+
+def _run_subgraph(graph, env, bound_inputs):
+    """Execute a subgraph against a child env; returns its outputs in
+    declaration order.  ``bound_inputs``: Tensors for the subgraph's
+    formal inputs (ONNX: subgraph inputs shadow outer names)."""
+    child = dict(env)
+    # ONNX scoping: names DEFINED by the subgraph (its initializers and
+    # formal inputs) shadow identically-named outer values — load
+    # initializers unconditionally, then bind formals over them
+    for init in graph.initializer:
+        child[init.name] = tensor.from_numpy(init.to_numpy(),
+                                             _rep_device())
+    for vi, t in zip(graph.input, bound_inputs):
+        child[vi.name] = t
+    _exec_nodes(graph.node, child)
+    return [child[v.name] for v in graph.output]
+
+
+def _concrete_bool(t):
+    """Python bool of a 0-d condition tensor, or None while tracing
+    (jax tracers have no concrete value)."""
+    try:
+        return bool(np.asarray(t.data).reshape(()))
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        return None
+
+
+def _exec_control_flow(node, env):
+    """ONNX If / Loop (SURVEY.md §3.4 — beyond upstream parity, whose
+    sonnx is a flat node dispatch with no subgraph support).
+
+    If: a concrete condition Python-branches (eager, or compile-time
+    constant under jit); a TRACED condition lowers to ``lax.cond`` with
+    both subgraphs traced as pure functions over the captured
+    outer-scope tensors (XLA's native conditional — both branches must
+    produce matching shapes/dtypes, the ONNX requirement).
+
+    Loop: the common ONNX form — concrete max trip count M, loop-carried
+    values, optional early-exit condition, scan outputs stacked along a
+    new leading axis.  Runs as a Python loop over the taped ops: exact
+    and differentiable in eager; under jit a concrete M unrolls into
+    the trace (a traced M or traced exit condition raises — use
+    ``lax.scan`` via the native API for that regime)."""
+    attrs = node.attrs()
+    if node.op_type == "If":
+        cond = env[node.input[0]]
+        then_g, else_g = attrs["then_branch"], attrs["else_branch"]
+        cb = _concrete_bool(cond)
+        if cb is not None:
+            return _run_subgraph(then_g if cb else else_g, env, [])
+        # traced condition -> lax.cond over pure branch functions.
+        # Captured outer names = every input name referenced anywhere in
+        # either subgraph (RECURSING into nested If/Loop bodies) that
+        # exists in the enclosing env.
+        def referenced(g, acc):
+            for n in g.node:
+                acc.update(i for i in n.input if i)
+                for a in n.attribute:
+                    if a.g is not None:
+                        referenced(a.g, acc)
+            return acc
+
+        refs = set()
+        referenced(then_g, refs)
+        referenced(else_g, refs)
+        cap_names = sorted(r for r in refs if r in env)
+        cap = [env[n] for n in cap_names]
+
+        def fn(cv, *arrays):
+            def branch(g):
+                def run(arrs):
+                    benv = {n: tensor._wrap(a, _rep_device())
+                            for n, a in zip(cap_names, arrs)}
+                    outs = _run_subgraph(g, benv, [])
+                    return tuple(o.data for o in outs)
+                return run
+            return jax.lax.cond(jnp.reshape(cv, ()).astype(bool),
+                                branch(then_g), branch(else_g),
+                                tuple(arrays))
+
+        out = autograd._op(fn, cond, *cap, _name="If")
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    # Loop
+    body = attrs["body"]
+    m_t = env.get(node.input[0]) if node.input[0] else None
+    cond_t = env.get(node.input[1]) if len(node.input) > 1 \
+        and node.input[1] else None
+    carried = [env[i] for i in node.input[2:]]
+    n_carried = len(carried)
+    n_scan = len(body.output) - 1 - n_carried
+
+    if m_t is None:
+        raise NotImplementedError(
+            "sonnx Loop requires a max trip count (while-style Loops "
+            "with only a dynamic condition are not supported)")
+    try:
+        m = int(np.asarray(m_t.data).reshape(()))
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        raise NotImplementedError(
+            "sonnx Loop requires a CONCRETE max trip count (traced trip "
+            "counts need the native lax.scan API)") from None
+
+    dev = _rep_device()
+    cond_val = True if cond_t is None else _concrete_bool(cond_t)
+    if cond_val is None:
+        raise NotImplementedError(
+            "sonnx Loop requires a concrete initial condition")
+    scans = [[] for _ in range(n_scan)]
+    cond_cur = tensor.from_numpy(np.asarray(cond_val), dev) \
+        if cond_t is None else cond_t
+    for it in range(m):
+        cb = _concrete_bool(cond_cur)
+        if cb is False:
+            break
+        if cb is None:
+            raise NotImplementedError(
+                "sonnx Loop: the exit condition became data-dependent "
+                "under tracing; only concrete conditions are supported")
+        it_t = tensor.from_numpy(np.asarray(it, np.int64), dev)
+        outs = _run_subgraph(body, env, [it_t, cond_cur] + carried)
+        cond_cur = outs[0]
+        carried = list(outs[1:1 + n_carried])
+        for j in range(n_scan):
+            scans[j].append(autograd.unsqueeze(outs[1 + n_carried + j], 0))
+    if any(not s for s in scans):
+        raise NotImplementedError(
+            "sonnx Loop: zero-iteration scan outputs (empty tensors) "
+            "are not supported")
+    stacked = [autograd.cat(s, axis=0) for s in scans]
+    return carried + stacked
 
 
 class SingaBackend:
@@ -468,6 +616,12 @@ def _h_pad(node, args):
 def _h_global_avg_pool(node, args):
     return autograd.reduce_mean(args[0], axes=(2, 3), keepdims=True)
 
+
+# subgraph-carrying control-flow ops, dispatched in _exec_nodes (they
+# need the enclosing env for outer-scope capture, so they live outside
+# the flat handler table); the conformance sweep counts them as
+# supported ops
+_CONTROL_FLOW_OPS = ("If", "Loop")
 
 _ONNX_OPS = {
     "Add": _handle_binary(jnp.add),

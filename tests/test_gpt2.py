@@ -116,6 +116,38 @@ def test_parallel_gpt_moe_matches_serial():
                                    float(tensor.to_numpy(ls)), rtol=3e-4)
 
 
+def test_flash_attn_impl_matches_fused():
+    """attn_impl="flash" (Pallas online softmax; interpret mode on CPU)
+    must reproduce the fused S x S path's logits and one training
+    step."""
+    from singa_tpu import device as device_module
+
+    ids, labels = _batch(_cfg())
+    losses = {}
+    for impl in ("fused", "flash"):
+        device_module.get_default_device().SetRandSeed(0)
+        cfg = _cfg(attn_impl=impl)
+        m = GPT2LMHead(cfg)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.compile([tensor.from_numpy(ids)], is_train=True, use_graph=True)
+        _, loss = m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        _, loss = m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        losses[impl] = float(tensor.to_numpy(loss))
+    np.testing.assert_allclose(losses["flash"], losses["fused"],
+                               rtol=2e-4)
+    # the flash op records the same TPAttention name+params as fused,
+    # so ONNX export covers flash-built models too
+    from singa_tpu import sonnx
+
+    m.eval()
+    x = tensor.from_numpy(ids)
+    proto = sonnx.to_onnx(m, [x])
+    rep = sonnx.prepare(proto)
+    native = tensor.to_numpy(m.forward(x))
+    np.testing.assert_allclose(tensor.to_numpy(rep.run([x])[0]), native,
+                               rtol=2e-3, atol=2e-4)
+
+
 def test_generate():
     cfg = _cfg()
     m = GPT2LMHead(cfg)

@@ -185,10 +185,68 @@ CASES = {
          _init(np.zeros(3, np.float32), "b")),
         [(A - A.mean(-1, keepdims=True))
          / np.sqrt(A.var(-1, keepdims=True) + 1e-5)]),
+    "If": lambda: (
+        # cond=True selects the then-branch (x+1); both branches CAPTURE
+        # the outer graph input "x" (ONNX outer-scope visibility)
+        {"cond": np.asarray(True), "x": A},
+        {"then_branch": _branch_graph("Add", "x", 1.0, "tb"),
+         "else_branch": _branch_graph("Sub", "x", 1.0, "eb")},
+        (), [A + 1.0]),
+    "Loop": lambda: (
+        # 3 iterations of v = v + v0, where "v0" inside the body is the
+        # OUTER graph input (outer-scope capture) and also the initial
+        # carried value; v is emitted per-iteration as a scan output
+        {"M": np.asarray(3, np.int64), "keepgoing": np.asarray(True),
+         "v0": A},
+        {"body": _loop_body_graph()},
+        (), [4.0 * A, np.stack([2.0 * A, 3.0 * A, 4.0 * A])]),
 }
 
+
+def _branch_graph(op, captured, const, tag):
+    """Subgraph: out = op(captured_outer_name, const) — no formal
+    inputs, exercising outer-scope capture."""
+    return GraphProto(
+        name=tag,
+        node=[NodeProto(op_type=op, name=f"{tag}_n",
+                        input=[captured, f"{tag}_c"],
+                        output=[f"{tag}_out"])],
+        initializer=[_init(np.full((2, 3), const, np.float32),
+                           f"{tag}_c")],
+        output=[ValueInfoProto(name=f"{tag}_out",
+                               elem_type=onnx_pb.FLOAT, shape=[2, 3])])
+
+
+def _loop_body_graph():
+    """Loop body (iter, cond_in, v_in) -> (cond_out, v_out, scan_out):
+    v_out = v_in + v0 ("v0" captured from the outer scope); scan_out =
+    v_out; cond passes through."""
+    return GraphProto(
+        name="body",
+        node=[
+            NodeProto(op_type="Add", name="b_add", input=["v_in", "v0"],
+                      output=["v_out"]),
+            NodeProto(op_type="Identity", name="b_id_c",
+                      input=["cond_in"], output=["cond_out"]),
+            NodeProto(op_type="Identity", name="b_id_s",
+                      input=["v_out"], output=["scan_out"]),
+        ],
+        input=[ValueInfoProto(name="iter", elem_type=onnx_pb.INT64,
+                              shape=[]),
+               ValueInfoProto(name="cond_in", elem_type=onnx_pb.BOOL,
+                              shape=[]),
+               ValueInfoProto(name="v_in", elem_type=onnx_pb.FLOAT,
+                              shape=[2, 3])],
+        output=[ValueInfoProto(name="cond_out", elem_type=onnx_pb.BOOL,
+                               shape=[]),
+                ValueInfoProto(name="v_out", elem_type=onnx_pb.FLOAT,
+                               shape=[2, 3]),
+                ValueInfoProto(name="scan_out", elem_type=onnx_pb.FLOAT,
+                               shape=[2, 3])])
+
 def test_sweep_covers_every_supported_op():
-    missing = set(sonnx._ONNX_OPS) - set(CASES)
+    supported = set(sonnx._ONNX_OPS) | set(sonnx._CONTROL_FLOW_OPS)
+    missing = supported - set(CASES)
     assert not missing, f"ops without a conformance case: {sorted(missing)}"
 
 
@@ -233,7 +291,7 @@ def test_gelu_tanh_attribute_and_export_roundtrip():
 @pytest.mark.parametrize("op", sorted(CASES))
 def test_onnx_node_conformance(op):
     inputs, attrs, inits, golden = CASES[op]()
-    n_out = 2 if op == "Split" else 1
+    n_out = {"Split": 2, "Loop": 2}.get(op, 1)
     outs = _run_node(op, inputs, attrs, n_out=n_out, initializers=inits)
 
     if golden is None and op == "Split":
