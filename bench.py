@@ -280,6 +280,20 @@ def main():
         out[f"{name}_train_throughput"] = round(r["tp"], 2)
         out[f"{name}_tp_spread"] = [round(r["tp_min"], 2),
                                     round(r["tp_max"], 2)]
+    # long-context headline from the (separately run) LONGCTX sweep:
+    # best tokens/s at the longest surviving S (bench_longctx.py
+    # re-measures; this just records the standing result)
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "LONGCTX.json")) as f:
+            lc = json.load(f)
+        alive = [c for c in lc["cells"] if not c.get("failed")]
+        top = max(alive, key=lambda c: (c["seqlen"], c["tokens_per_sec"]))
+        out["longctx_max_seqlen_1chip"] = top["seqlen"]
+        out["longctx_tokens_per_sec"] = top["tokens_per_sec"]
+        out["longctx_impl"] = top["impl"]
+    except (OSError, KeyError, ValueError):
+        pass
     print(json.dumps(out))
 
 

@@ -70,7 +70,8 @@ if __name__ == "__main__":
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--attn", choices=["fused", "flash"], default="flash")
     ap.add_argument("--remat", action="store_true")
-    ap.add_argument("--bf16", action="store_true", default=True)
+    ap.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                    default=True)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--steps", type=int, default=5)
     run(ap.parse_args())

@@ -40,10 +40,20 @@ from .device import get_default_device
 # module-level training flag, same contract as reference autograd.training
 training = False
 
+# export-taping flag: sonnx.to_onnx tapes one training-mode forward to
+# build the graph; ops with training-time side effects (BN running-stat
+# updates) must treat that pass as pure
+exporting = False
+
 
 def set_training(flag: bool):
     global training
     training = bool(flag)
+
+
+def set_exporting(flag: bool):
+    global exporting
+    exporting = bool(flag)
 
 
 class Operation:

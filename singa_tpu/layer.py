@@ -184,6 +184,11 @@ class ReLU(Layer):
         return autograd.relu(x)
 
 
+class ReLU6(Layer):
+    def forward(self, x):
+        return autograd.relu6(x)
+
+
 class LeakyReLU(Layer):
     def __init__(self, a=0.01):
         super().__init__()

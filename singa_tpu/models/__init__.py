@@ -1,3 +1,4 @@
 """Model zoo mirroring the reference's ``examples/*/model/`` trees
-(SURVEY.md §2.4): MLP, CNN, AlexNet, ResNet, XceptionNet, char-RNN LSTM,
-BERT, GPT-2 (incl. a tensor/sequence/expert-parallel GPT-MoE variant)."""
+(SURVEY.md §2.4): MLP, CNN, AlexNet, ResNet, VGG, MobileNetV2,
+XceptionNet, char-RNN LSTM, BERT, GPT-2 (incl. a tensor/sequence/
+expert-parallel GPT-MoE variant)."""

@@ -29,7 +29,7 @@ class GPT2Config:
                  n_layer=12, n_head=12, n_inner=None, dropout=0.1,
                  layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
                  moe_experts=8, moe_top_k=2, moe_aux_weight=0.01,
-                 moe_groups=None, remat=False, attn_impl="fused"):
+                 moe_groups=None, remat=False, attn_impl="auto"):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -51,8 +51,12 @@ class GPT2Config:
         # (jax.checkpoint) — memory for FLOPs on long sequences
         self.remat = remat
         # attn_impl: "fused" (S x S scores in HBM) or "flash" (Pallas
-        # online-softmax, O(S·D) HBM) — measured crossover in
-        # LONGCTX.json
+        # online-softmax fwd+bwd kernels, O(S·D) HBM).  "auto" picks by
+        # the measured LONGCTX.json crossover: flash wins throughput AND
+        # memory from S=2048 up (and is the only impl surviving
+        # S >= 16384 on one chip); fused wins at short S.
+        if attn_impl == "auto":
+            attn_impl = "flash" if n_positions >= 2048 else "fused"
         self.attn_impl = attn_impl
 
     @classmethod

@@ -99,6 +99,14 @@ def batchnorm2d(x, scale, bias, running_mean, running_var,
             return _bn_train(xv, sv, bv_, eps)
 
         y, bm, bv = _op(f, x, scale, bias, _name="BatchNorm2d")
+        # running-stat refs ride the op instance so sonnx can export a
+        # proper 5-input BatchNormalization node (sonnx._dec_batchnorm)
+        y.creator.params = {"eps": eps, "momentum": momentum,
+                            "rm": running_mean, "rv": running_var}
+        if autograd.exporting:
+            # export taping must be pure: skip the stat update so the
+            # exported initializers hold the pre-forward running stats
+            return y
         running_mean.data = (
             momentum * running_mean.data
             + (1.0 - momentum) * jax.lax.stop_gradient(bm.data))
@@ -115,4 +123,7 @@ def batchnorm2d(x, scale, bias, running_mean, running_var,
         b = _channel_f32(bv_ - sv * jax.lax.rsqrt(rv + eps) * rm)
         return xv * a + b.astype(xv.dtype)
 
+    # (no export metadata here: tape edges only exist when
+    # autograd.training is True, which always takes the branch above —
+    # an eval-mode BN op can never appear on an export tape)
     return _op(f, x, scale, bias, _name="BatchNorm2dEval")

@@ -1,32 +1,50 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels, forward AND backward.
 
 The reference has no native attention (BERT arrives via ONNX GEMM+softmax
 graphs that materialize the S×S score matrix — SURVEY.md §5.7).  This
 kernel is the TPU-native upgrade: online-softmax tiling keeps the score
 matrix in VMEM block by block, so HBM traffic stays O(S·D) instead of
-O(S²) — the enabler for long-context work (see parallel/ring_attention.py
-for the multi-chip sequence-parallel version).
+O(S²) — the enabler for long-context training (see
+parallel/ring_attention.py for the multi-chip sequence-parallel version).
 
-Forward: Pallas kernel, grid over (batch*heads, query blocks); each step
-streams key/value blocks through VMEM with a running (max, denom, acc)
-online softmax.  Backward: blockwise via jax.vjp of the lax.scan
-reference — which XLA reverses by SAVING per-step residuals, i.e. the
-backward is O(S²) memory, not O(S·D).
+Design (canonical TPU flash schedule):
 
-**Measured status (LONGCTX.json, v5e, round 3): demoted from the
-training path.**  The XLA fused path beats this kernel on throughput at
-every S in {512..4096} (kernel ~5% MFU under xprof) and, because of the
-scan-reversal residuals, on training memory too; the production
-long-context lever is ``remat=True`` on the fused path (only
-fused+remat survives S=8192 on one chip).  The kernel's O(S·D) FORWARD
-remains useful for inference and as the Pallas exemplar; a competitive
-training story needs true flash backward kernels (dq/dk/dv with block
-recomputation in-kernel).
+* **Forward** — grid ``(B·H, S/block_q, S/block_k)``; the innermost
+  key-block dimension iterates sequentially on the core, carrying the
+  online-softmax state ``(acc, m, l)`` in VMEM scratch that is zeroed at
+  ``j == 0`` and flushed to the output block at ``j == n_k - 1``.  The
+  kernel emits the per-row logsumexp ``L = m + log(l)`` as a second
+  output — the only residual (beyond q/k/v/o) the backward needs.
+* **Backward** — two kernels that RECOMPUTE attention probabilities
+  blockwise from the saved logsumexp (``p = exp(s - L)``), never
+  materializing S×S in HBM:
+  - ``dq``: grid ``(B·H, n_q, n_k)``, accumulates ``Σ_j ds·K_j`` in a
+    VMEM scratch across the sequential k dimension;
+  - ``dk/dv``: grid ``(B·H, n_k, n_q)`` (q innermost), accumulates
+    ``Σ_i dsᵀ·Q_i`` and ``Σ_i pᵀ·dO_i``.
+  The softmax-Jacobian contraction uses the standard
+  ``ds = p ∘ (dp − δ)`` identity with ``δ = rowsum(dO ∘ O)`` computed
+  once outside the kernels.
+* **Causal** — fully-above-diagonal blocks are skipped with ``pl.when``
+  (≈2× compute saved at long S); diagonal blocks mask with iota.
 
-Supports an optional additive key mask of shape (BH, S) (e.g. BERT's
-padding mask) and a causal flag.  D (head dim) must be <= 128 and S a
-multiple of the block size; ops/attention.py falls back to the fused-jnp
-path otherwise.
+This replaces the round-2 design whose backward differentiated a
+``lax.scan`` reference — XLA's scan reversal saved per-step residuals,
+i.e. O(S²) backward memory, which is why LONGCTX.json (round 3, first
+half) recorded the kernel losing to the fused path everywhere.  The
+rewritten kernels' training memory is O(S·D) end to end, and the
+measured fwd+bwd time now BEATS the fused path on the real chip
+(v5e, GPT-2-small shapes, causal, bf16, 8192 tokens/call):
+1.3× at S=4096, 2.4× at S=8192, ~2.9× at S=16384 with the default
+1024/1024 blocks (block sweep: 128→1024 monotonically faster; 2048²
+tiles exceed VMEM).  LONGCTX.json carries the end-to-end training
+crossover table.
+
+Supports an optional additive key mask of shape (B·H, S) (e.g. BERT's
+padding mask) and a causal flag.  D (head dim) must be ≤ 128 and S a
+multiple of the block sizes; ops/attention.py falls back to the
+blockwise-scan reference otherwise (whose VJP is the old O(S²) path —
+fine at the short S where it is used).
 """
 
 from __future__ import annotations
@@ -39,82 +57,292 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+_LANES = 128  # row-stat scratch lane width (min f32 tile is (8, 128))
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k, scale,
-               causal, block_q):
-    """One (batch*head, q-block) grid step.
+def _interpret():
+    return jax.default_backend() == "cpu"  # no Mosaic on CPU (tests)
 
-    q_ref: (block_q, D); k_ref/v_ref: (S, D); mask_ref: (1, S) additive;
-    o_ref: (block_q, D).
-    """
-    q = q_ref[:] * scale
-    s_total = k_ref.shape[0]
-    num_kb = s_total // block_k
-    d = q_ref.shape[1]
 
-    qi = pl.program_id(1)
+def _causal_skip(qi, kj, block_q, block_k):
+    """True iff key block kj lies entirely above the causal diagonal of
+    query block qi (first key position > last query position)."""
+    return kj * block_k > qi * block_q + (block_q - 1)
 
-    def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :]
-        v = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        s = s + mask_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+
+def _apply_causal(s, qi, kj, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q,
+                block_k):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        run = jnp.logical_not(_causal_skip(qi, kj, block_q, block_k))
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]                                   # (block_q, D)
+        k = k_ref[0]                                   # (block_k, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = s + mask_ref[0, 0][None, :].astype(jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_causal(s, qi, kj, block_q, block_k)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jnp.dot(
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
-    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(kj == n_k - 1)
+    def _flush():
+        l = l_ref[:, 0]
+        # rows with zero mass (fully masked) emit 0, not NaN
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l_safe)
 
 
 def _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k):
-    """q,k,v: (BH, S, D); mask: (BH, S) additive (reshaped to (BH,1,S)
-    for the kernel's tiling constraints)."""
-    mask = mask[:, None, :]
+    """q,k,v: (BH, S, D); mask: (BH, S) additive.  Returns (o, lse) with
+    lse: (BH, 1, S) float32."""
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    grid = (bh, s // block_q)
-    kernel = functools.partial(_fa_kernel, block_k=block_k, scale=scale,
-                               causal=causal, block_q=block_q)
-    interpret = jax.default_backend() == "cpu"  # no Mosaic on CPU (tests)
+    grid = (bh, s // block_q, s // block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, 1, s), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, mask[:, None, :])
+
+
+# --------------------------------------------------------------- backward
+
+
+def _recompute_p(q, k, mask_row, lse_row, qi, kj, scale, causal,
+                 block_q, block_k):
+    """Recompute the (block_q, block_k) probability tile from saved
+    logsumexp: p = exp(s·scale + mask − lse)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    s = s + mask_row[None, :].astype(jnp.float32)
+    if causal:
+        s = _apply_causal(s, qi, kj, block_q, block_k)
+    return jnp.exp(s - lse_row[:, None])
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
+               dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = jnp.logical_not(_causal_skip(qi, kj, block_q, block_k))
+
+    @pl.when(run)
+    def _step():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0]
+        p = _recompute_p(q, k, mask_ref[0, 0], lse_ref[0, 0], qi, kj,
+                         scale, causal, block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_acc[:] = dq_acc[:] + jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_k - 1)
+    def _flush():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
+                lse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                causal, block_q, block_k):
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = jnp.logical_not(_causal_skip(qi, kj, block_q, block_k))
+
+    @pl.when(run)
+    def _step():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0]
+        p = _recompute_p(q, k, mask_ref[0, 0], lse_ref[0, 0], qi, kj,
+                         scale, causal, block_q, block_k)
+        # dv += pᵀ·dO  — contract the query dim without materializing pᵀ
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal, block_q,
+                      block_k):
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    # δ = rowsum(dO ∘ O): one O(S·D) pass, shared by both kernels
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]                     # (BH, 1, S)
+    mask3 = mask[:, None, :]
+
+    dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
+                                  block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        interpret=interpret,
-    )(q, k, v, mask)
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, mask3, do, delta, lse)
+
+    dkv_kernel = functools.partial(_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, mask3, do, delta, lse)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, causal, block_q, block_k):
+    o, _ = _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, mask, causal, block_q, block_k):
+    o, lse = _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+    return o, (q, k, v, mask, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, res, do):
+    q, k, v, mask, o, lse = res
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal,
+                                   block_q, block_k)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------- non-kernel reference
 
 
 def _blockwise_reference(q, k, v, mask, causal, block_k):
     """Numerically identical online-softmax attention built from a
-    lax.scan over key blocks — used for the backward pass (its VJP never
-    materializes S×S) and as the non-Pallas fallback."""
+    lax.scan over key blocks — the fallback for shapes the Mosaic kernel
+    rejects (unaligned S, D > 128).  NOTE its VJP reverses the scan by
+    saving per-step residuals (O(S²) backward memory) — acceptable only
+    at the short/odd S where this path is selected."""
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     qs = q * scale
@@ -148,29 +376,7 @@ def _blockwise_reference(q, k, v, mask, causal, block_k):
     return (acc / l[..., None]).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, mask, causal, block_q, block_k):
-    return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
-
-
-def _flash_fwd(q, k, v, mask, causal, block_q, block_k):
-    o = _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
-    return o, (q, k, v, mask)
-
-
-def _flash_bwd(causal, block_q, block_k, res, do):
-    q, k, v, mask = res
-    # memory-efficient gradient: differentiate the blockwise-scan
-    # reference (same math as the kernel) — XLA reverses the scan, so
-    # peak memory stays O(S·D) per block
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _blockwise_reference(q_, k_, v_, mask, causal,
-                                                block_k), q, k, v)
-    dq, dk, dv = vjp(do)
-    return dq, dk, dv, None
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
+# ----------------------------------------------------------- public API
 
 
 def flash_attention(q, k, v, mask=None, causal=False,
@@ -180,15 +386,21 @@ def flash_attention(q, k, v, mask=None, causal=False,
     to (B, H, S, S) but only key-mask shapes (B, 1, 1, S) are accepted by
     the kernel path.  Returns (B, H, S, D)."""
     b, h, s, d = q.shape
-    # the Mosaic kernel keeps the STRICT original-block divisibility
-    # guard (arbitrary clamped blocks would violate TPU tile alignment);
-    # unaligned/short S falls back to the blockwise reference, whose
-    # block only needs to divide S — shrink it to S when it doesn't
-    kernel_ok = s % block_q == 0 and s % block_k == 0
-    if s % block_k != 0 or block_k > s:
-        block_k = s
-    if block_q > s:
-        block_q = s
+
+    def fit(block):
+        """Largest 128-multiple <= block that divides S (0 if none) —
+        an S like 2560 must shrink to 512, not fall off the kernel
+        onto the O(S²)-backward scan fallback; a non-128-aligned S
+        (Mosaic tile constraint) yields 0 → fallback."""
+        block = min(block, s) // 128 * 128
+        while block >= 128 and s % block != 0:
+            block -= 128
+        return block
+
+    block_q, block_k = fit(block_q), fit(block_k)
+    kernel_ok = block_q > 0 and block_k > 0
+    if not kernel_ok:
+        block_k = s  # the blockwise fallback only needs block_k | S
     bh = b * h
     qf = q.reshape(bh, s, d)
     kf = k.reshape(bh, s, d)
@@ -205,9 +417,13 @@ def flash_attention(q, k, v, mask=None, causal=False,
     use_kernel = not force_reference and d <= 128 and kernel_ok
     if not use_kernel:
         if mf is None:
-            # general mask: fall back to fused jnp with full mask
+            # general mask: fall back to fused jnp with the full mask
+            # (causal still applies — same semantics as the kernel path)
             scale = 1.0 / math.sqrt(d)
             sc = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale + mask
+            if causal:
+                cm = jnp.tril(jnp.ones((s, s), bool))
+                sc = jnp.where(cm[None, None], sc, NEG_INF)
             p = jax.nn.softmax(sc, axis=-1)
             return jnp.einsum("bhst,bhtd->bhsd", p, v)
         o = _blockwise_reference(qf, kf, vf, mf, causal, block_k)
@@ -223,20 +439,22 @@ def flash_attention_op(q, k, v, mask=None, causal=False, remat=False):
     Recorded as ``TPAttention`` with the same ``scale``/``causal``
     params as the fused path: the kernel computes the identical math
     (scale = 1/sqrt(D) internally), so sonnx's decomposed attention
-    export covers flash-built models too.  ``remat`` wraps the op in
-    jax.checkpoint for API symmetry with the fused path (measured
-    neutral here — the flash backward's scan-reversal residuals, not
-    the forward's, dominate; see LONGCTX.json)."""
-    from ...autograd import _op, checkpoint_op  # local import, no cycles
+    export covers flash-built models too.  ``remat`` is accepted for
+    API symmetry with the fused path but is a no-op here: the kernel
+    backward already recomputes probabilities blockwise from the saved
+    logsumexp, so there is no S×S residual to rematerialize away
+    (wrapping in jax.checkpoint would only re-run the forward kernel
+    for zero memory gain)."""
+    del remat
+    from ...autograd import _op  # local import, no cycles
 
-    apply = checkpoint_op if remat else _op
     scale = 1.0 / math.sqrt(q.shape[-1])
     if mask is None:
-        return apply(
+        return _op(
             lambda qv, kv, vv, scale, causal: flash_attention(
                 qv, kv, vv, causal=causal),
             q, k, v, _name="TPAttention", scale=scale, causal=causal)
-    return apply(
+    return _op(
         lambda qv, kv, vv, mv, scale, causal: flash_attention(
             qv, kv, vv, mv, causal=causal),
         q, k, v, mask, _name="TPAttention", scale=scale, causal=causal)

@@ -27,6 +27,7 @@ single-chip and multi-chip.
 
 from __future__ import annotations
 
+import logging
 import math
 
 import jax.numpy as jnp
@@ -222,8 +223,23 @@ class ParallelMHA(Layer):
                 and sharding.plan_active():
             ctx = _ring_attention_op(q, k, v, mask, plan, self.causal)
         else:
+            # pallas_call has no GSPMD partitioning rule: under an active
+            # sharded plan the fused einsum path (auto-partitioned
+            # head-locally) is the correct kernel; flash is a
+            # single-device lever (BertModel raises at construction for
+            # the same combination — here mid-forward we warn and fall
+            # back so an auto-selected attn_impl keeps training)
+            use_flash = self.use_flash and not (
+                plan is not None and sharding.plan_active())
+            if self.use_flash and not use_flash \
+                    and not getattr(self, "_warned_flash", False):
+                self._warned_flash = True
+                logging.getLogger("singa_tpu").warning(
+                    "ParallelMHA: use_flash ignored under an active "
+                    "ShardingPlan (no GSPMD rule for pallas_call); "
+                    "using the fused head-sharded path")
             ctx = _sdpa(q, k, v, mask, self.causal, remat=self.remat,
-                        use_flash=self.use_flash)
+                        use_flash=use_flash)
         ctx = autograd.transpose(ctx, (0, 2, 1, 3))
         ctx = autograd.reshape(ctx, (b, s, e))
         if plan is not None:

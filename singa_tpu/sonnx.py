@@ -501,9 +501,12 @@ def _h_reduce(fn):
         if axes is None and len(args) > 1 and args[1] is not None:
             axes = _static_ints(args[1])
         keepdims = bool(a.get("keepdims", 1))
-        return _op(lambda x, ax=tuple(axes) if axes else None,
-                   kd=keepdims: fn(x, axis=ax, keepdims=kd),
-                   args[0], _name=node.op_type)
+        # ax/keepdims ride op.params so a re-export of the imported
+        # graph (sonnx._dec_reduce_mean) reproduces the node faithfully
+        return _op(lambda x, ax, keepdims: fn(x, axis=ax,
+                                              keepdims=keepdims),
+                   args[0], _name=node.op_type,
+                   ax=tuple(axes) if axes else None, keepdims=keepdims)
     return h
 
 
@@ -706,7 +709,7 @@ _EXPORT_OPS = {
     "Matmul": "MatMul", "AddBias": "Add", "SoftMax": "Softmax",
     "Exp": "Exp", "Log": "Log", "Sqrt": "Sqrt", "Abs": "Abs",
     "Negative": "Neg", "Conv2d": "Conv", "MaxPool2d": "MaxPool",
-    "AvgPool2d": "AveragePool", "BatchNorm2d": "BatchNormalization",
+    "AvgPool2d": "AveragePool",
     "Flatten": "Flatten", "Reshape": "Reshape", "Transpose": "Transpose",
     "Concat": "Concat", "Identity": "Identity", "Erf": "Erf",
     "LayerNorm": "LayerNormalization", "_Dropout": "Dropout",
@@ -782,6 +785,50 @@ def _dec_first_token(op, in_names, emit, out_name):
               [out_name], axis=1)
 
 
+def _dec_batchnorm(op, in_names, emit, out_name):
+    """BN -> the standard 5-input BatchNormalization node.  The running
+    mean/var are not tape inputs (they are layer state, updated outside
+    the tape) — they ride ``op.params`` (ops/batchnorm.py) and export as
+    constants.  to_onnx tapes with autograd.exporting set, so the values
+    here are the pre-forward running stats (the taping pass is pure)."""
+    p = getattr(op, "params", {}) or {}
+    from . import tensor as tensor_mod
+
+    u = emit.uniq("bn")
+    names = []
+    for key in ("rm", "rv"):
+        t = p[key]
+        arr = tensor_mod.to_numpy(t).astype(np.float32)
+        names.append(emit.const(t.name or f"{u}_{key}", arr))
+    emit.node("BatchNormalization",
+              [in_names[0], in_names[1], in_names[2], names[0], names[1]],
+              [out_name], epsilon=float(p.get("eps", 1e-5)),
+              momentum=float(p.get("momentum", 0.9)))
+
+
+def _dec_reduce_mean(op, in_names, emit, out_name):
+    """reduce_mean(x, axes) -> ReduceMean with axes as an input
+    (opset >= 18 moved axes from attribute to input)."""
+    p = getattr(op, "params", {}) or {}
+    ax = p.get("ax")
+    ins = [in_names[0]]
+    if ax is not None:
+        axes = np.asarray(list(ax), np.int64)
+        ins.append(emit.const(
+            f"const_axes_{'_'.join(map(str, axes.tolist()))}", axes))
+    emit.node("ReduceMean", ins, [out_name],
+              keepdims=1 if p.get("keepdims") else 0)
+
+
+def _dec_relu6(op, in_names, emit, out_name):
+    """relu6(x) -> Clip(x, 0, 6) (ONNX has no Relu6 node; opset >= 11
+    carries min/max as inputs)."""
+    emit.node("Clip",
+              [in_names[0],
+               emit.const("const_zero_f32", np.float32(0.0)),
+               emit.const("const_six_f32", np.float32(6.0))], [out_name])
+
+
 def _dec_mul_scalar(op, in_names, emit, out_name):
     s = float((getattr(op, "params", {}) or {}).get("s", 1.0))
     emit.node("Mul", [in_names[0], emit.const(f"const_scalar_{s!r}",
@@ -795,6 +842,9 @@ _EXPORT_DECOMPOSE = {
     "AttnMask": _dec_attn_mask,
     "FirstToken": _dec_first_token,
     "MulScalar": _dec_mul_scalar,
+    "ReLU6": _dec_relu6,
+    "ReduceMean": _dec_reduce_mean,
+    "BatchNorm2d": _dec_batchnorm,
 }
 
 
@@ -803,10 +853,12 @@ def to_onnx(m, inputs, model_name="singa_model"):
     forward pass over ``inputs`` (list of Tensors)."""
     prev = autograd.training
     autograd.set_training(True)
+    autograd.set_exporting(True)  # taping must be pure (no BN stat writes)
     try:
         y = m.forward(*inputs)
     finally:
         autograd.set_training(prev)
+        autograd.set_exporting(False)
     outputs = list(y) if isinstance(y, (list, tuple)) else [y]
 
     # walk the tape from outputs back to inputs/params

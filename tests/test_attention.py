@@ -72,6 +72,87 @@ def test_flash_gradients_match_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
 
 
+def test_flash_multiblock_grads_mask_and_causal():
+    """Exercise the REAL kernel grids (init/flush across the sequential
+    block dim, causal block skipping, unequal block_q != block_k) — with
+    the 1024-default blocks a short-S test clamps to a single block and
+    never hits the accumulator paths."""
+    q, k, v = _qkv(s=512)
+    mask = np.zeros((2, 1, 1, 512), np.float32)
+    mask[:, :, :, 480:] = -1e9
+    mask = jnp.asarray(mask)
+    for causal in (False, True):
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, mask, causal=causal,
+                                block_q=128, block_k=256)
+            return jnp.sum(o ** 2)
+
+        def loss_ref(q, k, v):
+            sc_mask = mask
+            if causal:
+                cm = jnp.where(jnp.arange(512)[:, None]
+                               >= jnp.arange(512)[None, :],
+                               0.0, -1e30)[None, None]
+                sc_mask = mask + cm
+            return jnp.sum(_ref(q, k, v, sc_mask) ** 2)
+
+        o = flash_attention(q, k, v, mask, causal=causal,
+                            block_q=128, block_k=256)
+        sc_mask = mask
+        if causal:
+            cm = jnp.where(jnp.arange(512)[:, None]
+                           >= jnp.arange(512)[None, :],
+                           0.0, -1e30)[None, None]
+            sc_mask = mask + cm
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(_ref(q, k, v, sc_mask)),
+                                   atol=2e-3)
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-2, rtol=5e-2)
+
+
+def test_flash_block_fit_nonpow2_seqlen():
+    """S not divisible by the 1024-default blocks (e.g. 384) must shrink
+    the block to a 128-multiple divisor and STAY on the kernel — not
+    fall back to the O(S²)-backward scan path."""
+    from singa_tpu.ops.pallas import flash_attention as fa
+
+    q, k, v = _qkv(b=1, h=2, s=384)
+    called = []
+    orig = fa._flash
+    fa._flash = lambda *a: called.append(a[-2:]) or orig(*a)
+    try:
+        o = flash_attention(q, k, v, causal=True)
+    finally:
+        fa._flash = orig
+    assert called and called[0] == (384, 384), called  # kernel path, fit blocks
+    cm = jnp.where(jnp.arange(384)[:, None] >= jnp.arange(384)[None, :],
+                   0.0, -1e30)[None, None]
+    np.testing.assert_allclose(np.asarray(o), np.asarray(_ref(q, k, v, cm)),
+                               atol=2e-3)
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_flash_logsumexp_residual():
+    """The fwd kernel's second output (logsumexp) is what the backward
+    recomputes probabilities from — it must match scipy's logsumexp."""
+    from singa_tpu.ops.pallas.flash_attention import _flash_fwd_pallas
+
+    q, k, v = _qkv(b=1, h=2, s=512)
+    qf, kf, vf = (x.reshape(2, 512, 64) for x in (q, k, v))
+    mask = jnp.zeros((2, 512), jnp.float32)
+    _, lse = _flash_fwd_pallas(qf, kf, vf, mask, False, 128, 128)
+    sc = jnp.einsum("bsd,btd->bst", qf, kf) / math.sqrt(64)
+    lse_ref = jax.scipy.special.logsumexp(sc, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse[:, 0, :]),
+                               np.asarray(lse_ref), atol=1e-3)
+
+
 def test_sdpa_op_taped(dev):
     autograd.set_training(True)
     try:

@@ -99,3 +99,84 @@ def test_xception_param_count(dev):
     n_params = sum(int(np.prod(v.shape)) for v in m.get_params().values())
     # reference Xception: 22,855,952 params
     assert abs(n_params - 22_855_952) / 22_855_952 < 0.01, n_params
+
+
+def test_mobilenet_v2_param_count_and_step(dev):
+    from singa_tpu.models.mobilenet import mobilenet_v2
+
+    m = mobilenet_v2(num_classes=1000)
+    x, y = _data(dev, n=2, c=3, hw=64, classes=1000)
+    m.compile([x], is_train=True, use_graph=False)
+    n_params = sum(int(np.prod(v.shape)) for v in m.get_params().values())
+    # torchvision mobilenet_v2: 3,504,872 params
+    assert abs(n_params - 3_504_872) / 3_504_872 < 0.01, n_params
+    m.set_optimizer(opt.SGD(lr=0.01))
+    out, loss = m(x, y)
+    assert out.shape == (2, 1000)
+    assert np.isfinite(float(loss.data))
+
+
+def test_vgg16_param_count(dev):
+    from singa_tpu.models.vgg import vgg16
+
+    m = vgg16(num_classes=1000)
+    x, _ = _data(dev, n=1, c=3, hw=224, classes=1000)
+    m.compile([x], is_train=False, use_graph=False)
+    n_params = sum(int(np.prod(v.shape)) for v in m.get_params().values())
+    # torchvision vgg16: 138,357,544 params
+    assert abs(n_params - 138_357_544) / 138_357_544 < 0.01, n_params
+
+
+def test_vgg11_bn_trains_small_input(dev):
+    from singa_tpu.models.vgg import vgg11
+
+    m = vgg11(num_classes=10, batch_norm=True, hidden=64)
+    m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+    x, y = _data(dev, n=4, c=3, hw=32)
+    m.compile([x], is_train=True, use_graph=False)
+    out, loss = m(x, y)
+    assert out.shape == (4, 10)
+    assert np.isfinite(float(loss.data))
+
+
+def test_mobilenet_onnx_roundtrip(dev):
+    from singa_tpu import sonnx
+    from singa_tpu.models.mobilenet import mobilenet_v2
+
+    m = mobilenet_v2(num_classes=10, width_mult=0.25)
+    x, _ = _data(dev, n=1, c=3, hw=32)
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    native = tensor.to_numpy(m.forward(x))
+    rep = sonnx.prepare(sonnx.to_onnx(m, [x]), dev)
+    (out,) = rep.run([x])
+    np.testing.assert_allclose(tensor.to_numpy(out), native, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_resnet18_onnx_roundtrip_with_bn_stats(dev):
+    """BN exports as the 5-input BatchNormalization node with the
+    PRE-forward running stats (export taping is pure); the imported
+    graph must match native eval output after some training moved the
+    stats off init."""
+    from singa_tpu import sonnx
+    from singa_tpu.models.resnet import resnet18
+
+    m = resnet18(num_classes=10)
+    m.set_optimizer(opt.SGD(lr=0.01))
+    x, y = _data(dev, n=2, c=3, hw=32)
+    m.compile([x], is_train=True, use_graph=False)
+    m(x, y)  # one step so running stats are non-trivial
+    m.eval()
+    native = tensor.to_numpy(m.forward(x))
+    rm_before = {k: tensor.to_numpy(v).copy()
+                 for k, v in m.get_states().items()
+                 if k.endswith("running_mean")}
+    rep = sonnx.prepare(sonnx.to_onnx(m, [x]), dev)
+    # export must not perturb model state
+    for k, v in m.get_states().items():
+        if k in rm_before:
+            np.testing.assert_array_equal(tensor.to_numpy(v), rm_before[k])
+    (out,) = rep.run([x])
+    np.testing.assert_allclose(tensor.to_numpy(out), native, rtol=1e-3,
+                               atol=1e-3)
