@@ -140,6 +140,39 @@ def bench_bert(batch=16, seqlen=512, iters=10, repeats=3, bf16=True):
         amp.enable(False)
 
 
+def bench_gpt2(batch=8, seqlen=1024, iters=10, repeats=3, bf16=True):
+    """GPT-2 small causal-LM training step (beyond-parity transformer
+    workload; attn_impl='auto' resolves to fused at this S — the flash
+    long-context regime is swept separately by bench_longctx.py)."""
+    from singa_tpu import amp, device, opt, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    amp.enable(bf16)
+    try:
+        dev = device.create_tpu_device(0)
+        dev.SetRandSeed(0)
+        cfg = GPT2Config.small(n_positions=seqlen, dropout=0.0)
+        m = GPT2LMHead(cfg)
+        m.set_optimizer(opt.SGD(lr=1e-4, momentum=0.9))
+
+        rng = np.random.RandomState(0)
+        ids = tensor.from_numpy(
+            rng.randint(0, cfg.vocab_size,
+                        (batch, seqlen)).astype(np.int32), dev)
+        labels = tensor.from_numpy(
+            rng.randint(0, cfg.vocab_size,
+                        (batch, seqlen)).astype(np.int32), dev)
+        m.compile([ids], is_train=True, use_graph=True, sequential=False)
+        dts = _timed_windows(m, ids, labels, iters, repeats)
+        med, lo, hi = _throughput(dts, batch, iters)
+        return {"tp": med, "tp_min": lo, "tp_max": hi,
+                "flops": _step_flops(m),
+                "steps_per_sec": med / batch,
+                "tokens_per_sec": med * seqlen}
+    finally:
+        amp.enable(False)
+
+
 def bench_mlp(batch=512, data_size=784, iters=50, repeats=3):
     """Config #1: MLP (MNIST-shaped), fp32 — functional-parity workload."""
     from singa_tpu import device, opt, tensor
@@ -227,6 +260,7 @@ def main():
     for name, fn in (
         ("bert", lambda: bench_bert(batch=bert_batch, repeats=repeats,
                                     bf16=bf16)),
+        ("gpt2", lambda: bench_gpt2(repeats=repeats, bf16=bf16)),
         ("mlp", lambda: bench_mlp(repeats=repeats)),
         ("charrnn", lambda: bench_charrnn(repeats=repeats)),
         ("charrnn_pallas",

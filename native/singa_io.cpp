@@ -244,4 +244,78 @@ void prefetch_queue_free(void* hq) {
   delete static_cast<PrefetchQueue*>(hq);
 }
 
+// ---------------------------------------------------------------------------
+// Batch image augmentation (reference parity: src/io/transformer.cc does
+// crop/flip/normalize in C++ with OpenCV, unverified — SURVEY.md §2.1
+// "IO: readers/writers" image transformer row).  One fused pass per
+// image: random crop to (ph, pw) + coin-flip horizontal mirror (train)
+// or center crop (eval), uint8 HWC -> normalized float32 CHW, threaded
+// over the batch.  Deterministic per (seed, image index).
+// ---------------------------------------------------------------------------
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+int augment_batch(const uint8_t* src, int64_t n, int64_t h, int64_t w,
+                  int64_t c, int64_t ph, int64_t pw, const float* mean,
+                  const float* stddev, uint64_t seed, int train,
+                  int64_t threads, float* dst) {
+  if (ph > h || pw > w || c <= 0 || n < 0) return -1;
+  std::vector<float> scale(c), bias(c);
+  for (int64_t ch = 0; ch < c; ch++) {
+    float s = stddev ? stddev[ch] : 1.0f;
+    float m = mean ? mean[ch] : 0.0f;
+    scale[ch] = 1.0f / (255.0f * s);
+    bias[ch] = -m / s;
+  }
+  if (threads <= 0) {
+    threads = static_cast<int64_t>(std::thread::hardware_concurrency());
+    if (threads <= 0) threads = 4;
+  }
+  if (threads > n) threads = n > 0 ? n : 1;
+
+  auto worker = [&](int64_t t0) {
+    for (int64_t i = t0; i < n; i += threads) {
+      uint64_t r = splitmix64(seed ^ (0xA5A5A5A5ULL + (uint64_t)i));
+      int64_t y, x;
+      bool mirror = false;
+      if (train) {
+        y = (h == ph) ? 0 : (int64_t)(r % (uint64_t)(h - ph + 1));
+        r = splitmix64(r);
+        x = (w == pw) ? 0 : (int64_t)(r % (uint64_t)(w - pw + 1));
+        r = splitmix64(r);
+        mirror = (r & 1ULL) != 0;
+      } else {
+        y = (h - ph) / 2;
+        x = (w - pw) / 2;
+      }
+      const uint8_t* im = src + (size_t)i * h * w * c;
+      for (int64_t ch = 0; ch < c; ch++) {
+        float sc = scale[ch], bi = bias[ch];
+        float* out = dst + (((size_t)i * c + ch) * ph) * pw;
+        for (int64_t yy = 0; yy < ph; yy++) {
+          const uint8_t* row = im + ((y + yy) * w + x) * c + ch;
+          float* orow = out + yy * pw;
+          if (mirror) {
+            for (int64_t xx = 0; xx < pw; xx++)
+              orow[xx] = (float)row[(pw - 1 - xx) * c] * sc + bi;
+          } else {
+            for (int64_t xx = 0; xx < pw; xx++)
+              orow[xx] = (float)row[xx * c] * sc + bi;
+          }
+        }
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int64_t t = 1; t < threads; t++) pool.emplace_back(worker, t);
+  worker(0);
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
 }  // extern "C"

@@ -87,6 +87,61 @@ def to_chw(img):
     return np.transpose(img, (2, 0, 1))
 
 
+def augment_batch(imgs, patch, mean=None, std=None, train=True, seed=0,
+                  threads=0):
+    """Fused batch augmentation: per-image random crop to ``patch`` +
+    coin-flip horizontal mirror (train) or center crop (eval), uint8
+    NHWC -> normalized float32 NCHW.
+
+    Runs in the native C++ runtime when available (native/singa_io.cpp
+    ``augment_batch`` — one threaded pass per image, the reference's
+    C++ transformer equivalent); falls back to numpy with identical
+    EVAL-mode output (train-mode random draws differ between the two
+    implementations — both are deterministic in ``seed``).
+    """
+    import ctypes
+
+    from .io import binfile as _bf
+
+    imgs = np.ascontiguousarray(imgs, np.uint8)
+    assert imgs.ndim == 4, "imgs must be (N, H, W, C) uint8"
+    n, h, w, c = imgs.shape
+    ph, pw = (patch, patch) if isinstance(patch, int) else patch
+    assert ph <= h and pw <= w, f"patch {patch} larger than {(h, w)}"
+    mean_a = (np.zeros(c, np.float32) if mean is None
+              else np.asarray(mean, np.float32))
+    std_a = (np.ones(c, np.float32) if std is None
+             else np.asarray(std, np.float32))
+    out = np.empty((n, c, ph, pw), np.float32)
+
+    lib = _bf._load_native()
+    if lib is not None and hasattr(lib, "augment_batch"):
+        rc = lib.augment_batch(
+            imgs.ctypes.data_as(ctypes.c_void_p), n, h, w, c, ph, pw,
+            mean_a.ctypes.data_as(ctypes.c_void_p),
+            std_a.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(seed), 1 if train else 0, threads,
+            out.ctypes.data_as(ctypes.c_void_p))
+        if rc == 0:
+            return out
+    # numpy fallback
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    fimgs = imgs.astype(np.float32) / 255.0
+    for i in range(n):
+        if train:
+            y = rng.randint(0, h - ph + 1)
+            x = rng.randint(0, w - pw + 1)
+            mirror = rng.rand() < 0.5
+        else:
+            y, x = (h - ph) // 2, (w - pw) // 2
+            mirror = False
+        im = fimgs[i, y:y + ph, x:x + pw]
+        if mirror:
+            im = im[:, ::-1]
+        out[i] = np.transpose((im - mean_a) / std_a, (2, 0, 1))
+    return out
+
+
 class ImageTool:
     """Chainable augmentation pipeline (reference ImageTool API shape):
     ImageTool(img).resize(40).crop((32,32),'random').flip().get()"""

@@ -33,8 +33,13 @@ def _load_native():
         if _lib is not None or _lib_err is not None:
             return _lib
         try:
-            if not os.path.exists(_SO_PATH):
-                subprocess.run(["make", "-C", _NATIVE_DIR],
+            src = os.path.join(_NATIVE_DIR, "singa_io.cpp")
+            stale = (not os.path.exists(_SO_PATH)
+                     or (os.path.exists(src)
+                         and os.path.getmtime(src) > os.path.getmtime(
+                             _SO_PATH)))
+            if stale:
+                subprocess.run(["make", "-B", "-C", _NATIVE_DIR],
                                check=True, capture_output=True, timeout=120)
             lib = ctypes.CDLL(_SO_PATH)
             lib.binfile_writer_open.restype = ctypes.c_void_p
@@ -73,6 +78,12 @@ def _load_native():
                 ("prefetch_queue_size", ctypes.c_int64, [ctypes.c_void_p]),
                 ("prefetch_queue_close", None, [ctypes.c_void_p]),
                 ("prefetch_queue_free", None, [ctypes.c_void_p]),
+                ("augment_batch", ctypes.c_int,
+                 [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                  ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                  ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                  ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+                  ctypes.c_void_p]),
             ]:
                 fn = getattr(lib, name)
                 fn.restype = res

@@ -191,22 +191,44 @@ class Model(layer.Layer):
 
     # -- checkpointing (reference: save_states/load_states zip format,
     #    SURVEY.md §3.5/§5.4) ---------------------------------------------
-    def save_states(self, fpath, aux_states=None):
-        """Zip of one .npy per state tensor + optimizer state + aux."""
-        states = {k: tensor.to_numpy(v) for k, v in self.get_states().items()}
+    def save_states(self, fpath, aux_states=None, async_save=False):
+        """Zip of one .npy per state tensor + optimizer state + aux.
+
+        ``async_save=True`` (beyond reference parity — the TPU-native
+        upgrade orbax calls async checkpointing): the state is CAPTURED
+        at call time as fresh DEVICE-SIDE copies (``jnp.copy`` — an
+        async on-device op, so this returns without waiting), while the
+        device→host transfer and zip write run in a background thread.
+        The copies are essential, not just an optimization: graph mode
+        compiles the step with donated state buffers, so the *original*
+        arrays are deleted by the very next training step.  Returns an
+        ``AsyncSaveHandle``; call ``.wait()`` before relying on the
+        file (exceptions re-raise there)."""
+        snap = (jnp.copy if async_save else (lambda a: a))
+        captured = {k: snap(v.data) for k, v in self.get_states().items()}
         if self._optimizer is not None:
-            for k, v in self._optimizer.get_states().items():
-                states[f"__opt__{k}"] = np.asarray(v)
+            # state_tensors (not get_states): keep the transfer off this
+            # thread; snap() shields the buffers from step donation
+            for k, v in self._optimizer.state_tensors().items():
+                captured[f"__opt__{k}"] = snap(v.data)
         if aux_states:
             for k, v in aux_states.items():
-                states[f"__aux__{k}"] = np.asarray(v)
-        tmp = fpath + ".tmp"
-        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
-            for k, v in states.items():
-                buf = _io.BytesIO()
-                np.save(buf, v)
-                zf.writestr(k + ".npy", buf.getvalue())
-        os.replace(tmp, fpath)
+                captured[f"__aux__{k}"] = np.asarray(v)
+
+        def _write():
+            states = {k: np.asarray(v) for k, v in captured.items()}
+            tmp = fpath + ".tmp"
+            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+                for k, v in states.items():
+                    buf = _io.BytesIO()
+                    np.save(buf, v)
+                    zf.writestr(k + ".npy", buf.getvalue())
+            os.replace(tmp, fpath)
+
+        if not async_save:
+            _write()
+            return None
+        return AsyncSaveHandle(_write)
 
     def load_states(self, fpath):
         aux = {}
@@ -226,6 +248,35 @@ class Model(layer.Layer):
         if self._optimizer is not None and opt_states:
             self._optimizer.set_states(opt_states)
         return aux
+
+
+class AsyncSaveHandle:
+    """Background checkpoint write started by
+    ``Model.save_states(async_save=True)``."""
+
+    def __init__(self, fn):
+        import threading
+
+        self._exc = None
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # re-raised on wait()
+                self._exc = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint write still in progress")
+        if self._exc is not None:
+            raise self._exc
+
+    def done(self):
+        return not self._thread.is_alive()
 
 
 class _GraphRunner:

@@ -149,3 +149,34 @@ def test_layer_get_set_params(dev):
     lin.set_params(newp)
     y = lin(x)
     np.testing.assert_array_equal(tensor.to_numpy(y), np.zeros((2, 4), np.float32))
+
+
+@pytest.mark.parametrize("use_graph", [False, True])
+def test_async_save_states_consistent_under_training(tmp_path, dev,
+                                                     use_graph):
+    """async_save snapshots device copies at call time: steps taken
+    while the background write is in flight must not leak into the
+    checkpoint, and the file must equal a synchronous save made at the
+    same point.  The graph-mode case is the sharp one — the compiled
+    step DONATES state buffers, so capturing raw .data references
+    instead of copies crashes the background write."""
+    m = _make(dev, use_graph=use_graph)
+    x, y = _data(dev)
+    for _ in range(2):
+        m(x, y)
+    sync_path = str(tmp_path / "sync.zip")
+    async_path = str(tmp_path / "async.zip")
+    m.save_states(sync_path)
+    handle = m.save_states(async_path, async_save=True)
+    for _ in range(3):  # mutate/donate state while the write is in flight
+        m(x, y)
+    handle.wait()
+    assert handle.done()
+
+    m_sync = _make(dev, use_graph=False, seed=7)
+    m_sync.load_states(sync_path)
+    m_async = _make(dev, use_graph=False, seed=8)
+    m_async.load_states(async_path)
+    for k, v in m_async.get_params().items():
+        np.testing.assert_array_equal(
+            tensor.to_numpy(v), tensor.to_numpy(m_sync.get_params()[k]))

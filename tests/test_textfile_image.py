@@ -73,3 +73,38 @@ def test_jpg_codec_roundtrip():
 
     with pytest.raises(ValueError):
         encode_jpg(rng.randn(8, 8, 3).astype(np.float32))
+
+
+def test_augment_batch_eval_native_matches_numpy():
+    """Eval mode (center crop, no flip) is deterministic, so the native
+    C++ path and the numpy fallback must agree to float rounding."""
+    from singa_tpu.image_tool import augment_batch
+    from singa_tpu.io import binfile as bf
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (8, 40, 36, 3), dtype=np.uint8)
+    mean, std = [0.48, 0.45, 0.4], [0.22, 0.23, 0.24]
+    out_a = augment_batch(imgs, (32, 24), mean, std, train=False)
+    lib, err = bf._lib, bf._lib_err
+    bf._lib, bf._lib_err = None, Exception("forced fallback")
+    try:
+        out_b = augment_batch(imgs, (32, 24), mean, std, train=False)
+    finally:
+        bf._lib, bf._lib_err = lib, err
+    assert out_a.shape == (8, 3, 32, 24)
+    np.testing.assert_allclose(out_a, out_b, atol=1e-5)
+
+
+def test_augment_batch_train_deterministic_and_cropped():
+    from singa_tpu.image_tool import augment_batch
+
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, (16, 40, 40, 3), dtype=np.uint8)
+    a = augment_batch(imgs, 32, train=True, seed=5)
+    b = augment_batch(imgs, 32, train=True, seed=5)
+    c = augment_batch(imgs, 32, train=True, seed=6)
+    assert a.shape == (16, 3, 32, 32)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    # un-normalized output stays in [0, 1]
+    assert a.min() >= 0.0 and a.max() <= 1.0
