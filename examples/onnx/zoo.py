@@ -69,12 +69,15 @@ def run_one(name, dev, batch, seed, train_steps):
                 self.optimizer(loss)
                 return out, loss
 
-        tm = Trainable(proto)
+        tm = Trainable(proto, dev)  # default device is CppCPU (host)
         tm.loss_fn = layer.SoftMaxCrossEntropy()
         tm.set_optimizer(opt.SGD(lr=1e-3, momentum=0.9))
         y = tensor.from_numpy(
             rng.randint(0, 10, (batch,)).astype(np.int32), dev)
-        tm.compile([x], is_train=True, use_graph=False)
+        # graph mode: the imported graph's whole train step compiles to
+        # ONE executable — eager per-node dispatch of a 200-node import
+        # is dominated by host->device latency
+        tm.compile([x], is_train=True, use_graph=True)
         losses = [float(tm(x, y)[1].data) for _ in range(train_steps)]
         print(f"{name}: imported-graph training loss "
               f"{losses[0]:.4f} -> {losses[-1]:.4f}")

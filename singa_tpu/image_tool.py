@@ -108,10 +108,11 @@ def augment_batch(imgs, patch, mean=None, std=None, train=True, seed=0,
     n, h, w, c = imgs.shape
     ph, pw = (patch, patch) if isinstance(patch, int) else patch
     assert ph <= h and pw <= w, f"patch {patch} larger than {(h, w)}"
-    mean_a = (np.zeros(c, np.float32) if mean is None
-              else np.asarray(mean, np.float32))
-    std_a = (np.ones(c, np.float32) if std is None
-             else np.asarray(std, np.float32))
+    # broadcast to per-channel length — the native loop indexes [ch]
+    mean_a = np.ascontiguousarray(np.broadcast_to(
+        np.asarray(0.0 if mean is None else mean, np.float32), (c,)))
+    std_a = np.ascontiguousarray(np.broadcast_to(
+        np.asarray(1.0 if std is None else std, np.float32), (c,)))
     out = np.empty((n, c, ph, pw), np.float32)
 
     lib = _bf._load_native()

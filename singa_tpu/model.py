@@ -204,7 +204,16 @@ class Model(layer.Layer):
         arrays are deleted by the very next training step.  Returns an
         ``AsyncSaveHandle``; call ``.wait()`` before relying on the
         file (exceptions re-raise there)."""
-        snap = (jnp.copy if async_save else (lambda a: a))
+        def snap(a):
+            if not async_save:
+                return a
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                # multi-host sharded state: the collective fetch must
+                # happen on THIS thread (SPMD lockstep — a background
+                # thread would deadlock the other processes)
+                return _host_array(a)
+            return jnp.copy(a)  # shield from graph-mode buffer donation
+
         captured = {k: snap(v.data) for k, v in self.get_states().items()}
         if self._optimizer is not None:
             # state_tensors (not get_states): keep the transfer off this
@@ -216,7 +225,7 @@ class Model(layer.Layer):
                 captured[f"__aux__{k}"] = np.asarray(v)
 
         def _write():
-            states = {k: np.asarray(v) for k, v in captured.items()}
+            states = {k: _host_array(v) for k, v in captured.items()}
             tmp = fpath + ".tmp"
             with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
                 for k, v in states.items():
@@ -248,6 +257,16 @@ class Model(layer.Layer):
         if self._optimizer is not None and opt_states:
             self._optimizer.set_states(opt_states)
         return aux
+
+
+def _host_array(a) -> np.ndarray:
+    """Device->host fetch mirroring tensor.to_numpy's multi-host path
+    (process_allgather for cross-process sharded arrays)."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils as mh
+
+        return np.asarray(mh.process_allgather(a, tiled=True))
+    return np.asarray(a)
 
 
 class AsyncSaveHandle:

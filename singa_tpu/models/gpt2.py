@@ -180,8 +180,32 @@ class GPT2LMHead(model.Model):
     # -- sampling (fixed-shape, jit-friendly: full-context forward per
     #    emitted token, like examples/rnn's fixed-shape sampling) --------
     def generate(self, prompt_ids, max_new_tokens=20, temperature=1.0,
-                 rng=None):
-        """Greedy/temperature sampling. prompt_ids: np.ndarray (S0,)."""
+                 rng=None, use_cache=None):
+        """Greedy/temperature sampling. prompt_ids: np.ndarray (S0,).
+
+        ``use_cache`` (default auto): dense single-device models whose
+        generation fits n_positions decode through the KV-cached
+        incremental path (models/gpt2_decode.py — one compiled
+        prefill + lax.scan, O(S·D) per token) instead of one
+        full-context forward per token; MoE/plan models and
+        over-length generations use the windowed path below."""
+        n0 = len(np.asarray(prompt_ids).reshape(-1))
+        if use_cache is None:
+            use_cache = (self.plan is None
+                         and self.cfg.moe_every is None
+                         and n0 + max_new_tokens <= self.cfg.n_positions)
+        if use_cache:
+            from . import gpt2_decode
+
+            was_training = self.training
+            self.eval()
+            try:
+                return gpt2_decode.generate(
+                    self, prompt_ids, max_new_tokens=max_new_tokens,
+                    temperature=temperature, rng=rng)
+            finally:
+                if was_training:
+                    self.train(True)
         was_training = self.training
         self.eval()
         try:

@@ -1,0 +1,223 @@
+"""KV-cached incremental decoding for GPT-2 (TPU-native inference path).
+
+The reference has no inference machinery at all (its ONNX examples run
+full forwards — SURVEY.md §2.4); the round-2 ``generate`` here did the
+fixed-window equivalent: one FULL-context forward per emitted token,
+O(S²·T) total attention work.  This module is the idiomatic TPU design:
+
+* **prefill** — one causal forward over the (padded) prompt that also
+  returns every layer's K/V, written into a preallocated
+  ``(L, B, H, ctx, D)`` cache;
+* **decode** — a single ``lax.scan`` over new tokens, each step
+  attending its one-query block against the cache (masked to the live
+  positions) and writing its K/V at the current position with
+  ``lax.dynamic_update_slice`` — O(S·D) per token, static shapes, ONE
+  compiled executable for the whole generation.
+
+The math mirrors the layer stack exactly (same fp32-stat LayerNorm,
+same tanh-approx gelu, same scale placement), and
+``tests/test_gpt2.py`` asserts the cached step's logits equal the full
+forward's to tolerance at every position.  Dense single-device models
+only (no plan, no MoE) — sampling under a sharded plan still uses the
+windowed path.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def extract_params(m):
+    """Pull the dense GPT2LMHead weight pytree (raw jax arrays).
+    Raises for MoE/plan variants — those sample via the windowed path."""
+    t = m.transformer
+    if m.plan is not None:
+        raise ValueError("KV-cache decode is single-device (plan=None)")
+    blocks = []
+    for blk in t.blocks:
+        mlp = blk.mlp
+        if mlp is None:
+            raise RuntimeError("model not initialized: call compile() or "
+                               "run one forward first")
+        if not hasattr(mlp, "fc1"):
+            raise ValueError("KV-cache decode does not support MoE blocks")
+        blocks.append(dict(
+            ln1_s=blk.ln1.scale.data, ln1_b=blk.ln1.bias.data,
+            wq=blk.attn.q_proj.W.data, bq=blk.attn.q_proj.b.data,
+            wk=blk.attn.k_proj.W.data, bk=blk.attn.k_proj.b.data,
+            wv=blk.attn.v_proj.W.data, bv=blk.attn.v_proj.b.data,
+            wo=blk.attn.out_proj.W.data, bo=blk.attn.out_proj.b.data,
+            ln2_s=blk.ln2.scale.data, ln2_b=blk.ln2.bias.data,
+            w1=mlp.fc1.W.data, b1=mlp.fc1.b.data,
+            w2=mlp.fc2.W.data, b2=mlp.fc2.b.data,
+        ))
+    head = None if m.cfg.tie_weights else m.lm_head.W.data
+    return dict(wte=t.wte.W.data, wpe=t.wpe.W.data, blocks=blocks,
+                lnf_s=t.ln_f.scale.data, lnf_b=t.ln_f.bias.data,
+                head=head)
+
+
+def _ln(x, s, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * s + b).astype(x.dtype)
+
+
+def _attn_full(q, k, v, n_head):
+    """Causal attention over the full (B, S, E) prefill block."""
+    b, s, e = q.shape
+    d = e // n_head
+
+    def heads(t):
+        return t.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = heads(q), heads(k), heads(v)
+    sc = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(d)
+    cm = jnp.tril(jnp.ones((s, s), bool))
+    sc = jnp.where(cm[None, None], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    o = jnp.einsum("bhst,bhtd->bhsd", p, vh)
+    return o.transpose(0, 2, 1, 3).reshape(b, s, e)
+
+
+def _block_prefill(x, p, n_head, eps):
+    h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
+    q = h @ p["wq"] + p["bq"]
+    k = h @ p["wk"] + p["bk"]
+    v = h @ p["wv"] + p["bv"]
+    a = _attn_full(q, k, v, n_head)
+    x = x + (a @ p["wo"] + p["bo"])
+    h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
+    x = x + (jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"])
+    return x, k, v
+
+
+def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps):
+    """x: (B, 1, E); k/v_cache: (B, H, ctx, D) with this step's K/V
+    already written at ``pos``.  Attends to positions <= pos."""
+    b, _, e = x.shape
+    d = e // n_head
+    ctx = k_cache.shape[2]
+    h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
+    q = (h @ p["wq"] + p["bq"]).reshape(b, n_head, 1, d)
+    k_new = (h @ p["wk"] + p["bk"]).reshape(b, n_head, 1, d)
+    v_new = (h @ p["wv"] + p["bv"]).reshape(b, n_head, 1, d)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, (0, 0, pos, 0))
+    sc = jnp.einsum("bhqd,bhtd->bhqt", q, k_cache) / math.sqrt(d)
+    live = jnp.arange(ctx)[None, None, None, :] <= pos
+    sc = jnp.where(live, sc, NEG_INF)
+    p_attn = jax.nn.softmax(sc, axis=-1)
+    a = jnp.einsum("bhqt,bhtd->bhqd", p_attn, v_cache)
+    a = a.transpose(0, 2, 1, 3).reshape(b, 1, e)
+    x = x + (a @ p["wo"] + p["bo"])
+    h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
+    x = x + (jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"])
+    return x, k_cache, v_cache
+
+
+def _logits(x, params):
+    head = params["head"]
+    if head is None:
+        return x @ params["wte"].T
+    return x @ head
+
+
+def prefill(params, ids, n_head, eps):
+    """ids: (B, Sp) int32 (padded prompt).  Returns (logits, k_caches,
+    v_caches) with caches (L, B, H, Sp, D) — pad positions hold garbage
+    K/V that decode never attends to (mask is position-indexed)."""
+    b, sp = ids.shape
+    pos = jnp.arange(sp, dtype=jnp.int32)[None, :]
+    x = jnp.take(params["wte"], ids, axis=0) + \
+        jnp.take(params["wpe"], pos, axis=0)
+    ks, vs = [], []
+    for p in params["blocks"]:
+        x, k, v = _block_prefill(x, p, n_head, eps)
+        e = x.shape[-1]
+        d = e // n_head
+        ks.append(k.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
+        vs.append(v.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
+    x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
+    return _logits(x, params), jnp.stack(ks), jnp.stack(vs)
+
+
+@partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
+                                   "greedy"))
+def generate_cached(params, ids, prompt_len, n_head, eps, n_new, ctx,
+                    greedy, temperature, key):
+    """One compiled prefill + lax.scan decode.  ids: (1, ctx) right-
+    padded prompt; returns (1, n_new) sampled token ids."""
+    logits, kc, vc = prefill(params, ids, n_head, eps)
+    # caches preallocated at ctx; prefill already spans ctx here
+    first_logit = jax.lax.dynamic_index_in_dim(
+        logits, prompt_len - 1, axis=1, keepdims=False)[0]  # (V,)
+
+    def sample(logit, k):
+        if greedy:
+            return jnp.argmax(logit).astype(jnp.int32)
+        p = jax.nn.softmax(logit.astype(jnp.float32) / temperature)
+        return jax.random.categorical(
+            k, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
+
+    k0, key = jax.random.split(key)
+    tok0 = sample(first_logit, k0)
+
+    def step(carry, _):
+        tok, pos, kc, vc, key = carry
+        x = params["wte"][tok][None, None, :] + \
+            params["wpe"][pos][None, None, :]
+        new_kc, new_vc = [], []
+        for li, p in enumerate(params["blocks"]):
+            x, kl, vl = _block_decode(x, p, kc[li], vc[li], pos, n_head,
+                                      eps)
+            new_kc.append(kl)
+            new_vc.append(vl)
+        kc = jnp.stack(new_kc)
+        vc = jnp.stack(new_vc)
+        x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
+        logit = _logits(x, params)[0, 0]
+        k, key = jax.random.split(key)
+        nxt = sample(logit, k)
+        return (nxt, pos + 1, kc, vc, key), tok
+
+    (last, _, _, _, _), toks = jax.lax.scan(
+        step, (tok0, prompt_len, kc, vc, key), None, length=n_new - 1)
+    return jnp.concatenate([toks, last[None]])[None, :]
+
+
+def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None):
+    """KV-cached sampling for a dense GPT2LMHead.  Requires
+    prompt_len + max_new_tokens <= cfg.n_positions (the windowed
+    fallback in models/gpt2.py handles longer generations)."""
+    params = extract_params(m)
+    cfg = m.cfg
+    ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+    n0 = len(ids)
+    if max_new_tokens <= 0:
+        return ids.copy()
+    if n0 + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt ({n0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"n_positions ({cfg.n_positions}); use the windowed "
+            "GPT2LMHead.generate")
+    ctx = cfg.n_positions
+    window = np.zeros((1, ctx), np.int32)
+    window[0, :n0] = ids
+    # rng=None must stay non-deterministic across calls like the
+    # windowed sampler's `rng or np.random` fallback
+    seed = int((rng or np.random).randint(0, 2 ** 31 - 1))
+    new = generate_cached(
+        params, jnp.asarray(window), n0, cfg.n_head,
+        float(cfg.layer_norm_eps), int(max_new_tokens), ctx,
+        temperature <= 0, jnp.float32(max(temperature, 1e-6)),
+        jax.random.PRNGKey(seed))
+    return np.concatenate([ids, np.asarray(new[0])]).astype(np.int32)

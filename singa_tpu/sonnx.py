@@ -77,6 +77,19 @@ class SingaRep:
                     self._consts[node.output[0]] = t
         finally:
             _REP_DEVICE.reset(token)
+        # BatchNormalization mean/var inputs are MUTABLE training state
+        # (the training branch writes running stats into them), not
+        # frozen constants: promote them to non-trainable weights so
+        # get_states()/persistent_tensors() track them and graph mode
+        # threads them through the compiled step instead of leaking
+        # traced values into untracked tensors
+        for node in graph.node:
+            if node.op_type == "BatchNormalization":
+                for name in list(node.input)[3:5]:
+                    if name in self._consts:
+                        t = self._consts.pop(name)
+                        t.name = name
+                        self.weights[name] = t
 
     def params(self):
         return self.weights
@@ -102,8 +115,12 @@ class SingaRep:
         # the default
         token = _REP_DEVICE.set(self.device)
         try:
+            # skip hoisted constants AND promoted BN stats: a Constant
+            # node whose output was promoted into weights must not
+            # re-execute, or its frozen export-time value would shadow
+            # the live (trained/loaded) running stats in env
             _exec_nodes(self.graph.node, env,
-                        skip_consts=set(self._consts))
+                        skip_consts=set(self._consts) | set(self.weights))
         finally:
             _REP_DEVICE.reset(token)
         return [env[n] for n in self.output_names]

@@ -158,3 +158,82 @@ def test_generate():
     assert out.shape == (8,)
     assert (out[:3] == [1, 2, 3]).all()
     assert ((0 <= out) & (out < cfg.vocab_size)).all()
+
+
+def test_kv_cache_generate_matches_windowed_greedy():
+    """The KV-cached incremental decoder (models/gpt2_decode.py) must
+    reproduce the windowed full-forward sampler token for token under
+    greedy decoding."""
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompt = np.arange(9) % cfg.vocab_size
+    g_win = m.generate(prompt, max_new_tokens=12, temperature=0,
+                       use_cache=False)
+    g_kv = m.generate(prompt, max_new_tokens=12, temperature=0,
+                      use_cache=True)
+    np.testing.assert_array_equal(g_win, g_kv)
+    assert g_kv[:9].tolist() == prompt.tolist()
+
+
+def test_kv_cache_prefill_logits_match_forward():
+    """Teacher-forced check with no argmax involved: the pure-jnp
+    prefill logits must match the layer-stack forward at every
+    position."""
+    import jax.numpy as jnp
+
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (1, 16)).astype(np.int32)
+    x = tensor.from_numpy(ids)
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    ref = tensor.to_numpy(m.forward(x))
+    params = gpt2_decode.extract_params(m)
+    got, _, _ = gpt2_decode.prefill(params, jnp.asarray(ids), cfg.n_head,
+                                    cfg.layer_norm_eps)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=2e-3, rtol=1e-3)
+
+
+def test_kv_cache_rejects_over_length_and_falls_back():
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    long_prompt = np.zeros(cfg.n_positions - 2, np.int32)
+    # auto mode falls back to the windowed sampler instead of raising
+    out = m.generate(long_prompt, max_new_tokens=5, temperature=0)
+    assert len(out) == len(long_prompt) + 5
+    import pytest as _pytest
+
+    from singa_tpu.models import gpt2_decode
+    with _pytest.raises(ValueError):
+        gpt2_decode.generate(m, long_prompt, max_new_tokens=5)
+
+
+def test_generate_zero_tokens_returns_prompt():
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompt = np.arange(5) % cfg.vocab_size
+    out = m.generate(prompt, max_new_tokens=0, temperature=0)
+    np.testing.assert_array_equal(out, prompt)
+
+
+def test_generate_default_rng_not_deterministic():
+    """rng=None temperature sampling must differ across calls (parity
+    with the windowed sampler's np.random fallback)."""
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompt = np.arange(5) % cfg.vocab_size
+    outs = {tuple(m.generate(prompt, max_new_tokens=8,
+                             temperature=1.0).tolist())
+            for _ in range(4)}
+    assert len(outs) > 1, "identical samples across calls"

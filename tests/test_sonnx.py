@@ -493,3 +493,56 @@ def test_if_branch_initializer_shadows_outer_name(dev):
                     "x": tensor.from_numpy(x_np, dev),
                     "c": tensor.from_numpy(outer_c, dev)})
     np.testing.assert_allclose(tensor.to_numpy(y), np.full((2, 3), 5.0))
+
+
+def test_imported_bn_model_trains_in_graph_mode(dev):
+    """Imported BatchNormalization mean/var are mutable training state:
+    they must ride rep.weights (tracked by persistent_tensors) or graph
+    mode compiles a step whose arity disagrees with the replay call
+    (regression: 'Computation compiled for N inputs but called with M')."""
+    from singa_tpu import layer as layer_mod
+    from singa_tpu.models.mobilenet import mobilenet_v2
+
+    m = mobilenet_v2(num_classes=10, width_mult=0.25)
+    x = tensor.from_numpy(
+        np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32),
+        dev)
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    proto = sonnx.to_onnx(m, [x])
+
+    class Trainable(sonnx.SONNXModel):
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    tm = Trainable(proto, dev)
+    tm.loss_fn = layer_mod.SoftMaxCrossEntropy()
+    tm.set_optimizer(opt.SGD(lr=1e-2, momentum=0.9))
+    y = tensor.from_numpy(
+        np.random.RandomState(1).randint(0, 10, (2,)).astype(np.int32),
+        dev)
+    tm.compile([x], is_train=True, use_graph=True)
+    bn_states = [k for k in tm.get_states() if k not in tm.get_params()]
+    assert bn_states, "imported BN running stats missing from states"
+    before = {k: tensor.to_numpy(tm.get_states()[k]).copy()
+              for k in bn_states}
+    losses = [float(tm(x, y)[1].data) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
+    # training must MOVE the promoted running stats (they are live
+    # state, not shadowed by re-executed Constant nodes)...
+    moved = [k for k in bn_states
+             if not np.array_equal(tensor.to_numpy(tm.get_states()[k]),
+                                   before[k])]
+    assert moved, "promoted BN stats never updated by training"
+    # ...and eval must READ them: perturbing them changes the output
+    tm.train(False)
+    out0 = tensor.to_numpy(tm.forward(x))
+    for k in bn_states:
+        t = tm.get_states()[k]
+        layer_mod.Layer._load_into(t, tensor.to_numpy(t) + 5.0)
+    out1 = tensor.to_numpy(tm.forward(x))
+    assert not np.allclose(out0, out1), \
+        "eval ignores promoted BN running stats"
