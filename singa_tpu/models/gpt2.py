@@ -190,28 +190,33 @@ class GPT2LMHead(model.Model):
         full-context forward per token; MoE/plan models and
         over-length generations use the windowed path below."""
         n0 = len(np.asarray(prompt_ids).reshape(-1))
+        blocks = self.transformer.blocks
+        initialized = bool(blocks) and blocks[0].mlp is not None
         if use_cache is None:
             use_cache = (self.plan is None
                          and self.cfg.moe_every is None
+                         and initialized  # deferred init needs a forward
                          and n0 + max_new_tokens <= self.cfg.n_positions)
-        if use_cache:
-            from . import gpt2_decode
+        # .training only exists after train()/eval(); an un-compiled
+        # model can still generate (the windowed path lazily inits)
+        was_training = getattr(self, "training", False)
+        self.eval()
+        try:
+            if use_cache:
+                from . import gpt2_decode
 
-            was_training = self.training
-            self.eval()
-            try:
                 return gpt2_decode.generate(
                     self, prompt_ids, max_new_tokens=max_new_tokens,
                     temperature=temperature, rng=rng)
-            finally:
-                if was_training:
-                    self.train(True)
-        was_training = self.training
-        self.eval()
-        try:
             ids = list(np.asarray(prompt_ids).tolist())
             ctx = self.cfg.n_positions
-            dev = self.transformer.wte.W.device  # follow the params
+            wte = self.transformer.wte
+            if hasattr(wte, "W"):
+                dev = wte.W.device  # follow the params
+            else:  # un-compiled model: first forward will deferred-init
+                from .. import device as device_module
+
+                dev = device_module.get_default_device()
             for _ in range(max_new_tokens):
                 live = ids[-ctx:]
                 # causal attention ignores positions to the RIGHT, so a

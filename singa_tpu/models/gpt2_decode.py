@@ -132,9 +132,12 @@ def _logits(x, params):
 
 
 def prefill(params, ids, n_head, eps):
-    """ids: (B, Sp) int32 (padded prompt).  Returns (logits, k_caches,
-    v_caches) with caches (L, B, H, Sp, D) — pad positions hold garbage
-    K/V that decode never attends to (mask is position-indexed)."""
+    """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
+    v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
+    rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
+    for all pad positions would double prefill cost) — and caches are
+    (L, B, H, Sp, D); pad positions hold garbage K/V that decode never
+    attends to (mask is position-indexed)."""
     b, sp = ids.shape
     pos = jnp.arange(sp, dtype=jnp.int32)[None, :]
     x = jnp.take(params["wte"], ids, axis=0) + \
@@ -147,7 +150,7 @@ def prefill(params, ids, n_head, eps):
         ks.append(k.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
         vs.append(v.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
-    return _logits(x, params), jnp.stack(ks), jnp.stack(vs)
+    return x, jnp.stack(ks), jnp.stack(vs)
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
@@ -156,10 +159,12 @@ def generate_cached(params, ids, prompt_len, n_head, eps, n_new, ctx,
                     greedy, temperature, key):
     """One compiled prefill + lax.scan decode.  ids: (1, ctx) right-
     padded prompt; returns (1, n_new) sampled token ids."""
-    logits, kc, vc = prefill(params, ids, n_head, eps)
-    # caches preallocated at ctx; prefill already spans ctx here
-    first_logit = jax.lax.dynamic_index_in_dim(
-        logits, prompt_len - 1, axis=1, keepdims=False)[0]  # (V,)
+    hidden, kc, vc = prefill(params, ids, n_head, eps)
+    # caches preallocated at ctx; prefill already spans ctx here.
+    # Vocab-project ONLY the last live row — (1, V), not (ctx, V)
+    last_h = jax.lax.dynamic_index_in_dim(
+        hidden, prompt_len - 1, axis=1, keepdims=False)    # (1, E)
+    first_logit = _logits(last_h[:, None, :], params)[0, 0]  # (V,)
 
     def sample(logit, k):
         if greedy:
@@ -213,8 +218,18 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None):
     window = np.zeros((1, ctx), np.int32)
     window[0, :n0] = ids
     # rng=None must stay non-deterministic across calls like the
-    # windowed sampler's `rng or np.random` fallback
-    seed = int((rng or np.random).randint(0, 2 ** 31 - 1))
+    # windowed sampler's np.random fallback; accept both RandomState
+    # (.randint) and Generator (.integers); greedy decoding draws
+    # nothing (the key is unused, and consuming the caller's rng would
+    # perturb downstream reproducibility)
+    if temperature <= 0:
+        seed = 0
+    elif rng is None:
+        seed = int(np.random.randint(0, 2 ** 31 - 1))
+    elif hasattr(rng, "integers"):
+        seed = int(rng.integers(0, 2 ** 31 - 1))
+    else:
+        seed = int(rng.randint(0, 2 ** 31 - 1))
     new = generate_cached(
         params, jnp.asarray(window), n0, cfg.n_head,
         float(cfg.layer_norm_eps), int(max_new_tokens), ctx,

@@ -253,12 +253,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal, block_q,
-                      block_k):
+                      block_k, dlse=None):
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    # δ = rowsum(dO ∘ O): one O(S·D) pass, shared by both kernels
+    # δ = rowsum(dO ∘ O): one O(S·D) pass, shared by both kernels.
+    # A direct cotangent on the logsumexp output enters the softmax
+    # Jacobian as ds += p∘dlse, i.e. δ' = δ − dlse (ring attention's
+    # partial-merge differentiates through lse).
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]                     # (BH, 1, S)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     mask3 = mask[:, None, :]
 
     dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -314,24 +319,31 @@ def _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal, block_q,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, mask, causal, block_q, block_k):
-    o, _ = _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
-    return o
+def _flash_core(q, k, v, mask, causal, block_q, block_k):
+    """Differentiable (o, lse) pair — lse carries a real cotangent
+    (ring attention's partial merge differentiates through it)."""
+    return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
 
 
-def _flash_fwd(q, k, v, mask, causal, block_q, block_k):
+def _flash_core_fwd(q, k, v, mask, causal, block_q, block_k):
     o, lse = _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
-    return o, (q, k, v, mask, o, lse)
+    return (o, lse), (q, k, v, mask, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, res, do):
+def _flash_core_bwd(causal, block_q, block_k, res, cts):
     q, k, v, mask, o, lse = res
+    do, dlse = cts
     dq, dk, dv = _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal,
-                                   block_q, block_k)
+                                   block_q, block_k, dlse=dlse)
     return dq, dk, dv, None
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _flash(q, k, v, mask, causal, block_q, block_k):
+    # o-only view: indexing the custom_vjp pair feeds dlse = 0
+    return _flash_core(q, k, v, mask, causal, block_q, block_k)[0]
 
 
 # ------------------------------------------------- non-kernel reference
@@ -430,6 +442,51 @@ def flash_attention(q, k, v, mask=None, causal=False,
         return o.reshape(b, h, s, d)
     o = _flash(qf, kf, vf, mf, causal, block_q, block_k)
     return o.reshape(b, h, s, d)
+
+
+def flash_attention_lse(q, k, v, mask=None, causal=False,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp (B, H, S) float32 — the quantity ring attention needs to
+    merge per-shard partial attentions exactly.  Differentiable in both
+    outputs (the lse cotangent folds into the softmax Jacobian).
+
+    Kernel path for aligned shapes; a fused-jnp fallback (same math,
+    native jax autodiff) covers small/unaligned S, e.g. CPU-mesh tests.
+    ``mask``: additive key mask shaped (B, 1, 1, S) or None."""
+    b, h, s, d = q.shape
+
+    def fit(block):
+        block = min(block, s) // 128 * 128
+        while block >= 128 and s % block != 0:
+            block -= 128
+        return block
+
+    bq, bk = fit(block_q), fit(block_k)
+    if d <= 128 and bq > 0 and bk > 0:
+        bh = b * h
+        qf, kf, vf = (x.reshape(bh, s, d) for x in (q, k, v))
+        if mask is None:
+            mf = jnp.zeros((bh, s), q.dtype)
+        else:
+            mf = jnp.repeat(
+                jnp.broadcast_to(mask[:, 0, 0, :], (b, s)), h, axis=0)
+        o, lse = _flash_core(qf, kf, vf, mf, causal, bq, bk)
+        return o.reshape(b, h, s, d), lse[:, 0, :].reshape(b, h, s)
+    # fallback: fused jnp with explicit logsumexp (jax autodiff)
+    scale = 1.0 / math.sqrt(d)
+    sc = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) * scale
+    if mask is not None:
+        sc = sc + mask.astype(jnp.float32)
+    if causal:
+        cm = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(cm[None, None], sc, NEG_INF)
+    m = jnp.max(sc, axis=-1, keepdims=True)
+    p = jnp.exp(sc - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhst,bhtd->bhsd", p / l, v.astype(jnp.float32))
+    return o.astype(q.dtype), (m + jnp.log(l))[..., 0]
 
 
 def flash_attention_op(q, k, v, mask=None, causal=False, remat=False):

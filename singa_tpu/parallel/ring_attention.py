@@ -28,7 +28,7 @@ NEG_INF = -1e30
 
 
 def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
-                        remat=True):
+                        remat=True, use_flash=False):
     """Per-rank blocks inside shard_map: q,k,v (B, H, S_local, D).
     Returns (B, H, S_local, D) — the attention of local queries against
     the FULL (globally sharded) key/value sequence.
@@ -43,7 +43,17 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     one pair per hop — backward memory drops from O(S_local·S) to
     O(S_local·D) per rank, the same cure the single-chip Pallas flash
     backward applies (ops/pallas/flash_attention.py), for ~⅓ more
-    backward FLOPs."""
+    backward FLOPs.
+
+    ``use_flash``: each ring step's (local Q) × (visiting K/V shard)
+    attention runs through the Pallas flash kernel instead of the fused
+    einsum — inside shard_map the kernel executes per device (manual
+    mode), so this composes the single-chip flash win with sequence
+    parallelism.  The per-step partials merge exactly via each step's
+    logsumexp; causal steps specialize per block position (above the
+    diagonal: skipped entirely; on it: causal kernel; below: dense
+    kernel).  ``remat`` is ignored here — the flash backward already
+    recomputes blockwise."""
     axis_size = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
@@ -53,6 +63,55 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     q_pos = rank * s_loc + jnp.arange(s_loc)  # global positions (S_local,)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    if use_flash:
+        from ..ops.pallas.flash_attention import flash_attention_lse
+
+        def flash_step(carry, t):
+            acc, m_prev, l_prev, k_cur, v_cur, mask_cur = carry
+            src = (rank - t) % axis_size
+
+            def dense(_):
+                o, lse = flash_attention_lse(q, k_cur, v_cur, mask_cur,
+                                             causal=False)
+                return o.astype(jnp.float32), lse
+
+            def diag(_):
+                o, lse = flash_attention_lse(q, k_cur, v_cur, mask_cur,
+                                             causal=True)
+                return o.astype(jnp.float32), lse
+
+            def skip(_):
+                return (jnp.zeros((b, h, s_loc, d), jnp.float32),
+                        jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
+
+            if causal:
+                o_t, lse_t = lax.cond(
+                    src > rank, skip,
+                    lambda op: lax.cond(src == rank, diag, dense, op),
+                    None)
+            else:
+                o_t, lse_t = dense(None)
+            # exact partial merge via per-step logsumexp
+            m_new = jnp.maximum(m_prev, lse_t)
+            alpha = jnp.exp(m_prev - m_new)
+            w = jnp.exp(lse_t - m_new)
+            acc = acc * alpha[..., None] + o_t * w[..., None]
+            l_new = l_prev * alpha + w
+            k_next = lax.ppermute(k_cur, axis_name, perm)
+            v_next = lax.ppermute(v_cur, axis_name, perm)
+            mask_next = (None if mask_cur is None
+                         else lax.ppermute(mask_cur, axis_name, perm))
+            return (acc, m_new, l_new, k_next, v_next, mask_next), None
+
+        init = (jnp.zeros((b, h, s_loc, d), jnp.float32),
+                jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
+                jnp.zeros((b, h, s_loc), jnp.float32),
+                k, v, kv_mask)
+        (acc, m, l, *_), _ = lax.scan(flash_step, init,
+                                      jnp.arange(axis_size))
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l[..., None]).astype(q.dtype)
 
     def step(carry, t):
         acc, m_prev, l_prev, k_cur, v_cur, mask_cur = carry

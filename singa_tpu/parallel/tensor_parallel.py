@@ -221,7 +221,11 @@ class ParallelMHA(Layer):
 
         if self.seq_parallel and plan is not None \
                 and sharding.plan_active():
-            ctx = _ring_attention_op(q, k, v, mask, plan, self.causal)
+            # use_flash composes here: inside shard_map the Pallas
+            # kernel runs per device (manual mode), so each ring step's
+            # local-Q x visiting-K/V attention is the flash kernel
+            ctx = _ring_attention_op(q, k, v, mask, plan, self.causal,
+                                     use_flash=self.use_flash)
         else:
             # pallas_call has no GSPMD partitioning rule: under an active
             # sharded plan the fused einsum path (auto-partitioned
@@ -236,8 +240,10 @@ class ParallelMHA(Layer):
                 self._warned_flash = True
                 logging.getLogger("singa_tpu").warning(
                     "ParallelMHA: use_flash ignored under an active "
-                    "ShardingPlan (no GSPMD rule for pallas_call); "
-                    "using the fused head-sharded path")
+                    "ShardingPlan without a seq axis (no GSPMD rule "
+                    "for pallas_call outside shard_map); using the "
+                    "fused head-sharded path — shard the seq axis to "
+                    "get ring attention with per-shard flash kernels")
             ctx = _sdpa(q, k, v, mask, self.causal, remat=self.remat,
                         use_flash=use_flash)
         ctx = autograd.transpose(ctx, (0, 2, 1, 3))
@@ -341,7 +347,7 @@ def _sdpa(q, k, v, mask, causal, remat=False, use_flash=False):
                  causal=causal)
 
 
-def _ring_attention_op(q, k, v, mask, plan, causal):
+def _ring_attention_op(q, k, v, mask, plan, causal, use_flash=False):
     """Ring attention as a taped op: shard_map over the FULL mesh with
     (B@data, H@model, S@seq, D) blocks; the K/V ring rotates over the
     ``seq`` axis only (lax.ppermute — the one collective XLA cannot
@@ -364,13 +370,15 @@ def _ring_attention_op(q, k, v, mask, plan, causal):
         mspec = P(DATA, None, None, SEQ)
         f = jax.shard_map(
             lambda q_, k_, v_, m_: ring_self_attention(
-                q_, k_, v_, SEQ, causal=causal, kv_mask=m_),
+                q_, k_, v_, SEQ, causal=causal, kv_mask=m_,
+                use_flash=use_flash),
             mesh=plan.mesh, in_specs=(spec, spec, spec, mspec),
             out_specs=spec, check_vma=False)
         return autograd._op(f, q, k, v, mask, _name="RingAttention")
     f = jax.shard_map(
         lambda q_, k_, v_: ring_self_attention(q_, k_, v_, SEQ,
-                                               causal=causal),
+                                               causal=causal,
+                                               use_flash=use_flash),
         mesh=plan.mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return autograd._op(f, q, k, v, _name="RingAttention")

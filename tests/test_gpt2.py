@@ -194,8 +194,9 @@ def test_kv_cache_prefill_logits_match_forward():
     m.eval()
     ref = tensor.to_numpy(m.forward(x))
     params = gpt2_decode.extract_params(m)
-    got, _, _ = gpt2_decode.prefill(params, jnp.asarray(ids), cfg.n_head,
-                                    cfg.layer_norm_eps)
+    hidden, _, _ = gpt2_decode.prefill(params, jnp.asarray(ids),
+                                       cfg.n_head, cfg.layer_norm_eps)
+    got = gpt2_decode._logits(hidden, params)
     np.testing.assert_allclose(np.asarray(got), ref, atol=2e-3, rtol=1e-3)
 
 
