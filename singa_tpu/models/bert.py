@@ -92,14 +92,13 @@ class BertLayer(layer.Layer):
             from ..parallel.tensor_parallel import (
                 ColumnParallelLinear, ParallelMHA, RowParallelLinear)
 
-            if cfg.use_flash:
-                raise ValueError(
-                    "use_flash + ShardingPlan is not supported: the "
-                    "Pallas flash kernel is single-device; sequence "
-                    "sharding already bounds attention memory (ring "
-                    "attention), so drop use_flash for parallel runs")
+            # use_flash + plan delegates to ParallelMHA's policy: with a
+            # sharded seq axis each ring step runs the flash kernel
+            # inside shard_map; without one it warns and uses the fused
+            # head-sharded path (no GSPMD rule for bare pallas_call)
             self.attn = ParallelMHA(cfg.num_attention_heads, plan,
                                     dropout=cfg.attn_dropout,
+                                    use_flash=cfg.use_flash,
                                     remat=cfg.remat)
             self.fc1 = ColumnParallelLinear(cfg.intermediate_size, plan)
             self.fc2 = RowParallelLinear(cfg.hidden_size, plan)

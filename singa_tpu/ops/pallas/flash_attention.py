@@ -391,6 +391,28 @@ def _blockwise_reference(q, k, v, mask, causal, block_k):
 # ----------------------------------------------------------- public API
 
 
+def _fit_block(block, s):
+    """Largest 128-multiple <= block that divides S (0 if none) — an S
+    like 2560 must shrink to 512, not fall off the kernel onto the
+    O(S²)-backward scan fallback; a non-128-aligned S (Mosaic tile
+    constraint) yields 0 → fallback."""
+    block = min(block, s) // 128 * 128
+    while block >= 128 and s % block != 0:
+        block -= 128
+    return block
+
+
+def _key_mask_flat(mask, b, h, s):
+    """(B,1,1,S) additive key mask -> (B·H, S) kernel layout, or None
+    if the mask is not a pure key mask (kernel can't tile it)."""
+    if mask is None:
+        return None
+    if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+        return jnp.repeat(
+            jnp.broadcast_to(mask[:, 0, 0, :], (b, s)), h, axis=0)
+    return None
+
+
 def flash_attention(q, k, v, mask=None, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     force_reference=False):
@@ -398,18 +420,8 @@ def flash_attention(q, k, v, mask=None, causal=False,
     to (B, H, S, S) but only key-mask shapes (B, 1, 1, S) are accepted by
     the kernel path.  Returns (B, H, S, D)."""
     b, h, s, d = q.shape
-
-    def fit(block):
-        """Largest 128-multiple <= block that divides S (0 if none) —
-        an S like 2560 must shrink to 512, not fall off the kernel
-        onto the O(S²)-backward scan fallback; a non-128-aligned S
-        (Mosaic tile constraint) yields 0 → fallback."""
-        block = min(block, s) // 128 * 128
-        while block >= 128 and s % block != 0:
-            block -= 128
-        return block
-
-    block_q, block_k = fit(block_q), fit(block_k)
+    block_q = _fit_block(block_q, s)
+    block_k = _fit_block(block_k, s)
     kernel_ok = block_q > 0 and block_k > 0
     if not kernel_ok:
         block_k = s  # the blockwise fallback only needs block_k | S
@@ -420,12 +432,9 @@ def flash_attention(q, k, v, mask=None, causal=False,
     if mask is None:
         mf = jnp.zeros((bh, s), q.dtype)
     else:
-        if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
-            mf = jnp.broadcast_to(mask[:, 0, 0, :], (b, s))
-            mf = jnp.repeat(mf, h, axis=0)
-        else:
+        mf = _key_mask_flat(mask, b, h, s)
+        if mf is None:  # general mask: kernel can't tile it
             force_reference = True
-            mf = None
     use_kernel = not force_reference and d <= 128 and kernel_ok
     if not use_kernel:
         if mf is None:
@@ -455,22 +464,17 @@ def flash_attention_lse(q, k, v, mask=None, causal=False,
     native jax autodiff) covers small/unaligned S, e.g. CPU-mesh tests.
     ``mask``: additive key mask shaped (B, 1, 1, S) or None."""
     b, h, s, d = q.shape
-
-    def fit(block):
-        block = min(block, s) // 128 * 128
-        while block >= 128 and s % block != 0:
-            block -= 128
-        return block
-
-    bq, bk = fit(block_q), fit(block_k)
-    if d <= 128 and bq > 0 and bk > 0:
+    bq, bk = _fit_block(block_q, s), _fit_block(block_k, s)
+    mf = _key_mask_flat(mask, b, h, s)
+    # general (per-query) masks can't tile through the kernel — same
+    # guard as flash_attention; the fallback below handles them
+    kernel_ok = (d <= 128 and bq > 0 and bk > 0
+                 and (mask is None or mf is not None))
+    if kernel_ok:
         bh = b * h
         qf, kf, vf = (x.reshape(bh, s, d) for x in (q, k, v))
-        if mask is None:
+        if mf is None:
             mf = jnp.zeros((bh, s), q.dtype)
-        else:
-            mf = jnp.repeat(
-                jnp.broadcast_to(mask[:, 0, 0, :], (b, s)), h, axis=0)
         o, lse = _flash_core(qf, kf, vf, mf, causal, bq, bk)
         return o.reshape(b, h, s, d), lse[:, 0, :].reshape(b, h, s)
     # fallback: fused jnp with explicit logsumexp (jax autodiff)

@@ -4,10 +4,10 @@ The reference has NO long-context machinery (SURVEY.md §5.7: max sequence
 length is bounded by single-device memory).  This module is the TPU-native
 extension point the survey calls for: shard the sequence axis over a mesh
 ('seq') axis, keep Q resident per chip, and rotate K/V blocks around the
-ICI ring with ``lax.ppermute`` while an online softmax accumulates — peak
-memory per chip is O(S_local · D) and the K/V transfers overlap with the
-per-block attention compute (XLA's latency-hiding scheduler pipelines the
-permute with the einsum).
+ICI ring with ``lax.ppermute`` while per-step partial attentions merge by
+logsumexp — peak memory per chip is O(S_local · D) and the K/V transfers
+overlap with the per-block attention compute (XLA's latency-hiding
+scheduler pipelines the permute with the einsum/kernel).
 
 Use ``ring_self_attention`` inside an existing ``shard_map`` (arrays are
 per-rank blocks), or ``ring_attention_sharded`` to run over global arrays
@@ -33,6 +33,11 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     Returns (B, H, S_local, D) — the attention of local queries against
     the FULL (globally sharded) key/value sequence.
 
+    One ``lax.scan`` body serves both per-step attention kernels; each
+    step produces a NORMALIZED partial ``(o_t, lse_t)`` for the visiting
+    K/V shard and the shared merge combines them exactly:
+    ``m=max(lse_t)``, ``o = Σ o_t·e^{lse_t−m} / Σ e^{lse_t−m}``.
+
     ``kv_mask``: optional additive mask over KEY positions, shaped
     (B, 1, 1, S_local) per rank (the sequence-sharded slice of a padding
     mask like BERT's (B,1,1,S) -1e9 mask).  It rotates around the ring
@@ -49,28 +54,20 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     attention runs through the Pallas flash kernel instead of the fused
     einsum — inside shard_map the kernel executes per device (manual
     mode), so this composes the single-chip flash win with sequence
-    parallelism.  The per-step partials merge exactly via each step's
-    logsumexp; causal steps specialize per block position (above the
+    parallelism.  Causal steps specialize per block position (above the
     diagonal: skipped entirely; on it: causal kernel; below: dense
     kernel).  ``remat`` is ignored here — the flash backward already
     recomputes blockwise."""
     axis_size = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    qs = q * scale
-
     q_pos = rank * s_loc + jnp.arange(s_loc)  # global positions (S_local,)
-
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     if use_flash:
         from ..ops.pallas.flash_attention import flash_attention_lse
 
-        def flash_step(carry, t):
-            acc, m_prev, l_prev, k_cur, v_cur, mask_cur = carry
-            src = (rank - t) % axis_size
-
+        def step_attn(src, k_cur, v_cur, mask_cur):
             def dense(_):
                 o, lse = flash_attention_lse(q, k_cur, v_cur, mask_cur,
                                              causal=False)
@@ -85,51 +82,46 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
                 return (jnp.zeros((b, h, s_loc, d), jnp.float32),
                         jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
 
+            if not causal:
+                return dense(None)
+            return lax.cond(
+                src > rank, skip,
+                lambda op: lax.cond(src == rank, diag, dense, op), None)
+
+        wrap_remat = False  # the kernel backward already recomputes
+    else:
+        scale = 1.0 / math.sqrt(d)
+        qs = q * scale
+
+        def step_attn(src, k_cur, v_cur, mask_cur):
+            sc = jnp.einsum("bhsd,bhtd->bhst", qs, k_cur)
+            if mask_cur is not None:
+                sc = sc + mask_cur
             if causal:
-                o_t, lse_t = lax.cond(
-                    src > rank, skip,
-                    lambda op: lax.cond(src == rank, diag, dense, op),
-                    None)
-            else:
-                o_t, lse_t = dense(None)
-            # exact partial merge via per-step logsumexp
-            m_new = jnp.maximum(m_prev, lse_t)
-            alpha = jnp.exp(m_prev - m_new)
-            w = jnp.exp(lse_t - m_new)
-            acc = acc * alpha[..., None] + o_t * w[..., None]
-            l_new = l_prev * alpha + w
-            k_next = lax.ppermute(k_cur, axis_name, perm)
-            v_next = lax.ppermute(v_cur, axis_name, perm)
-            mask_next = (None if mask_cur is None
-                         else lax.ppermute(mask_cur, axis_name, perm))
-            return (acc, m_new, l_new, k_next, v_next, mask_next), None
+                k_pos = src * s_loc + jnp.arange(s_loc)
+                vis = q_pos[:, None] >= k_pos[None, :]
+                sc = jnp.where(vis[None, None], sc, NEG_INF)
+            m_c = jnp.max(sc, axis=-1)
+            p = jnp.exp(sc - m_c[..., None])
+            l_c = jnp.sum(p, axis=-1)
+            l_safe = jnp.where(l_c == 0.0, 1.0, l_c)
+            o_t = jnp.einsum("bhst,bhtd->bhsd", p,
+                             v_cur) / l_safe[..., None]
+            return o_t, m_c + jnp.log(l_safe)
 
-        init = (jnp.zeros((b, h, s_loc, d), jnp.float32),
-                jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
-                jnp.zeros((b, h, s_loc), jnp.float32),
-                k, v, kv_mask)
-        (acc, m, l, *_), _ = lax.scan(flash_step, init,
-                                      jnp.arange(axis_size))
-        l = jnp.where(l == 0.0, 1.0, l)
-        return (acc / l[..., None]).astype(q.dtype)
+        wrap_remat = remat
 
-    def step(carry, t):
+    def body(carry, t):
         acc, m_prev, l_prev, k_cur, v_cur, mask_cur = carry
         # the K/V block currently held arrived from rank (rank - t) mod W
         src = (rank - t) % axis_size
-        sc = jnp.einsum("bhsd,bhtd->bhst", qs, k_cur)
-        if mask_cur is not None:
-            sc = sc + mask_cur
-        if causal:
-            k_pos = src * s_loc + jnp.arange(s_loc)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            sc = jnp.where(mask[None, None], sc, NEG_INF)
-        m_cur = jnp.max(sc, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(sc - m_new[..., None])
+        o_t, lse_t = step_attn(src, k_cur, v_cur, mask_cur)
+        # exact partial merge via per-step logsumexp
+        m_new = jnp.maximum(m_prev, lse_t)
         alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum("bhst,bhtd->bhsd", p, v_cur)
+        w = jnp.exp(lse_t - m_new)
+        acc = acc * alpha[..., None] + o_t * w[..., None]
+        l_new = l_prev * alpha + w
         # rotate K/V (and the key mask) one hop around the ICI ring
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
@@ -137,11 +129,12 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
                      else lax.ppermute(mask_cur, axis_name, perm))
         return (acc, m_new, l_new, k_next, v_next, mask_next), None
 
+    if wrap_remat:
+        body = jax.checkpoint(body)
     init = (jnp.zeros((b, h, s_loc, d), jnp.float32),
             jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
             jnp.zeros((b, h, s_loc), jnp.float32),
             k, v, kv_mask)
-    body = jax.checkpoint(step) if remat else step
     (acc, m, l, *_), _ = lax.scan(body, init, jnp.arange(axis_size))
     # fully-masked rows (l == 0) normalize to 0, not NaN
     l = jnp.where(l == 0.0, 1.0, l)

@@ -228,11 +228,11 @@ class ParallelMHA(Layer):
                                      use_flash=self.use_flash)
         else:
             # pallas_call has no GSPMD partitioning rule: under an active
-            # sharded plan the fused einsum path (auto-partitioned
-            # head-locally) is the correct kernel; flash is a
-            # single-device lever (BertModel raises at construction for
-            # the same combination — here mid-forward we warn and fall
-            # back so an auto-selected attn_impl keeps training)
+            # sharded plan WITHOUT a seq axis the fused einsum path
+            # (auto-partitioned head-locally) is the correct kernel —
+            # warn and fall back so an auto-selected attn_impl keeps
+            # training (with a seq axis, the branch above runs the
+            # flash kernel per ring step inside shard_map)
             use_flash = self.use_flash and not (
                 plan is not None and sharding.plan_active())
             if self.use_flash and not use_flash \

@@ -136,7 +136,7 @@ def _exec_nodes(nodes, env, skip_consts=()):
         if node.op_type == "Constant" and node.output \
                 and node.output[0] in skip_consts:
             continue  # pre-evaluated at prepare time
-        if node.op_type in ("If", "Loop"):
+        if node.op_type in ("If", "Loop", "Scan"):
             outs = _exec_control_flow(node, env)
         else:
             handler = _ONNX_OPS.get(node.op_type)
@@ -196,6 +196,8 @@ def _exec_control_flow(node, env):
     the trace (a traced M or traced exit condition raises — use
     ``lax.scan`` via the native API for that regime)."""
     attrs = node.attrs()
+    if node.op_type == "Scan":
+        return _exec_scan(node, env)
     if node.op_type == "If":
         cond = env[node.input[0]]
         then_g, else_g = attrs["then_branch"], attrs["else_branch"]
@@ -284,6 +286,57 @@ def _exec_control_flow(node, env):
             "are not supported")
     stacked = [autograd.cat(s, axis=0) for s in scans]
     return carried + stacked
+
+
+def _exec_scan(node, env):
+    """ONNX Scan (completes the control-flow trio with If/Loop): a
+    recurrence with M loop-carried states and N sequence inputs whose
+    trip count is the scan axis LENGTH — always static under tracing,
+    so the unrolled taped execution is exact, jit-safe, and
+    differentiable.  Supports scan_input/output_axes and forward/
+    reverse directions."""
+    attrs = node.attrs()
+    body = attrs["body"]
+    n_scan_in = int(attrs["num_scan_inputs"])
+    ins = [env[i] for i in node.input]
+    n_state = len(ins) - n_scan_in
+    states = list(ins[:n_state])
+    xs = ins[n_state:]
+    in_axes = list(attrs.get("scan_input_axes") or [0] * n_scan_in)
+    in_dirs = list(attrs.get("scan_input_directions") or [0] * n_scan_in)
+    trip = xs[0].shape[in_axes[0]]
+    if trip == 0:
+        raise NotImplementedError(
+            "sonnx Scan: zero-length scan axis (empty scan outputs) is "
+            "not supported")
+    scans = None
+    for t in range(trip):
+        slices = []
+        for x, ax, dr in zip(xs, in_axes, in_dirs):
+            idx = trip - 1 - t if dr else t
+            slices.append(autograd._op(
+                lambda a, idx, ax: jnp.take(a, idx, axis=ax),
+                x, _name="ScanSlice", idx=idx, ax=ax))
+        outs = _run_subgraph(body, env, states + slices)
+        states = list(outs[:n_state])
+        youts = outs[n_state:]
+        if scans is None:
+            scans = [[] for _ in youts]
+        for j, y in enumerate(youts):
+            scans[j].append(y)
+    scans = scans or []
+    k = len(scans)
+    out_axes = list(attrs.get("scan_output_axes") or [0] * k)
+    out_dirs = list(attrs.get("scan_output_directions") or [0] * k)
+    stacked = []
+    for ys, ax, dr in zip(scans, out_axes, out_dirs):
+        if dr:
+            ys = ys[::-1]
+        if ax < 0:  # negative axes are relative to the STACKED rank
+            ax += len(ys[0].shape) + 1
+        ys = [autograd.unsqueeze(y, ax) for y in ys]
+        stacked.append(autograd.cat(ys, axis=ax))
+    return states + stacked
 
 
 class SingaBackend:
@@ -641,7 +694,7 @@ def _h_global_avg_pool(node, args):
 # need the enclosing env for outer-scope capture, so they live outside
 # the flat handler table); the conformance sweep counts them as
 # supported ops
-_CONTROL_FLOW_OPS = ("If", "Loop")
+_CONTROL_FLOW_OPS = ("If", "Loop", "Scan")
 
 _ONNX_OPS = {
     "Add": _handle_binary(jnp.add),

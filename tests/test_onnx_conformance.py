@@ -200,7 +200,25 @@ CASES = {
          "v0": A},
         {"body": _loop_body_graph()},
         (), [4.0 * A, np.stack([2.0 * A, 3.0 * A, 4.0 * A])]),
+    "Scan": lambda: (
+        # cumulative sum: state' = state + x_t, scan output = state'
+        {"s0": np.zeros(3, np.float32), "xs": A},
+        {"body": _scan_body_graph(), "num_scan_inputs": 1},
+        (), [A.sum(axis=0), np.cumsum(A, axis=0)]),
 }
+
+
+def _scan_body_graph():
+    """Scan body (s_in, x_t) -> (s_out, y_t): s_out = s_in + x_t,
+    y_t = s_out."""
+    return GraphProto(
+        name="scan_body",
+        input=[ValueInfoProto(name="s_in"), ValueInfoProto(name="x_t")],
+        node=[NodeProto(op_type="Add", name="sb_add",
+                        input=["s_in", "x_t"], output=["s_out"]),
+              NodeProto(op_type="Identity", name="sb_id",
+                        input=["s_out"], output=["y_t"])],
+        output=[ValueInfoProto(name="s_out"), ValueInfoProto(name="y_t")])
 
 
 def _branch_graph(op, captured, const, tag):
@@ -291,7 +309,7 @@ def test_gelu_tanh_attribute_and_export_roundtrip():
 @pytest.mark.parametrize("op", sorted(CASES))
 def test_onnx_node_conformance(op):
     inputs, attrs, inits, golden = CASES[op]()
-    n_out = {"Split": 2, "Loop": 2}.get(op, 1)
+    n_out = {"Split": 2, "Loop": 2, "Scan": 2}.get(op, 1)
     outs = _run_node(op, inputs, attrs, n_out=n_out, initializers=inits)
 
     if golden is None and op == "Split":

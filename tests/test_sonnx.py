@@ -546,3 +546,77 @@ def test_imported_bn_model_trains_in_graph_mode(dev):
     out1 = tensor.to_numpy(tm.forward(x))
     assert not np.allclose(out0, out1), \
         "eval ignores promoted BN running stats"
+
+
+def _scan_cumsum_model(reverse=False):
+    """Scan with one state and one sequence input: state' = state + x_t,
+    scan output = state' (i.e. cumulative sum along axis 0)."""
+    body = onnx_pb.GraphProto(
+        name="body",
+        input=[onnx_pb.ValueInfoProto(name="s_in"),
+               onnx_pb.ValueInfoProto(name="x_t")],
+        node=[onnx_pb.NodeProto(op_type="Add", input=["s_in", "x_t"],
+                                output=["s_out"]),
+              onnx_pb.NodeProto(op_type="Identity", input=["s_out"],
+                                output=["y_t"])],
+        output=[onnx_pb.ValueInfoProto(name="s_out"),
+                onnx_pb.ValueInfoProto(name="y_t")])
+    attrs = [onnx_pb.AttributeProto.make("body", body),
+             onnx_pb.AttributeProto.make("num_scan_inputs", 1)]
+    if reverse:
+        attrs.append(onnx_pb.AttributeProto.make(
+            "scan_input_directions", [1]))
+        attrs.append(onnx_pb.AttributeProto.make(
+            "scan_output_directions", [1]))
+    scan = onnx_pb.NodeProto(op_type="Scan", input=["s0", "x"],
+                             output=["s_final", "ys"],
+                             attribute=attrs)
+    g = onnx_pb.GraphProto(
+        name="g",
+        input=[onnx_pb.ValueInfoProto(name="s0"),
+               onnx_pb.ValueInfoProto(name="x")],
+        node=[scan],
+        output=[onnx_pb.ValueInfoProto(name="s_final"),
+                onnx_pb.ValueInfoProto(name="ys")])
+    return onnx_pb.ModelProto(graph=g)
+
+
+def test_scan_cumsum(dev):
+    rep = sonnx.prepare(_scan_cumsum_model(), dev)
+    x = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    s0 = np.zeros((3,), np.float32)
+    s_final, ys = rep.run([s0, x])
+    np.testing.assert_allclose(tensor.to_numpy(ys), np.cumsum(x, axis=0),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(tensor.to_numpy(s_final), x.sum(axis=0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scan_reverse_direction(dev):
+    rep = sonnx.prepare(_scan_cumsum_model(reverse=True), dev)
+    x = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+    s0 = np.zeros((2,), np.float32)
+    s_final, ys = rep.run([s0, x])
+    # reverse scan: iterate from the end; outputs re-reversed
+    expect = np.cumsum(x[::-1], axis=0)[::-1]
+    np.testing.assert_allclose(tensor.to_numpy(ys), expect,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(tensor.to_numpy(s_final), x.sum(axis=0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scan_differentiable(dev):
+    """Imported Scan recurrences must train like everything else."""
+    autograd.set_training(True)
+    try:
+        rep = sonnx.prepare(_scan_cumsum_model(), dev)
+        x_t = tensor.from_numpy(
+            np.random.RandomState(2).randn(5, 3).astype(np.float32), dev)
+        x_t.requires_grad = x_t.stores_grad = True
+        s0 = tensor.from_numpy(np.zeros((3,), np.float32), dev)
+        _, ys = rep.run([s0, x_t])
+        loss = autograd.reduce_sum(autograd.mul(ys, ys))
+        grads = dict(autograd.backward(loss))
+        assert x_t in grads and grads[x_t].shape == x_t.shape
+    finally:
+        autograd.set_training(False)
