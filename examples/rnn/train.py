@@ -63,7 +63,8 @@ def run(args):
     print(f"corpus: {len(corpus.raw)} chars, vocab {corpus.vocab_size}")
 
     m = CharRNN(corpus.vocab_size, hidden_size=args.hidden_size,
-                num_layers=args.num_layers, seq_length=args.seq_length)
+                num_layers=args.num_layers, seq_length=args.seq_length,
+                cell=args.cell)
     m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
     x0 = tensor.Tensor((args.batch_size, args.seq_length, corpus.vocab_size),
                        dev)
@@ -95,6 +96,8 @@ if __name__ == "__main__":
     p.add_argument("--seq-length", type=int, default=64)
     p.add_argument("--hidden-size", type=int, default=128)
     p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--cell", default="lstm",
+                   choices=["lstm", "gru", "vanilla_tanh", "vanilla_relu"])
     p.add_argument("--lr", type=float, default=0.5)
     p.add_argument("--use-graph", action="store_true", default=False)
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")

@@ -9,13 +9,20 @@ from .. import autograd, layer, model, tensor
 
 class CharRNN(model.Model):
     def __init__(self, vocab_size, hidden_size=256, num_layers=2,
-                 seq_length=100):
+                 seq_length=100, cell="lstm"):
         super().__init__()
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.seq_length = seq_length
-        self.lstm = layer.LSTM(hidden_size, num_layers=num_layers,
-                               batch_first=True)
+        # cell: any of the reference cuDNN RNN modes (ops/rnn.py) —
+        # lstm / gru / vanilla_tanh / vanilla_relu
+        cls = {"lstm": layer.LSTM, "gru": layer.GRU,
+               "vanilla_tanh": lambda *a, **k: layer.RNN(
+                   *a, nonlinearity="tanh", **k),
+               "vanilla_relu": lambda *a, **k: layer.RNN(
+                   *a, nonlinearity="relu", **k)}[cell]
+        self.lstm = cls(hidden_size, num_layers=num_layers,
+                        batch_first=True)
         self.dense = layer.Linear(vocab_size)
         self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
 

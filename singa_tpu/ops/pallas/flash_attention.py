@@ -486,11 +486,15 @@ def flash_attention_lse(q, k, v, mask=None, causal=False,
     if causal:
         cm = jnp.tril(jnp.ones((s, s), bool))
         sc = jnp.where(cm[None, None], sc, NEG_INF)
-    m = jnp.max(sc, axis=-1, keepdims=True)
+    # NEG_INF floor: -inf-masked full rows must yield p=0/lse=NEG_INF,
+    # not exp(-inf - -inf) = NaN
+    m = jnp.maximum(jnp.max(sc, axis=-1, keepdims=True), NEG_INF)
     p = jnp.exp(sc - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhst,bhtd->bhsd", p / l, v.astype(jnp.float32))
-    return o.astype(q.dtype), (m + jnp.log(l))[..., 0]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhst,bhtd->bhsd", p / l_safe,
+                   v.astype(jnp.float32))
+    return o.astype(q.dtype), (m + jnp.log(l_safe))[..., 0]
 
 
 def flash_attention_op(q, k, v, mask=None, causal=False, remat=False):

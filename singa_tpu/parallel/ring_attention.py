@@ -101,7 +101,10 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
                 k_pos = src * s_loc + jnp.arange(s_loc)
                 vis = q_pos[:, None] >= k_pos[None, :]
                 sc = jnp.where(vis[None, None], sc, NEG_INF)
-            m_c = jnp.max(sc, axis=-1)
+            # clamp: a -inf-masked full row would give m_c = -inf and
+            # p = exp(-inf - -inf) = NaN; with the floor the row yields
+            # p = 0, lse_t = NEG_INF and drops out of the merge
+            m_c = jnp.maximum(jnp.max(sc, axis=-1), NEG_INF)
             p = jnp.exp(sc - m_c[..., None])
             l_c = jnp.sum(p, axis=-1)
             l_safe = jnp.where(l_c == 0.0, 1.0, l_c)

@@ -305,6 +305,11 @@ def _exec_scan(node, env):
     in_axes = list(attrs.get("scan_input_axes") or [0] * n_scan_in)
     in_dirs = list(attrs.get("scan_input_directions") or [0] * n_scan_in)
     trip = xs[0].shape[in_axes[0]]
+    for x, ax in zip(xs, in_axes):
+        if x.shape[ax] != trip:
+            raise ValueError(
+                f"sonnx Scan: scan inputs disagree on trip count "
+                f"({x.shape[ax]} vs {trip})")
     if trip == 0:
         raise NotImplementedError(
             "sonnx Scan: zero-length scan axis (empty scan outputs) is "

@@ -22,11 +22,12 @@ B, S = 4, 8
 
 
 class TinyLM(model.Model):
-    def __init__(self, plan=None, causal=True):
+    def __init__(self, plan=None, causal=True, use_flash=False):
         super().__init__()
         self.embed = VocabParallelEmbedding(VOCAB, HIDDEN, plan)
         self.blocks = [
-            ParallelTransformerBlock(HEADS, INTER, plan, causal=causal)
+            ParallelTransformerBlock(HEADS, INTER, plan, causal=causal,
+                                     use_flash=use_flash)
             for _ in range(LAYERS)
         ]
         self.head = ColumnParallelLinear(VOCAB, plan, gather_output=True)
@@ -201,3 +202,74 @@ def test_create_mesh_axes():
     assert mesh.shape["pipe"] == 1 and mesh.shape["expert"] == 1
     with pytest.raises(ValueError):
         shd.create_mesh(dp=16, tp=16)
+
+
+def test_parallel_mha_flash_under_seq_plan_matches_serial():
+    """ParallelMHA(use_flash=True) under a seq-sharded plan routes each
+    ring step through the flash kernel; losses must match the serial
+    fused model (the policy BertLayer now delegates here)."""
+    mesh = shd.create_mesh(dp=1, tp=2, sp=4)
+    plan = shd.ShardingPlan(mesh)
+
+    serial = _compile(TinyLM(plan=None), False)
+    par = TinyLM(plan=plan, use_flash=True)
+    par.set_sharding_plan(plan)
+    _compile(par, True)
+    par.set_states({k: tensor.to_numpy(v)
+                    for k, v in serial.get_states().items()})
+    loss_s = _run_steps(serial)
+    loss_p = _run_steps(par)
+    np.testing.assert_allclose(loss_p, loss_s, rtol=2e-4, atol=2e-5)
+
+
+def test_parallel_mha_flash_without_seq_axis_warns_and_falls_back(caplog):
+    """No seq axis: the flash request is dropped with a one-shot warning
+    and the fused head-sharded path keeps training."""
+    import logging as _logging
+
+    mesh = shd.create_mesh(dp=2, tp=4)
+    plan = shd.ShardingPlan(mesh)
+    par = TinyLM(plan=plan, use_flash=True)
+    par.set_sharding_plan(plan)
+    with caplog.at_level(_logging.WARNING, logger="singa_tpu"):
+        _compile(par, True)
+        losses = _run_steps(par)
+    assert all(np.isfinite(losses))
+    assert any("use_flash ignored" in r.message for r in caplog.records)
+
+
+def test_ring_attention_inf_mask_no_nan():
+    """-inf additive masks (the jnp.where(pad, -inf, 0) idiom) must not
+    NaN the merge even when a whole rank's K/V shard is masked
+    (regression: the normalized-partial refactor computed
+    exp(-inf - -inf) before the NEG_INF clamp)."""
+    import jax.numpy as jnp
+    from singa_tpu.parallel.ring_attention import ring_self_attention
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n = len(jax.devices())
+    rng = np.random.RandomState(3)
+    b, h, s, d = 1, 2, 8 * n, 4
+    q = rng.randn(b, h, s, d).astype(np.float32)
+    k = rng.randn(b, h, s, d).astype(np.float32)
+    v = rng.randn(b, h, s, d).astype(np.float32)
+    mask = np.zeros((b, 1, 1, s), np.float32)
+    mask[:, :, :, -8:] = -np.inf  # masks the LAST rank's shard entirely
+    mesh = Mesh(np.asarray(jax.devices()), ("seq",))
+    spec = P(None, None, "seq", None)
+    mspec = P(None, None, None, "seq")
+    f = jax.shard_map(
+        lambda q_, k_, v_, m_: ring_self_attention(
+            q_, k_, v_, "seq", kv_mask=m_),
+        mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+        check_vma=False)
+    o = np.asarray(f(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                     jnp.asarray(mask)))
+    assert np.isfinite(o).all()
+    # matches the dense reference with the same -inf mask
+    import math as _math
+    sc = np.einsum("bhsd,bhtd->bhst", q, k) / _math.sqrt(d) + mask
+    sc = sc - sc.max(-1, keepdims=True)
+    p = np.exp(sc); p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhst,bhtd->bhsd", p, v)
+    np.testing.assert_allclose(o, ref, atol=2e-4)

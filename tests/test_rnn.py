@@ -203,3 +203,21 @@ def test_lstm_layer_use_pallas_flag(dev):
     x = tensor.from_numpy(np.random.RandomState(1).randn(2, 5, 3).astype(np.float32), dev)
     y, _ = lstm(x)
     assert y.shape == (2, 5, 8)
+
+
+def test_charrnn_gru_and_vanilla_cells(dev):
+    """The char-RNN model accepts every reference cuDNN RNN mode."""
+    from singa_tpu.models.char_rnn import CharRNN, one_hot
+
+    for cell in ("gru", "vanilla_tanh", "vanilla_relu"):
+        dev.SetRandSeed(0)
+        m = CharRNN(20, hidden_size=16, num_layers=1, seq_length=8,
+                    cell=cell)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 20, (4, 8))
+        x = tensor.from_numpy(one_hot(ids, 20), dev)
+        y = tensor.from_numpy(np.roll(ids, -1, 1).astype(np.int32), dev)
+        m.compile([x], is_train=True, use_graph=False)
+        losses = [float(m(x, y)[1].data) for _ in range(5)]
+        assert losses[-1] < losses[0], (cell, losses)
