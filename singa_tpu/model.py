@@ -26,6 +26,7 @@ advances deterministically inside the compiled step).
 from __future__ import annotations
 
 import os
+import tempfile
 import zipfile
 import io as _io
 
@@ -36,6 +37,11 @@ from jax.sharding import PartitionSpec as P
 
 from . import autograd, layer, tensor
 from .tensor import Tensor
+
+# captured once at import (single-threaded): background save threads
+# must not race os.umask(), which is process-global
+_UMASK = os.umask(0)
+os.umask(_UMASK)
 
 # registry of graph runners (for Device.ResetGraph / PrintTimeProfiling)
 _graph_runners = []
@@ -226,13 +232,30 @@ class Model(layer.Layer):
 
         def _write():
             states = {k: _host_array(v) for k, v in captured.items()}
-            tmp = fpath + ".tmp"
-            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
-                for k, v in states.items():
-                    buf = _io.BytesIO()
-                    np.save(buf, v)
-                    zf.writestr(k + ".npy", buf.getvalue())
-            os.replace(tmp, fpath)
+            # unique temp per call: two overlapping async saves to the
+            # same fpath must not interleave writes into one temp file
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(fpath) + ".",
+                suffix=".tmp",
+                dir=os.path.dirname(os.path.abspath(fpath)) or ".",
+            )
+            try:
+                # mkstemp creates 0600; restore umask-derived mode so the
+                # checkpoint stays as readable as a plain open() would be
+                os.fchmod(fd, 0o666 & ~_UMASK)
+                with os.fdopen(fd, "wb") as fh:
+                    with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
+                        for k, v in states.items():
+                            buf = _io.BytesIO()
+                            np.save(buf, v)
+                            zf.writestr(k + ".npy", buf.getvalue())
+                os.replace(tmp, fpath)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
         if not async_save:
             _write()

@@ -40,11 +40,18 @@ measured fwd+bwd time now BEATS the fused path on the real chip
 tiles exceed VMEM).  LONGCTX.json carries the end-to-end training
 crossover table.
 
-Supports an optional additive key mask of shape (B·H, S) (e.g. BERT's
-padding mask) and a causal flag.  D (head dim) must be ≤ 128 and S a
-multiple of the block sizes; ops/attention.py falls back to the
-blockwise-scan reference otherwise (whose VJP is the old O(S²) path —
-fine at the short S where it is used).
+**Shape generality (round 4).**  The wrapper pads S up to the next
+multiple of 128 (tail keys masked to −∞ through the key-mask input,
+tail query rows sliced off — their cotangent pads back as zeros) and D
+up to the next multiple of 128 (zero columns cancel in the dot
+products; the softmax scale stays 1/sqrt(D_original)), so EVERY shape
+keeps the O(S·D)-backward kernel; the O(S²) fallbacks survive only
+behind ``force_reference`` (tests).  General per-query masks
+(broadcastable to (B, H, S, S)) stream through the kernels as an extra
+(block_q, block_k) mask tile; pure key masks (B, 1, 1, S) keep the
+cheaper (1, block_k) row layout.  Block sizes are capped at 512 when a
+general mask or a padded D>128 head is present so the extra VMEM tile
+fits.
 """
 
 from __future__ import annotations
@@ -84,9 +91,13 @@ def _apply_causal(s, qi, kj, block_q, block_k):
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, block_q,
-                block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, *rest, scale, causal,
+                block_q, block_k, has_qmask):
+    if has_qmask:
+        qmask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        qmask_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi, kj = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
 
@@ -109,6 +120,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         s = s + mask_ref[0, 0][None, :].astype(jnp.float32)
+        if has_qmask:
+            s = s + qmask_ref[0].astype(jnp.float32)
         if causal:
             s = _apply_causal(s, qi, kj, block_q, block_k)
         m_prev = m_ref[:, 0]
@@ -132,23 +145,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l_safe)
 
 
-def _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k):
-    """q,k,v: (BH, S, D); mask: (BH, S) additive.  Returns (o, lse) with
-    lse: (BH, 1, S) float32."""
+def _qmask_specs(qdiv, qmod, block_q, block_k, swap=False):
+    """BlockSpec for the (M, S, S) general-mask input.  ``(b // qdiv) %
+    qmod`` maps the grid's B·H index onto the mask's leading dim without
+    materializing broadcasts: M=1 → (1,1), M=B → (H,B), M=H → (1,H)
+    (per-head bias like ALiBi stays H-sized in HBM), M=B·H → (1,B·H).
+    ``swap=True`` for the dk/dv grid where the q block index is
+    innermost."""
+    if swap:
+        return pl.BlockSpec((1, block_q, block_k),
+                            lambda b, j, i: ((b // qdiv) % qmod, i, j))
+    return pl.BlockSpec((1, block_q, block_k),
+                        lambda b, i, j: ((b // qdiv) % qmod, i, j))
+
+
+def _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal, block_q,
+                      block_k, qmap):
+    """q,k,v: (BH, S, D); mask: (BH, S) additive key mask; qmask:
+    optional (M, S, S) additive general mask addressed by qmap =
+    (qdiv, qmod) (see _qmask_specs).  Returns (o, lse) with lse:
+    (BH, 1, S) float32."""
     bh, s, d = q.shape
-    scale = 1.0 / math.sqrt(d)
     grid = (bh, s // block_q, s // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               has_qmask=qmask is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+    ]
+    args = [q, k, v, mask[:, None, :]]
+    if qmask is not None:
+        in_specs.append(_qmask_specs(*qmap, block_q, block_k))
+        args.append(qmask)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -163,27 +198,34 @@ def _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, mask[:, None, :])
+    )(*args)
 
 
 # --------------------------------------------------------------- backward
 
 
-def _recompute_p(q, k, mask_row, lse_row, qi, kj, scale, causal,
-                 block_q, block_k):
+def _recompute_p(q, k, mask_row, qmask_tile, lse_row, qi, kj, scale,
+                 causal, block_q, block_k):
     """Recompute the (block_q, block_k) probability tile from saved
     logsumexp: p = exp(s·scale + mask − lse)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     s = s + mask_row[None, :].astype(jnp.float32)
+    if qmask_tile is not None:
+        s = s + qmask_tile.astype(jnp.float32)
     if causal:
         s = _apply_causal(s, qi, kj, block_q, block_k)
     return jnp.exp(s - lse_row[:, None])
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
-               dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+               *rest, scale, causal, block_q, block_k, has_qmask):
+    if has_qmask:
+        qmask_ref, dq_ref, dq_acc = rest
+    else:
+        qmask_ref = None
+        dq_ref, dq_acc = rest
     qi, kj = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
 
@@ -199,7 +241,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
     def _step():
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         do = do_ref[0]
-        p = _recompute_p(q, k, mask_ref[0, 0], lse_ref[0, 0], qi, kj,
+        p = _recompute_p(q, k, mask_ref[0, 0],
+                         None if qmask_ref is None else qmask_ref[0],
+                         lse_ref[0, 0], qi, kj,
                          scale, causal, block_q, block_k)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -214,8 +258,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
-                lse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
-                causal, block_q, block_k):
+                lse_ref, *rest, scale, causal, block_q, block_k,
+                has_qmask):
+    if has_qmask:
+        qmask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        qmask_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     kj, qi = pl.program_id(1), pl.program_id(2)
     n_q = pl.num_programs(2)
 
@@ -232,7 +281,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
     def _step():
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         do = do_ref[0]
-        p = _recompute_p(q, k, mask_ref[0, 0], lse_ref[0, 0], qi, kj,
+        p = _recompute_p(q, k, mask_ref[0, 0],
+                         None if qmask_ref is None else qmask_ref[0],
+                         lse_ref[0, 0], qi, kj,
                          scale, causal, block_q, block_k)
         # dv += pᵀ·dO  — contract the query dim without materializing pᵀ
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -252,10 +303,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal, block_q,
-                      block_k, dlse=None):
+def _flash_bwd_pallas(q, k, v, mask, qmask, o, lse, do, scale, causal,
+                      block_q, block_k, qmap, dlse=None):
     bh, s, d = q.shape
-    scale = 1.0 / math.sqrt(d)
     # δ = rowsum(dO ∘ O): one O(S·D) pass, shared by both kernels.
     # A direct cotangent on the logsumexp output enters the softmax
     # Jacobian as ds += p∘dlse, i.e. δ' = δ − dlse (ring attention's
@@ -265,42 +315,55 @@ def _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal, block_q,
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
     mask3 = mask[:, None, :]
+    has_qmask = qmask is not None
 
     dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
-                                  block_q=block_q, block_k=block_k)
+                                  block_q=block_q, block_k=block_k,
+                                  has_qmask=has_qmask)
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+    ]
+    dq_args = [q, k, v, mask3, do, delta, lse]
+    if has_qmask:
+        dq_in_specs.append(_qmask_specs(*qmap, block_q, block_k))
+        dq_args.append(qmask)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, s // block_q, s // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, mask3, do, delta, lse)
+    )(*dq_args)
 
     dkv_kernel = functools.partial(_dkv_kernel, scale=scale,
                                    causal=causal, block_q=block_q,
-                                   block_k=block_k)
+                                   block_k=block_k, has_qmask=has_qmask)
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+    ]
+    dkv_args = [q, k, v, mask3, do, delta, lse]
+    if has_qmask:
+        dkv_in_specs.append(_qmask_specs(*qmap, block_q, block_k,
+                                         swap=True))
+        dkv_args.append(qmask)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, s // block_k, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -314,36 +377,42 @@ def _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal, block_q,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, mask3, do, delta, lse)
+    )(*dkv_args)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, mask, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_core(q, k, v, mask, qmask, scale, causal, block_q, block_k,
+                qmap):
     """Differentiable (o, lse) pair — lse carries a real cotangent
     (ring attention's partial merge differentiates through it)."""
-    return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+    return _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal,
+                             block_q, block_k, qmap)
 
 
-def _flash_core_fwd(q, k, v, mask, causal, block_q, block_k):
-    o, lse = _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
-    return (o, lse), (q, k, v, mask, o, lse)
+def _flash_core_fwd(q, k, v, mask, qmask, scale, causal, block_q,
+                    block_k, qmap):
+    o, lse = _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal,
+                               block_q, block_k, qmap)
+    return (o, lse), (q, k, v, mask, qmask, o, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, res, cts):
-    q, k, v, mask, o, lse = res
+def _flash_core_bwd(scale, causal, block_q, block_k, qmap, res, cts):
+    q, k, v, mask, qmask, o, lse = res
     do, dlse = cts
-    dq, dk, dv = _flash_bwd_pallas(q, k, v, mask, o, lse, do, causal,
-                                   block_q, block_k, dlse=dlse)
-    return dq, dk, dv, None
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, mask, qmask, o, lse, do,
+                                   scale, causal, block_q, block_k,
+                                   qmap, dlse=dlse)
+    return dq, dk, dv, None, None
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def _flash(q, k, v, mask, causal, block_q, block_k):
+def _flash(q, k, v, mask, qmask, scale, causal, block_q, block_k, qmap):
     # o-only view: indexing the custom_vjp pair feeds dlse = 0
-    return _flash_core(q, k, v, mask, causal, block_q, block_k)[0]
+    return _flash_core(q, k, v, mask, qmask, scale, causal, block_q,
+                       block_k, qmap)[0]
 
 
 # ------------------------------------------------- non-kernel reference
@@ -351,10 +420,10 @@ def _flash(q, k, v, mask, causal, block_q, block_k):
 
 def _blockwise_reference(q, k, v, mask, causal, block_k):
     """Numerically identical online-softmax attention built from a
-    lax.scan over key blocks — the fallback for shapes the Mosaic kernel
-    rejects (unaligned S, D > 128).  NOTE its VJP reverses the scan by
-    saving per-step residuals (O(S²) backward memory) — acceptable only
-    at the short/odd S where this path is selected."""
+    lax.scan over key blocks — kept as the ``force_reference`` oracle the
+    kernel tests compare against.  NOTE its VJP reverses the scan by
+    saving per-step residuals (O(S²) backward memory) — never selected
+    automatically since the round-4 pad-to-block wrapper."""
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     qs = q * scale
@@ -388,14 +457,31 @@ def _blockwise_reference(q, k, v, mask, causal, block_k):
     return (acc / l[..., None]).astype(q.dtype)
 
 
+def _fused_reference(q, k, v, mask, causal):
+    """Plain softmax(QKᵀ)V with the full (broadcast) mask, f32 compute —
+    the ``force_reference`` oracle for general-mask shapes."""
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    sc = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) * scale
+    if mask is not None:
+        sc = sc + mask.astype(jnp.float32)
+    if causal:
+        cm = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(cm[None, None], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
 # ----------------------------------------------------------- public API
 
 
 def _fit_block(block, s):
     """Largest 128-multiple <= block that divides S (0 if none) — an S
     like 2560 must shrink to 512, not fall off the kernel onto the
-    O(S²)-backward scan fallback; a non-128-aligned S (Mosaic tile
-    constraint) yields 0 → fallback."""
+    O(S²)-backward scan fallback; S is always padded to a 128-multiple
+    first, so at least 128 fits."""
     block = min(block, s) // 128 * 128
     while block >= 128 and s % block != 0:
         block -= 128
@@ -404,7 +490,7 @@ def _fit_block(block, s):
 
 def _key_mask_flat(mask, b, h, s):
     """(B,1,1,S) additive key mask -> (B·H, S) kernel layout, or None
-    if the mask is not a pure key mask (kernel can't tile it)."""
+    if the mask is not a pure key mask."""
     if mask is None:
         return None
     if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
@@ -413,44 +499,107 @@ def _key_mask_flat(mask, b, h, s):
     return None
 
 
+def _general_mask_flat(mask, b, h, s):
+    """Additive mask broadcastable to (B, H, S, S) -> ((M, S, S),
+    (qdiv, qmod)) where ``(bh // qdiv) % qmod`` maps the kernel's B·H
+    grid index onto M, WITHOUT materializing the broadcast — a per-head
+    bias like ALiBi's (1, H, S, S) stays H-sized in HBM.  (None, None)
+    for layouts the kernel can't tile."""
+    if mask.ndim == 2:
+        mask = mask[None, None]
+    if mask.ndim != 4:
+        return None, None
+    b0, h0 = mask.shape[0], mask.shape[1]
+    if b0 not in (1, b) or h0 not in (1, h):
+        return None, None
+    mask = jnp.broadcast_to(mask, (b0, h0, s, s))
+    if h0 == 1:
+        # (1,1,S,S) -> (1, 1); (B,1,S,S) -> (H, B)
+        return mask[:, 0], ((b * h) // b0 if b0 > 1 else b * h, b0)
+    # (1,H,S,S) -> (1, H); (B,H,S,S) -> (1, B·H)
+    return mask.reshape(b0 * h, s, s), (1, b0 * h)
+
+
+def _pad_axis(x, target, axis, value=0.0):
+    pad = target - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _prep_kernel(q, k, v, mask, block_q, block_k):
+    """Pad to kernel-legal shapes and build the mask layouts.
+
+    Returns ``(qf, kf, vf, mf, qmask, qmap, scale, bq, bk)`` with
+    qf/kf/vf (B·H, S_pad, D_pad), mf (B·H, S_pad) f32 key mask (tail
+    keys −∞), qmask optional (M, S_pad, S_pad), or None if the mask
+    layout defeats the kernel (caller must use the fused reference)."""
+    b, h, s, d = q.shape
+    bh = b * h
+    scale = 1.0 / math.sqrt(d)                # original D, not padded
+    sp = -(-s // 128) * 128
+    dp = max(128, -(-d // 128) * 128)
+
+    mf_key = _key_mask_flat(mask, b, h, s)
+    qmask, qmap = None, None
+    if mask is not None and mf_key is None:
+        qmask, qmap = _general_mask_flat(mask, b, h, s)
+        if qmask is None:
+            return None
+    if qmask is not None:
+        # the extra (block_q, block_k) mask tile needs VMEM headroom
+        block_q, block_k = min(block_q, 512), min(block_k, 512)
+    if dp > 128:
+        # per-tile VMEM grows linearly with D (q/k/v/do tiles and the
+        # f32 accumulator scratches are (block, D)); shrink the block
+        # budget proportionally so wide heads still compile
+        cap = max(128, (512 * 128 // dp) // 128 * 128)
+        block_q, block_k = min(block_q, cap), min(block_k, cap)
+    bq, bk = _fit_block(block_q, sp), _fit_block(block_k, sp)
+
+    def flat_pad(x):
+        x = x.reshape(bh, s, d)
+        x = _pad_axis(x, sp, 1)
+        return _pad_axis(x, dp, 2)
+
+    qf, kf, vf = flat_pad(q), flat_pad(k), flat_pad(v)
+    mf = jnp.zeros((bh, s), jnp.float32) if mf_key is None \
+        else mf_key.astype(jnp.float32)
+    mf = _pad_axis(mf, sp, 1, value=NEG_INF)  # tail keys masked out
+    if qmask is not None:
+        qmask = _pad_axis(_pad_axis(qmask, sp, 1), sp, 2)
+    return qf, kf, vf, mf, qmask, qmap, scale, bq, bk
+
+
 def flash_attention(q, k, v, mask=None, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     force_reference=False):
     """q,k,v: (B, H, S, D) raw jax arrays; mask: additive, broadcastable
-    to (B, H, S, S) but only key-mask shapes (B, 1, 1, S) are accepted by
-    the kernel path.  Returns (B, H, S, D)."""
+    to (B, H, S, S) — key masks (B, 1, 1, S) take the cheap row layout,
+    anything else streams as (block_q, block_k) tiles.  Any S and D are
+    accepted (padded to kernel-legal shapes internally).  Returns
+    (B, H, S, D)."""
     b, h, s, d = q.shape
-    block_q = _fit_block(block_q, s)
-    block_k = _fit_block(block_k, s)
-    kernel_ok = block_q > 0 and block_k > 0
-    if not kernel_ok:
-        block_k = s  # the blockwise fallback only needs block_k | S
-    bh = b * h
-    qf = q.reshape(bh, s, d)
-    kf = k.reshape(bh, s, d)
-    vf = v.reshape(bh, s, d)
-    if mask is None:
-        mf = jnp.zeros((bh, s), q.dtype)
-    else:
+    prep = None if force_reference else _prep_kernel(
+        q, k, v, mask, block_q, block_k)
+    if prep is None:
         mf = _key_mask_flat(mask, b, h, s)
-        if mf is None:  # general mask: kernel can't tile it
-            force_reference = True
-    use_kernel = not force_reference and d <= 128 and kernel_ok
-    if not use_kernel:
+        if mask is not None and mf is None:
+            return _fused_reference(q, k, v, mask, causal)
+        bk = _fit_block(block_k, s)
+        if bk == 0:
+            bk = s
+        bh = b * h
         if mf is None:
-            # general mask: fall back to fused jnp with the full mask
-            # (causal still applies — same semantics as the kernel path)
-            scale = 1.0 / math.sqrt(d)
-            sc = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale + mask
-            if causal:
-                cm = jnp.tril(jnp.ones((s, s), bool))
-                sc = jnp.where(cm[None, None], sc, NEG_INF)
-            p = jax.nn.softmax(sc, axis=-1)
-            return jnp.einsum("bhst,bhtd->bhsd", p, v)
-        o = _blockwise_reference(qf, kf, vf, mf, causal, block_k)
+            mf = jnp.zeros((bh, s), q.dtype)
+        o = _blockwise_reference(q.reshape(bh, s, d), k.reshape(bh, s, d),
+                                 v.reshape(bh, s, d), mf, causal, bk)
         return o.reshape(b, h, s, d)
-    o = _flash(qf, kf, vf, mf, causal, block_q, block_k)
-    return o.reshape(b, h, s, d)
+    qf, kf, vf, mf, qmask, qmap, scale, bq, bk = prep
+    o = _flash(qf, kf, vf, mf, qmask, scale, causal, bq, bk, qmap)
+    return o[:, :s, :d].reshape(b, h, s, d)
 
 
 def flash_attention_lse(q, k, v, mask=None, causal=False,
@@ -460,23 +609,17 @@ def flash_attention_lse(q, k, v, mask=None, causal=False,
     merge per-shard partial attentions exactly.  Differentiable in both
     outputs (the lse cotangent folds into the softmax Jacobian).
 
-    Kernel path for aligned shapes; a fused-jnp fallback (same math,
-    native jax autodiff) covers small/unaligned S, e.g. CPU-mesh tests.
-    ``mask``: additive key mask shaped (B, 1, 1, S) or None."""
+    Kernel path for every shape since the round-4 padding wrapper; the
+    fused-jnp fallback below survives only for mask layouts the kernel
+    can't tile (non-broadcastable ndim)."""
     b, h, s, d = q.shape
-    bq, bk = _fit_block(block_q, s), _fit_block(block_k, s)
-    mf = _key_mask_flat(mask, b, h, s)
-    # general (per-query) masks can't tile through the kernel — same
-    # guard as flash_attention; the fallback below handles them
-    kernel_ok = (d <= 128 and bq > 0 and bk > 0
-                 and (mask is None or mf is not None))
-    if kernel_ok:
-        bh = b * h
-        qf, kf, vf = (x.reshape(bh, s, d) for x in (q, k, v))
-        if mf is None:
-            mf = jnp.zeros((bh, s), q.dtype)
-        o, lse = _flash_core(qf, kf, vf, mf, causal, bq, bk)
-        return o.reshape(b, h, s, d), lse[:, 0, :].reshape(b, h, s)
+    prep = _prep_kernel(q, k, v, mask, block_q, block_k)
+    if prep is not None:
+        qf, kf, vf, mf, qmask, qmap, scale, bq, bk = prep
+        o, lse = _flash_core(qf, kf, vf, mf, qmask, scale, causal, bq,
+                             bk, qmap)
+        return (o[:, :s, :d].reshape(b, h, s, d),
+                lse[:, 0, :s].reshape(b, h, s))
     # fallback: fused jnp with explicit logsumexp (jax autodiff)
     scale = 1.0 / math.sqrt(d)
     sc = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),

@@ -56,8 +56,12 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     mode), so this composes the single-chip flash win with sequence
     parallelism.  Causal steps specialize per block position (above the
     diagonal: skipped entirely; on it: causal kernel; below: dense
-    kernel).  ``remat`` is ignored here — the flash backward already
-    recomputes blockwise."""
+    kernel).  ``remat`` is ignored here because the kernel's custom VJP
+    already recomputes probabilities blockwise from the saved logsumexp
+    — and since the round-4 pad-to-block wrapper, flash_attention_lse
+    takes the kernel path at EVERY (S_local, D), so the O(S_local·D)
+    backward-memory guarantee holds unconditionally (the old jnp
+    fallback that betrayed it on unaligned shapes is gone)."""
     axis_size = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape

@@ -123,7 +123,7 @@ def test_flash_block_fit_nonpow2_seqlen():
     q, k, v = _qkv(b=1, h=2, s=384)
     called = []
     orig = fa._flash
-    fa._flash = lambda *a: called.append(a[-2:]) or orig(*a)
+    fa._flash = lambda *a: called.append(a[7:9]) or orig(*a)
     try:
         o = flash_attention(q, k, v, causal=True)
     finally:
@@ -138,6 +138,96 @@ def test_flash_block_fit_nonpow2_seqlen():
     assert np.isfinite(np.asarray(g)).all()
 
 
+def test_flash_unaligned_seqlen_stays_on_kernel():
+    """Arbitrary S (1537 — not a 128-multiple) pads to the next block
+    multiple inside the wrapper and keeps the O(S·D)-backward kernel:
+    fwd + grads must match the dense reference exactly on real rows."""
+    from singa_tpu.ops.pallas import flash_attention as fa
+
+    q, k, v = _qkv(b=1, h=2, s=1537 if N_DEV == 1 else 257)
+    s = q.shape[2]
+    called = []
+    orig = fa._flash
+    fa._flash = lambda *a: called.append(a[0].shape) or orig(*a)
+    try:
+        o = flash_attention(q, k, v, causal=True)
+    finally:
+        fa._flash = orig
+    assert called and called[0][1] % 128 == 0, called  # padded, on-kernel
+    cm = jnp.where(jnp.arange(s)[:, None] >= jnp.arange(s)[None, :],
+                   0.0, -1e30)[None, None]
+    np.testing.assert_allclose(np.asarray(o), np.asarray(_ref(q, k, v, cm)),
+                               atol=2e-3)
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2))(q)
+    g_ref = jax.grad(lambda q: jnp.sum(_ref(q, k, v, cm) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_flash_general_mask_through_kernel():
+    """A per-query (B, 1, S, S) additive mask streams through the kernel
+    as (block_q, block_k) tiles instead of forcing the O(S²) fused
+    fallback; (B, H, S, S) takes the flattened layout."""
+    from singa_tpu.ops.pallas import flash_attention as fa
+
+    for mask_shape in [(2, 1, 256, 256), (2, 2, 256, 256),
+                       (1, 1, 256, 256), (1, 2, 256, 256)]:
+        q, k, v = _qkv(s=256)
+        rng = np.random.RandomState(7)
+        mask = jnp.asarray(
+            np.where(rng.rand(*mask_shape) > 0.2, 0.0, -1e9)
+            .astype(np.float32))
+        called = []
+        orig = fa._flash
+        fa._flash = lambda *a: called.append(a[4] is not None) or orig(*a)
+        try:
+            o = flash_attention(q, k, v, mask)
+        finally:
+            fa._flash = orig
+        assert called and called[0], (mask_shape, called)  # qmask path
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(_ref(q, k, v, mask)),
+                                   atol=2e-3)
+        g = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, mask) ** 2))(q)
+        g_ref = jax.grad(lambda q: jnp.sum(_ref(q, k, v, mask) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=5e-2, rtol=5e-2)
+
+
+def test_flash_wide_head_dim_padded():
+    """D = 192 (not a 128-multiple, > 128) pads to 256 with zero columns
+    — scores and softmax scale are unchanged, so output matches the
+    dense reference."""
+    q, k, v = _qkv(b=1, h=2, s=256, d=192)
+    o = flash_attention(q, k, v, causal=True)
+    cm = jnp.where(jnp.arange(256)[:, None] >= jnp.arange(256)[None, :],
+                   0.0, -1e30)[None, None]
+    np.testing.assert_allclose(np.asarray(o), np.asarray(_ref(q, k, v, cm)),
+                               atol=2e-3)
+    g = jax.grad(lambda k: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2))(k)
+    g_ref = jax.grad(lambda k: jnp.sum(_ref(q, k, v, cm) ** 2))(k)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_flash_unaligned_lse_matches():
+    """flash_attention_lse on an unaligned S: padded tail must not
+    perturb the real rows' logsumexp."""
+    from singa_tpu.ops.pallas.flash_attention import flash_attention_lse
+
+    q, k, v = _qkv(b=1, h=2, s=200)
+    o, lse = flash_attention_lse(q, k, v)
+    sc = jnp.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(64)
+    lse_ref = jax.scipy.special.logsumexp(sc, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(_ref(q, k, v)),
+                               atol=2e-3)
+
+
 def test_flash_logsumexp_residual():
     """The fwd kernel's second output (logsumexp) is what the backward
     recomputes probabilities from — it must match scipy's logsumexp."""
@@ -146,7 +236,8 @@ def test_flash_logsumexp_residual():
     q, k, v = _qkv(b=1, h=2, s=512)
     qf, kf, vf = (x.reshape(2, 512, 64) for x in (q, k, v))
     mask = jnp.zeros((2, 512), jnp.float32)
-    _, lse = _flash_fwd_pallas(qf, kf, vf, mask, False, 128, 128)
+    _, lse = _flash_fwd_pallas(qf, kf, vf, mask, None, 1 / math.sqrt(64),
+                               False, 128, 128, 1)
     sc = jnp.einsum("bsd,btd->bst", qf, kf) / math.sqrt(64)
     lse_ref = jax.scipy.special.logsumexp(sc, axis=-1)
     np.testing.assert_allclose(np.asarray(lse[:, 0, :]),
