@@ -7,8 +7,15 @@ and an OOM kills one cell, not the sweep — an OOM *is* a data point
 (the fused S x S path is EXPECTED to die first; flash's O(S·D) HBM
 footprint surviving it is the kernel's reason to exist).
 
-Tokens/step is held constant (B·S = 16·512) so throughput cells are
-comparable.  Output: LONGCTX.json + one summary line.
+Tokens/step is held constant (B·S = 16·512 = 8192) up to S=8192; at
+S=16384/32768 the batch floors at 1, so tokens/step grows to S (2x/4x
+nominal).  Every cell therefore records ``tokens_per_step`` and
+``step_ms_per_8k_tokens`` (= step_ms · 8192 / tokens_per_step) — the
+normalized column is the one that is like-for-like across all S;
+``tokens_per_sec`` is already per-token and needs no normalization.
+Peak-HBM cells at floored-batch S reflect the LARGER step (more
+tokens resident), which only understates the flash kernel's advantage.
+Output: LONGCTX.json + one summary line.
 
     python bench_longctx.py --out LONGCTX.json
 """
@@ -72,8 +79,11 @@ for fn, _n, _c in m._graph_runner._compiled.values():
         pass
 print("CELL " + json.dumps({
     "seqlen": seqlen, "impl": impl, "remat": remat, "batch": batch,
+    "tokens_per_step": batch * seqlen,
     "tokens_per_sec": round(batch * seqlen / dt, 1),
-    "step_ms": round(dt * 1e3, 2), **hbm,
+    "step_ms": round(dt * 1e3, 2),
+    "step_ms_per_8k_tokens": round(dt * 1e3 * 8192 / (batch * seqlen), 2),
+    **hbm,
     "loss": round(lv, 3)}), flush=True)
 """
 
@@ -128,8 +138,10 @@ def main():
                             "tokens_per_sec": best["tokens_per_sec"]})
     import jax
 
-    result = {"workload": "gpt2-small causal LM train, constant 8192 "
-                          "tokens/step, bf16 amp",
+    result = {"workload": "gpt2-small causal LM train, 8192 tokens/step "
+                          "(batch floors at 1 past S=8192 — see "
+                          "tokens_per_step / step_ms_per_8k_tokens per "
+                          "cell), bf16 amp",
               "backend": jax.devices()[0].device_kind,
               "cells": cells, "winner_by_seqlen": winners}
     with open(os.path.join(_REPO, args.out), "w") as f:

@@ -180,8 +180,9 @@ class GPT2LMHead(model.Model):
     # -- sampling (fixed-shape, jit-friendly: full-context forward per
     #    emitted token, like examples/rnn's fixed-shape sampling) --------
     def generate(self, prompt_ids, max_new_tokens=20, temperature=1.0,
-                 rng=None, use_cache=None):
-        """Greedy/temperature sampling. prompt_ids: np.ndarray (S0,).
+                 rng=None, use_cache=None, top_k=0, top_p=None):
+        """Greedy/temperature sampling with optional top-k / top-p
+        (nucleus) filtering. prompt_ids: np.ndarray (S0,).
 
         ``use_cache`` (default auto): dense single-device models whose
         generation fits n_positions decode through the KV-cached
@@ -199,6 +200,13 @@ class GPT2LMHead(model.Model):
                          and n0 + max_new_tokens <= self.cfg.n_positions)
         # .training only exists after train()/eval(); an un-compiled
         # model can still generate (the windowed path lazily inits)
+        # validate sampling params up front so BOTH paths (KV-cached and
+        # windowed) fail the same way — the windowed math would otherwise
+        # NaN on top_p=0 instead of raising
+        if top_k and top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         was_training = getattr(self, "training", False)
         self.eval()
         try:
@@ -207,7 +215,8 @@ class GPT2LMHead(model.Model):
 
                 return gpt2_decode.generate(
                     self, prompt_ids, max_new_tokens=max_new_tokens,
-                    temperature=temperature, rng=rng)
+                    temperature=temperature, rng=rng, top_k=top_k,
+                    top_p=top_p)
             ids = list(np.asarray(prompt_ids).tolist())
             ctx = self.cfg.n_positions
             wte = self.transformer.wte
@@ -231,7 +240,19 @@ class GPT2LMHead(model.Model):
                 if temperature <= 0:
                     nxt = int(np.argmax(last))
                 else:
-                    p = np.exp((last - last.max()) / temperature)
+                    logit = last.astype(np.float64) / temperature
+                    if top_k:
+                        kth = np.sort(logit)[-int(top_k)]
+                        logit = np.where(logit < kth, -np.inf, logit)
+                    if top_p is not None:
+                        order = np.argsort(-logit)
+                        sp = np.exp(logit[order] - logit[order][0])
+                        sp /= sp.sum()
+                        cum = np.cumsum(sp)
+                        keep = np.zeros(len(logit), bool)
+                        keep[order] = (cum - sp) < top_p
+                        logit = np.where(keep, logit, -np.inf)
+                    p = np.exp(logit - logit.max())
                     p /= p.sum()
                     r = rng or np.random
                     nxt = int(r.choice(len(p), p=p))

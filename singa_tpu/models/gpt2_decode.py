@@ -17,9 +17,12 @@ O(S²·T) total attention work.  This module is the idiomatic TPU design:
 The math mirrors the layer stack exactly (same fp32-stat LayerNorm,
 same tanh-approx gelu, same scale placement), and
 ``tests/test_gpt2.py`` asserts the cached step's logits equal the full
-forward's to tolerance at every position.  Dense single-device models
-only (no plan, no MoE) — sampling under a sharded plan still uses the
-windowed path.
+forward's to tolerance at every position.  Batched (possibly ragged)
+prompts decode lockstep in one executable (`jax.vmap` over the row
+core — per-row cache writes lower to scatters), with greedy,
+temperature, top-k, and top-p (nucleus) sampling.  Dense single-device
+models only (no plan, no MoE) — sampling under a sharded plan still
+uses the windowed path.
 """
 
 from __future__ import annotations
@@ -34,9 +37,14 @@ import numpy as np
 NEG_INF = -1e30
 
 
-def extract_params(m):
+def extract_params(m, dtype=None):
     """Pull the dense GPT2LMHead weight pytree (raw jax arrays).
-    Raises for MoE/plan variants — those sample via the windowed path."""
+    ``dtype`` (e.g. jnp.bfloat16) casts the float weights for inference
+    — decode is weight-read-bound, so bf16 weights ≈ double the
+    steady-state tokens/sec (measured 803 → 1604 on the v5e at the
+    bench config); LayerNorm statistics stay fp32 inside _ln either
+    way.  Raises for MoE/plan variants — those sample via the windowed
+    path."""
     t = m.transformer
     if m.plan is not None:
         raise ValueError("KV-cache decode is single-device (plan=None)")
@@ -59,9 +67,14 @@ def extract_params(m):
             w2=mlp.fc2.W.data, b2=mlp.fc2.b.data,
         ))
     head = None if m.cfg.tie_weights else m.lm_head.W.data
-    return dict(wte=t.wte.W.data, wpe=t.wpe.W.data, blocks=blocks,
-                lnf_s=t.ln_f.scale.data, lnf_b=t.ln_f.bias.data,
-                head=head)
+    params = dict(wte=t.wte.W.data, wpe=t.wpe.W.data, blocks=blocks,
+                  lnf_s=t.ln_f.scale.data, lnf_b=t.ln_f.bias.data,
+                  head=head)
+    if dtype is not None:
+        params = jax.tree.map(
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    return params
 
 
 def _ln(x, s, b, eps):
@@ -153,13 +166,36 @@ def prefill(params, ids, n_head, eps):
     return x, jnp.stack(ks), jnp.stack(vs)
 
 
-@partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
-                                   "greedy"))
-def generate_cached(params, ids, prompt_len, n_head, eps, n_new, ctx,
-                    greedy, temperature, key):
-    """One compiled prefill + lax.scan decode.  ids: (1, ctx) right-
-    padded prompt; returns (1, n_new) sampled token ids."""
-    hidden, kc, vc = prefill(params, ids, n_head, eps)
+def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p):
+    """One token from a (V,) logit row.  ``greedy``/``top_k``/
+    ``use_top_p`` are static; ``temperature``/``top_p`` are traced.
+    Filter order follows the de-facto standard (HF generate):
+    temperature → top-k → top-p (nucleus) → categorical."""
+    if greedy:
+        return jnp.argmax(logit).astype(jnp.int32)
+    logit = logit.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jax.lax.top_k(logit, top_k)[0][-1]
+        logit = jnp.where(logit < kth, NEG_INF, logit)
+    if use_top_p:
+        order = jnp.argsort(-logit)
+        sp = jax.nn.softmax(logit[order])
+        cum = jnp.cumsum(sp)
+        # smallest prefix with mass >= top_p: drop tokens whose
+        # *preceding* cumulative mass already reached it (the top-1
+        # token is always kept)
+        keep_sorted = (cum - sp) < top_p
+        keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+        logit = jnp.where(keep, logit, NEG_INF)
+    return jax.random.categorical(key, logit).astype(jnp.int32)
+
+
+def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
+                  n_head, eps, n_new, greedy, top_k, use_top_p):
+    """Single-prompt core: ids (ctx,) right-padded, returns (n_new,).
+    Batched decoding vmaps this over (ids, prompt_len, key) — the
+    per-row cache writes at differing positions lower to scatters."""
+    hidden, kc, vc = prefill(params, ids[None, :], n_head, eps)
     # caches preallocated at ctx; prefill already spans ctx here.
     # Vocab-project ONLY the last live row — (1, V), not (ctx, V)
     last_h = jax.lax.dynamic_index_in_dim(
@@ -167,11 +203,8 @@ def generate_cached(params, ids, prompt_len, n_head, eps, n_new, ctx,
     first_logit = _logits(last_h[:, None, :], params)[0, 0]  # (V,)
 
     def sample(logit, k):
-        if greedy:
-            return jnp.argmax(logit).astype(jnp.int32)
-        p = jax.nn.softmax(logit.astype(jnp.float32) / temperature)
-        return jax.random.categorical(
-            k, jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32)
+        return _sample(logit, k, temperature, top_p, greedy, top_k,
+                       use_top_p)
 
     k0, key = jax.random.split(key)
     tok0 = sample(first_logit, k0)
@@ -196,43 +229,96 @@ def generate_cached(params, ids, prompt_len, n_head, eps, n_new, ctx,
 
     (last, _, _, _, _), toks = jax.lax.scan(
         step, (tok0, prompt_len, kc, vc, key), None, length=n_new - 1)
-    return jnp.concatenate([toks, last[None]])[None, :]
+    return jnp.concatenate([toks, last[None]])
 
 
-def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None):
-    """KV-cached sampling for a dense GPT2LMHead.  Requires
-    prompt_len + max_new_tokens <= cfg.n_positions (the windowed
-    fallback in models/gpt2.py handles longer generations)."""
-    params = extract_params(m)
-    cfg = m.cfg
-    ids = np.asarray(prompt_ids, np.int32).reshape(-1)
-    n0 = len(ids)
-    if max_new_tokens <= 0:
-        return ids.copy()
-    if n0 + max_new_tokens > cfg.n_positions:
-        raise ValueError(
-            f"prompt ({n0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"n_positions ({cfg.n_positions}); use the windowed "
-            "GPT2LMHead.generate")
-    ctx = cfg.n_positions
-    window = np.zeros((1, ctx), np.int32)
-    window[0, :n0] = ids
+@partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
+                                   "greedy", "top_k", "use_top_p"))
+def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
+                    greedy, temperature, keys, top_k=0, top_p=1.0,
+                    use_top_p=False):
+    """One compiled prefill + lax.scan decode for a BATCH of prompts.
+    ids: (B, ctx) right-padded; prompt_lens: (B,) int32; keys: (B, 2)
+    PRNG keys.  Returns (B, n_new) sampled token ids.  ``top_k=0``
+    disables top-k; ``use_top_p`` gates nucleus sampling (static so the
+    sort compiles away when off)."""
+    row = partial(_generate_row, n_head=n_head, eps=eps, n_new=n_new,
+                  greedy=greedy, top_k=top_k, use_top_p=use_top_p)
+    return jax.vmap(
+        lambda i, n, k: row(params, i, n, k, temperature, top_p))(
+            ids, prompt_lens, keys)
+
+
+def _seed(temperature, rng):
     # rng=None must stay non-deterministic across calls like the
     # windowed sampler's np.random fallback; accept both RandomState
     # (.randint) and Generator (.integers); greedy decoding draws
     # nothing (the key is unused, and consuming the caller's rng would
     # perturb downstream reproducibility)
     if temperature <= 0:
-        seed = 0
-    elif rng is None:
-        seed = int(np.random.randint(0, 2 ** 31 - 1))
-    elif hasattr(rng, "integers"):
-        seed = int(rng.integers(0, 2 ** 31 - 1))
+        return 0
+    if rng is None:
+        return int(np.random.randint(0, 2 ** 31 - 1))
+    if hasattr(rng, "integers"):
+        return int(rng.integers(0, 2 ** 31 - 1))
+    return int(rng.randint(0, 2 ** 31 - 1))
+
+
+def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
+             top_k=0, top_p=None, dtype=None):
+    """KV-cached sampling for a dense GPT2LMHead.  Requires
+    prompt_len + max_new_tokens <= cfg.n_positions (the windowed
+    fallback in models/gpt2.py handles longer generations).
+
+    ``prompt_ids``: one 1-D prompt (returns a 1-D array) or a list/2-D
+    batch of prompts, possibly ragged (returns a list of 1-D arrays —
+    each its prompt + continuation; all rows decode lockstep in ONE
+    compiled executable).  ``top_k`` (int > 0) / ``top_p`` (0 < p ≤ 1)
+    filter the temperature-scaled distribution before sampling.
+    ``dtype=jnp.bfloat16`` runs inference in bf16 (≈2× steady-state
+    throughput; see extract_params)."""
+    params = extract_params(m, dtype=dtype)
+    cfg = m.cfg
+    if isinstance(prompt_ids, np.ndarray):
+        single = prompt_ids.ndim == 1
+        seq = [prompt_ids] if single else list(prompt_ids)
     else:
-        seed = int(rng.randint(0, 2 ** 31 - 1))
+        seq = list(prompt_ids)
+        # ragged batches defeat np.ndim on the whole object; classify
+        # by the first element instead
+        single = not seq or np.ndim(seq[0]) == 0
+        if single:
+            seq = [prompt_ids]
+    rows = [np.asarray(r, np.int32).reshape(-1) for r in seq]
+    if max_new_tokens <= 0:
+        out = [r.copy() for r in rows]
+        return out[0] if single else out
+    for r in rows:
+        if len(r) + max_new_tokens > cfg.n_positions:
+            raise ValueError(
+                f"prompt ({len(r)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds n_positions ({cfg.n_positions}); use the "
+                "windowed GPT2LMHead.generate")
+    if top_k and top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    ctx = cfg.n_positions
+    bsz = len(rows)
+    window = np.zeros((bsz, ctx), np.int32)
+    for i, r in enumerate(rows):
+        window[i, :len(r)] = r
+    lens = np.asarray([len(r) for r in rows], np.int32)
+    keys = jax.random.split(
+        jax.random.PRNGKey(_seed(temperature, rng)), bsz)
     new = generate_cached(
-        params, jnp.asarray(window), n0, cfg.n_head,
+        params, jnp.asarray(window), jnp.asarray(lens), cfg.n_head,
         float(cfg.layer_norm_eps), int(max_new_tokens), ctx,
-        temperature <= 0, jnp.float32(max(temperature, 1e-6)),
-        jax.random.PRNGKey(seed))
-    return np.concatenate([ids, np.asarray(new[0])]).astype(np.int32)
+        temperature <= 0, jnp.float32(max(temperature, 1e-6)), keys,
+        top_k=int(top_k or 0),
+        top_p=jnp.float32(1.0 if top_p is None else top_p),
+        use_top_p=top_p is not None)
+    new = np.asarray(new)
+    out = [np.concatenate([r, new[i]]).astype(np.int32)
+           for i, r in enumerate(rows)]
+    return out[0] if single else out

@@ -2,6 +2,7 @@
 (tp/sp + MoE blocks), generation."""
 
 import numpy as np
+import pytest
 
 from singa_tpu import tensor, opt
 from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
@@ -238,3 +239,130 @@ def test_generate_default_rng_not_deterministic():
                              temperature=1.0).tolist())
             for _ in range(4)}
     assert len(outs) > 1, "identical samples across calls"
+
+
+def test_batched_decode_matches_single_rows():
+    """Ragged batched KV-cache decoding (one vmapped executable) must
+    reproduce each prompt's single-row greedy decode token for token."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompts = [np.arange(9) % cfg.vocab_size,
+               (np.arange(4) + 3) % cfg.vocab_size,
+               (np.arange(13) * 2 + 1) % cfg.vocab_size]
+    batched = gpt2_decode.generate(m, prompts, max_new_tokens=6,
+                                   temperature=0)
+    assert isinstance(batched, list) and len(batched) == 3
+    for p, got in zip(prompts, batched):
+        single = gpt2_decode.generate(m, p, max_new_tokens=6,
+                                      temperature=0)
+        np.testing.assert_array_equal(got, single)
+        assert got[:len(p)].tolist() == p.tolist()
+
+
+def test_topk_decode_restricts_support():
+    """top_k=1 must equal greedy; top_k=k must only ever emit tokens
+    whose teacher-forced logit ranks in the top k at that step."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompt = np.arange(7) % cfg.vocab_size
+
+    g_greedy = m.generate(prompt, max_new_tokens=8, temperature=0)
+    g_k1 = m.generate(prompt, max_new_tokens=8, temperature=1.0,
+                      top_k=1, rng=np.random.RandomState(0))
+    np.testing.assert_array_equal(g_greedy, g_k1)
+
+    k = 3
+    out = gpt2_decode.generate(m, prompt, max_new_tokens=8,
+                               temperature=1.0, top_k=k,
+                               rng=np.random.RandomState(1))
+    # teacher-force the sampled sequence: every emitted token's logit
+    # must reach the k-th largest, within a margin covering the ~2e-3
+    # fp difference between the decode stack and m.forward (a hard
+    # membership check would flake on boundary ties)
+    m.eval()
+    window = np.zeros((1, cfg.n_positions), np.int32)
+    window[0, :len(out)] = out
+    logits = tensor.to_numpy(m.forward(tensor.from_numpy(window)))[0]
+    for t in range(len(prompt), len(out)):
+        step_logits = logits[t - 1]
+        kth = np.sort(step_logits)[-k]
+        assert step_logits[out[t]] >= kth - 5e-3, \
+            (t, out[t], float(step_logits[out[t]]), float(kth))
+
+
+def test_topp_decode_restricts_support():
+    """Tiny top_p must equal greedy; top_p=p must only emit tokens in
+    the smallest nucleus with mass >= p at each step."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompt = (np.arange(6) + 2) % cfg.vocab_size
+
+    g_greedy = m.generate(prompt, max_new_tokens=8, temperature=0)
+    g_p = m.generate(prompt, max_new_tokens=8, temperature=1.0,
+                     top_p=1e-6, rng=np.random.RandomState(0))
+    np.testing.assert_array_equal(g_greedy, g_p)
+
+    p_thresh = 0.6
+    out = gpt2_decode.generate(m, prompt, max_new_tokens=8,
+                               temperature=1.0, top_p=p_thresh,
+                               rng=np.random.RandomState(2))
+    m.eval()
+    window = np.zeros((1, cfg.n_positions), np.int32)
+    window[0, :len(out)] = out
+    logits = tensor.to_numpy(m.forward(tensor.from_numpy(window)))[0]
+    for t in range(len(prompt), len(out)):
+        lg = logits[t - 1].astype(np.float64)
+        probs = np.exp(lg - lg.max())
+        probs /= probs.sum()
+        # nucleus rule: kept iff the cumulative mass BEFORE the token
+        # (in prob-descending order) is < p.  Allow a small mass margin
+        # for the ~2e-3 logit difference between the decode stack and
+        # m.forward (hard membership would flake on boundary ties).
+        tok = int(out[t])
+        mass_before = float(probs[probs > probs[tok]].sum())
+        assert mass_before < p_thresh + 5e-3, \
+            (t, tok, mass_before, p_thresh)
+
+
+def test_decode_rejects_bad_sampling_params():
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompt = np.arange(4) % cfg.vocab_size
+    with pytest.raises(ValueError):
+        gpt2_decode.generate(m, prompt, max_new_tokens=2, top_p=0.0)
+    with pytest.raises(ValueError):
+        gpt2_decode.generate(m, prompt, max_new_tokens=2, top_p=1.5)
+
+
+def test_windowed_path_rejects_bad_sampling_params():
+    """The public generate() must raise the same ValueError on the
+    windowed fallback path as on the KV-cached path (the windowed math
+    would otherwise NaN on top_p=0)."""
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompt = np.arange(4) % cfg.vocab_size
+    for kw in ({"top_p": 0.0}, {"top_p": 1.5}, {"top_k": -2}):
+        with pytest.raises(ValueError):
+            m.generate(prompt, max_new_tokens=2, temperature=1.0,
+                       use_cache=False, **kw)
+        with pytest.raises(ValueError):
+            m.generate(prompt, max_new_tokens=2, temperature=1.0,
+                       use_cache=True, **kw)

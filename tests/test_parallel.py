@@ -273,3 +273,92 @@ def test_ring_attention_inf_mask_no_nan():
     p = np.exp(sc); p /= p.sum(-1, keepdims=True)
     ref = np.einsum("bhst,bhtd->bhsd", p, v)
     np.testing.assert_allclose(o, ref, atol=2e-4)
+
+
+def _peak_temp_bytes(m):
+    """Per-device temp (activation/residual) HBM of the compiled train
+    step, from XLA's static memory analysis — the quantity that bounds
+    the max trainable sequence length."""
+    best = 0
+    for entry in m._graph_runner._compiled.values():
+        fn = entry[0]
+        try:
+            ma = fn.memory_analysis()
+        except AttributeError:
+            continue
+        if ma is not None:
+            best = max(best, int(ma.temp_size_in_bytes))
+    assert best > 0, "no compiled executable with memory analysis"
+    return best
+
+
+def test_longctx_max_trainable_seqlen_scales_with_mesh():
+    """SURVEY §5.7 / round-3 verdict item 1b: the max trainable S scales
+    with the seq-mesh size.  At a fixed global S, the ring-attention
+    (sp=8, flash) training step needs a FRACTION of the single-device
+    fused step's per-device activation memory — so a global S whose
+    serial step exceeds one rank's HBM budget still trains when
+    sharded, and one sharded step runs to a finite loss here to prove
+    it compiles AND executes, not just partitions."""
+    S_long = 2048
+    ids = np.random.RandomState(0).randint(
+        0, VOCAB, size=(1, S_long)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    def build(plan, use_flash):
+        m = TinyLM(plan=plan, use_flash=use_flash)
+        if plan is not None:
+            m.set_sharding_plan(plan)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.compile([tensor.from_numpy(ids)], is_train=True,
+                  use_graph=True)
+        m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        return m
+
+    serial = build(None, use_flash=False)
+    serial_temp = _peak_temp_bytes(serial)
+
+    mesh = shd.create_mesh(sp=8)
+    ring = build(shd.ShardingPlan(mesh), use_flash=True)
+    ring_temp = _peak_temp_bytes(ring)
+
+    # the serial fused step materializes O(S^2) score/prob residuals;
+    # the ring step holds O(S_local * S) at worst.  Demand a >=4x
+    # per-rank saving at sp=8 (the asymptotic factor is ~W, but the
+    # model's S-independent weights/optimizer state dilute it at this
+    # toy size)
+    assert ring_temp * 4 <= serial_temp, (ring_temp, serial_temp)
+
+    # and the sharded step actually trains: finite loss on a real step
+    _, loss = ring(tensor.from_numpy(ids), tensor.from_numpy(labels))
+    assert np.isfinite(float(tensor.to_numpy(loss)))
+
+
+def test_longctx_ring_memory_linear_not_quadratic_in_seqlen():
+    """Companion growth-law check: as the global S grows with the mesh
+    (S_local fixed), per-rank ring memory grows ~LINEARLY (the O(S·D)
+    K/V hop residuals), while the serial fused step grows
+    ~quadratically (O(S²) score residuals).  Linear growth is what
+    makes S_max scale with W: W ranks buy a W-times-longer trainable
+    sequence at roughly constant per-rank headroom beyond the O(S·D)
+    term every attention impl pays to hold K/V at all."""
+    def temp_at(s_global, sp=None):
+        ids = np.random.RandomState(0).randint(
+            0, VOCAB, size=(1, s_global)).astype(np.int32)
+        labels = np.roll(ids, -1, axis=1).astype(np.int32)
+        plan = (None if sp is None
+                else shd.ShardingPlan(shd.create_mesh(sp=sp)))
+        m = TinyLM(plan=plan, use_flash=sp is not None)
+        if plan is not None:
+            m.set_sharding_plan(plan)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.compile([tensor.from_numpy(ids)], is_train=True,
+                  use_graph=True)
+        m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        return _peak_temp_bytes(m)
+
+    ring_ratio = temp_at(2048, sp=8) / temp_at(512, sp=2)
+    serial_ratio = temp_at(2048) / temp_at(512)
+    # 4x the sequence: linear growth ~4x, quadratic ~16x
+    assert ring_ratio < 6, ring_ratio
+    assert serial_ratio > 1.8 * ring_ratio, (serial_ratio, ring_ratio)
