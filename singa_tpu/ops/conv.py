@@ -72,6 +72,72 @@ def conv2d(x, W, b=None, stride=1, padding=0, dilation=1,
     return _op(f, x, W, b, _name="Conv2d", **kw)
 
 
+def conv_transpose2d(x, W, b=None, stride=1, padding=0, dilation=1,
+                     group=1, output_padding=0):
+    """Transposed (fractionally-strided) convolution, channels-first,
+    any spatial rank — the backward-data conv exposed as a forward op
+    (the reference wires cuDNN's ConvolutionBackwardData; here it is
+    one ``lax.conv_general_dilated`` with lhs_dilation = stride and a
+    spatially-flipped, group-transposed kernel, which XLA lowers onto
+    the MXU like any conv).
+
+    ``W`` uses the ONNX/torch ConvTranspose layout
+    (C_in, C_out/group, *kernel).  Output spatial size per dim:
+    (in-1)*stride - pad_lo - pad_hi + (k-1)*dilation + 1 + output_padding.
+    """
+    kernel = W.shape[2:]
+    n = len(kernel)
+    assert x.shape[2:] and len(x.shape[2:]) == n, (
+        f"input rank {len(x.shape)} does not match kernel rank {n + 2}")
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    output_padding = _tup(output_padding, n)
+    if not isinstance(padding, (tuple, list)):
+        padding = (padding,) * n
+    pads = tuple(p if isinstance(p, (tuple, list)) else (int(p), int(p))
+                 for p in padding)
+    assert len(pads) == n
+    keff = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilation))
+    # transposed-conv padding identity: lo' = k_eff-1-lo (negative pads
+    # crop, which lax accepts), plus output_padding on the high edge
+    tpads = tuple((ke - 1 - lo, ke - 1 - hi + op)
+                  for ke, (lo, hi), op in zip(keff, pads, output_padding))
+    spec = (0, 1) + tuple(range(2, 2 + n))
+    dnums = lax.ConvDimensionNumbers(lhs_spec=spec, rhs_spec=spec,
+                                     out_spec=spec)
+    g = int(group)
+
+    def f(xv, wv, *rest, stride=stride, pads=pads, dilation=dilation,
+          group=g, output_padding=output_padding, tpads=tpads):
+        xv, wv = amp.cast_in(xv, wv)
+        cin, cog = wv.shape[0], wv.shape[1]
+        # (C_in, C_out/g, k) -> (C_out, C_in/g, k): group i of the
+        # output reads group i of the input (transposed-conv grouping)
+        w = wv.reshape((group, cin // group, cog) + tuple(kernel))
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            (group * cog, cin // group) + tuple(kernel))
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        y = lax.conv_general_dilated(
+            xv, w,
+            window_strides=(1,) * n,
+            padding=tpads,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            feature_group_count=group,
+            dimension_numbers=dnums,
+        )
+        if rest:
+            bshape = (1, -1) + (1,) * n
+            y = y + amp.cast_in(rest[0]).reshape(bshape)
+        return y
+
+    kw = dict(stride=stride, pads=pads, dilation=dilation, group=g,
+              output_padding=output_padding)
+    if b is None:
+        return _op(f, x, W, _name="ConvTranspose2d", **kw)
+    return _op(f, x, W, b, _name="ConvTranspose2d", **kw)
+
+
 def _tup(v, n):
     if isinstance(v, (tuple, list)):
         assert len(v) == n, f"expected {n} values, got {v}"

@@ -695,6 +695,260 @@ def _h_global_avg_pool(node, args):
     return autograd.reduce_mean(args[0], axes=(2, 3), keepdims=True)
 
 
+def _h_conv_transpose(node, args):
+    from .ops import conv as conv_ops
+
+    a = node.attrs()
+    kernel = a.get("kernel_shape", list(args[1].shape[2:]))
+    n = len(kernel)
+    if a.get("auto_pad", "NOTSET") not in ("NOTSET", b"NOTSET"):
+        raise NotImplementedError(
+            "ONNX ConvTranspose auto_pad modes are not supported "
+            "(exporters emit explicit pads)")
+    if a.get("output_shape") is not None:
+        raise NotImplementedError(
+            "ONNX ConvTranspose output_shape is not supported; use "
+            "pads/output_padding")
+    pads = a.get("pads", [0] * 2 * n)
+    pairs = tuple((pads[i], pads[i + n]) for i in range(n))
+    return conv_ops.conv_transpose2d(
+        args[0], args[1], args[2] if len(args) > 2 else None,
+        stride=tuple(a.get("strides", [1] * n)), padding=pairs,
+        dilation=tuple(a.get("dilations", [1] * n)),
+        group=a.get("group", 1),
+        output_padding=tuple(a.get("output_padding", [0] * n)))
+
+
+def _h_argmax(node, args):
+    a = node.attrs()
+    axis = a.get("axis", 0)
+    keepdims = bool(a.get("keepdims", 1))
+    if a.get("select_last_index", 0):
+        raise NotImplementedError(
+            "ONNX ArgMax select_last_index=1 is not supported")
+    # int32, not int64: x64 is disabled in this runtime, so an int64
+    # cast would silently truncate anyway and warn on every call
+    return _op(lambda x: jnp.argmax(x, axis=axis,
+                                    keepdims=keepdims).astype(jnp.int32),
+               args[0], _name="ArgMax")
+
+
+def _h_topk(node, args):
+    a = node.attrs()
+    axis = a.get("axis", -1)
+    largest = bool(a.get("largest", 1))
+    if not a.get("sorted", 1):
+        raise NotImplementedError("ONNX TopK sorted=0 is not supported")
+    k = int(_np(args[1]).reshape(-1)[0])
+
+    def f(x):
+        y = jnp.moveaxis(x, axis, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(y, k)
+        else:
+            vals, idx = jax.lax.top_k(-y, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, axis),
+                jnp.moveaxis(idx.astype(jnp.int32), -1, axis))
+
+    return _op(f, args[0], _name="TopK")
+
+
+def _h_einsum(node, args):
+    eq = node.attrs()["equation"]
+    if isinstance(eq, bytes):
+        eq = eq.decode()
+    return _op(lambda *xs: jnp.einsum(eq, *xs), *args, _name="Einsum")
+
+
+# ---- ONNX RNN family -> ops/rnn.py packed-weight stack --------------------
+# Gate-order maps from ONNX's conventions onto the cuDNN order the
+# packed buffer uses (ops/rnn.py): LSTM iofc -> ifgo; GRU zrh -> rzn.
+_ONNX_GATE_PERM = {"lstm": [0, 2, 3, 1], "gru": [1, 0, 2],
+                   "vanilla_tanh": [0], "vanilla_relu": [0]}
+_ONNX_DEFAULT_ACTS = {
+    "lstm": ("sigmoid", "tanh", "tanh"),
+    "gru": ("sigmoid", "tanh"),
+    "vanilla_tanh": ("tanh",), "vanilla_relu": ("relu",)}
+
+
+def _h_rnn(onnx_kind):
+    def h(node, args):
+        from .ops import rnn as rnn_ops
+
+        a = node.attrs()
+        H = int(a["hidden_size"])
+        direction = a.get("direction", "forward")
+        if isinstance(direction, bytes):
+            direction = direction.decode()
+        n_dirs = 2 if direction == "bidirectional" else 1
+        if a.get("layout", 0):
+            raise NotImplementedError(
+                "ONNX RNN layout=1 is not supported (PyTorch/TF "
+                "exporters emit layout=0)")
+        if a.get("clip") is not None:
+            raise NotImplementedError("ONNX RNN clip is not supported")
+        if onnx_kind == "lstm":
+            if len(args) > 7 and args[7] is not None:
+                raise NotImplementedError(
+                    "ONNX LSTM peephole weights (input P) are not "
+                    "supported")
+            if a.get("input_forget", 0):
+                raise NotImplementedError(
+                    "ONNX LSTM input_forget=1 is not supported")
+        acts = a.get("activations")
+        if acts is not None:
+            acts = tuple(
+                (x.decode() if isinstance(x, bytes) else x).lower()
+                for x in acts)
+        mode = onnx_kind
+        if onnx_kind == "rnn":
+            per_dir_acts = acts or ("tanh",) * n_dirs
+            if len(set(per_dir_acts)) > 1:
+                raise NotImplementedError(
+                    "ONNX RNN with different activations per direction "
+                    f"({per_dir_acts}) is not supported")
+            first = per_dir_acts[0]
+            if first not in ("tanh", "relu"):
+                raise NotImplementedError(
+                    f"ONNX RNN activation {first!r} is not supported")
+            mode = "vanilla_relu" if first == "relu" else "vanilla_tanh"
+        elif acts:
+            # both directions must carry the default activation triple
+            want = _ONNX_DEFAULT_ACTS[mode] * n_dirs
+            if acts != want[:len(acts)] or len(acts) < len(want):
+                raise NotImplementedError(
+                    f"ONNX {node.op_type} non-default activations "
+                    f"{acts} are not supported")
+
+        seq_lens = args[4] if len(args) > 4 else None
+        T = args[0].shape[0]
+        if seq_lens is not None:
+            sl = _np(seq_lens).reshape(-1)
+            if not (sl == T).all():
+                raise NotImplementedError(
+                    "ONNX RNN per-row sequence_lens are not supported "
+                    "(all rows must equal the padded length)")
+        if mode == "gru" and not a.get("linear_before_reset", 0):
+            return _gru_lbr0(node, args, H, direction)
+
+        X, W, R = args[0], args[1], args[2]
+        B = args[3] if len(args) > 3 else None
+        h0 = args[5] if len(args) > 5 else None
+        c0 = args[6] if len(args) > 6 else None
+        T, bsz, inp = X.shape
+        D = n_dirs
+        # direction="reverse" = flip time, run the forward handle, flip
+        # back (half the cost of emulating via a bidirectional handle;
+        # Y_h/Y_c of a reverse scan are the states after its LAST step,
+        # which the flipped forward run yields directly)
+        if direction == "reverse":
+            X = _op(lambda x: jnp.flip(x, 0), X, _name="Flip")
+        G = rnn_ops._GATES[mode]
+        perm = _ONNX_GATE_PERM[mode]
+        row_idx = np.concatenate(
+            [np.arange(p * H, (p + 1) * H) for p in perm])
+
+        handle = rnn_ops.RNNHandle(
+            inp, H, num_layers=1, mode=mode,
+            bidirectional=direction == "bidirectional")
+
+        def pack_dir(d):
+            wd = autograd.gather(_slice0(W, d), 0, row_idx)
+            rd = autograd.gather(_slice0(R, d), 0, row_idx)
+            if B is not None:
+                bd = _slice0(B, d)
+                b_ih = autograd.gather(bd, 0, row_idx)
+                b_hh = autograd.gather(bd, 0, row_idx + G * H)
+            else:
+                z = tensor.from_numpy(np.zeros(G * H, np.float32),
+                                      _rep_device())
+                b_ih = b_hh = z
+            return [autograd.reshape(wd, (-1,)),
+                    autograd.reshape(rd, (-1,)),
+                    autograd.reshape(b_ih, (-1,)),
+                    autograd.reshape(b_hh, (-1,))]
+
+        pieces = []
+        for d in range(D):
+            pieces.extend(pack_dir(d))
+        w_flat = autograd.cat(pieces, 0) if len(pieces) > 1 else pieces[0]
+
+        zeros_h = tensor.from_numpy(np.zeros((D, bsz, H), np.float32),
+                                    _rep_device())
+        hx = h0 if h0 is not None else zeros_h
+        cx = c0 if c0 is not None else zeros_h
+
+        y, hy, cy = rnn_ops.rnn_forward(X, hx, cx, w_flat, handle)
+        # y: (T, B, D*H) -> ONNX Y (T, D, B, H)
+        if direction == "reverse":
+            y = _op(lambda v: jnp.flip(v, 0), y, _name="Flip")
+        y = autograd.reshape(y, (T, bsz, D, H))
+        Y = autograd.transpose(y, (0, 2, 1, 3))
+        if mode == "lstm":
+            return Y, hy, cy
+        return Y, hy
+
+    return h
+
+
+def _slice0(t, i):
+    """t[i] along axis 0 as an autograd op (keeps initializer grads)."""
+    return autograd.reshape(
+        autograd.gather(t, 0, np.asarray([i])), tuple(t.shape[1:]))
+
+
+def _gru_lbr0(node, args, H, direction):
+    """ONNX GRU with linear_before_reset=0 (the ONNX default): the
+    candidate gate applies the reset BEFORE the recurrent matmul —
+    n = tanh(Wn x + Wbn + Rn (r⊙h) + Rbn) — a different functional form
+    from the cuDNN cell (which is lbr=1), so it runs as its own scan
+    instead of mapping onto the packed stack."""
+    X, W, R = args[0], args[1], args[2]
+    B = args[3] if len(args) > 3 else None
+    h0 = args[5] if len(args) > 5 else None
+    T, bsz, _inp = X.shape
+    D = 2 if direction == "bidirectional" else 1
+    dirs = (["fwd", "rev"] if direction == "bidirectional"
+            else (["rev"] if direction == "reverse" else ["fwd"]))
+
+    def f(x, w, r, *rest):
+        b = rest[0] if B is not None else None
+        h_init = rest[-1] if h0 is not None else None
+        ys, hts = [], []
+        for di, dname in enumerate(dirs):
+            wz, wr, wn = jnp.split(w[di], 3, axis=0)
+            rz, rr, rn = jnp.split(r[di], 3, axis=0)
+            if b is not None:
+                wbz, wbr, wbn, rbz, rbr, rbn = jnp.split(b[di], 6)
+            else:
+                wbz = wbr = wbn = rbz = rbr = rbn = jnp.zeros(H, x.dtype)
+            hstart = (h_init[di] if h_init is not None
+                      else jnp.zeros((bsz, H), x.dtype))
+
+            def cell(h, xt):
+                z = jax.nn.sigmoid(xt @ wz.T + wbz + h @ rz.T + rbz)
+                rg = jax.nn.sigmoid(xt @ wr.T + wbr + h @ rr.T + rbr)
+                n = jnp.tanh(xt @ wn.T + wbn + (rg * h) @ rn.T + rbn)
+                h = (1 - z) * n + z * h
+                return h, h
+
+            hT, y = jax.lax.scan(cell, hstart, x,
+                                 reverse=dname == "rev")
+            ys.append(y)
+            hts.append(hT)
+        Y = jnp.stack(ys, axis=1)               # (T, D, B, H)
+        hy = jnp.stack(hts, axis=0)             # (D, B, H)
+        return Y, hy
+
+    ins = [X, W, R]
+    if B is not None:
+        ins.append(B)
+    if h0 is not None:
+        ins.append(h0)
+    return _op(f, *ins, _name="GRU")
+
+
 # subgraph-carrying control-flow ops, dispatched in _exec_nodes (they
 # need the enclosing env for outer-scope capture, so they live outside
 # the flat handler table); the conformance sweep counts them as
@@ -770,6 +1024,13 @@ _ONNX_OPS = {
     "Range": _h_range,
     "Tile": _h_tile,
     "Pad": _h_pad,
+    "ConvTranspose": _h_conv_transpose,
+    "ArgMax": _h_argmax,
+    "TopK": _h_topk,
+    "Einsum": _h_einsum,
+    "LSTM": _h_rnn("lstm"),
+    "GRU": _h_rnn("gru"),
+    "RNN": _h_rnn("rnn"),
 }
 
 

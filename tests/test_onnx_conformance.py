@@ -205,7 +205,93 @@ CASES = {
         {"s0": np.zeros(3, np.float32), "xs": A},
         {"body": _scan_body_graph(), "num_scan_inputs": 1},
         (), [A.sum(axis=0), np.cumsum(A, axis=0)]),
+    "ConvTranspose": lambda: _conv_transpose_case(),
+    "ArgMax": lambda: ({"x": A}, {"axis": 1, "keepdims": 0}, (),
+                       [np.argmax(A, axis=1).astype(np.int64)]),
+    "TopK": lambda: (
+        {"x": A}, {"axis": -1},
+        (_init(np.asarray([2], np.int64), "k"),),
+        [np.sort(A, axis=-1)[:, ::-1][:, :2],
+         np.argsort(-A, axis=-1, kind="stable")[:, :2]
+         .astype(np.int64)]),
+    "Einsum": lambda: ({"a": A, "b": B}, {"equation": "ij,kj->ik"}, (),
+                       [np.einsum("ij,kj->ik", A, B)]),
+    "LSTM": lambda: _rnn_case("LSTM"),
+    "GRU": lambda: _rnn_case("GRU"),
+    "RNN": lambda: _rnn_case("RNN"),
 }
+
+
+
+
+def _conv_transpose_case():
+    import torch
+
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 4, 3, 3).astype(np.float32)  # (C_in, C_out, k, k)
+    golden = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2,
+        padding=1).numpy()
+    return ({"x": x}, {"strides": [2, 2], "pads": [1, 1, 1, 1]},
+            (_init(w, "w"),), [golden])
+
+
+def _rnn_case(kind, direction="forward", bidirectional=False,
+              with_bias=True, with_h0=True):
+    """Build ONNX-format weights, compute the golden with torch (whose
+    gate orders differ from ONNX: LSTM iofc->ifgo perm [0,2,3,1], GRU
+    zrh->rzn perm [1,0,2] — independent derivation of the importer's
+    mapping)."""
+    import torch
+
+    T, Bz, I, H = 4, 3, 5, 6
+    G = {"LSTM": 4, "GRU": 3, "RNN": 1}[kind]
+    perm = {"LSTM": [0, 2, 3, 1], "GRU": [1, 0, 2], "RNN": [0]}[kind]
+    D = 2 if bidirectional else 1
+    x = rng.randn(T, Bz, I).astype(np.float32)
+    W = rng.randn(D, G * H, I).astype(np.float32) * 0.4
+    R = rng.randn(D, G * H, H).astype(np.float32) * 0.4
+    Bb = rng.randn(D, 2 * G * H).astype(np.float32) * 0.4 if with_bias \
+        else np.zeros((D, 2 * G * H), np.float32)
+    h0 = rng.randn(D, Bz, H).astype(np.float32) if with_h0 else \
+        np.zeros((D, Bz, H), np.float32)
+    c0 = rng.randn(D, Bz, H).astype(np.float32)
+
+    mod = {"LSTM": torch.nn.LSTM, "GRU": torch.nn.GRU,
+           "RNN": torch.nn.RNN}[kind](I, H, 1,
+                                      bidirectional=bidirectional)
+    ridx = np.concatenate([np.arange(p * H, (p + 1) * H) for p in perm])
+    with torch.no_grad():
+        for d in range(D):
+            sfx = "_reverse" if d == 1 else ""
+            getattr(mod, f"weight_ih_l0{sfx}").copy_(
+                torch.from_numpy(W[d][ridx]))
+            getattr(mod, f"weight_hh_l0{sfx}").copy_(
+                torch.from_numpy(R[d][ridx]))
+            getattr(mod, f"bias_ih_l0{sfx}").copy_(
+                torch.from_numpy(Bb[d, :G * H][ridx]))
+            getattr(mod, f"bias_hh_l0{sfx}").copy_(
+                torch.from_numpy(Bb[d, G * H:][ridx]))
+        tx = torch.from_numpy(x)
+        th0 = torch.from_numpy(h0)
+        if kind == "LSTM":
+            y, (hT, cT) = mod(tx, (th0, torch.from_numpy(c0)))
+        else:
+            y, hT = mod(tx, th0)
+    Y = y.numpy().reshape(T, Bz, D, H).transpose(0, 2, 1, 3)
+    attrs = {"hidden_size": H}
+    if bidirectional:
+        attrs["direction"] = "bidirectional"
+    if kind == "GRU":
+        attrs["linear_before_reset"] = 1  # torch's GRU form
+    inputs = {"x": x}
+    inits = [_init(W, "W"), _init(R, "R"), _init(Bb, "B"),
+             _init(np.full(Bz, T, np.int32), "seq"), _init(h0, "h0")]
+    golden = [Y, hT.numpy()]
+    if kind == "LSTM":
+        inits.append(_init(c0, "c0"))
+        golden.append(cT.numpy())
+    return (inputs, attrs, tuple(inits), golden)
 
 
 def _scan_body_graph():
@@ -309,7 +395,8 @@ def test_gelu_tanh_attribute_and_export_roundtrip():
 @pytest.mark.parametrize("op", sorted(CASES))
 def test_onnx_node_conformance(op):
     inputs, attrs, inits, golden = CASES[op]()
-    n_out = {"Split": 2, "Loop": 2, "Scan": 2}.get(op, 1)
+    n_out = {"Split": 2, "Loop": 2, "Scan": 2, "TopK": 2,
+             "LSTM": 3, "GRU": 2, "RNN": 2}.get(op, 1)
     outs = _run_node(op, inputs, attrs, n_out=n_out, initializers=inits)
 
     if golden is None and op == "Split":
