@@ -6,8 +6,8 @@ Workloads (BASELINE.json configs):
     bf16 amp, batch 128, graph mode (one donated jit executable).
   * BERT-base masked-LM train, S=512, batch 16 (config #4-ish).
   * MLP (config #1) and char-RNN LSTM (config #3) functional-parity
-    workloads; the char-RNN is timed with BOTH the lax.scan cell and
-    the Pallas fused cell so the default stays measurement-backed.
+    workloads (lax.scan LSTM cell — the Pallas fused cell was deleted
+    in round 4 after losing/tying at every measurable shape).
 
 Timing protocol: each workload warms (eager + compile + one replay +
 sync), then runs ``repeats`` timed windows of ``iters`` steps; the
@@ -279,10 +279,10 @@ def bench_mlp(batch=512, data_size=784, iters=50, repeats=3):
 
 
 def bench_charrnn(batch=64, seqlen=100, vocab=100, hidden=256, layers=2,
-                  iters=10, repeats=3, use_pallas=False):
-    """Config #3: char-RNN LSTM.  `use_pallas` switches the LSTM cell
-    between lax.scan (default) and the Pallas fused kernel so the
-    winner is measured, not assumed."""
+                  iters=10, repeats=3):
+    """Config #3: char-RNN LSTM (lax.scan cell — the Pallas fused cell
+    was deleted in round 4 after losing/tying at every measurable
+    shape; see ops/rnn.py RNNHandle docstring)."""
     from singa_tpu import device, opt, tensor
     from singa_tpu import layer, model, autograd
     from singa_tpu.models.char_rnn import one_hot
@@ -291,8 +291,7 @@ def bench_charrnn(batch=64, seqlen=100, vocab=100, hidden=256, layers=2,
         def __init__(self):
             super().__init__()
             self.lstm = layer.LSTM(hidden, num_layers=layers,
-                                   batch_first=True,
-                                   use_pallas=use_pallas)
+                                   batch_first=True)
             self.dense = layer.Linear(vocab)
             self.loss_fn = layer.SoftMaxCrossEntropy()
 
@@ -350,8 +349,6 @@ def main():
         ("gpt2", lambda: bench_gpt2(repeats=repeats, bf16=bf16)),
         ("mlp", lambda: bench_mlp(repeats=repeats)),
         ("charrnn", lambda: bench_charrnn(repeats=repeats)),
-        ("charrnn_pallas",
-         lambda: bench_charrnn(repeats=repeats, use_pallas=True)),
     ):
         if name in skip:
             continue

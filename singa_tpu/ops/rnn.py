@@ -35,12 +35,16 @@ class RNNHandle:
     """Parity stand-in for CudnnRNNHandle: computes the packed weight size
     and the per-(layer, direction) slice offsets.
 
-    ``use_pallas`` switches the LSTM cell between the lax.scan path and
-    the Pallas fused-cell kernel.  Default False is measurement-backed
-    (round 3, real v5e, char-RNN shape B64/T100/H256/L2, 5-window
-    medians): scan 9554 vs Pallas 9506 samples/s — a statistical tie,
-    so the simpler path stays default (BENCH_BASELINE.json
-    workload_notes)."""
+    The round-1..3 Pallas fused-cell LSTM kernel was DELETED in round 4
+    after the decisive sweep (real v5e, on-device loop differencing):
+    at the char-RNN bench shape it could not fit VMEM at all (T·B·4H
+    floats must be resident) and silently fell back to a hoisted-GEMM
+    scan that tied the plain scan (5108 vs 4816 samples/s, overlapping
+    spreads); at every VMEM-fitting shape (T≤20) both paths run in
+    tens of microseconds and the kernel LOSES or ties (0.32x–1.23x,
+    all within tunnel noise).  lax.scan + XLA is the one
+    measurement-backed path.  ``use_pallas`` is still accepted (and
+    ignored) for checkpoint/API compatibility."""
 
     def __init__(self, input_size, hidden_size, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, use_pallas=False):
@@ -52,7 +56,7 @@ class RNNHandle:
         self.bidirectional = bool(bidirectional)
         self.num_directions = 2 if bidirectional else 1
         self.dropout = float(dropout)
-        self.use_pallas = bool(use_pallas)
+        del use_pallas  # accepted for API compat; kernel deleted (round 4)
         self.slices = self._layout()
         self.weights_size = self._total
 
@@ -122,13 +126,8 @@ def _cell_fn(mode):
     return cell
 
 
-def _scan_direction(x, h0, c0, params, mode, reverse, use_pallas=False):
+def _scan_direction(x, h0, c0, params, mode, reverse):
     """x: (T, B, I) -> y: (T, B, H); returns (y, h_T, c_T)."""
-    if mode == "lstm" and not reverse and use_pallas:
-        from .pallas.lstm import pallas_lstm
-
-        return pallas_lstm(x, params["w_ih"], params["w_hh"],
-                           params["b_ih"] + params["b_hh"], h0, c0)
     cell = _cell_fn(mode)
     if mode == "gru":
         def f(carry, xt):
@@ -169,8 +168,7 @@ def rnn_forward(x, hx, cx, W, handle, batch_first=False):
             def f(xv, hv, cv, wv, l=l, d=d, idx=idx):
                 params = handle.unpack(wv, l, d)
                 y, hT, cT = _scan_direction(
-                    xv, hv[idx], cv[idx], params, mode, reverse=(d == 1),
-                    use_pallas=handle.use_pallas)
+                    xv, hv[idx], cv[idx], params, mode, reverse=(d == 1))
                 return y, hT, cT
 
             y, hT, cT = _Func(fn=f, name=f"RNN[l{l}d{d}]")(inp, hx, cx, W)
@@ -199,7 +197,7 @@ class _BaseRNN(Layer):
 
     def __init__(self, hidden_size, num_layers=1, bidirectional=False,
                  dropout=0.0, batch_first=False, return_sequences=True,
-                 use_pallas=False):
+                 use_pallas=False):  # accepted+ignored (round 4)
         super().__init__()
         self.hidden_size = int(hidden_size)
         self.num_layers = int(num_layers)
@@ -207,14 +205,14 @@ class _BaseRNN(Layer):
         self.dropout = float(dropout)
         self.batch_first = bool(batch_first)
         self.return_sequences = return_sequences
-        self.use_pallas = bool(use_pallas)
+        del use_pallas
         self.handle = None
 
     def initialize(self, x, hx=None, cx=None):
         input_size = x.shape[-1]
         self.handle = RNNHandle(
             input_size, self.hidden_size, self.num_layers, self.mode,
-            self.bidirectional, self.dropout, use_pallas=self.use_pallas)
+            self.bidirectional, self.dropout)
         self.W = self.handle.init_weights(x.device, amp.param_dtype(x.data.dtype))
 
     def _zero_state(self, x):
