@@ -1,0 +1,186 @@
+"""PERF.md §5 lever #2, measured (round-3 verdict item 10): can a
+Pallas residual-block megakernel cut ResNet-50's stage-2 inter-op
+activation traffic enough to matter end to end?
+
+The experiment: ONE conv2_x bottleneck block (1x1 256→64 · BN · ReLU ·
+3x3 64→64 · BN · ReLU · 1x1 64→256 · BN · +skip · ReLU) at the bench
+shape (B=128, 56×56, bf16), FORWARD path, BN folded to scale/bias (the
+fold is exact for inference and an upper bound on the training win —
+training BN needs cross-batch stats the megakernel would have to
+round-trip anyway).
+
+* ``xla_chain``  — the same math as lax ops, jitted: XLA fuses the
+  BN/ReLU chains into the convs but writes y1 (56·56·64) and y2
+  between them.
+* ``megakernel`` — one Pallas kernel, grid over images, channels-last:
+  the whole 56×56 image + all three weights live in VMEM; the 3x3 is
+  nine shifted (3136,64)@(64,64) GEMMs; y1/y2 never touch HBM.
+
+Run on the real chip:  python experiments/resnet_megakernel.py
+Appends nothing; PERF.md §6 records the measured outcome.
+"""
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, HW, C, CM = 128, 56, 256, 64  # bottleneck: C -> CM -> CM -> C
+
+
+def _interpret():
+    return jax.default_backend() == "cpu"
+
+
+def megakernel_block(x, w1, s1, b1, w2, s2, b2, w3, s3, b3):
+    """x: (B, HW, HW, C) bf16 channels-last.  One grid step per image;
+    y1/y2 stay in VMEM scratch."""
+
+    def kernel(x_ref, w1_ref, s1_ref, b1_ref, w2_ref, s2_ref, b2_ref,
+               w3_ref, s3_ref, b3_ref, o_ref, y1p_ref, y2_ref):
+        xb = x_ref[0]                              # (HW, HW, C)
+        xf = xb.reshape(HW * HW, C)
+        y1 = jnp.maximum(
+            jnp.dot(xf, w1_ref[:], preferred_element_type=jnp.float32)
+            * s1_ref[:] + b1_ref[:], 0.0)          # (HW*HW, CM) f32
+        # write y1 into the CENTER of a zero-padded scratch so the 3x3
+        # can read nine statically-shifted views of the ref
+        y1p_ref[:] = jnp.zeros_like(y1p_ref)
+        y1p_ref[1:HW + 1, 1:HW + 1, :] = \
+            y1.astype(jnp.bfloat16).reshape(HW, HW, CM)
+
+        acc = jnp.zeros((HW * HW, CM), jnp.float32)
+        for di in range(3):
+            for dj in range(3):
+                patch = y1p_ref[di:di + HW, dj:dj + HW, :] \
+                    .reshape(HW * HW, CM)
+                acc = acc + jnp.dot(
+                    patch, w2_ref[di, dj],
+                    preferred_element_type=jnp.float32)
+        y2 = jnp.maximum(acc * s2_ref[:] + b2_ref[:], 0.0)
+        y2_ref[:] = y2.astype(jnp.bfloat16)
+
+        # final 1x1 + skip in row chunks: a full (HW², C) f32
+        # intermediate alone is 3.2MB and blows the 16MB scoped-VMEM
+        # stack together with the stages above
+        rows = HW // 4
+        for ci in range(4):
+            y2c = y2_ref[ci * rows * HW:(ci + 1) * rows * HW, :]
+            y3c = jnp.dot(y2c, w3_ref[:],
+                          preferred_element_type=jnp.float32) \
+                * s3_ref[:] + b3_ref[:]
+            xc = x_ref[0, ci * rows:(ci + 1) * rows].reshape(
+                rows * HW, C)
+            o_ref[0, ci * rows:(ci + 1) * rows] = jnp.maximum(
+                y3c + xc.astype(jnp.float32), 0.0
+            ).astype(o_ref.dtype).reshape(rows, HW, C)
+
+    vmem = pltpu.VMEM
+    return pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, HW, HW, C), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=vmem),  # w1
+            pl.BlockSpec(memory_space=vmem),  # s1
+            pl.BlockSpec(memory_space=vmem),  # b1
+            pl.BlockSpec(memory_space=vmem),  # w2
+            pl.BlockSpec(memory_space=vmem),  # s2
+            pl.BlockSpec(memory_space=vmem),  # b2
+            pl.BlockSpec(memory_space=vmem),  # w3
+            pl.BlockSpec(memory_space=vmem),  # s3
+            pl.BlockSpec(memory_space=vmem),  # b3
+        ],
+        out_specs=pl.BlockSpec((1, HW, HW, C), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, HW, HW, C), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((HW + 2, HW + 2, CM), jnp.bfloat16),
+            pltpu.VMEM((HW * HW, CM), jnp.bfloat16),
+        ],
+        interpret=_interpret(),
+    )(x, w1, s1, b1, w2, s2, b2, w3, s3, b3)
+
+
+def xla_chain(x, w1, s1, b1, w2, s2, b2, w3, s3, b3):
+    """Same math through lax ops (NHWC) — what the framework's XLA
+    pipeline does, minus the batch-stats work of real training BN."""
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, (1, 1, C, CM), ("NHWC", "HWIO", "NHWC"))
+    y1 = jax.lax.conv_general_dilated(
+        x, w1.reshape(1, 1, C, CM), (1, 1), "SAME",
+        dimension_numbers=dn, preferred_element_type=jnp.float32)
+    y1 = jnp.maximum(y1 * s1 + b1, 0.0).astype(jnp.bfloat16)
+    dn2 = jax.lax.conv_dimension_numbers(
+        y1.shape, (3, 3, CM, CM), ("NHWC", "HWIO", "NHWC"))
+    y2 = jax.lax.conv_general_dilated(
+        y1, w2, (1, 1), "SAME", dimension_numbers=dn2,
+        preferred_element_type=jnp.float32)
+    y2 = jnp.maximum(y2 * s2 + b2, 0.0).astype(jnp.bfloat16)
+    dn3 = jax.lax.conv_dimension_numbers(
+        y2.shape, (1, 1, CM, C), ("NHWC", "HWIO", "NHWC"))
+    y3 = jax.lax.conv_general_dilated(
+        y2, w3.reshape(1, 1, CM, C), (1, 1), "SAME",
+        dimension_numbers=dn3, preferred_element_type=jnp.float32)
+    y3 = y3 * s3 + b3
+    return jnp.maximum(y3 + x.astype(jnp.float32), 0.0).astype(x.dtype)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, HW, HW, C).astype(np.float32) * 0.5
+                    ).astype(jnp.bfloat16)
+    w1 = jnp.asarray(rng.randn(C, CM).astype(np.float32) * 0.05
+                     ).astype(jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(3, 3, CM, CM).astype(np.float32) * 0.05
+                     ).astype(jnp.bfloat16)
+    w3 = jnp.asarray(rng.randn(CM, C).astype(np.float32) * 0.05
+                     ).astype(jnp.bfloat16)
+    s1, b1 = (jnp.ones(CM, jnp.float32), jnp.zeros(CM, jnp.float32))
+    s2, b2 = (jnp.ones(CM, jnp.float32), jnp.zeros(CM, jnp.float32))
+    s3, b3 = (jnp.ones(C, jnp.float32), jnp.zeros(C, jnp.float32))
+    args = (w1, s1, b1, w2, s2, b2, w3, s3, b3)
+
+    # correctness first
+    ref = jax.jit(xla_chain)(x, *args)
+    got = jax.jit(megakernel_block)(x, *args)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    print(f"max |megakernel - xla_chain| = {err:.4f}")
+    assert err < 0.5, "megakernel math diverges"
+
+    def timed(fn, n1=5, n2=50):
+        @jax.jit
+        def loop(x, n):
+            def body(i, x):
+                return fn(x, *args)
+            return jax.lax.fori_loop(0, n, body, x)
+
+        float(loop(x, n1)[0, 0, 0, 0].astype(jnp.float32))
+        ts = []
+        for _ in range(3):
+            t0 = time.time()
+            float(loop(x, n2)[0, 0, 0, 0].astype(jnp.float32))
+            tn = time.time() - t0
+            t0 = time.time()
+            float(loop(x, n1)[0, 0, 0, 0].astype(jnp.float32))
+            t1 = time.time() - t0
+            ts.append((tn - t1) / (n2 - n1) * 1e3)
+        return sorted(ts)[1]
+
+    t_xla = timed(xla_chain)
+    t_mega = timed(megakernel_block)
+    flops = (2 * B * HW * HW * (C * CM + 9 * CM * CM + CM * C))
+    print(f"xla_chain : {t_xla:8.3f} ms  "
+          f"({flops / t_xla / 1e9:6.1f} TFLOP/s)")
+    print(f"megakernel: {t_mega:8.3f} ms  "
+          f"({flops / t_mega / 1e9:6.1f} TFLOP/s)")
+    print(f"ratio (xla/mega): {t_xla / t_mega:.3f}x")
+
+
+if __name__ == "__main__":
+    main()
