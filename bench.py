@@ -142,7 +142,8 @@ def bench_bert(batch=16, seqlen=512, iters=10, repeats=3, bf16=True):
 
 def bench_gpt2(batch=8, seqlen=1024, iters=10, repeats=3, bf16=True):
     """GPT-2 small causal-LM training step (beyond-parity transformer
-    workload; attn_impl='auto' resolves to fused at this S — the flash
+    workload).  attn_impl='auto' resolves to FLASH at S=1024 since the
+    round-4 crossover re-sweep (flash +31% over fused here; the full
     long-context regime is swept separately by bench_longctx.py)."""
     from singa_tpu import amp, device, opt, tensor
     from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead

@@ -52,11 +52,14 @@ class GPT2Config:
         self.remat = remat
         # attn_impl: "fused" (S x S scores in HBM) or "flash" (Pallas
         # online-softmax fwd+bwd kernels, O(S·D) HBM).  "auto" picks by
-        # the measured LONGCTX.json crossover: flash wins throughput AND
-        # memory from S=2048 up (and is the only impl surviving
-        # S >= 16384 on one chip); fused wins at short S.
+        # the measured crossover, re-swept in round 4 (real v5e, GPT-2
+        # small, 8192 tokens/step): flash TIES fused at S in {256, 512}
+        # (104.4 vs 103.4 / 108.0 vs 108.0 k tok/s) and WINS 31% at
+        # S=1024 (100.2 vs 76.5) — the threshold moved down from
+        # round 3's 2048.  Flash stays the only impl surviving
+        # S >= 16384 on one chip (LONGCTX.json); fused keeps short S.
         if attn_impl == "auto":
-            attn_impl = "flash" if n_positions >= 2048 else "fused"
+            attn_impl = "flash" if n_positions >= 1024 else "fused"
         self.attn_impl = attn_impl
 
     @classmethod
