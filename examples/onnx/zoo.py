@@ -25,25 +25,34 @@ def _zoo():
     from singa_tpu.models.alexnet import AlexNet
     from singa_tpu.models.mobilenet import mobilenet_v2
     from singa_tpu.models.resnet import resnet18, resnet50
+    from singa_tpu.models.unet import unet
     from singa_tpu.models.vgg import vgg11, vgg16
     from singa_tpu.models.xceptionnet import Xception
 
-    # (factory, input hw) — small widths keep the offline demo quick
+    # (factory, input hw, classifier_train) — small widths keep the
+    # offline demo quick; classifier_train=False marks models whose
+    # labels are not 1-of-10 (the registry carries it so the runner
+    # needs no per-name special cases)
     return {
         "mobilenet_v2": (lambda: mobilenet_v2(num_classes=10,
-                                              width_mult=0.5), 64),
+                                              width_mult=0.5), 64, True),
         "vgg11": (lambda: vgg11(num_classes=10, batch_norm=True,
-                                hidden=256), 64),
-        "vgg16": (lambda: vgg16(num_classes=10, hidden=256), 64),
-        "resnet18": (lambda: resnet18(num_classes=10), 64),
-        "resnet50": (lambda: resnet50(num_classes=10), 64),
-        "alexnet": (lambda: AlexNet(num_classes=10), 224),
-        "xception": (lambda: Xception(num_classes=10), 96),
+                                hidden=256), 64, True),
+        "vgg16": (lambda: vgg16(num_classes=10, hidden=256), 64, True),
+        "resnet18": (lambda: resnet18(num_classes=10), 64, True),
+        "resnet50": (lambda: resnet50(num_classes=10), 64, True),
+        "alexnet": (lambda: AlexNet(num_classes=10), 224, True),
+        "xception": (lambda: Xception(num_classes=10), 96, True),
+        # segmentation family: ConvTranspose decoder + skip concats
+        # (round-4 importer/exporter coverage); per-pixel labels, so no
+        # classifier-style imported-graph training
+        "unet": (lambda: unet(num_classes=4, base_channels=8,
+                              depth=2), 64, False),
     }
 
 
 def run_one(name, dev, batch, seed, train_steps):
-    factory, hw = _zoo()[name]
+    factory, hw, classifier_train = _zoo()[name]
     rng = np.random.RandomState(seed)
     m = factory()
     x = tensor.from_numpy(
@@ -61,7 +70,7 @@ def run_one(name, dev, batch, seed, train_steps):
           f"({'OK' if ok else 'MISMATCH'}), "
           f"{len(proto.graph.node)} nodes, {time.time() - t0:.1f}s")
 
-    if train_steps:
+    if train_steps and classifier_train:
         class Trainable(sonnx.SONNXModel):
             def train_one_batch(self, x, y):
                 out = self.forward(x)

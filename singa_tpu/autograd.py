@@ -489,8 +489,11 @@ def transpose(x, shape=None):
 
 
 def cat(xs, axis=0):
+    # axis rides op.params so sonnx export can write the (required)
+    # ONNX Concat axis attribute
     return _Func(
-        fn=lambda *vs, axis=axis: jnp.concatenate(vs, axis=axis), name="Concat"
+        fn=lambda *vs, axis=axis: jnp.concatenate(vs, axis=axis),
+        name="Concat", axis=axis
     )(*xs)
 
 

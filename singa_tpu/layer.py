@@ -371,6 +371,54 @@ class Conv2d(Layer):
         return y
 
 
+class ConvTranspose2d(Layer):
+    """Transposed convolution (beyond reference parity — upstream has no
+    deconv layer; segmentation/decoder models need it).  NCHW layout;
+    weight uses the torch/ONNX ConvTranspose convention
+    (C_in, C_out/group, kH, kW) so checkpoints and ONNX export line up
+    with ops/conv.py conv_transpose2d."""
+
+    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+                 dilation=1, group=1, bias=True, output_padding=0):
+        super().__init__()
+        self.nb_kernels = int(nb_kernels)
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.dilation = _pair(dilation)
+        self.group = int(group)
+        self.bias = bool(bias)
+        self.output_padding = _pair(output_padding)
+
+    def initialize(self, x):
+        in_channels = x.shape[1]
+        assert in_channels % self.group == 0
+        assert self.nb_kernels % self.group == 0
+        w_shape = (in_channels, self.nb_kernels // self.group) \
+            + self.kernel_size
+        self.W = Tensor(w_shape, device=x.device,
+                        dtype=amp.param_dtype(x.data.dtype),
+                        requires_grad=True, stores_grad=True)
+        std = math.sqrt(2.0 / (w_shape[1] * np.prod(self.kernel_size)
+                               + in_channels))
+        self.W.gaussian(0.0, std)
+        if self.bias:
+            self.b = Tensor((self.nb_kernels,), device=x.device,
+                            dtype=amp.param_dtype(x.data.dtype),
+                            requires_grad=True, stores_grad=True)
+            self.b.set_value(0.0)
+
+    def forward(self, x):
+        from .ops import conv as conv_ops
+
+        return conv_ops.conv_transpose2d(
+            x, self.W, self.b if self.bias else None,
+            stride=self.stride, padding=self.padding,
+            dilation=self.dilation, group=self.group,
+            output_padding=self.output_padding,
+        )
+
+
 class BatchNorm2d(Layer):
     """Reference layer.BatchNorm2d over operation/batchnorm.cc (cuDNN
     spatial BN, unverified): per-channel affine + running stats."""

@@ -1045,6 +1045,7 @@ _EXPORT_OPS = {
     "Matmul": "MatMul", "AddBias": "Add", "SoftMax": "Softmax",
     "Exp": "Exp", "Log": "Log", "Sqrt": "Sqrt", "Abs": "Abs",
     "Negative": "Neg", "Conv2d": "Conv", "MaxPool2d": "MaxPool",
+    "ConvTranspose2d": "ConvTranspose",
     "AvgPool2d": "AveragePool",
     "Flatten": "Flatten", "Reshape": "Reshape", "Transpose": "Transpose",
     "Concat": "Concat", "Identity": "Identity", "Erf": "Erf",
@@ -1310,6 +1311,13 @@ def to_onnx(m, inputs, model_name="singa_model"):
         p = getattr(op, "params", {}) or {}
         if base == "SoftMax":
             node.attribute.append(AttributeProto.make("axis", p.get("axis", -1)))
+        elif base == "Concat":
+            # ONNX Concat has NO default axis — omitting it made
+            # importers concatenate along axis 0 (caught by the UNet
+            # round-trip: channel concat became batch concat).  KeyError
+            # here (not a silent 0) if a Concat op ever lacks the param.
+            node.attribute.append(
+                AttributeProto.make("axis", int(p["axis"])))
         elif base == "Flatten":
             node.attribute.append(AttributeProto.make("axis", p.get("axis", 1)))
         elif base == "Transpose" and p.get("perm") is not None:
@@ -1325,6 +1333,18 @@ def to_onnx(m, inputs, model_name="singa_model"):
                 "dilations", list(p.get("dilation", (1, 1)))))
             node.attribute.append(AttributeProto.make(
                 "group", p.get("group", 1)))
+        elif base == "ConvTranspose2d":
+            node.attribute.append(AttributeProto.make(
+                "strides", list(p.get("stride", (1, 1)))))
+            pads = p.get("pads", ((0, 0), (0, 0)))
+            node.attribute.append(AttributeProto.make(
+                "pads", [pr[0] for pr in pads] + [pr[1] for pr in pads]))
+            node.attribute.append(AttributeProto.make(
+                "dilations", list(p.get("dilation", (1, 1)))))
+            node.attribute.append(AttributeProto.make(
+                "group", p.get("group", 1)))
+            node.attribute.append(AttributeProto.make(
+                "output_padding", list(p.get("output_padding", (0, 0)))))
         elif base in ("MaxPool2d", "AvgPool2d"):
             node.attribute.append(AttributeProto.make(
                 "kernel_shape", list(p["kernel"])))

@@ -4,7 +4,7 @@ unverified)."""
 import numpy as np
 import pytest
 
-from singa_tpu import opt, tensor
+from singa_tpu import layer, opt, tensor
 from singa_tpu import device as device_module
 from singa_tpu.models.cnn import CNN
 from singa_tpu.models.resnet import resnet18, resnet50
@@ -180,3 +180,53 @@ def test_resnet18_onnx_roundtrip_with_bn_stats(dev):
     (out,) = rep.run([x])
     np.testing.assert_allclose(tensor.to_numpy(out), native, rtol=1e-3,
                                atol=1e-3)
+
+
+def test_unet_trains_and_roundtrips(dev):
+    """Segmentation family (round 4): ConvTranspose decoder + skip
+    concats train under graph mode and survive the ONNX round trip
+    (which caught a real exporter bug: Concat's REQUIRED axis
+    attribute was never written — channel concat imported as batch
+    concat)."""
+    from singa_tpu import sonnx
+    from singa_tpu.models.unet import unet
+
+    m = unet(num_classes=3, base_channels=8, depth=2)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(2, 3, 32, 32).astype(np.float32), dev)
+    y = tensor.from_numpy(rng.randint(0, 3, (2, 32, 32)).astype(np.int32),
+                          dev)
+    m.compile([x], is_train=True, use_graph=True)
+    losses = [float(tensor.to_numpy(m(x, y)[1])) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+    m.eval()
+    proto = sonnx.to_onnx(m, [x])
+    assert any(n.op_type == "ConvTranspose" for n in proto.graph.node)
+    cc = [n for n in proto.graph.node if n.op_type == "Concat"]
+    assert cc and all(n.attrs().get("axis") == 1 for n in cc)
+    rep = sonnx.prepare(proto, dev)
+    native = tensor.to_numpy(m.forward(x))
+    got = tensor.to_numpy(rep.run([x])[0])
+    np.testing.assert_allclose(got, native, rtol=2e-3, atol=2e-4)
+
+
+def test_conv_transpose_layer_shapes_and_grad(dev):
+    from singa_tpu import autograd as ag
+
+    ct = layer.ConvTranspose2d(6, 3, stride=2, padding=1,
+                               output_padding=1)
+    x = tensor.from_numpy(
+        np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32),
+        dev)
+    ag.set_training(True)
+    try:
+        y = ct(x)
+        assert y.shape == (2, 6, 16, 16), y.shape  # exact 2x upsample
+        loss = ag.reduce_sum(ag.mul(y, y))
+        grads = dict(ag.backward(loss))
+        assert ct.W in grads and np.isfinite(
+            tensor.to_numpy(grads[ct.W])).all()
+    finally:
+        ag.set_training(False)
