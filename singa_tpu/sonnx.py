@@ -719,18 +719,23 @@ def _h_conv_transpose(node, args):
         output_padding=tuple(a.get("output_padding", [0] * n)))
 
 
-def _h_argmax(node, args):
-    a = node.attrs()
-    axis = a.get("axis", 0)
-    keepdims = bool(a.get("keepdims", 1))
-    if a.get("select_last_index", 0):
-        raise NotImplementedError(
-            "ONNX ArgMax select_last_index=1 is not supported")
-    # int32, not int64: x64 is disabled in this runtime, so an int64
-    # cast would silently truncate anyway and warn on every call
-    return _op(lambda x: jnp.argmax(x, axis=axis,
-                                    keepdims=keepdims).astype(jnp.int32),
-               args[0], _name="ArgMax")
+def _h_arg_extremum(fn, name):
+    def h(node, args):
+        a = node.attrs()
+        axis = a.get("axis", 0)
+        keepdims = bool(a.get("keepdims", 1))
+        if a.get("select_last_index", 0):
+            raise NotImplementedError(
+                f"ONNX {name} select_last_index=1 is not supported")
+        # int32, not int64: x64 is disabled in this runtime, so an
+        # int64 cast would silently truncate anyway and warn every call
+        return _op(lambda x: fn(x, axis=axis,
+                                keepdims=keepdims).astype(jnp.int32),
+                   args[0], _name=name)
+    return h
+
+
+_h_argmax = _h_arg_extremum(jnp.argmax, "ArgMax")
 
 
 def _h_topk(node, args):
@@ -949,6 +954,153 @@ def _gru_lbr0(node, args, H, direction):
     return _op(f, *ins, _name="GRU")
 
 
+
+
+def _h_resize(node, args):
+    """ONNX Resize: mode nearest with coordinate_transformation_mode in
+    {half_pixel (spec default) + round_prefer_floor (spec default),
+    asymmetric + floor (torch's interpolate export)}, and mode
+    linear/cubic with half_pixel.  Scales or sizes; only trailing
+    spatial dims may resize."""
+    a = node.attrs()
+    mode = a.get("mode", "nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    ctm = a.get("coordinate_transformation_mode", "half_pixel")
+    if isinstance(ctm, bytes):
+        ctm = ctm.decode()
+    nearest_mode = a.get("nearest_mode", "round_prefer_floor")
+    if isinstance(nearest_mode, bytes):
+        nearest_mode = nearest_mode.decode()
+    x = args[0]
+    # opset 11+: inputs are (X, roi, scales, sizes)
+    scales = args[2] if len(args) > 2 and args[2] is not None else None
+    sizes = args[3] if len(args) > 3 and args[3] is not None else None
+    if sizes is not None:
+        out_shape = tuple(int(v) for v in _np(sizes).reshape(-1))
+        scale_per_dim = [o / d for o, d in zip(out_shape, x.shape)]
+    elif scales is not None:
+        scale_per_dim = [float(s) for s in _np(scales).reshape(-1)]
+        # spec: output dim = floor(input dim * scale)
+        out_shape = tuple(int(np.floor(d * s))
+                          for d, s in zip(x.shape, scale_per_dim))
+    else:
+        raise NotImplementedError("ONNX Resize needs scales or sizes")
+    if out_shape[:2] != tuple(x.shape[:2]):
+        raise NotImplementedError(
+            "ONNX Resize on batch/channel dims is not supported")
+    if mode == "nearest":
+        combo = (ctm, nearest_mode)
+        if combo not in (("asymmetric", "floor"),
+                         ("half_pixel", "round_prefer_floor")):
+            raise NotImplementedError(
+                f"ONNX Resize nearest supports asymmetric+floor and "
+                f"half_pixel+round_prefer_floor, got {ctm}+{nearest_mode}")
+
+        def f(v):
+            for ax in range(2, v.ndim):
+                n_in, n_out = v.shape[ax], out_shape[ax]
+                if n_in == n_out:
+                    continue
+                sc = scale_per_dim[ax]
+                pos = jnp.arange(n_out, dtype=jnp.float32)
+                if ctm == "asymmetric":
+                    # x_orig = x / scale; floor
+                    idx = jnp.floor(pos / sc)
+                else:
+                    # half_pixel: x_orig = (x + 0.5)/scale - 0.5;
+                    # round_prefer_floor == ceil(v - 0.5)
+                    idx = jnp.ceil((pos + 0.5) / sc - 0.5 - 0.5)
+                idx = jnp.clip(idx.astype(jnp.int32), 0, n_in - 1)
+                v = jnp.take(v, idx, axis=ax)
+            return v
+
+        return _op(f, x, _name="Resize")
+    if mode in ("linear", "cubic"):
+        if ctm != "half_pixel":
+            raise NotImplementedError(
+                f"ONNX Resize {mode} supports half_pixel only, got {ctm}")
+        method = "linear" if mode == "linear" else "cubic"
+        return _op(lambda v: jax.image.resize(v, out_shape, method=method),
+                   x, _name="Resize")
+    raise NotImplementedError(f"ONNX Resize mode {mode!r}")
+
+
+def _h_instance_norm(node, args):
+    eps = node.attrs().get("epsilon", 1e-5)
+
+    def f(x, s, b):
+        ax = tuple(range(2, x.ndim))
+        mu = jnp.mean(x, axis=ax, keepdims=True)
+        var = jnp.var(x, axis=ax, keepdims=True)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mu) / jnp.sqrt(var + eps)) * s.reshape(shape) \
+            + b.reshape(shape)
+
+    return _op(f, args[0], args[1], args[2], _name="InstanceNormalization")
+
+
+def _h_prelu(node, args):
+    def f(x, slope):
+        # ONNX PRelu broadcast is UNIDIRECTIONAL (trailing-aligned);
+        # torch exporters additionally rely on a (C,) slope applying
+        # per channel on NCHW.  Reshape to the channel axis only when
+        # trailing alignment can't claim it (ambiguity resolves to the
+        # spec's own rule).
+        s = slope
+        if s.ndim == 1 and x.ndim > 2 and s.shape[0] == x.shape[1] \
+                and s.shape[0] != x.shape[-1]:
+            s = s.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, x * s)
+
+    return _op(f, args[0], args[1], _name="PRelu")
+
+
+def _h_cumsum(node, args):
+    a = node.attrs()
+    if a.get("exclusive", 0) or a.get("reverse", 0):
+        raise NotImplementedError(
+            "ONNX CumSum exclusive/reverse are not supported")
+    axis = int(_np(args[1]).reshape(-1)[0])
+    return _op(lambda x: jnp.cumsum(x, axis=axis), args[0],
+               _name="CumSum")
+
+
+def _h_depth_space(to_space):
+    def h(node, args):
+        bs = int(node.attrs()["blocksize"])
+        mode = node.attrs().get("mode", "DCR")
+        if isinstance(mode, bytes):
+            mode = mode.decode()
+
+        def f(x):
+            n, c, hh, ww = x.shape
+            if to_space:
+                if mode == "DCR":
+                    y = x.reshape(n, bs, bs, c // (bs * bs), hh, ww)
+                    y = y.transpose(0, 3, 4, 1, 5, 2)
+                else:  # CRD
+                    y = x.reshape(n, c // (bs * bs), bs, bs, hh, ww)
+                    y = y.transpose(0, 1, 4, 2, 5, 3)
+                return y.reshape(n, c // (bs * bs), hh * bs, ww * bs)
+            y = x.reshape(n, c, hh // bs, bs, ww // bs, bs)
+            y = y.transpose(0, 3, 5, 1, 2, 4)
+            return y.reshape(n, c * bs * bs, hh // bs, ww // bs)
+
+        return _op(f, args[0],
+                   _name="DepthToSpace" if to_space else "SpaceToDepth")
+    return h
+
+
+def _h_gather_elements(node, args):
+    axis = node.attrs().get("axis", 0)
+    # indices stay a graph input (runtime indices from ArgMax/TopK are
+    # the common pattern; eager _np would break under tracing)
+    return _op(lambda x, i: jnp.take_along_axis(
+        x, i.astype(jnp.int32), axis=axis),
+        args[0], args[1], _name="GatherElements")
+
+
 # subgraph-carrying control-flow ops, dispatched in _exec_nodes (they
 # need the enclosing env for outer-scope capture, so they live outside
 # the flat handler table); the conformance sweep counts them as
@@ -1025,6 +1177,42 @@ _ONNX_OPS = {
     "Tile": _h_tile,
     "Pad": _h_pad,
     "ConvTranspose": _h_conv_transpose,
+    "Resize": _h_resize,
+    "InstanceNormalization": _h_instance_norm,
+    "PRelu": _h_prelu,
+    "CumSum": _h_cumsum,
+    "DepthToSpace": _h_depth_space(True),
+    "SpaceToDepth": _h_depth_space(False),
+    "GatherElements": _h_gather_elements,
+    "And": _handle_binary(jnp.logical_and),
+    "Or": _handle_binary(jnp.logical_or),
+    "Xor": _handle_binary(jnp.logical_xor),
+    "Not": _handle_unary(jnp.logical_not),
+    "GreaterOrEqual": _handle_binary(lambda a, b: (a >= b)),
+    "LessOrEqual": _handle_binary(lambda a, b: (a <= b)),
+    "Mod": lambda node, args: _handle_binary(
+        jnp.fmod if node.attrs().get("fmod", 0) else jnp.mod)(node, args),
+    "Sign": _handle_unary(jnp.sign),
+    "Round": _handle_unary(jnp.round),
+    "Sin": _handle_unary(jnp.sin),
+    "Cos": _handle_unary(jnp.cos),
+    "Softsign": _handle_unary(lambda x: x / (1 + jnp.abs(x))),
+    "HardSigmoid": lambda node, args: _op(
+        lambda x, alpha, beta: jnp.clip(alpha * x + beta, 0.0, 1.0),
+        args[0], _name="HardSigmoid",
+        alpha=node.attrs().get("alpha", 0.2),
+        beta=node.attrs().get("beta", 0.5)),
+    "HardSwish": _handle_unary(
+        lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)),
+    "ReduceProd": _h_reduce(jnp.prod),
+    "ReduceL1": _h_reduce(lambda x, axis, keepdims: jnp.sum(
+        jnp.abs(x), axis=axis, keepdims=keepdims)),
+    "ReduceL2": _h_reduce(lambda x, axis, keepdims: jnp.sqrt(
+        jnp.sum(x * x, axis=axis, keepdims=keepdims))),
+    "ReduceLogSumExp": _h_reduce(
+        lambda x, axis, keepdims: jax.scipy.special.logsumexp(
+            x, axis=axis, keepdims=keepdims)),
+    "ArgMin": _h_arg_extremum(jnp.argmin, "ArgMin"),
     "ArgMax": _h_argmax,
     "TopK": _h_topk,
     "Einsum": _h_einsum,

@@ -219,6 +219,60 @@ CASES = {
     "LSTM": lambda: _rnn_case("LSTM"),
     "GRU": lambda: _rnn_case("GRU"),
     "RNN": lambda: _rnn_case("RNN"),
+    "Resize": lambda: _resize_case(),
+    "InstanceNormalization": lambda: _instancenorm_case(),
+    "PRelu": lambda: (
+        {"x": A}, {}, (_init(np.asarray([0.1, 0.2, 0.3], np.float32),
+                             "slope"),),
+        [np.where(A >= 0, A, A * np.asarray([0.1, 0.2, 0.3]))]),
+    "CumSum": lambda: (
+        {"x": A}, {}, (_init(np.asarray([1], np.int64), "ax"),),
+        [np.cumsum(A, axis=1)]),
+    "DepthToSpace": lambda: (
+        # CRD mode == torch pixel_shuffle (DCR default covered by the
+        # element-indexed loop golden in test_depth_space_modes)
+        {"x": rng.randn(1, 8, 2, 3).astype(np.float32)},
+        {"blocksize": 2, "mode": "CRD"}, (), None),
+    "SpaceToDepth": lambda: (
+        {"x": rng.randn(1, 2, 4, 6).astype(np.float32)},
+        {"blocksize": 2}, (), None),
+    "GatherElements": lambda: (
+        {"x": A}, {"axis": 1},
+        (_init(np.asarray([[0, 2], [1, 0]], np.int64), "idx"),),
+        [np.take_along_axis(A, np.asarray([[0, 2], [1, 0]]), axis=1)]),
+    "And": lambda: ({"a": A > 0, "b": B > 0}, {}, (),
+                    [(A > 0) & (B > 0)]),
+    "Or": lambda: ({"a": A > 0, "b": B > 0}, {}, (),
+                   [(A > 0) | (B > 0)]),
+    "Xor": lambda: ({"a": A > 0, "b": B > 0}, {}, (),
+                    [(A > 0) ^ (B > 0)]),
+    "Not": lambda: ({"x": A > 0}, {}, (), [~(A > 0)]),
+    "GreaterOrEqual": lambda: ({"a": A, "b": B}, {}, (), [A >= B]),
+    "LessOrEqual": lambda: ({"a": A, "b": B}, {}, (), [A <= B]),
+    "Mod": lambda: ({"a": np.abs(A) + 1, "b": np.full_like(A, 0.7)},
+                    {"fmod": 1}, (),
+                    [np.fmod(np.abs(A) + 1, 0.7)]),
+    "Sign": lambda: ({"x": A}, {}, (), [np.sign(A)]),
+    "Round": lambda: ({"x": 3 * A}, {}, (), [np.round(3 * A)]),
+    "Sin": lambda: ({"x": A}, {}, (), [np.sin(A)]),
+    "Cos": lambda: ({"x": A}, {}, (), [np.cos(A)]),
+    "Softsign": lambda: ({"x": A}, {}, (), [A / (1 + np.abs(A))]),
+    "HardSigmoid": lambda: ({"x": A}, {"alpha": 0.25, "beta": 0.4}, (),
+                            [np.clip(0.25 * A + 0.4, 0, 1)]),
+    "HardSwish": lambda: ({"x": 4 * A}, {}, (),
+                          [4 * A * np.clip(4 * A / 6 + 0.5, 0, 1)]),
+    "ReduceProd": lambda: ({"x": np.abs(A) + 0.5}, {"axes": [1]}, (),
+                           [np.prod(np.abs(A) + 0.5, axis=1,
+                                    keepdims=True)]),
+    "ReduceL1": lambda: ({"x": A}, {"axes": [0]}, (),
+                         [np.abs(A).sum(axis=0, keepdims=True)]),
+    "ReduceL2": lambda: ({"x": A}, {"axes": [1]}, (),
+                         [np.sqrt((A * A).sum(axis=1, keepdims=True))]),
+    "ReduceLogSumExp": lambda: (
+        {"x": A}, {"axes": [1]}, (),
+        [np.log(np.exp(A).sum(axis=1, keepdims=True))]),
+    "ArgMin": lambda: ({"x": A}, {"axis": 1, "keepdims": 0}, (),
+                       [np.argmin(A, axis=1).astype(np.int32)]),
 }
 
 
@@ -292,6 +346,37 @@ def _rnn_case(kind, direction="forward", bidirectional=False,
         inits.append(_init(c0, "c0"))
         golden.append(cT.numpy())
     return (inputs, attrs, tuple(inits), golden)
+
+
+
+
+def _resize_case():
+    import torch
+
+    x = rng.randn(1, 2, 4, 5).astype(np.float32)
+    # nearest, asymmetric+floor, scales (2, 2) — exactly torch's
+    # interpolate(mode="nearest")
+    golden = torch.nn.functional.interpolate(
+        torch.from_numpy(x), scale_factor=2, mode="nearest").numpy()
+    return ({"x": x}, {"mode": "nearest",
+                       "coordinate_transformation_mode": "asymmetric",
+                       "nearest_mode": "floor"},
+            (_init(np.asarray([], np.float32), "roi"),
+             _init(np.asarray([1, 1, 2, 2], np.float32), "scales")),
+            [golden])
+
+
+def _instancenorm_case():
+    import torch
+
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    s = rng.rand(3).astype(np.float32) + 0.5
+    b = rng.randn(3).astype(np.float32)
+    golden = torch.nn.functional.instance_norm(
+        torch.from_numpy(x), weight=torch.from_numpy(s),
+        bias=torch.from_numpy(b), eps=1e-5).numpy()
+    return ({"x": x}, {"epsilon": 1e-5},
+            (_init(s, "s"), _init(b, "b")), [golden])
 
 
 def _scan_body_graph():
@@ -415,7 +500,90 @@ def test_onnx_node_conformance(op):
             golden = [torch.nn.functional.max_pool2d(tx["x"], 2).numpy()]
         elif op == "AveragePool":
             golden = [torch.nn.functional.avg_pool2d(tx["x"], 2).numpy()]
+        elif op == "DepthToSpace":
+            golden = [torch.nn.functional.pixel_shuffle(tx["x"], 2).numpy()]
+        elif op == "SpaceToDepth":
+            golden = [_s2d_loop(np.asarray(inputs["x"]), 2)]
     for got, want in zip(outs, golden):
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want, np.float32),
                                    rtol=2e-4, atol=1e-5, err_msg=op)
+
+
+def _s2d_loop(x, bs):
+    """ONNX SpaceToDepth per the spec's element mapping, written as
+    loops so it is independent of any reshape/transpose recipe."""
+    n, c, h, w = x.shape
+    y = np.zeros((n, c * bs * bs, h // bs, w // bs), x.dtype)
+    for bi in range(bs):
+        for bj in range(bs):
+            for ci in range(c):
+                y[:, (bi * bs + bj) * c + ci] = \
+                    x[:, ci, bi::bs, bj::bs]
+    return y
+
+
+def test_depth_space_modes():
+    """DCR (default) and CRD DepthToSpace against element-indexed loop
+    goldens; SpaceToDepth(DepthToSpace(x, DCR)) is the identity."""
+    x = rng.randn(2, 8, 3, 4).astype(np.float32)
+    bs, c2 = 2, 2
+
+    def d2s_loop(x, mode):
+        n, c, h, w = x.shape
+        y = np.zeros((n, c2, h * bs, w * bs), x.dtype)
+        for bi in range(bs):
+            for bj in range(bs):
+                for ci in range(c2):
+                    src = ((bi * bs + bj) * c2 + ci if mode == "DCR"
+                           else ci * bs * bs + bi * bs + bj)
+                    y[:, ci, bi::bs, bj::bs] = x[:, src]
+        return y
+
+    for mode in ("DCR", "CRD"):
+        got = _run_node("DepthToSpace", {"x": x},
+                        {"blocksize": bs, "mode": mode})[0]
+        np.testing.assert_allclose(got, d2s_loop(x, mode), err_msg=mode)
+    d2s = _run_node("DepthToSpace", {"x": x}, {"blocksize": bs})[0]
+    back = _run_node("SpaceToDepth", {"x": d2s}, {"blocksize": bs})[0]
+    np.testing.assert_allclose(back, x)
+
+
+def test_resize_spec_defaults_and_floor_shape():
+    """The ONNX-default nearest combo (half_pixel + round_prefer_floor)
+    and the spec's floor(d*scale) output shape — the two divergences a
+    review repro caught against onnxruntime semantics."""
+    x = np.arange(5, dtype=np.float32).reshape(1, 1, 1, 5)
+    # defaults (no ctm/nearest_mode attrs), scale 0.4 -> out dim 2,
+    # half_pixel+round_prefer_floor picks elements [1, 3]
+    got = _run_node("Resize", {"x": x}, {"mode": "nearest"},
+                    initializers=(
+                        _init(np.asarray([], np.float32), "roi"),
+                        _init(np.asarray([1, 1, 1, 0.4], np.float32),
+                              "scales")))[0]
+    np.testing.assert_allclose(got.reshape(-1), [1.0, 3.0])
+    # scale 1.5 on dim 5: floor(7.5) = 7, not round's 8
+    got = _run_node("Resize", {"x": x}, {"mode": "nearest"},
+                    initializers=(
+                        _init(np.asarray([], np.float32), "roi"),
+                        _init(np.asarray([1, 1, 1, 1.5], np.float32),
+                              "scales")))[0]
+    assert got.shape == (1, 1, 1, 7), got.shape
+
+
+def test_prelu_trailing_broadcast_wins_ambiguity():
+    """ONNX unidirectional broadcast: slope (3,) on x (2,3,4,3) applies
+    along the LAST axis even though it also matches the channel dim."""
+    x = rng.randn(2, 3, 4, 3).astype(np.float32)
+    slope = np.asarray([0.1, 0.2, 0.3], np.float32)
+    got = _run_node("PRelu", {"x": x}, {},
+                    initializers=(_init(slope, "slope"),))[0]
+    want = np.where(x >= 0, x, x * slope)  # numpy trailing broadcast
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_reduce_logsumexp_stable():
+    x = np.full((2, 3), 100.0, np.float32)
+    got = _run_node("ReduceLogSumExp", {"x": x}, {"axes": [1]})[0]
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, 100.0 + np.log(3.0), rtol=1e-5)
