@@ -191,14 +191,17 @@ class GPT2LMHead(model.Model):
         generation fits n_positions decode through the KV-cached
         incremental path (models/gpt2_decode.py — one compiled
         prefill + lax.scan, O(S·D) per token) instead of one
-        full-context forward per token; MoE/plan models and
-        over-length generations use the windowed path below."""
+        full-context forward per token; plan-sharded dense models
+        decode there too (SPMD over the mesh, round 4); MoE models
+        and over-length generations use the windowed path below."""
         n0 = len(np.asarray(prompt_ids).reshape(-1))
         blocks = self.transformer.blocks
         initialized = bool(blocks) and blocks[0].mlp is not None
         if use_cache is None:
-            use_cache = (self.plan is None
-                         and self.cfg.moe_every is None
+            # plan-sharded dense models decode through the KV cache too
+            # since round 4 (extract_params lays weights out per the
+            # plan; the pure-jnp generation jits SPMD over the mesh)
+            use_cache = (self.cfg.moe_every is None
                          and initialized  # deferred init needs a forward
                          and n0 + max_new_tokens <= self.cfg.n_positions)
         # .training only exists after train()/eval(); an un-compiled

@@ -1016,13 +1016,26 @@ def _h_resize(node, args):
             return v
 
         return _op(f, x, _name="Resize")
-    if mode in ("linear", "cubic"):
+    if mode == "linear":
         if ctm != "half_pixel":
             raise NotImplementedError(
-                f"ONNX Resize {mode} supports half_pixel only, got {ctm}")
-        method = "linear" if mode == "linear" else "cubic"
-        return _op(lambda v: jax.image.resize(v, out_shape, method=method),
-                   x, _name="Resize")
+                f"ONNX Resize linear supports half_pixel only, got {ctm}")
+        if a.get("antialias", 0):
+            raise NotImplementedError(
+                "ONNX Resize antialias=1 is not supported")
+        # antialias=False: ONNX defaults to plain interpolation on
+        # downscale; jax.image.resize would antialias by default
+        return _op(lambda v: jax.image.resize(
+            v, out_shape, method="linear", antialias=False),
+            x, _name="Resize")
+    if mode == "cubic":
+        # jax's cubic kernel is Keys a=-0.5; ONNX/torch/ORT default
+        # cubic_coeff_a=-0.75 — silently substituting one for the other
+        # ships wrong activations, so refuse rather than approximate
+        raise NotImplementedError(
+            "ONNX Resize mode=cubic is not supported (jax's Keys "
+            "a=-0.5 kernel differs from ONNX's default "
+            "cubic_coeff_a=-0.75)")
     raise NotImplementedError(f"ONNX Resize mode {mode!r}")
 
 
