@@ -211,13 +211,20 @@ def bench_gpt2_decode(batch=8, prompt_len=128, n_new=512, repeats=3,
     import jax
     import jax.numpy as jnp
 
-    from singa_tpu import tensor
+    from singa_tpu import device, tensor
     from singa_tpu.models import gpt2_decode
     from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 
-    cfg = GPT2Config.small(n_positions=1024, dropout=0.0)
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(0)
+    # attn_impl pinned to fused: the layer-stack forward here only
+    # exists to deferred-init the params (decode itself is the pure-jnp
+    # KV path), and S=1024 auto now resolves to the flash kernel, which
+    # the host CppCPU device can't run when the default backend is TPU
+    cfg = GPT2Config.small(n_positions=1024, dropout=0.0,
+                           attn_impl="fused")
     m = GPT2LMHead(cfg)
-    m.compile([tensor.from_numpy(np.zeros((1, 8), np.int32))],
+    m.compile([tensor.from_numpy(np.zeros((1, 8), np.int32), dev)],
               is_train=False, use_graph=False)
     params = gpt2_decode.extract_params(
         m, dtype=jnp.bfloat16 if bf16 else None)
