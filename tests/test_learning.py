@@ -138,3 +138,40 @@ def test_charrnn_perplexity_bound(dev):
         _, loss = m(x, y)
     ppl = float(np.exp(tensor.to_numpy(loss)))
     assert ppl < 2.0, f"char-RNN perplexity {ppl:.2f} >= 2.0 (|V|={vocab})"
+
+
+def test_unet_segments_rectangles_over_90(dev):
+    """Segmentation family learning target: binary masks of axis-
+    aligned bright rectangles on noisy backgrounds.  Chance pixel
+    accuracy tracks the background fraction (~72% with these sizes);
+    predicting 'all background' cannot pass the foreground-IoU bar, so
+    the decoder (ConvTranspose + skips) must genuinely localize."""
+    from singa_tpu.models.unet import unet
+
+    rng = np.random.RandomState(0)
+    n, hw = 48, 32
+    xs = rng.randn(n, 1, hw, hw).astype(np.float32) * 0.3
+    ys = np.zeros((n, hw, hw), np.int32)
+    for i in range(n):
+        h0, w0 = rng.randint(2, hw // 2, 2)
+        hh, ww = rng.randint(8, hw // 2, 2)
+        xs[i, 0, h0:h0 + hh, w0:w0 + ww] += 1.5
+        ys[i, h0:h0 + hh, w0:w0 + ww] = 1
+
+    m = unet(num_classes=2, base_channels=8, depth=2)
+    m.set_optimizer(opt.Adam(lr=2e-3))
+    x = tensor.from_numpy(xs, dev)
+    y = tensor.from_numpy(ys, dev)
+    m.compile([x], is_train=True, use_graph=True)
+    for _ in range(60):
+        _, loss = m(x, y)
+    assert np.isfinite(float(tensor.to_numpy(loss)))
+
+    m.eval()
+    pred = np.argmax(tensor.to_numpy(m.forward(x)), axis=1)
+    pix_acc = float(np.mean(pred == ys))
+    inter = np.logical_and(pred == 1, ys == 1).sum()
+    union = np.logical_or(pred == 1, ys == 1).sum()
+    iou = inter / max(union, 1)
+    assert pix_acc > 0.90, pix_acc
+    assert iou > 0.60, (iou, pix_acc)
