@@ -152,8 +152,9 @@ def ring_attention_sharded(q, k, v, mesh=None, axis_name="seq",
                            causal=False):
     """Global arrays (B, H, S, D) with S sharded over ``axis_name``."""
     if mesh is None:
-        devices = jax.devices()
-        mesh = Mesh(__import__("numpy").asarray(devices), (axis_name,))
+        import numpy as np
+
+        mesh = Mesh(np.asarray(jax.devices()), (axis_name,))
     spec = P(None, None, axis_name, None)
 
     f = jax.shard_map(
