@@ -273,7 +273,22 @@ def _planned_step_collectives(kind, world):
     from singa_tpu.parallel import sharding as shd
 
     rng = np.random.RandomState(0)
-    if kind == "tp":
+    if kind == "sp":
+        # ring attention: flash kernel per hop inside shard_map; the
+        # HLO's collective-permute bytes are the MEASURED fwd+bwd ring
+        # wire cost (the analytic ici_projection_ring_attention row
+        # otherwise assumes ~3x the forward K/V bytes for training)
+        from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+        mesh = shd.create_mesh(sp=world)
+        plan = shd.ShardingPlan(mesh)
+        m = GPT2LMHead(GPT2Config.tiny(dropout=0.0, attn_impl="flash"),
+                       plan=plan)
+        ids = tensor.from_numpy(
+            rng.randint(0, 256, (1, 8 * world)).astype(np.int32))
+        labels = tensor.from_numpy(
+            rng.randint(0, 256, (1, 8 * world)).astype(np.int32))
+    elif kind == "tp":
         from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 
         mesh = shd.create_mesh(dp=2, tp=world // 2)
@@ -383,7 +398,7 @@ def _ring_attention_projection(worlds=(8, 16)):
            "assumed_ici_bytes_per_s": _ICI_BW}
     for w in worlds:
         t_comm = kv_bytes_hop / _ICI_BW          # per fwd hop
-        t_comm_train = 3 * t_comm                # + dK/dV backward ring
+        t_comm_train = 4 * t_comm                # HLO-measured factor
         fwd_no = h["t_fwd_s"] / (h["t_fwd_s"] + t_comm)
         fwd_full = min(1.0, h["t_fwd_s"] / max(h["t_fwd_s"], t_comm))
         tr_no = h["t_fwd_bwd_s"] / (h["t_fwd_bwd_s"] + t_comm_train)
@@ -552,6 +567,15 @@ def main():
         result["hlo_tensor_parallel"] = _planned_step_collectives("tp", W)
         result["hlo_moe"] = _planned_step_collectives("ep", W)
         result["hlo_pipeline"] = _planned_step_collectives("pp", W)
+        ring = _planned_step_collectives("sp", W)
+        ring["note"] = (
+            "collective_bytes_per_step sums the LOOP-BODY instruction "
+            "bytes once; each executes per ring hop, so per-step wire "
+            "= bytes x W. The 8 collective-permutes = fwd k/v + bwd "
+            "k/v re-rotation + dk/dv cotangents + saved-carry pair: "
+            "4x the forward K/V bytes, the factor "
+            "ici_projection_ring_attention's train rows use.")
+        result["hlo_ring_attention"] = ring
 
     with open(os.path.join(_REPO, args.out), "w") as f:
         json.dump(result, f, indent=1)

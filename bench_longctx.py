@@ -92,7 +92,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--out", default="LONGCTX.json")
-    ap.add_argument("--seqlens", default="512,2048,4096,8192,16384,32768")
+    ap.add_argument("--seqlens",
+                    default="512,1024,2048,4096,8192,16384,32768")
     args = ap.parse_args()
 
     cells = []

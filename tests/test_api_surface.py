@@ -70,9 +70,9 @@ def test_layer_api():
     from singa import layer
 
     _has(layer, [
-        "Layer", "Linear", "Conv2d", "BatchNorm2d", "Pooling2d",
-        "MaxPool2d", "AvgPool2d", "ReLU", "Flatten", "Dropout",
-        "LayerNorm", "Embedding", "LSTM", "GRU", "RNN",
+        "Layer", "Linear", "Conv2d", "ConvTranspose2d", "BatchNorm2d",
+        "Pooling2d", "MaxPool2d", "AvgPool2d", "ReLU", "Flatten",
+        "Dropout", "LayerNorm", "Embedding", "LSTM", "GRU", "RNN",
         "MultiHeadAttention", "SoftMaxCrossEntropy",
     ])
 
@@ -126,7 +126,8 @@ def test_parallel_api():
 
 def test_models_zoo():
     from singa_tpu.models import (alexnet, bert, char_rnn, cnn, gpt2,  # noqa
-                                  mlp, resnet, xceptionnet)
+                                  mlp, mobilenet, resnet, unet, vgg,
+                                  xceptionnet)
 
     from singa_tpu.models.resnet import (resnet18, resnet34, resnet50,
                                          resnet101, resnet152)
