@@ -165,13 +165,20 @@ def rnn_forward(x, hx, cx, W, handle, batch_first=False):
         for d in range(D):
             idx = l * D + d
 
-            def f(xv, hv, cv, wv, l=l, d=d, idx=idx):
+            def f(xv, hv, cv, wv, l=l, d=d, idx=idx, **_meta):
                 params = handle.unpack(wv, l, d)
                 y, hT, cT = _scan_direction(
                     xv, hv[idx], cv[idx], params, mode, reverse=(d == 1))
                 return y, hT, cT
 
-            y, hT, cT = _Func(fn=f, name=f"RNN[l{l}d{d}]")(inp, hx, cx, W)
+            # slice metadata rides op.params so sonnx export can unpack
+            # the flat weight into ONNX W/R/B initializers (_dec_rnn)
+            y, hT, cT = _Func(
+                fn=f, name=f"RNN[l{l}d{d}]",
+                mode=mode, layer=l, direction=d, idx=idx, hidden=H,
+                slices={name: handle.slices[(l, d, name)]
+                        for name in ("w_ih", "w_hh", "b_ih", "b_hh")},
+            )(inp, hx, cx, W)
             outs.append(y)
             h_finals.append(hT)
             c_finals.append(cT)

@@ -1373,6 +1373,106 @@ def _dec_mul_scalar(op, in_names, emit, out_name):
                                               np.float32(s))], [out_name])
 
 
+
+
+# ONNX gate-order maps for EXPORT (ours -> ONNX): inverse of the import
+# permutations (_ONNX_GATE_PERM)
+_EXPORT_GATE_PERM = {"lstm": [0, 3, 1, 2], "gru": [1, 0, 2],
+                     "vanilla_tanh": [0], "vanilla_relu": [0]}
+_EXPORT_RNN_NODE = {"lstm": "LSTM", "gru": "GRU",
+                    "vanilla_tanh": "RNN", "vanilla_relu": "RNN"}
+
+
+def _dec_rnn(op, in_names, emit, out_name):
+    """One taped RNN[l{l}d{d}] op (ops/rnn.py rnn_forward: a single
+    layer-direction scan over the packed flat weight) -> one ONNX
+    LSTM/GRU/RNN node.  The packed-weight slices ride op.params, so the
+    ONNX-format W/R/B constants are computed here from the flat
+    weight's concrete values (gate reorder ours->ONNX is the inverse of
+    the importer's map — tests/test_sonnx round-trips both); the
+    initial states are WIRED from the op's hx/cx inputs through Slice
+    nodes (graph inputs and upstream-computed states export
+    faithfully, nothing is baked).  The op's three outputs (y (T,B,H),
+    h_T (B,H), c_T) become Squeeze views of the node's Y (T,1,B,H) /
+    Y_h (1,B,H) / Y_c."""
+    from .ops.rnn import _GATES
+
+    p = getattr(op, "params", {}) or {}
+    mode = p["mode"]
+    H = int(p["hidden"])
+    G = _GATES[mode]
+    reverse = int(p["direction"]) == 1
+    idx = int(p["idx"])
+    sl = p["slices"]
+
+    w_t = op.src[3][2]          # the flat packed weight Tensor
+    w_flat = tensor.to_numpy(w_t)
+
+    def unpack(name):
+        a, b, shape = sl[name]
+        return w_flat[a:b].reshape(shape)
+
+    ridx = np.concatenate(
+        [np.arange(q * H, (q + 1) * H)
+         for q in _EXPORT_GATE_PERM[mode]])
+    W = unpack("w_ih")[ridx][None]            # (1, G*H, I)
+    R = unpack("w_hh")[ridx][None]            # (1, G*H, H)
+    B = np.concatenate([unpack("b_ih")[ridx],
+                        unpack("b_hh")[ridx]])[None]  # (1, 2*G*H)
+
+    u = emit.uniq(_EXPORT_RNN_NODE[mode])
+
+    def row(src_name, tag):
+        # hx/cx are (L*D, B, H); the node wants row ``idx`` as (1,B,H)
+        out = f"{u}_{tag}"
+        emit.node("Slice",
+                  [src_name,
+                   emit.const(f"const_i64_{idx}",
+                              np.asarray([idx], np.int64)),
+                   emit.const(f"const_i64_{idx + 1}",
+                              np.asarray([idx + 1], np.int64)),
+                   emit.const("const_i64_0",
+                              np.asarray([0], np.int64))],
+                  [out])
+        return out
+
+    wn = emit.const(f"{u}_W", W.astype(np.float32))
+    rn = emit.const(f"{u}_R", R.astype(np.float32))
+    bn = emit.const(f"{u}_B", B.astype(np.float32))
+    ins = [in_names[0], wn, rn, bn, "", row(in_names[1], "h0")]
+    attrs = dict(hidden_size=H)
+    if reverse:
+        attrs["direction"] = "reverse"
+    if mode == "gru":
+        attrs["linear_before_reset"] = 1   # the cuDNN cell form
+    if mode == "vanilla_relu":
+        attrs["activations"] = ["Relu"]
+    node_type = _EXPORT_RNN_NODE[mode]
+    y_raw, h_raw = f"{u}_Y", f"{u}_Yh"
+    outs = [y_raw, h_raw]
+    if mode == "lstm":
+        ins.append(row(in_names[2], "c0"))
+        outs.append(f"{u}_Yc")
+    emit.node(node_type, ins, outs, **attrs)
+
+    # taped outputs: out0 = y (T,B,H); out1 = h_T (B,H); out2 = c_T.
+    # tensor_name suffixes are deterministic (_out{i}) — derive the
+    # sibling names from out0's.  Squeeze axes ride as an int64 INPUT
+    # (opset >= 13 form; the exported model declares opset 20).
+    assert out_name.endswith("_out0"), out_name
+    stem = out_name[:-1]
+    ax1 = emit.const("const_i64_axes1", np.asarray([1], np.int64))
+    ax0 = emit.const("const_i64_axes0", np.asarray([0], np.int64))
+    emit.node("Squeeze", [y_raw, ax1], [out_name])
+    emit.node("Squeeze", [h_raw, ax0], [stem + "1"])
+    if mode == "lstm":
+        emit.node("Squeeze", [f"{u}_Yc", ax0], [stem + "2"])
+    else:
+        # rnn_forward's c_T for non-LSTM modes is zeros_like(h_T):
+        # h - h gives the right shape without baking one
+        emit.node("Sub", [stem + "1", stem + "1"], [stem + "2"])
+
+
 _EXPORT_DECOMPOSE = {
     "Attention": _dec_attention,
     "TPAttention": _dec_attention,
@@ -1458,8 +1558,18 @@ def to_onnx(m, inputs, model_name="singa_model"):
         if id(op) in seen_ops:
             return
         seen_ops[id(op)] = True
+        base = op.name.split("#")[0]
+        is_rnn = base.startswith("RNN[l")
         in_names = []
-        for src_op, x_id, x_t, _ in op.src:
+        for src_i, (src_op, x_id, x_t, _) in enumerate(op.src):
+            if is_rnn and src_i == 3:
+                # the packed flat weight: _dec_rnn re-emits it as
+                # unpacked ONNX W/R/B constants — resolving it here
+                # would store every RNN's parameters twice (and, for a
+                # re-exported imported model, drag in the importer's
+                # dangling weight-packing subgraph)
+                in_names.append(None)
+                continue
             if x_id in input_names:
                 in_names.append(input_names[x_id])
             elif x_id in param_by_id:
@@ -1487,7 +1597,9 @@ def to_onnx(m, inputs, model_name="singa_model"):
                     "export found an untracked constant input (tensor with "
                     "requires_grad=False); mark it requires_grad or feed it "
                     "as a model input")
-        base = op.name.split("#")[0]
+        if is_rnn:
+            _dec_rnn(op, in_names, emit, tensor_name(None, op, 0))
+            return
         if base in _EXPORT_DECOMPOSE:
             _EXPORT_DECOMPOSE[base](op, in_names, emit,
                                     tensor_name(None, op, 0))

@@ -718,3 +718,103 @@ def test_onnx_rnn_reverse_direction(dev):
     # t=0, i.e. the loop-end h — NOT Y[-1]
     np.testing.assert_allclose(np.asarray(outs[1]), h[None], rtol=2e-4,
                                atol=1e-5)
+
+
+def test_rnn_family_export_import_roundtrip(dev):
+    """Native RNN layers export as ONNX LSTM/GRU/RNN nodes (round 4:
+    the importer gained the family earlier in the round; export closes
+    the asymmetry).  Each taped layer-direction scan becomes one node
+    whose W/R/B constants are unpacked from the flat packed weight with
+    the inverse gate reorder — all modes x both directions, 2 layers."""
+    from singa_tpu import layer, model
+
+    class Net(model.Model):
+        def __init__(self, cls, bidir):
+            super().__init__()
+            self.rnn = cls(8, bidirectional=bidir, num_layers=2)
+            self.fc = layer.Linear(5)
+
+        def forward(self, x):
+            y, _ = self.rnn(x)
+            return self.fc(y)
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(6, 3, 4).astype(np.float32)
+    for cls, node_type in ((layer.LSTM, "LSTM"), (layer.GRU, "GRU"),
+                           (layer.RNN, "RNN")):
+        for bidir in (False, True):
+            m = Net(cls, bidir)
+            x = tensor.from_numpy(x_np, dev)
+            m.compile([x], is_train=False, use_graph=False)
+            m.eval()
+            native = tensor.to_numpy(m.forward(x))
+            proto = sonnx.to_onnx(m, [x])
+            n_nodes = sum(1 for n in proto.graph.node
+                          if n.op_type == node_type)
+            assert n_nodes == 2 * (2 if bidir else 1), \
+                (node_type, bidir, n_nodes)
+            rep = sonnx.prepare(proto, dev)
+            got = tensor.to_numpy(rep.run([x])[0])
+            np.testing.assert_allclose(got, native, rtol=2e-4,
+                                       atol=1e-5,
+                                       err_msg=f"{node_type} {bidir}")
+
+
+def test_char_rnn_model_exports(dev):
+    """The config-#3 model family round-trips through ONNX end to end
+    (embedding-free one-hot input -> LSTM stack -> head)."""
+    from singa_tpu.models.char_rnn import CharRNN, one_hot
+
+    m = CharRNN(20, hidden_size=12, num_layers=2, seq_length=7)
+    ids = np.random.RandomState(0).randint(0, 20, (3, 7))
+    x = tensor.from_numpy(one_hot(ids, 20), dev)
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    native = tensor.to_numpy(m.forward(x))
+    proto = sonnx.to_onnx(m, [x])
+    assert any(n.op_type == "LSTM" for n in proto.graph.node)
+    rep = sonnx.prepare(proto, dev)
+    got = tensor.to_numpy(rep.run([x])[0])
+    np.testing.assert_allclose(got, native, rtol=2e-4, atol=1e-5)
+
+
+def test_rnn_export_wires_user_initial_state(dev):
+    """A user-supplied h0/c0 passed as MODEL INPUTS must be wired into
+    the exported LSTM node (Slice of the graph input), not baked as an
+    export-time constant — running the imported model with a different
+    h0 must track the native model.  Also: the flat packed weight must
+    NOT appear among the initializers (the node carries unpacked W/R/B
+    constants; storing both would double the parameter bytes)."""
+    from singa_tpu import layer, model
+
+    class Net(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.rnn = layer.LSTM(8, num_layers=1)
+
+        def forward(self, x, h0, c0):
+            y, _ = self.rnn(x, h0, c0)
+            return y
+
+    m = Net()
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(5, 2, 4).astype(np.float32), dev)
+    h0 = tensor.from_numpy(rng.randn(1, 2, 8).astype(np.float32), dev)
+    c0 = tensor.from_numpy(rng.randn(1, 2, 8).astype(np.float32), dev)
+    m.compile([x, h0, c0], is_train=False, use_graph=False)
+    m.eval()
+    native = tensor.to_numpy(m.forward(x, h0, c0))
+    proto = sonnx.to_onnx(m, [x, h0, c0])
+    assert not any(
+        len(i.dims) == 1
+        and int(np.prod(i.dims)) == m.rnn.handle.weights_size
+        for i in proto.graph.initializer)
+    rep = sonnx.prepare(proto, dev)
+    got = tensor.to_numpy(rep.run([x, h0, c0])[0])
+    np.testing.assert_allclose(got, native, rtol=2e-4, atol=1e-5)
+    # a DIFFERENT initial state at run time must flow through
+    h2 = tensor.from_numpy(np.zeros((1, 2, 8), np.float32), dev)
+    native2 = tensor.to_numpy(m.forward(x, h2, c0))
+    got2 = tensor.to_numpy(rep.run([x, h2, c0])[0])
+    np.testing.assert_allclose(got2, native2, rtol=2e-4, atol=1e-5)
+    assert np.abs(native - native2).max() > 1e-4  # h0 genuinely matters
