@@ -695,6 +695,49 @@ def _h_global_avg_pool(node, args):
     return autograd.reduce_mean(args[0], axes=(2, 3), keepdims=True)
 
 
+def _h_global_max_pool(node, args):
+    return _op(lambda x: jnp.max(x, axis=tuple(range(2, x.ndim)),
+                                 keepdims=True),
+               args[0], _name="GlobalMaxPool")
+
+
+def _h_upsample(node, args):
+    """Legacy Upsample (deprecated at opset 10 in favor of Resize):
+    scales as attr (opset 7) or second input (9); nearest mode uses the
+    asymmetric/floor indexing this op predates Resize's ctm zoo with."""
+    a = node.attrs()
+    mode = a.get("mode", "nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    scales = a.get("scales")
+    if scales is None:
+        scales = [float(s) for s in _np(args[1]).reshape(-1)]
+    x = args[0]
+    out_shape = tuple(int(np.floor(d * s))
+                      for d, s in zip(x.shape, scales))
+    if out_shape[:2] != tuple(x.shape[:2]):
+        raise NotImplementedError(
+            "ONNX Upsample on batch/channel dims is not supported")
+    if mode == "nearest":
+        def f(v):
+            for ax in range(2, v.ndim):
+                n_in, n_out = v.shape[ax], out_shape[ax]
+                if n_in == n_out:
+                    continue
+                idx = jnp.clip(jnp.floor(
+                    jnp.arange(n_out, dtype=jnp.float32)
+                    / scales[ax]).astype(jnp.int32), 0, n_in - 1)
+                v = jnp.take(v, idx, axis=ax)
+            return v
+
+        return _op(f, x, _name="Upsample")
+    if mode in ("linear", "bilinear"):
+        return _op(lambda v: jax.image.resize(
+            v, out_shape, method="linear", antialias=False),
+            x, _name="Upsample")
+    raise NotImplementedError(f"ONNX Upsample mode {mode!r}")
+
+
 def _h_conv_transpose(node, args):
     from .ops import conv as conv_ops
 
@@ -1161,6 +1204,8 @@ _ONNX_OPS = {
     "MaxPool": _h_pool(True),
     "AveragePool": _h_pool(False),
     "GlobalAveragePool": _h_global_avg_pool,
+    "GlobalMaxPool": _h_global_max_pool,
+    "Upsample": _h_upsample,
     "BatchNormalization": _h_batchnorm,
     "Reshape": _h_reshape,
     "Transpose": _h_transpose,

@@ -220,6 +220,11 @@ CASES = {
     "GRU": lambda: _rnn_case("GRU"),
     "RNN": lambda: _rnn_case("RNN"),
     "Resize": lambda: _resize_case(),
+    "GlobalMaxPool": lambda: ({"x": X4}, {}, (),
+                              [X4.max(axis=(2, 3), keepdims=True)]),
+    "Upsample": lambda: (
+        {"x": rng.randn(1, 2, 3, 4).astype(np.float32)},
+        {"mode": "nearest", "scales": [1.0, 1.0, 2.0, 2.0]}, (), None),
     "InstanceNormalization": lambda: _instancenorm_case(),
     "PRelu": lambda: (
         {"x": A}, {}, (_init(np.asarray([0.1, 0.2, 0.3], np.float32),
@@ -504,6 +509,9 @@ def test_onnx_node_conformance(op):
             golden = [torch.nn.functional.pixel_shuffle(tx["x"], 2).numpy()]
         elif op == "SpaceToDepth":
             golden = [_s2d_loop(np.asarray(inputs["x"]), 2)]
+        elif op == "Upsample":
+            golden = [torch.nn.functional.interpolate(
+                tx["x"], scale_factor=2, mode="nearest").numpy()]
     for got, want in zip(outs, golden):
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want, np.float32),
