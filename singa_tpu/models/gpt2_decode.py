@@ -212,6 +212,23 @@ def prefill(params, ids, n_head, eps):
     return x, jnp.stack(ks), jnp.stack(vs)
 
 
+def _advance_one(params, x, kc, vc, pos, n_head, eps):
+    """Advance one decode step through every block: x (B, 1, E) at
+    position ``pos`` against caches (L, B, H, ctx, D).  Returns
+    ((B, V) logits, new kc, new vc).  Shared by sampling
+    (_generate_row) and beam search so the two paths cannot drift."""
+    new_kc, new_vc = [], []
+    for li, p in enumerate(params["blocks"]):
+        x, kl, vl = _block_decode(x, p, kc[li], vc[li], pos, n_head,
+                                  eps)
+        new_kc.append(kl)
+        new_vc.append(vl)
+    kc = jnp.stack(new_kc)
+    vc = jnp.stack(new_vc)
+    x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
+    return _logits(x, params)[:, 0], kc, vc
+
+
 def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p):
     """One token from a (V,) logit row.  ``greedy``/``top_k``/
     ``use_top_p`` are static; ``temperature``/``top_p`` are traced.
@@ -259,18 +276,10 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
         tok, pos, kc, vc, key = carry
         x = params["wte"][tok][None, None, :] + \
             params["wpe"][pos][None, None, :]
-        new_kc, new_vc = [], []
-        for li, p in enumerate(params["blocks"]):
-            x, kl, vl = _block_decode(x, p, kc[li], vc[li], pos, n_head,
+        logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
                                       eps)
-            new_kc.append(kl)
-            new_vc.append(vl)
-        kc = jnp.stack(new_kc)
-        vc = jnp.stack(new_vc)
-        x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
-        logit = _logits(x, params)[0, 0]
         k, key = jax.random.split(key)
-        nxt = sample(logit, k)
+        nxt = sample(logits[0], k)
         return (nxt, pos + 1, kc, vc, key), tok
 
     (last, _, _, _, _), toks = jax.lax.scan(
@@ -293,6 +302,101 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
     return jax.vmap(
         lambda i, n, k: row(params, i, n, k, temperature, top_p))(
             ids, prompt_lens, keys)
+
+
+@partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
+                                   "num_beams"))
+def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
+                        ctx, num_beams):
+    """Fixed-length beam search, ONE compiled prefill + scan.  ids:
+    (1, ctx) right-padded prompt.  Returns ((num_beams, n_new) token
+    ids, (num_beams,) total log-probs), best beam first.  The beams
+    are the batch: per-beam KV caches reorder by parent at every step
+    (a gather on the leading axis).  Exact when num_beams covers the
+    frontier (tests compare against exhaustive search on tiny models).
+    """
+    hidden, kc, vc = prefill(params, ids, n_head, eps)
+    last_h = jax.lax.dynamic_index_in_dim(
+        hidden, prompt_len - 1, axis=1, keepdims=False)
+    logp0 = jax.nn.log_softmax(
+        _logits(last_h[:, None, :], params)[0, 0].astype(jnp.float32))
+    V = logp0.shape[0]
+    k0 = min(num_beams, V)
+    top0, tok0 = jax.lax.top_k(logp0, k0)
+    # pad the beam set if num_beams > V (dead beams at -inf)
+    pad = num_beams - k0
+    scores = jnp.concatenate(
+        [top0, jnp.full((pad,), NEG_INF, jnp.float32)])
+    toks = jnp.concatenate([tok0, jnp.zeros((pad,), jnp.int32)])
+    # replicate the prompt caches across beams
+    kc = jnp.broadcast_to(kc[:, None], (kc.shape[0], num_beams)
+                          + kc.shape[1:]).reshape(
+        (kc.shape[0], num_beams * kc.shape[1]) + kc.shape[2:])
+    vc = jnp.broadcast_to(vc[:, None], (vc.shape[0], num_beams)
+                          + vc.shape[1:]).reshape(
+        (vc.shape[0], num_beams * vc.shape[1]) + vc.shape[2:])
+    seqs = jnp.zeros((num_beams, n_new), jnp.int32)
+    seqs = seqs.at[:, 0].set(toks)
+
+    def step(carry, t):
+        seqs, scores, toks, kc, vc = carry
+        pos = prompt_len + t
+        x = jnp.take(params["wte"], toks, axis=0)[:, None, :] \
+            + params["wpe"][pos][None, None, :]
+        logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
+                                      eps)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))  # (B, V)
+        cand = scores[:, None] + logp                       # (B, V)
+        flat_scores, flat_idx = jax.lax.top_k(
+            cand.reshape(-1), num_beams)
+        parents = flat_idx // V
+        toks = (flat_idx % V).astype(jnp.int32)
+        seqs = seqs[parents].at[:, t + 1].set(toks)
+        kc = kc[:, parents]
+        vc = vc[:, parents]
+        return (seqs, flat_scores, toks, kc, vc), None
+
+    if n_new > 1:
+        (seqs, scores, *_), _ = jax.lax.scan(
+            step, (seqs, scores, toks, kc, vc),
+            jnp.arange(n_new - 1))
+    # already best-first: top_k (and the padded init) sort descending
+    return seqs, scores
+
+
+def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
+                  dtype=None):
+    """Fixed-length beam search for a dense (optionally plan-sharded)
+    GPT2LMHead: returns the highest-total-log-prob continuation of
+    ``max_new_tokens`` tokens.  One prompt (the beams are the batch);
+    ``num_beams=1`` equals greedy decoding.  No EOS handling — this
+    framework's models are tokenizer-free, so sequences are
+    fixed-length and the length penalty cancels."""
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    params = extract_params(m, dtype=dtype)
+    cfg = m.cfg
+    ids = np.asarray(prompt_ids, np.int32)
+    if ids.ndim > 1:
+        raise ValueError(
+            "generate_beam takes ONE 1-D prompt (the beams are the "
+            f"batch); got shape {ids.shape} — loop over rows for a "
+            "batch")
+    ids = ids.reshape(-1)
+    n0 = len(ids)
+    if max_new_tokens <= 0:
+        return ids.copy()
+    if n0 + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt ({n0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"n_positions ({cfg.n_positions})")
+    window = np.zeros((1, cfg.n_positions), np.int32)
+    window[0, :n0] = ids
+    seqs, _scores = _beam_search_cached(
+        params, jnp.asarray(window), n0, cfg.n_head,
+        float(cfg.layer_norm_eps), int(max_new_tokens),
+        cfg.n_positions, int(num_beams))
+    return np.concatenate([ids, np.asarray(seqs[0])]).astype(np.int32)
 
 
 def _seed(temperature, rng):

@@ -411,3 +411,67 @@ def test_tp_sharded_kv_decode_matches_serial():
     # and the public wrapper auto-selects the cached path for the plan
     got2 = par.generate(prompt, max_new_tokens=8, temperature=0)
     np.testing.assert_array_equal(got2, ref)
+
+
+def test_beam_search_matches_exhaustive_and_greedy():
+    """num_beams=1 == greedy; a beam wide enough to cover the frontier
+    (num_beams = V^2 >= every level's node count for T=3) must find the
+    EXACT argmax continuation, verified by scoring all V^T candidate
+    continuations with teacher-forced forwards."""
+    import itertools
+
+    from singa_tpu.models import gpt2_decode
+
+    from singa_tpu import device as device_module
+
+    cfg = GPT2Config(vocab_size=6, n_positions=16, n_embd=32,
+                     n_layer=2, n_head=4, n_inner=64, dropout=0.0)
+    device_module.get_default_device().SetRandSeed(3)  # deterministic
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 8), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    prompt = np.asarray([1, 4, 2], np.int32)
+    T = 3
+
+    g_greedy = m.generate(prompt, max_new_tokens=T, temperature=0)
+    g_beam1 = gpt2_decode.generate_beam(m, prompt, max_new_tokens=T,
+                                        num_beams=1)
+    np.testing.assert_array_equal(g_greedy, g_beam1)
+
+    # exhaustive oracle: total log-prob of every continuation
+    def score(cont):
+        seq = np.concatenate([prompt, np.asarray(cont, np.int32)])
+        window = np.zeros((1, cfg.n_positions), np.int32)
+        window[0, :len(seq)] = seq
+        logits = tensor.to_numpy(
+            m.forward(tensor.from_numpy(window)))[0].astype(np.float64)
+        total = 0.0
+        for t in range(T):
+            row = logits[len(prompt) - 1 + t]
+            row = row - row.max()
+            total += row[cont[t]] - np.log(np.exp(row).sum())
+        return total
+
+    scored = sorted(
+        itertools.product(range(cfg.vocab_size), repeat=T), key=score,
+        reverse=True)
+    g_wide = gpt2_decode.generate_beam(m, prompt, max_new_tokens=T,
+                                       num_beams=cfg.vocab_size ** 2)
+    got = tuple(int(v) for v in g_wide[len(prompt):])
+    # fp32-beam vs float64-oracle near-ties: accept any candidate
+    # within 1e-4 nats of the exhaustive best
+    assert score(got) >= score(scored[0]) - 1e-4, \
+        (got, scored[0], score(got), score(scored[0]))
+
+    # a modest beam must never score below greedy
+    g4 = gpt2_decode.generate_beam(m, prompt, max_new_tokens=T,
+                                   num_beams=4)
+    assert score(tuple(g4[len(prompt):])) >= \
+        score(tuple(g_greedy[len(prompt):])) - 1e-9
+    with pytest.raises(ValueError):
+        gpt2_decode.generate_beam(m, prompt, max_new_tokens=2,
+                                  num_beams=0)
+    with pytest.raises(ValueError):
+        gpt2_decode.generate_beam(m, np.zeros((2, 3), np.int32),
+                                  max_new_tokens=2)
