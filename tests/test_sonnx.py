@@ -818,3 +818,46 @@ def test_rnn_export_wires_user_initial_state(dev):
     got2 = tensor.to_numpy(rep.run([x, h2, c0])[0])
     np.testing.assert_allclose(got2, native2, rtol=2e-4, atol=1e-5)
     assert np.abs(native - native2).max() > 1e-4  # h0 genuinely matters
+
+
+def test_imported_lstm_reexports(dev):
+    """Full circle: an externally-shaped ONNX LSTM imports (gate
+    reorder onto the packed stack), wraps in SONNXModel, RE-exports
+    (the packed weight unpacks back to ONNX W/R/B — no dangling
+    weight-packing subgraph, no double-stored parameters), and
+    re-imports with parity against the original torch golden."""
+    from tests.test_onnx_conformance import _rnn_case
+
+    inputs, attrs, inits, golden = _rnn_case("LSTM")
+    node = onnx_pb.NodeProto(
+        op_type="LSTM", name="n0",
+        input=list(inputs) + [t.name for t in inits],
+        output=["Y", "Yh", "Yc"])
+    for k, v in attrs.items():
+        node.attribute.append(onnx_pb.AttributeProto.make(k, v))
+    g = onnx_pb.GraphProto(
+        name="g", node=[node], initializer=list(inits),
+        input=[onnx_pb.ValueInfoProto(name=k, elem_type=onnx_pb.FLOAT,
+                                      shape=list(np.asarray(v).shape))
+               for k, v in inputs.items()],
+        output=[onnx_pb.ValueInfoProto(name="Y",
+                                       elem_type=onnx_pb.FLOAT,
+                                       shape=[])])
+    proto = onnx_pb.ModelProto(graph=g)
+    x = tensor.from_numpy(np.asarray(inputs["x"]), dev)
+
+    m2 = sonnx.SONNXModel(proto, dev)
+    m2.compile([x], is_train=False, use_graph=False)
+    m2.eval()
+    native = tensor.to_numpy(m2.forward(x))
+    proto2 = sonnx.to_onnx(m2, [x])
+    assert any(n.op_type == "LSTM" for n in proto2.graph.node)
+    # no Gather/Concat pack subgraph dragged into the re-export
+    assert not any(n.op_type in ("Gather", "Concat")
+                   for n in proto2.graph.node)
+    rep2 = sonnx.prepare(proto2, dev)
+    (y2,) = rep2.run([x])
+    np.testing.assert_allclose(tensor.to_numpy(y2), native, rtol=2e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(tensor.to_numpy(y2), golden[0],
+                               rtol=2e-4, atol=1e-5)
