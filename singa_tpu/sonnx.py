@@ -1262,6 +1262,28 @@ _ONNX_OPS = {
         beta=node.attrs().get("beta", 0.5)),
     "HardSwish": _handle_unary(
         lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)),
+    "LogSoftmax": lambda node, args: _op(
+        lambda x: jax.nn.log_softmax(x, axis=node.attrs().get("axis",
+                                                              -1)),
+        args[0], _name="LogSoftmax"),
+    "Celu": lambda node, args: _op(
+        lambda x, alpha: jnp.maximum(x, 0) + jnp.minimum(
+            0, alpha * (jnp.exp(x / alpha) - 1)),
+        args[0], _name="Celu", alpha=node.attrs().get("alpha", 1.0)),
+    "Mish": _handle_unary(
+        lambda x: x * jnp.tanh(jnp.log1p(jnp.exp(x)))),
+    "ThresholdedRelu": lambda node, args: _op(
+        lambda x, alpha: jnp.where(x > alpha, x, 0.0),
+        args[0], _name="ThresholdedRelu",
+        alpha=node.attrs().get("alpha", 1.0)),
+    "Shrink": lambda node, args: _op(
+        lambda x, lambd, bias: jnp.where(
+            x > lambd, x - bias, jnp.where(x < -lambd, x + bias, 0.0)),
+        args[0], _name="Shrink",
+        lambd=node.attrs().get("lambd", 0.5),
+        bias=node.attrs().get("bias", 0.0)),
+    "ReduceSumSquare": _h_reduce(lambda x, axis, keepdims: jnp.sum(
+        x * x, axis=axis, keepdims=keepdims)),
     "ReduceProd": _h_reduce(jnp.prod),
     "ReduceL1": _h_reduce(lambda x, axis, keepdims: jnp.sum(
         jnp.abs(x), axis=axis, keepdims=keepdims)),

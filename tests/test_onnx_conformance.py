@@ -266,6 +266,20 @@ CASES = {
                             [np.clip(0.25 * A + 0.4, 0, 1)]),
     "HardSwish": lambda: ({"x": 4 * A}, {}, (),
                           [4 * A * np.clip(4 * A / 6 + 0.5, 0, 1)]),
+    "LogSoftmax": lambda: ({"x": A}, {"axis": 1}, (),
+                           [np.log(_softmax(A, 1))]),
+    "Celu": lambda: ({"x": A}, {"alpha": 0.5}, (),
+                     [np.maximum(A, 0)
+                      + np.minimum(0, 0.5 * (np.exp(A / 0.5) - 1))]),
+    "Mish": lambda: ({"x": A}, {}, (),
+                     [A * np.tanh(np.log1p(np.exp(A)))]),
+    "ThresholdedRelu": lambda: ({"x": A}, {"alpha": 0.3}, (),
+                                [np.where(A > 0.3, A, 0.0)]),
+    "Shrink": lambda: ({"x": A}, {"lambd": 0.4, "bias": 0.1}, (),
+                       [np.where(A > 0.4, A - 0.1,
+                                 np.where(A < -0.4, A + 0.1, 0.0))]),
+    "ReduceSumSquare": lambda: ({"x": A}, {"axes": [1]}, (),
+                                [(A * A).sum(axis=1, keepdims=True)]),
     "ReduceProd": lambda: ({"x": np.abs(A) + 0.5}, {"axes": [1]}, (),
                            [np.prod(np.abs(A) + 0.5, axis=1,
                                     keepdims=True)]),
