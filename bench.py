@@ -234,13 +234,15 @@ def bench_gpt2_decode(batch=8, prompt_len=128, n_new=512, repeats=3,
     window[:, :prompt_len] = rng.randint(0, cfg.vocab_size,
                                          (batch, prompt_len))
     ids = jnp.asarray(window)
-    lens = jnp.full((batch,), prompt_len, jnp.int32)
     keys = jax.random.split(jax.random.PRNGKey(0), batch)
 
     def run(nn):
-        out = gpt2_decode.generate_cached(
-            params, ids, lens, cfg.n_head, float(cfg.layer_norm_eps),
-            nn, cfg.n_positions, True, jnp.float32(1.0), keys)
+        # equal-length prompts: the uniform fast path (shared position,
+        # batched cache writes) — what generate() auto-selects here
+        out = gpt2_decode.generate_cached_uniform(
+            params, ids, prompt_len, cfg.n_head,
+            float(cfg.layer_norm_eps), nn, cfg.n_positions, True,
+            jnp.float32(1.0), keys)
         np.asarray(out)  # sync
 
     def timed(nn):

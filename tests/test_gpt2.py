@@ -475,3 +475,45 @@ def test_beam_search_matches_exhaustive_and_greedy():
     with pytest.raises(ValueError):
         gpt2_decode.generate_beam(m, np.zeros((2, 3), np.int32),
                                   max_new_tokens=2)
+
+
+def test_uniform_decode_path_matches_ragged_and_windowed():
+    """The equal-length fast path (one shared position, batched cache
+    writes) must be token-exact (f32) against BOTH the ragged vmap path
+    and the windowed oracle — greedy and temperature sampling (the two
+    paths consume identical per-row key chains)."""
+    import jax
+    import jax.numpy as jnp
+
+    from singa_tpu.models import gpt2_decode as gd
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    params = gd.extract_params(m)
+    B, PL, T = 3, 7, 10
+    rng = np.random.RandomState(1)
+    window = np.zeros((B, cfg.n_positions), np.int32)
+    window[:, :PL] = rng.randint(0, cfg.vocab_size, (B, PL))
+    ids = jnp.asarray(window)
+    lens = jnp.full((B,), PL, jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(5), B)
+
+    for greedy, temp in ((True, 1.0), (False, 0.8)):
+        o_ragged = np.asarray(gd.generate_cached(
+            params, ids, lens, cfg.n_head, float(cfg.layer_norm_eps),
+            T, cfg.n_positions, greedy, jnp.float32(temp), keys))
+        o_uni = np.asarray(gd.generate_cached_uniform(
+            params, ids, PL, cfg.n_head, float(cfg.layer_norm_eps),
+            T, cfg.n_positions, greedy, jnp.float32(temp), keys))
+        np.testing.assert_array_equal(o_uni, o_ragged,
+                                      err_msg=f"greedy={greedy}")
+
+    m.eval()
+    for i in range(B):
+        w = m.generate(window[i, :PL], max_new_tokens=T, temperature=0,
+                       use_cache=False)
+        u = m.generate(window[i, :PL], max_new_tokens=T, temperature=0,
+                       use_cache=True)  # routes to the uniform path
+        np.testing.assert_array_equal(u, w)
