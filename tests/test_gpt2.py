@@ -517,3 +517,28 @@ def test_uniform_decode_path_matches_ragged_and_windowed():
         u = m.generate(window[i, :PL], max_new_tokens=T, temperature=0,
                        use_cache=True)  # routes to the uniform path
         np.testing.assert_array_equal(u, w)
+
+
+def test_tp_sharded_beam_search_matches_serial():
+    """Beam search composes with plan-sharded params the same way
+    sampling does (pure-jnp SPMD): tp=4 beam tokens equal serial."""
+    from singa_tpu import device as device_module
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    device_module.get_default_device().SetRandSeed(0)
+    serial = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    serial.compile([x], is_train=False, use_graph=False)
+    plan = shd.ShardingPlan(shd.create_mesh(tp=4))
+    par = GPT2LMHead(cfg, plan=plan)
+    par.set_sharding_plan(plan)
+    par.compile([x], is_train=False, use_graph=False)
+    par.set_states({k: tensor.to_numpy(v)
+                    for k, v in serial.get_states().items()})
+    prompt = np.arange(7) % cfg.vocab_size
+    b_ser = gpt2_decode.generate_beam(serial, prompt, max_new_tokens=6,
+                                      num_beams=4)
+    b_par = gpt2_decode.generate_beam(par, prompt, max_new_tokens=6,
+                                      num_beams=4)
+    np.testing.assert_array_equal(b_ser, b_par)
