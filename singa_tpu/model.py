@@ -138,6 +138,72 @@ class Model(layer.Layer):
             return self._graph_runner.run(args, kwargs)
         return self.train_one_batch(*args, **kwargs)
 
+    def train_n_batches(self, *args, n_steps=None, **kwargs):
+        """Run K training steps in ONE host dispatch (round-5 addition;
+        the reference dispatches per iteration — SURVEY.md §3.1 hot
+        loop).  Two modes:
+
+        * **stacked** (default): every ``Tensor`` argument carries a
+          leading steps axis ``K`` (e.g. ``x: (K, B, D)``,
+          ``y: (K, B)``) — K different prefetched batches;
+        * **repeat** (``n_steps=K``): Tensor arguments are per-step
+          shaped and the SAME device-resident batch feeds all K steps
+          (useful for benchmarking and tight fitting loops without
+          K-stacked input memory).
+
+        Non-Tensor arguments are trace-time constants shared by every
+        step.  The compiled program is ``lax.scan`` over the SAME step
+        function graph mode traces for ``train_one_batch``, with
+        donated state — so one tunnel round-trip buys K optimizer
+        updates, which makes small latency-bound models (MLP,
+        char-RNN) compute-bound instead of paying one host RTT per
+        step.
+
+        Returns ``train_one_batch``'s outputs with a leading K axis on
+        every leaf (a scalar loss becomes a ``(K,)`` loss history;
+        mind the memory if the model returns logits and K is large).
+        Identical math to K single steps: the PRNG key, optimizer step
+        counter and schedules advance inside the scan exactly as they
+        would across K separate dispatches (tests/test_model.py asserts
+        parity)."""
+        if not (self.graph_mode and self._graph_runner is not None):
+            raise ValueError(
+                "train_n_batches requires compile(..., use_graph=True) "
+                "— the multi-step scan only exists inside the compiled "
+                "graph step")
+        if not autograd.training:
+            # mirror __call__'s gate: in eval mode the step would trace
+            # without taping and still mutate params K times
+            raise ValueError(
+                "train_n_batches requires training mode (call "
+                "model.train() first); the model is in eval mode")
+        ts = [a for a in args if isinstance(a, Tensor)] + \
+            [v for v in kwargs.values() if isinstance(v, Tensor)]
+        if not ts:
+            raise ValueError("train_n_batches needs at least one Tensor "
+                             "input (the leading dim is the step count)")
+        if n_steps is not None:
+            if int(n_steps) < 1:
+                raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+            return self._graph_runner.run(args, kwargs,
+                                          n_steps=int(n_steps),
+                                          repeat=True)
+        for t in ts:
+            if len(t.shape) == 0:
+                raise ValueError(
+                    "a 0-d Tensor argument cannot carry a steps axis; "
+                    "pass it as a plain Python scalar (trace-time "
+                    "constant) or use repeat mode (n_steps=K)")
+        k = ts[0].shape[0]
+        for t in ts:
+            if t.shape[0] != k:
+                raise ValueError(
+                    f"all Tensor inputs must share the leading steps "
+                    f"dim: got {t.shape[0]} vs {k}")
+        if k < 1:
+            raise ValueError(f"steps dim must be >= 1, got {k}")
+        return self._graph_runner.run(args, kwargs, n_steps=int(k))
+
     def train(self, mode=True):
         self.training = bool(mode)
         autograd.set_training(mode)
@@ -375,9 +441,35 @@ class _GraphRunner:
             globals_sig,
         )
 
-    def run(self, args, kwargs):
+    def _slice_step0(self, args, kwargs):
+        """Per-step view of multi-step (K-leading) inputs: Tensor args
+        sliced at step 0 (shape/dtype carriers for the abstract key,
+        state probe, and step-builder structure)."""
+        dev = self.model.device
+
+        def sl(v):
+            if isinstance(v, Tensor):
+                return tensor._wrap(v.data[0], dev)
+            return v
+
+        return (tuple(sl(a) for a in args),
+                {k: sl(v) for k, v in kwargs.items()})
+
+    def run(self, args, kwargs, n_steps=None, repeat=False):
         model = self.model
-        key = self._abstract_key(args, kwargs)
+        # multi-step: key/probe/build on the per-step slice; the leading
+        # K axis lives only in the scan's xs.  repeat mode feeds the
+        # same per-step-shaped batch to every scan iteration, so inputs
+        # have NO leading steps axis (lead stays 0).
+        if n_steps is None or repeat:
+            key_args, key_kwargs = args, kwargs
+        else:
+            key_args, key_kwargs = self._slice_step0(args, kwargs)
+        lead = 0 if (n_steps is None or repeat) else 1   # inputs
+        out_lead = 0 if n_steps is None else 1           # scan-stacked ys
+        key = self._abstract_key(key_args, key_kwargs)
+        if n_steps is not None:
+            key = key + (("__steps__", n_steps, repeat),)
         if key not in self._warm_keys:
             # Materialize lazily-created optimizer state (momentum buffers,
             # sparse residuals) by abstractly evaluating one step — no
@@ -390,7 +482,7 @@ class _GraphRunner:
             # kwarg creates NEW optimizer state (e.g. sparse residuals)
             # that must be materialized too, or it would be left holding
             # dead tracers from its first trace.
-            self._materialize_state(args, kwargs)
+            self._materialize_state(key_args, key_kwargs)
             self._warm_keys.add(key)
         state = model.persistent_tensors()
         names = list(state.keys())
@@ -407,18 +499,27 @@ class _GraphRunner:
             # the pipeline use explicit shard_map collectives.
             plan = model.sharding_plan
             if plan.input_specs is None:
-                # "auto" input layout shards dim 0 over data; reject
-                # non-divisible batches instead of silently replicating
-                # (explicit input_specs is the override for genuinely
-                # non-batch-leading inputs)
+                # "auto" input layout shards (per-step) dim 0 over data;
+                # reject non-divisible batches instead of silently
+                # replicating (explicit input_specs is the override for
+                # genuinely non-batch-leading inputs)
                 dp = plan.axis_size("data")
                 for a in in_arrays:
-                    if a.ndim >= 1 and a.shape[0] % dp != 0:
+                    if a.ndim - lead >= 1 and a.shape[lead] % dp != 0:
                         raise ValueError(
-                            f"input dim 0 ({a.shape[0]}) not divisible by "
-                            f"data-axis size {dp}; pass "
+                            f"input dim {lead} ({a.shape[lead]}) not "
+                            f"divisible by data-axis size {dp}; pass "
                             f"ShardingPlan(input_specs=...) for non-batch "
                             f"inputs")
+
+            def in_spec(a, i):
+                # per-step spec, prefixed with the (unsharded) steps axis
+                # for multi-step stacked inputs
+                if lead:
+                    per = jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                    return P(None, *plan.spec_for_input(per, i))
+                return plan.spec_for_input(a, i)
+
             layout = self._plan_layouts.get(key)
             if layout is None or layout[0] != names:
                 param_specs = {
@@ -429,7 +530,7 @@ class _GraphRunner:
                     plan.sharding(plan.spec_for_state(n, t, param_specs))
                     for n, t in zip(names, tensors)
                 ], [
-                    plan.sharding(plan.spec_for_input(a, i))
+                    plan.sharding(in_spec(a, i))
                     for i, a in enumerate(in_arrays)
                 ], plan.sharding(P()))
                 self._plan_layouts[key] = layout
@@ -448,10 +549,11 @@ class _GraphRunner:
             nproc = jax.process_count()
             if nproc == 1:
                 for a in in_arrays:
-                    if a.ndim >= 1 and a.shape[0] % comm.world_size != 0:
+                    if a.ndim - lead >= 1 \
+                            and a.shape[lead] % comm.world_size != 0:
                         raise ValueError(
-                            f"global batch dim {a.shape[0]} not divisible "
-                            f"by world size {comm.world_size}")
+                            f"global batch dim {a.shape[lead]} not "
+                            f"divisible by world size {comm.world_size}")
                 rep = NamedSharding(mesh, P())
                 ranked = NamedSharding(mesh, P(axis))
                 state_arrays = [
@@ -460,10 +562,16 @@ class _GraphRunner:
                     for n, t in zip(names, tensors)
                 ]
                 state_arrays.append(jax.device_put(dev._rng_key, rep))
+
+                def dist_spec(a):
+                    # batch axis on the mesh; the steps axis (multi-step)
+                    # stays unsharded so the scan slices per step
+                    if a.ndim - lead >= 1:
+                        return P(*([None] * lead), axis)
+                    return P(*([None] * lead)) if lead else P()
+
                 in_arrays = [
-                    jax.device_put(
-                        a,
-                        NamedSharding(mesh, P(axis) if a.ndim >= 1 else P()))
+                    jax.device_put(a, NamedSharding(mesh, dist_spec(a)))
                     for a in in_arrays
                 ]
             else:
@@ -475,7 +583,7 @@ class _GraphRunner:
                 # step) and passes through untouched.
                 state_arrays, in_arrays = self._globalize_multihost(
                     mesh, axis, names, tensors, in_arrays, dev,
-                    check=key not in self._compiled)
+                    check=key not in self._compiled, lead=lead)
         else:
             state_arrays = [jax.device_put(t.data, dev.jax_device)
                             for t in tensors]
@@ -491,7 +599,8 @@ class _GraphRunner:
             trace_ctx = contextlib.nullcontext()
         with trace_ctx:
             if key not in self._compiled or self._compiled[key][1] != names:
-                fn = self._build(args, kwargs, names)
+                fn = self._build(key_args, key_kwargs, names,
+                                 n_steps=n_steps, repeat=repeat)
                 cost = None
                 try:
                     compiled = fn.lower(state_arrays, in_arrays).compile()
@@ -536,22 +645,29 @@ class _GraphRunner:
             # derived from input dim 0 is consistent with the sharding.
             W = model._optimizer.communicator.world_size
             global_b = next(
-                (a.shape[0] for a in in_arrays
-                 if getattr(a, "ndim", 0) >= 1), None)
+                (a.shape[lead] for a in in_arrays
+                 if getattr(a, "ndim", 0) - lead >= 1), None)
             per_rank = global_b // W if global_b else None
 
             def merge(a):
-                return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+                # fold the per-rank axis into the batch axis (both sit
+                # after the optional leading steps axis of multi-step)
+                ol = out_lead
+                return a.reshape(a.shape[:ol]
+                                 + (a.shape[ol] * a.shape[ol + 1],)
+                                 + a.shape[ol + 2:])
 
             def unstack_auto(a):
-                if a.ndim == 1:
-                    return jnp.mean(a)
-                if per_rank is not None and a.ndim >= 2 \
-                        and a.shape[1] == per_rank:
+                if a.ndim == 1 + out_lead:
+                    return (jnp.mean(a, axis=out_lead) if out_lead
+                            else jnp.mean(a))
+                if per_rank is not None and a.ndim >= 2 + out_lead \
+                        and a.shape[out_lead + 1] == per_rank:
                     return merge(a)
+                per_leaf = tuple(a.shape[out_lead + 1:])
                 raise ValueError(
                     f"cannot auto-reassemble distributed output of "
-                    f"per-rank shape {tuple(a.shape[1:])}: dim 0 is "
+                    f"per-rank shape {per_leaf}: its leading dim is "
                     f"neither a scalar nor the per-rank batch "
                     f"({per_rank}); set model.dist_outputs to a list of "
                     f"per-leaf specs from {{'mean', 'concat', 'stack'}} "
@@ -568,7 +684,7 @@ class _GraphRunner:
                 applied = []
                 for spec, a in zip(specs, leaves):
                     if spec == "mean":
-                        applied.append(jnp.mean(a, axis=0))
+                        applied.append(jnp.mean(a, axis=out_lead))
                     elif spec == "concat":
                         applied.append(merge(a))
                     elif spec == "stack":
@@ -586,7 +702,7 @@ class _GraphRunner:
 
     @staticmethod
     def _globalize_multihost(mesh, axis, names, tensors, in_arrays, dev,
-                             check):
+                             check, lead=0):
         """Lift process-local arrays to global arrays over the
         multi-host mesh (jax.distributed runtime).
 
@@ -667,14 +783,16 @@ class _GraphRunner:
             if is_global(a):
                 global_in.append(a)
                 continue
-            if a.ndim >= 1:
-                if a.shape[0] % n_local != 0:
+            if a.ndim - lead >= 1:
+                if a.shape[lead] % n_local != 0:
                     raise ValueError(
-                        f"local batch dim {a.shape[0]} not divisible by "
-                        f"local device count {n_local}")
-                spec = P(axis)
+                        f"local batch dim {a.shape[lead]} not divisible "
+                        f"by local device count {n_local}")
+                # lead=1: multi-step stacked input — the steps axis stays
+                # replicated; the per-step batch axis shards over ranks
+                spec = P(*([None] * lead), axis)
             else:
-                spec = P()
+                spec = P(*([None] * lead)) if lead else P()
             global_in.append(
                 mh.host_local_array_to_global_array(np.asarray(a), mesh,
                                                     spec))
@@ -718,7 +836,15 @@ class _GraphRunner:
                     jnp.zeros(aval.shape, aval.dtype), dev.jax_device)
                 t.creator = None
 
-    def _build(self, args, kwargs, names):
+    def _build(self, args, kwargs, names, n_steps=None, repeat=False):
+        """Build the jitted step.  ``n_steps``: wrap the step in a
+        ``lax.scan`` over K stacked batches (train_n_batches) — one
+        executable, one dispatch, K optimizer updates; with ``repeat``
+        the same per-step batch feeds every iteration instead of
+        scanning stacked xs.  ``args``/``kwargs`` are always PER-STEP
+        shaped (the caller slices multi-step inputs), so the step
+        closure and the shard_map specs below are identical in all
+        modes; only the scan differs."""
         model = self.model
         dev = model.device
         tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
@@ -755,8 +881,28 @@ class _GraphRunner:
                     t.creator = None
                 dev._rng_key = saved_key
 
+        def finish(step_fn):
+            if n_steps is None:
+                return jax.jit(step_fn, donate_argnums=(0,))
+
+            if repeat:
+                def multi(state_arrays, in_arrays):
+                    # same device-resident batch every iteration
+                    return jax.lax.scan(
+                        lambda st, _: step_fn(st, in_arrays),
+                        state_arrays, None, length=n_steps)
+            else:
+                def multi(state_arrays, stacked_in):
+                    # scan slices each stacked input's leading steps
+                    # axis; the step's (new_state, out_tree) contract is
+                    # exactly scan's (carry, y), so outputs stack to
+                    # (K, ...) leaves
+                    return jax.lax.scan(step_fn, state_arrays, stacked_in)
+
+            return jax.jit(multi, donate_argnums=(0,))
+
         if not model.dist:
-            return jax.jit(step, donate_argnums=(0,))
+            return finish(step)
 
         # DistOpt: run the step per-rank under shard_map — SINGA's SPMD
         # programming model recovered inside a single-controller runtime.
@@ -804,4 +950,4 @@ class _GraphRunner:
             out_specs=(state_specs, P(axis)),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        return finish(sharded)

@@ -322,3 +322,30 @@ def test_dist_option_switch_after_compile(dev):
     for k in res:
         arr = tensor.to_numpy(m.persistent_tensors()[k])
         assert np.all(np.isfinite(arr))
+
+
+def test_dist_train_n_batches_equals_single_steps(dev):
+    """Multi-step dispatch (scan over the shard_map'd step) ≡ K
+    separate dist dispatches (round-5 verdict item #1)."""
+    k = 3
+    m1 = _make(dev, DistOpt(opt.SGD(lr=0.1)), seed=21)
+    m2 = _make(dev, DistOpt(opt.SGD(lr=0.1)), seed=21)
+    m2.set_params({n: v.clone() for n, v in m1.get_params().items()})
+    rng = np.random.RandomState(4)
+    xs = rng.randn(k, 32, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (k, 32)).astype(np.int32)
+
+    singles = []
+    for i in range(k):
+        _, loss = m1(tensor.from_numpy(xs[i], dev),
+                     tensor.from_numpy(ys[i], dev))
+        singles.append(float(loss.data))
+
+    out, losses = m2.train_n_batches(tensor.from_numpy(xs, dev),
+                                     tensor.from_numpy(ys, dev))
+    assert tuple(out.shape) == (k, 32, 4)  # auto-merged per-rank batches
+    np.testing.assert_allclose(np.asarray(losses.data), singles, rtol=2e-5)
+    for n, v in m1.get_params().items():
+        np.testing.assert_allclose(
+            tensor.to_numpy(v), tensor.to_numpy(m2.get_params()[n]),
+            rtol=1e-4, atol=1e-6, err_msg=n)

@@ -362,3 +362,37 @@ def test_longctx_ring_memory_linear_not_quadratic_in_seqlen():
     # 4x the sequence: linear growth ~4x, quadratic ~16x
     assert ring_ratio < 6, ring_ratio
     assert serial_ratio > 1.8 * ring_ratio, (serial_ratio, ring_ratio)
+
+
+def test_train_n_batches_under_plan_matches_serial_steps():
+    """Multi-step dispatch on the GSPMD plan path: lax.scan over the
+    planned step ≡ K single planned dispatches ≡ the serial model
+    (round-5 verdict item #1)."""
+    k = 3
+    mesh = shd.create_mesh(dp=2, tp=2, sp=2)
+    plan = shd.ShardingPlan(mesh)
+
+    serial = _compile(TinyLM(plan=None), False)
+    par = TinyLM(plan=plan)
+    par.set_sharding_plan(plan)
+    _compile(par, True)
+    par.set_states({n: tensor.to_numpy(v)
+                    for n, v in serial.get_states().items()})
+
+    xs = np.stack([_batch(seed=i)[0] for i in range(k)])
+    ys = np.stack([_batch(seed=i)[1] for i in range(k)])
+    singles = []
+    for i in range(k):
+        _, loss = serial(tensor.from_numpy(xs[i]),
+                         tensor.from_numpy(ys[i]))
+        singles.append(float(tensor.to_numpy(loss)))
+
+    _, losses = par.train_n_batches(tensor.from_numpy(xs),
+                                    tensor.from_numpy(ys))
+    np.testing.assert_allclose(np.asarray(losses.data), singles,
+                               rtol=2e-4, atol=2e-5)
+    ps, pp = serial.get_states(), par.get_states()
+    for n in ps:
+        np.testing.assert_allclose(
+            tensor.to_numpy(pp[n]), tensor.to_numpy(ps[n]),
+            rtol=2e-3, atol=2e-4, err_msg=n)
