@@ -130,8 +130,10 @@ def _ln(x, s, b, eps):
     return ((xf - mu) * jax.lax.rsqrt(var + eps) * s + b).astype(x.dtype)
 
 
-def _attn_full(q, k, v, n_head):
-    """Causal attention over the full (B, S, E) prefill block."""
+def _attn_full(q, k, v, n_head, start=None):
+    """Causal attention over the full (B, S, E) prefill block.
+    ``start``: optional (B,) first-live window position per row
+    (left-padded batch) — keys before it are masked out."""
     b, s, e = q.shape
     d = e // n_head
 
@@ -140,28 +142,35 @@ def _attn_full(q, k, v, n_head):
 
     qh, kh, vh = heads(q), heads(k), heads(v)
     sc = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(d)
-    cm = jnp.tril(jnp.ones((s, s), bool))
-    sc = jnp.where(cm[None, None], sc, NEG_INF)
+    cm = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    if start is not None:
+        live = jnp.arange(s)[None, :] >= start[:, None]  # (B, S) keys
+        cm = cm & live[:, None, None, :]
+        # fully-masked pad-query rows degrade to uniform attention over
+        # NEG_INF scores (finite garbage, never read) — NEG_INF is -1e30,
+        # not -inf, so no NaNs propagate
+    sc = jnp.where(cm, sc, NEG_INF)
     p = jax.nn.softmax(sc, axis=-1)
     o = jnp.einsum("bhst,bhtd->bhsd", p, vh)
     return o.transpose(0, 2, 1, 3).reshape(b, s, e)
 
 
-def _block_prefill(x, p, n_head, eps):
+def _block_prefill(x, p, n_head, eps, start=None):
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = h @ p["wq"] + p["bq"]
     k = h @ p["wk"] + p["bk"]
     v = h @ p["wv"] + p["bv"]
-    a = _attn_full(q, k, v, n_head)
+    a = _attn_full(q, k, v, n_head, start=start)
     x = x + (a @ p["wo"] + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
     x = x + (jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"])
     return x, k, v
 
 
-def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps):
+def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None):
     """x: (B, 1, E); k/v_cache: (B, H, ctx, D) with this step's K/V
-    already written at ``pos``.  Attends to positions <= pos."""
+    already written at ``pos``.  Attends to positions <= pos (and
+    >= ``start`` per row for left-padded batches)."""
     b, _, e = x.shape
     d = e // n_head
     ctx = k_cache.shape[2]
@@ -173,6 +182,9 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps):
     v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, (0, 0, pos, 0))
     sc = jnp.einsum("bhqd,bhtd->bhqt", q, k_cache) / math.sqrt(d)
     live = jnp.arange(ctx)[None, None, None, :] <= pos
+    if start is not None:
+        live = live & (jnp.arange(ctx)[None, None, None, :]
+                       >= start[:, None, None, None])
     sc = jnp.where(live, sc, NEG_INF)
     p_attn = jax.nn.softmax(sc, axis=-1)
     a = jnp.einsum("bhqt,bhtd->bhqd", p_attn, v_cache)
@@ -190,20 +202,31 @@ def _logits(x, params):
     return x @ head
 
 
-def prefill(params, ids, n_head, eps):
+def prefill(params, ids, n_head, eps, start=None):
     """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
     v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
     rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
     for all pad positions would double prefill cost) — and caches are
     (L, B, H, Sp, D); pad positions hold garbage K/V that decode never
-    attends to (mask is position-indexed)."""
+    attends to (mask is position-indexed).
+
+    ``start`` (B,): LEFT-padded batch — row i's prompt occupies window
+    positions [start_i, Sp_shared).  Row-relative position embeddings
+    (window pos − start_i, clipped for pads) and a per-row key mask make
+    the math identical to a right-padded row shifted by start_i, which
+    is what puts RAGGED batches on the shared-position fast path."""
     b, sp = ids.shape
-    pos = jnp.arange(sp, dtype=jnp.int32)[None, :]
+    if start is None:
+        # (1, Sp) gather broadcasts in the add — one wpe read, not B
+        pos = jnp.arange(sp, dtype=jnp.int32)[None, :]
+    else:
+        pos = jnp.clip(jnp.arange(sp, dtype=jnp.int32)[None, :]
+                       - start[:, None], 0, None)
     x = jnp.take(params["wte"], ids, axis=0) + \
         jnp.take(params["wpe"], pos, axis=0)
     ks, vs = [], []
     for p in params["blocks"]:
-        x, k, v = _block_prefill(x, p, n_head, eps)
+        x, k, v = _block_prefill(x, p, n_head, eps, start=start)
         e = x.shape[-1]
         d = e // n_head
         ks.append(k.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
@@ -212,15 +235,16 @@ def prefill(params, ids, n_head, eps):
     return x, jnp.stack(ks), jnp.stack(vs)
 
 
-def _advance_one(params, x, kc, vc, pos, n_head, eps):
+def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None):
     """Advance one decode step through every block: x (B, 1, E) at
     position ``pos`` against caches (L, B, H, ctx, D).  Returns
     ((B, V) logits, new kc, new vc).  Shared by sampling
-    (_generate_row) and beam search so the two paths cannot drift."""
+    (_generate_row), the left-padded ragged path, and beam search so
+    the paths cannot drift."""
     new_kc, new_vc = [], []
     for li, p in enumerate(params["blocks"]):
         x, kl, vl = _block_decode(x, p, kc[li], vc[li], pos, n_head,
-                                  eps)
+                                  eps, start=start)
         new_kc.append(kl)
         new_vc.append(vl)
     kc = jnp.stack(new_kc)
@@ -298,12 +322,16 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
     disables top-k; ``use_top_p`` gates nucleus sampling (static so the
     sort compiles away when off).
 
-    This is the RAGGED path (per-row positions, cache writes lower to
-    scatters).  Equal-length batches should use
-    :func:`generate_cached_uniform` — one shared position means one
-    batched cache write and full-batch GEMMs per step, measured +66%
-    tokens/sec at the bench config; ``generate`` routes automatically.
-    """
+    This is the per-row SCATTER path (vmapped row core, per-row
+    positions, cache writes lower to scatters).  Since round 5 it is
+    the EQUALITY ORACLE only: ``generate`` routes every batch — ragged
+    included, via left-padding — through
+    :func:`generate_cached_uniform`, whose shared position means one
+    batched cache write and full-batch GEMMs per step (measured +66%
+    tokens/sec at the bench config).  Kept because its math is
+    transparently per-row right-padded, which is what the left-padded
+    fast path must match token-for-token in f32
+    (tests/test_gpt2.py)."""
     row = partial(_generate_row, n_head=n_head, eps=eps, n_new=n_new,
                   greedy=greedy, top_k=top_k, use_top_p=use_top_p)
     return jax.vmap(
@@ -315,14 +343,20 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
                                    "greedy", "top_k", "use_top_p"))
 def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
                             ctx, greedy, temperature, keys, top_k=0,
-                            top_p=1.0, use_top_p=False):
-    """Equal-length fast path: ids (B, ctx) right-padded, ONE traced
-    scalar ``prompt_len`` shared by every row — the per-step cache
-    update is a single batched dynamic_update_slice and the projections
-    run as full-batch GEMMs (the vmapped ragged path pays per-row
-    scatters and B=1 matmuls for the same work).  Token-exact vs the
-    ragged path in f32; bf16 may flip argmax near-ties."""
-    hidden, kc, vc = prefill(params, ids, n_head, eps)
+                            top_p=1.0, use_top_p=False, start=None):
+    """Shared-position fast path: ids (B, ctx), ONE traced scalar
+    ``prompt_len`` (the shared first free window position) — the
+    per-step cache update is a single batched dynamic_update_slice and
+    the projections run as full-batch GEMMs (the vmapped ragged path
+    pays per-row scatters and B=1 matmuls for the same work).
+
+    Equal-length batches: right-padded ids, ``start=None``.  RAGGED
+    batches (round 5): LEFT-pad so every prompt ENDS at ``prompt_len``
+    and pass ``start`` (B,) = the per-row first live position; the only
+    per-row work is a wpe gather and the mask's lower bound — cache
+    writes and GEMMs stay batched.  Token-exact vs the per-row scatter
+    path in f32 (the oracle test); bf16 may flip argmax near-ties."""
+    hidden, kc, vc = prefill(params, ids, n_head, eps, start=start)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)     # (B, E)
     logits0 = _logits(last_h[:, None, :], params)[:, 0]     # (B, V)
@@ -339,10 +373,14 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
     def step(carry, t):
         toks, kc, vc, keys_cur = carry
         pos = prompt_len + t
-        x = jnp.take(params["wte"], toks, axis=0)[:, None, :] \
-            + params["wpe"][pos][None, None, :]
+        if start is None:
+            pe = params["wpe"][pos][None, None, :]
+        else:
+            # row-relative position: window pos − start_i
+            pe = jnp.take(params["wpe"], pos - start, axis=0)[:, None, :]
+        x = jnp.take(params["wte"], toks, axis=0)[:, None, :] + pe
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
-                                      eps)
+                                      eps, start=start)
         ks = jax.vmap(lambda k: jax.random.split(k))(keys_cur)
         nxt = sample(logits, ks[:, 0])
         return (nxt, kc, vc, ks[:, 1]), toks
@@ -463,7 +501,7 @@ def _seed(temperature, rng):
 
 
 def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
-             top_k=0, top_p=None, dtype=None):
+             top_k=0, top_p=None, dtype=None, _ragged_impl="left"):
     """KV-cached sampling for a dense GPT2LMHead.  Requires
     prompt_len + max_new_tokens <= cfg.n_positions (the windowed
     fallback in models/gpt2.py handles longer generations).
@@ -471,10 +509,13 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     ``prompt_ids``: one 1-D prompt (returns a 1-D array) or a list/2-D
     batch of prompts, possibly ragged (returns a list of 1-D arrays —
     each its prompt + continuation; all rows decode lockstep in ONE
-    compiled executable).  ``top_k`` (int > 0) / ``top_p`` (0 < p ≤ 1)
-    filter the temperature-scaled distribution before sampling.
-    ``dtype=jnp.bfloat16`` runs inference in bf16 (≈2× steady-state
-    throughput; see extract_params)."""
+    compiled executable).  Ragged batches are LEFT-padded onto the
+    shared-position fast path (round 5); ``_ragged_impl="scatter"``
+    selects the per-row vmap oracle instead (tests).  ``top_k``
+    (int > 0) / ``top_p`` (0 < p ≤ 1) filter the temperature-scaled
+    distribution before sampling.  ``dtype=jnp.bfloat16`` runs
+    inference in bf16 (≈2× steady-state throughput; see
+    extract_params)."""
     params = extract_params(m, dtype=dtype)
     cfg = m.cfg
     if isinstance(prompt_ids, np.ndarray):
@@ -503,27 +544,47 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     ctx = cfg.n_positions
     bsz = len(rows)
-    window = np.zeros((bsz, ctx), np.int32)
-    for i, r in enumerate(rows):
-        window[i, :len(r)] = r
     lens = np.asarray([len(r) for r in rows], np.int32)
+    uniform = len(set(int(n) for n in lens)) == 1
+    window = np.zeros((bsz, ctx), np.int32)
+    if uniform or _ragged_impl == "scatter":
+        for i, r in enumerate(rows):
+            window[i, :len(r)] = r
+    else:
+        # LEFT-pad (round 5): align every prompt's END at max_len so
+        # the whole ragged batch shares one position and rides the
+        # uniform fast path (start carries each row's first live
+        # window position).  No extra length constraint: the longest
+        # row's (len + n_new <= ctx) check above already bounds
+        # max_len + n_new.
+        max_len = int(lens.max())
+        for i, r in enumerate(rows):
+            window[i, max_len - len(r):max_len] = r
     keys = jax.random.split(
         jax.random.PRNGKey(_seed(temperature, rng)), bsz)
-    uniform = len(set(int(n) for n in lens)) == 1
-    # equal lengths (incl. every single-prompt call) take the uniform
-    # fast path: one shared position across the batch (+66% tok/s);
-    # ragged batches use the per-row vmap path.  Only the length
-    # argument and the entry point differ — everything else is shared
-    # so the two samplers cannot drift.
-    fn = generate_cached_uniform if uniform else generate_cached
-    len_arg = int(lens[0]) if uniform else jnp.asarray(lens)
-    new = fn(
-        params, jnp.asarray(window), len_arg, cfg.n_head,
-        float(cfg.layer_norm_eps), int(max_new_tokens), ctx,
-        temperature <= 0, jnp.float32(max(temperature, 1e-6)), keys,
+    common = dict(
         top_k=int(top_k or 0),
         top_p=jnp.float32(1.0 if top_p is None else top_p),
         use_top_p=top_p is not None)
+    sample_args = (cfg.n_head, float(cfg.layer_norm_eps),
+                   int(max_new_tokens), ctx, temperature <= 0,
+                   jnp.float32(max(temperature, 1e-6)), keys)
+    if uniform:
+        new = generate_cached_uniform(
+            params, jnp.asarray(window), int(lens[0]), *sample_args,
+            **common)
+    elif _ragged_impl == "left":
+        new = generate_cached_uniform(
+            params, jnp.asarray(window), int(lens.max()), *sample_args,
+            start=jnp.asarray(int(lens.max()) - lens), **common)
+    elif _ragged_impl == "scatter":
+        # per-row vmap oracle (see generate_cached docstring)
+        new = generate_cached(
+            params, jnp.asarray(window), jnp.asarray(lens),
+            *sample_args, **common)
+    else:
+        raise ValueError(f"unknown _ragged_impl {_ragged_impl!r}; "
+                         "expected 'left' or 'scatter'")
     new = np.asarray(new)
     out = [np.concatenate([r, new[i]]).astype(np.int32)
            for i, r in enumerate(rows)]

@@ -542,3 +542,34 @@ def test_tp_sharded_beam_search_matches_serial():
     b_par = gpt2_decode.generate_beam(par, prompt, max_new_tokens=6,
                                       num_beams=4)
     np.testing.assert_array_equal(b_ser, b_par)
+
+
+def test_left_padded_ragged_decode_matches_scatter_oracle():
+    """Round-5 fast path: a ragged batch routed through left-padding +
+    the shared-position executable must be token-exact (f32) against
+    the per-row scatter oracle — greedy AND sampled (same seed), and
+    with top-k/top-p filters on."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompts = [np.arange(9) % cfg.vocab_size,
+               (np.arange(4) + 3) % cfg.vocab_size,
+               (np.arange(13) * 2 + 1) % cfg.vocab_size,
+               np.asarray([5])]
+
+    for seed, kw in ((None, dict(temperature=0)),
+                     (7, dict(temperature=1.0)),
+                     (8, dict(temperature=0.8, top_k=5)),
+                     (9, dict(temperature=1.0, top_p=0.7))):
+        if seed is not None:
+            kw = dict(kw, rng=np.random.RandomState(seed))
+        left = gpt2_decode.generate(m, prompts, max_new_tokens=6, **kw)
+        if seed is not None:
+            kw = dict(kw, rng=np.random.RandomState(seed))
+        oracle = gpt2_decode.generate(m, prompts, max_new_tokens=6,
+                                      _ragged_impl="scatter", **kw)
+        for li, oi in zip(left, oracle):
+            np.testing.assert_array_equal(li, oi)
