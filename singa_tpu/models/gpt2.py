@@ -29,7 +29,8 @@ class GPT2Config:
                  n_layer=12, n_head=12, n_inner=None, dropout=0.1,
                  layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
                  moe_experts=8, moe_top_k=2, moe_aux_weight=0.01,
-                 moe_groups=None, remat=False, attn_impl="auto"):
+                 moe_capacity_factor=1.25, moe_groups=None, remat=False,
+                 attn_impl="auto"):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -44,6 +45,7 @@ class GPT2Config:
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_aux_weight = moe_aux_weight
+        self.moe_capacity_factor = moe_capacity_factor
         # routing-group override (default: plan's data-axis size); lets
         # a serial model reproduce a sharded run's grouped routing
         self.moe_groups = moe_groups
@@ -107,7 +109,9 @@ class GPT2Model(model.Model):
                 c.n_head, c.n_inner, plan, dropout=c.dropout, causal=True,
                 eps=c.layer_norm_eps,
                 moe_experts=c.moe_experts if moe else None,
-                moe_top_k=c.moe_top_k, moe_groups=c.moe_groups,
+                moe_top_k=c.moe_top_k,
+                moe_capacity_factor=c.moe_capacity_factor,
+                moe_groups=c.moe_groups,
                 remat=c.remat, use_flash=c.attn_impl == "flash"))
         self.ln_f = layer.LayerNorm(c.layer_norm_eps)
 
@@ -191,9 +195,11 @@ class GPT2LMHead(model.Model):
         generation fits n_positions decode through the KV-cached
         incremental path (models/gpt2_decode.py — one compiled
         prefill + lax.scan, O(S·D) per token) instead of one
-        full-context forward per token; plan-sharded dense models
-        decode there too (SPMD over the mesh, round 4); MoE models
-        and over-length generations use the windowed path below."""
+        full-context forward per token; plan-sharded models decode
+        there too (SPMD over the mesh, round 4), and MoE models since
+        round 5 (capacity-free expert routing — token-equal to the
+        windowed path when its capacity drops nothing); over-length
+        generations use the windowed path below."""
         n0 = len(np.asarray(prompt_ids).reshape(-1))
         blocks = self.transformer.blocks
         initialized = bool(blocks) and blocks[0].mlp is not None
@@ -201,8 +207,7 @@ class GPT2LMHead(model.Model):
             # plan-sharded dense models decode through the KV cache too
             # since round 4 (extract_params lays weights out per the
             # plan; the pure-jnp generation jits SPMD over the mesh)
-            use_cache = (self.cfg.moe_every is None
-                         and initialized  # deferred init needs a forward
+            use_cache = (initialized  # deferred init needs a forward
                          and n0 + max_new_tokens <= self.cfg.n_positions)
         # .training only exists after train()/eval(); an un-compiled
         # model can still generate (the windowed path lazily inits)

@@ -20,11 +20,11 @@ same tanh-approx gelu, same scale placement), and
 forward's to tolerance at every position.  Batched (possibly ragged)
 prompts decode lockstep in one executable (`jax.vmap` over the row
 core — per-row cache writes lower to scatters), with greedy,
-temperature, top-k, and top-p (nucleus) sampling.  Plan-sharded DENSE
-models decode here too (round 4): extract_params lays the weights out
-per the Megatron plan and the jitted generation runs SPMD.  MoE models
-still sample via the windowed path (expert dispatch needs the layer
-stack).
+temperature, top-k, and top-p (nucleus) sampling.  Plan-sharded models
+decode here too (round 4): extract_params lays the weights out per the
+Megatron plan and the jitted generation runs SPMD.  MoE models decode
+here as well (round 5): per-token top-k expert routing with no capacity
+limit — see extract_params.
 """
 
 from __future__ import annotations
@@ -40,19 +40,25 @@ NEG_INF = -1e30
 
 
 def extract_params(m, dtype=None):
-    """Pull the dense GPT2LMHead weight pytree (raw jax arrays).
+    """Pull the GPT2LMHead weight pytree (raw jax arrays).
     ``dtype`` (e.g. jnp.bfloat16) casts the float weights for inference
     — decode is weight-read-bound, so bf16 weights ≈ double the
     steady-state tokens/sec (measured 803 → 1604 on the v5e at the
     bench config); LayerNorm statistics stay fp32 inside _ln either
     way.
 
-    Plan-sharded dense models work too (round 4): each weight is
-    device_put with its layer's partition spec (Megatron column/row
-    layout), and since the decode math is pure jnp, the jitted
-    generation runs SPMD — GSPMD inserts the same collectives the
-    training forward uses.  MoE still raises (expert dispatch needs
-    the layer stack)."""
+    Plan-sharded models work too (round 4): each weight is device_put
+    with its layer's partition spec (Megatron column/row layout), and
+    since the decode math is pure jnp, the jitted generation runs SPMD
+    — GSPMD inserts the same collectives the training forward uses.
+
+    MoE blocks (round 5): the expert weights come out as stacked
+    (E, ...) arrays under ``moe_*`` keys and decode routes each token
+    to its top-k experts with NO capacity limit (capacity is a
+    static-shape training-efficiency device; at inference every token
+    gets its chosen experts).  Token-parity with the windowed sampler
+    therefore holds exactly when the windowed forward drops nothing —
+    the regime its capacity_factor is tuned for."""
     t = m.transformer
     blocks = []
     for blk in t.blocks:
@@ -60,18 +66,27 @@ def extract_params(m, dtype=None):
         if mlp is None:
             raise RuntimeError("model not initialized: call compile() or "
                                "run one forward first")
-        if not hasattr(mlp, "fc1"):
-            raise ValueError("KV-cache decode does not support MoE blocks")
-        blocks.append(dict(
+        common = dict(
             ln1_s=blk.ln1.scale.data, ln1_b=blk.ln1.bias.data,
             wq=blk.attn.q_proj.W.data, bq=blk.attn.q_proj.b.data,
             wk=blk.attn.k_proj.W.data, bk=blk.attn.k_proj.b.data,
             wv=blk.attn.v_proj.W.data, bv=blk.attn.v_proj.b.data,
             wo=blk.attn.out_proj.W.data, bo=blk.attn.out_proj.b.data,
             ln2_s=blk.ln2.scale.data, ln2_b=blk.ln2.bias.data,
-            w1=mlp.fc1.W.data, b1=mlp.fc1.b.data,
-            w2=mlp.fc2.W.data, b2=mlp.fc2.b.data,
-        ))
+        )
+        if hasattr(mlp, "fc1"):
+            common.update(w1=mlp.fc1.W.data, b1=mlp.fc1.b.data,
+                          w2=mlp.fc2.W.data, b2=mlp.fc2.b.data)
+        elif hasattr(mlp, "Wg"):  # MoEFFN expert-routed block
+            common.update(
+                moe_wg=mlp.Wg.data,
+                moe_w1=mlp.W1.data, moe_b1=mlp.b1.data,
+                moe_w2=mlp.W2.data, moe_b2=mlp.b2.data)
+        else:
+            raise ValueError(
+                f"KV-cache decode does not recognize MLP type "
+                f"{type(mlp).__name__}")
+        blocks.append(common)
     head = None if m.cfg.tie_weights else m.lm_head.W.data
     params = dict(wte=t.wte.W.data, wpe=t.wpe.W.data, blocks=blocks,
                   lnf_s=t.ln_f.scale.data, lnf_b=t.ln_f.bias.data,
@@ -115,9 +130,14 @@ def _shard_params(m, params):
             wk=blk.attn.k_proj.W, bk=blk.attn.k_proj.b,
             wv=blk.attn.v_proj.W, bv=blk.attn.v_proj.b,
             wo=blk.attn.out_proj.W, bo=blk.attn.out_proj.b,
-            ln2_s=blk.ln2.scale, ln2_b=blk.ln2.bias,
-            w1=blk.mlp.fc1.W, b1=blk.mlp.fc1.b,
-            w2=blk.mlp.fc2.W, b2=blk.mlp.fc2.b)
+            ln2_s=blk.ln2.scale, ln2_b=blk.ln2.bias)
+        if hasattr(blk.mlp, "fc1"):
+            owners.update(w1=blk.mlp.fc1.W, b1=blk.mlp.fc1.b,
+                          w2=blk.mlp.fc2.W, b2=blk.mlp.fc2.b)
+        else:  # MoEFFN: expert weights carry P(EXPERT, ...) specs
+            owners.update(moe_wg=blk.mlp.Wg,
+                          moe_w1=blk.mlp.W1, moe_b1=blk.mlp.b1,
+                          moe_w2=blk.mlp.W2, moe_b2=blk.mlp.b2)
         new_blocks.append({k: put(v, owners[k]) for k, v in p.items()})
     out["blocks"] = new_blocks
     return out
@@ -155,7 +175,7 @@ def _attn_full(q, k, v, n_head, start=None):
     return o.transpose(0, 2, 1, 3).reshape(b, s, e)
 
 
-def _block_prefill(x, p, n_head, eps, start=None):
+def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2):
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = h @ p["wq"] + p["bq"]
     k = h @ p["wk"] + p["bk"]
@@ -163,11 +183,12 @@ def _block_prefill(x, p, n_head, eps, start=None):
     a = _attn_full(q, k, v, n_head, start=start)
     x = x + (a @ p["wo"] + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + (jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"])
+    x = x + _mlp(h, p, moe_top_k)
     return x, k, v
 
 
-def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None):
+def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
+                  moe_top_k=2):
     """x: (B, 1, E); k/v_cache: (B, H, ctx, D) with this step's K/V
     already written at ``pos``.  Attends to positions <= pos (and
     >= ``start`` per row for left-padded batches)."""
@@ -191,8 +212,52 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None):
     a = a.transpose(0, 2, 1, 3).reshape(b, 1, e)
     x = x + (a @ p["wo"] + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + (jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"])
+    x = x + _mlp(h, p, moe_top_k)
     return x, k_cache, v_cache
+
+
+def _moe_weights(probs, top_k):
+    """Per-token combine weights (…, E) from router softmax ``probs``
+    (f32), zeros except the top-k experts.  Mirrors parallel/moe.py's
+    gating exactly in the no-drop regime: top-1 keeps the RAW chosen
+    prob (Switch); top-2 renormalizes the two gates to sum 1
+    (GShard)."""
+    e = probs.shape[-1]
+    m1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e,
+                        dtype=probs.dtype)
+    g1 = jnp.sum(probs * m1, axis=-1)
+    if top_k == 1:
+        return m1 * g1[..., None]
+    p2 = probs * (1.0 - m1)
+    m2 = jax.nn.one_hot(jnp.argmax(p2, axis=-1), e, dtype=probs.dtype)
+    g2 = jnp.sum(p2 * m2, axis=-1)
+    den = g1 + g2
+    den = jnp.where(den <= 0.0, 1.0, den)
+    return (m1 * (g1 / den)[..., None] + m2 * (g2 / den)[..., None])
+
+
+def _moe_ffn(h, p, top_k):
+    """Capacity-free MoE FFN for decode: route each of the (B, S, D)
+    post-LN tokens to its top-k experts and mask-and-sum over a python
+    loop of per-expert GEMMs (E dense MLPs — each big enough for the
+    MXU; memory stays O(B·S·F), not O(B·S·E·F)).  No capacity limit:
+    see extract_params."""
+    probs = jax.nn.softmax(
+        (h @ p["moe_wg"].astype(h.dtype)).astype(jnp.float32), axis=-1)
+    w = _moe_weights(probs, top_k).astype(h.dtype)          # (B, S, E)
+    y = jnp.zeros_like(h)
+    for e in range(p["moe_w1"].shape[0]):
+        he = jax.nn.gelu(h @ p["moe_w1"][e] + p["moe_b1"][e])
+        y = y + w[..., e:e + 1] * (he @ p["moe_w2"][e] + p["moe_b2"][e])
+    return y
+
+
+def _mlp(h, p, moe_top_k):
+    """The block's feed-forward: dense two-layer gelu MLP, or the
+    expert-routed MoE when the block carries ``moe_*`` weights."""
+    if "moe_wg" in p:
+        return _moe_ffn(h, p, moe_top_k)
+    return jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
 
 
 def _logits(x, params):
@@ -202,7 +267,7 @@ def _logits(x, params):
     return x @ head
 
 
-def prefill(params, ids, n_head, eps, start=None):
+def prefill(params, ids, n_head, eps, start=None, moe_top_k=2):
     """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
     v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
     rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
@@ -226,7 +291,8 @@ def prefill(params, ids, n_head, eps, start=None):
         jnp.take(params["wpe"], pos, axis=0)
     ks, vs = [], []
     for p in params["blocks"]:
-        x, k, v = _block_prefill(x, p, n_head, eps, start=start)
+        x, k, v = _block_prefill(x, p, n_head, eps, start=start,
+                                 moe_top_k=moe_top_k)
         e = x.shape[-1]
         d = e // n_head
         ks.append(k.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
@@ -235,7 +301,8 @@ def prefill(params, ids, n_head, eps, start=None):
     return x, jnp.stack(ks), jnp.stack(vs)
 
 
-def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None):
+def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
+                 moe_top_k=2):
     """Advance one decode step through every block: x (B, 1, E) at
     position ``pos`` against caches (L, B, H, ctx, D).  Returns
     ((B, V) logits, new kc, new vc).  Shared by sampling
@@ -244,7 +311,7 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None):
     new_kc, new_vc = [], []
     for li, p in enumerate(params["blocks"]):
         x, kl, vl = _block_decode(x, p, kc[li], vc[li], pos, n_head,
-                                  eps, start=start)
+                                  eps, start=start, moe_top_k=moe_top_k)
         new_kc.append(kl)
         new_vc.append(vl)
     kc = jnp.stack(new_kc)
@@ -278,11 +345,13 @@ def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p):
 
 
 def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
-                  n_head, eps, n_new, greedy, top_k, use_top_p):
+                  n_head, eps, n_new, greedy, top_k, use_top_p,
+                  moe_top_k=2):
     """Single-prompt core: ids (ctx,) right-padded, returns (n_new,).
     Batched decoding vmaps this over (ids, prompt_len, key) — the
     per-row cache writes at differing positions lower to scatters."""
-    hidden, kc, vc = prefill(params, ids[None, :], n_head, eps)
+    hidden, kc, vc = prefill(params, ids[None, :], n_head, eps,
+                             moe_top_k=moe_top_k)
     # caches preallocated at ctx; prefill already spans ctx here.
     # Vocab-project ONLY the last live row — (1, V), not (ctx, V)
     last_h = jax.lax.dynamic_index_in_dim(
@@ -301,7 +370,7 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
         x = params["wte"][tok][None, None, :] + \
             params["wpe"][pos][None, None, :]
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
-                                      eps)
+                                      eps, moe_top_k=moe_top_k)
         k, key = jax.random.split(key)
         nxt = sample(logits[0], k)
         return (nxt, pos + 1, kc, vc, key), tok
@@ -312,10 +381,11 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
-                                   "greedy", "top_k", "use_top_p"))
+                                   "greedy", "top_k", "use_top_p",
+                                   "moe_top_k"))
 def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
                     greedy, temperature, keys, top_k=0, top_p=1.0,
-                    use_top_p=False):
+                    use_top_p=False, moe_top_k=2):
     """One compiled prefill + lax.scan decode for a BATCH of prompts.
     ids: (B, ctx) right-padded; prompt_lens: (B,) int32; keys: (B, 2)
     PRNG keys.  Returns (B, n_new) sampled token ids.  ``top_k=0``
@@ -333,17 +403,20 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
     fast path must match token-for-token in f32
     (tests/test_gpt2.py)."""
     row = partial(_generate_row, n_head=n_head, eps=eps, n_new=n_new,
-                  greedy=greedy, top_k=top_k, use_top_p=use_top_p)
+                  greedy=greedy, top_k=top_k, use_top_p=use_top_p,
+                  moe_top_k=moe_top_k)
     return jax.vmap(
         lambda i, n, k: row(params, i, n, k, temperature, top_p))(
             ids, prompt_lens, keys)
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
-                                   "greedy", "top_k", "use_top_p"))
+                                   "greedy", "top_k", "use_top_p",
+                                   "moe_top_k"))
 def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
                             ctx, greedy, temperature, keys, top_k=0,
-                            top_p=1.0, use_top_p=False, start=None):
+                            top_p=1.0, use_top_p=False, start=None,
+                            moe_top_k=2):
     """Shared-position fast path: ids (B, ctx), ONE traced scalar
     ``prompt_len`` (the shared first free window position) — the
     per-step cache update is a single batched dynamic_update_slice and
@@ -356,7 +429,8 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
     per-row work is a wpe gather and the mask's lower bound — cache
     writes and GEMMs stay batched.  Token-exact vs the per-row scatter
     path in f32 (the oracle test); bf16 may flip argmax near-ties."""
-    hidden, kc, vc = prefill(params, ids, n_head, eps, start=start)
+    hidden, kc, vc = prefill(params, ids, n_head, eps, start=start,
+                             moe_top_k=moe_top_k)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)     # (B, E)
     logits0 = _logits(last_h[:, None, :], params)[:, 0]     # (B, V)
@@ -380,7 +454,8 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
             pe = jnp.take(params["wpe"], pos - start, axis=0)[:, None, :]
         x = jnp.take(params["wte"], toks, axis=0)[:, None, :] + pe
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
-                                      eps, start=start)
+                                      eps, start=start,
+                                      moe_top_k=moe_top_k)
         ks = jax.vmap(lambda k: jax.random.split(k))(keys_cur)
         nxt = sample(logits, ks[:, 0])
         return (nxt, kc, vc, ks[:, 1]), toks
@@ -391,9 +466,9 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
-                                   "num_beams"))
+                                   "num_beams", "moe_top_k"))
 def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
-                        ctx, num_beams):
+                        ctx, num_beams, moe_top_k=2):
     """Fixed-length beam search, ONE compiled prefill + scan.  ids:
     (1, ctx) right-padded prompt.  Returns ((num_beams, n_new) token
     ids, (num_beams,) total log-probs), best beam first.  The beams
@@ -401,7 +476,8 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
     (a gather on the leading axis).  Exact when num_beams covers the
     frontier (tests compare against exhaustive search on tiny models).
     """
-    hidden, kc, vc = prefill(params, ids, n_head, eps)
+    hidden, kc, vc = prefill(params, ids, n_head, eps,
+                             moe_top_k=moe_top_k)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)
     logp0 = jax.nn.log_softmax(
@@ -430,7 +506,7 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
         x = jnp.take(params["wte"], toks, axis=0)[:, None, :] \
             + params["wpe"][pos][None, None, :]
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
-                                      eps)
+                                      eps, moe_top_k=moe_top_k)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))  # (B, V)
         cand = scores[:, None] + logp                       # (B, V)
         flat_scores, flat_idx = jax.lax.top_k(
@@ -452,8 +528,8 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
 
 def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
                   dtype=None):
-    """Fixed-length beam search for a dense (optionally plan-sharded)
-    GPT2LMHead: returns the highest-total-log-prob continuation of
+    """Fixed-length beam search for a (optionally plan-sharded, possibly
+    MoE) GPT2LMHead: returns the highest-total-log-prob continuation of
     ``max_new_tokens`` tokens.  One prompt (the beams are the batch);
     ``num_beams=1`` equals greedy decoding.  No EOS handling — this
     framework's models are tokenizer-free, so sequences are
@@ -481,7 +557,8 @@ def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
     seqs, _scores = _beam_search_cached(
         params, jnp.asarray(window), n0, cfg.n_head,
         float(cfg.layer_norm_eps), int(max_new_tokens),
-        cfg.n_positions, int(num_beams))
+        cfg.n_positions, int(num_beams),
+        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2))
     return np.concatenate([ids, np.asarray(seqs[0])]).astype(np.int32)
 
 
@@ -565,7 +642,8 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     common = dict(
         top_k=int(top_k or 0),
         top_p=jnp.float32(1.0 if top_p is None else top_p),
-        use_top_p=top_p is not None)
+        use_top_p=top_p is not None,
+        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2))
     sample_args = (cfg.n_head, float(cfg.layer_norm_eps),
                    int(max_new_tokens), ctx, temperature <= 0,
                    jnp.float32(max(temperature, 1e-6)), keys)

@@ -573,3 +573,76 @@ def test_left_padded_ragged_decode_matches_scatter_oracle():
                                       _ragged_impl="scatter", **kw)
         for li, oi in zip(left, oracle):
             np.testing.assert_array_equal(li, oi)
+
+
+# -- MoE KV-cached decode (round 5) ----------------------------------------
+
+def _moe_model(top_k=2):
+    # capacity_factor high enough that the windowed/training forward
+    # drops NOTHING (cap >= token count): the KV decode path is
+    # capacity-free by design, so token parity is only defined in the
+    # no-drop regime (gpt2_decode.extract_params docstring)
+    cfg = GPT2Config.tiny(dropout=0.0, moe_every=2, moe_experts=4,
+                          moe_top_k=top_k, moe_capacity_factor=4.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    return cfg, m
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_kv_decode_matches_windowed_greedy(top_k):
+    """MoE KV-cached decode (capacity-free top-k routing) must equal
+    the windowed full-forward sampler token for token when the windowed
+    path's capacity drops nothing (tiny batch, near-uniform random
+    router — nothing approaches capacity)."""
+    cfg, m = _moe_model(top_k)
+    prompt = np.arange(9) % cfg.vocab_size
+    g_win = m.generate(prompt, max_new_tokens=10, temperature=0,
+                       use_cache=False)
+    g_kv = m.generate(prompt, max_new_tokens=10, temperature=0,
+                      use_cache=True)
+    np.testing.assert_array_equal(g_win, g_kv)
+    assert g_kv[:9].tolist() == prompt.tolist()
+
+
+def test_moe_kv_prefill_logits_match_forward():
+    """Teacher-forced: MoE prefill logits == layer-stack forward at
+    every position (routing decisions included)."""
+    import jax.numpy as jnp
+    from singa_tpu.models import gpt2_decode
+
+    cfg, m = _moe_model()
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    x = tensor.from_numpy(ids)
+    m.eval()
+    ref = tensor.to_numpy(m.forward(x))
+    params = gpt2_decode.extract_params(m)
+    hidden, _, _ = gpt2_decode.prefill(
+        params, jnp.asarray(ids), cfg.n_head, cfg.layer_norm_eps,
+        moe_top_k=cfg.moe_top_k)
+    got = gpt2_decode._logits(hidden, params)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=2e-3,
+                               rtol=1e-3)
+
+
+def test_moe_ragged_batch_and_beam_decode():
+    """MoE rides the full round-5 decode surface: ragged left-padded
+    batches and beam search (beam=1 ≡ greedy)."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg, m = _moe_model()
+    prompts = [np.arange(7) % cfg.vocab_size, np.asarray([3, 1, 4, 1]),
+               (np.arange(11) + 2) % cfg.vocab_size]
+    batched = gpt2_decode.generate(m, prompts, max_new_tokens=5,
+                                   temperature=0)
+    for p, got in zip(prompts, batched):
+        single = gpt2_decode.generate(m, p, max_new_tokens=5,
+                                      temperature=0)
+        np.testing.assert_array_equal(got, single)
+    beam1 = gpt2_decode.generate_beam(m, prompts[0], max_new_tokens=5,
+                                      num_beams=1)
+    greedy = gpt2_decode.generate(m, prompts[0], max_new_tokens=5,
+                                  temperature=0)
+    np.testing.assert_array_equal(beam1, greedy)
