@@ -58,7 +58,21 @@ def extract_params(m, dtype=None):
     static-shape training-efficiency device; at inference every token
     gets its chosen experts).  Token-parity with the windowed sampler
     therefore holds exactly when the windowed forward drops nothing —
-    the regime its capacity_factor is tuned for."""
+    the regime its capacity_factor is tuned for.
+
+    SESSION CACHE (round 5): the extracted (cast, plan-laid-out)
+    pytree is cached on the model, keyed by ``dtype``/plan and the
+    identity of every state buffer — repeated ``generate``/
+    ``generate_beam`` calls on an unchanged model skip the per-call
+    re-cast/re-shard (a full weight upload per request under a plan).
+    Any state mutation (a training step, ``set_states``,
+    ``load_states``) replaces the underlying ``jax.Array`` buffers, so
+    the identity signature misses and the cache rebuilds."""
+    bufs = [t_.data for _, t_ in sorted(m.get_states().items())]
+    sig = (str(dtype), id(m.plan), tuple(id(b) for b in bufs))
+    cache = getattr(m, "_decode_param_cache", None)
+    if cache is not None and cache[0] == sig:
+        return cache[2]
     t = m.transformer
     blocks = []
     for blk in t.blocks:
@@ -97,6 +111,9 @@ def extract_params(m, dtype=None):
             if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
     if m.plan is not None:
         params = _shard_params(m, params)
+    # the strong refs to the keyed buffers make the id() signature
+    # sound: while this entry lives, no new array can recycle their ids
+    m._decode_param_cache = (sig, bufs, params)
     return params
 
 
@@ -222,6 +239,9 @@ def _moe_weights(probs, top_k):
     gating exactly in the no-drop regime: top-1 keeps the RAW chosen
     prob (Switch); top-2 renormalizes the two gates to sum 1
     (GShard)."""
+    if top_k not in (1, 2):
+        raise ValueError("moe_top_k must be 1 (Switch) or 2 (GShard), "
+                         f"got {top_k}")
     e = probs.shape[-1]
     m1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e,
                         dtype=probs.dtype)
@@ -468,54 +488,70 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "num_beams", "moe_top_k"))
 def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
-                        ctx, num_beams, moe_top_k=2):
-    """Fixed-length beam search, ONE compiled prefill + scan.  ids:
-    (1, ctx) right-padded prompt.  Returns ((num_beams, n_new) token
-    ids, (num_beams,) total log-probs), best beam first.  The beams
-    are the batch: per-beam KV caches reorder by parent at every step
-    (a gather on the leading axis).  Exact when num_beams covers the
-    frontier (tests compare against exhaustive search on tiny models).
-    """
-    hidden, kc, vc = prefill(params, ids, n_head, eps,
+                        ctx, num_beams, moe_top_k=2, start=None):
+    """Fixed-length beam search, ONE compiled prefill + scan, for a
+    BATCH of prompts (round 5).  ids: (B, ctx) sharing one end
+    position ``prompt_len`` (right-padded when equal-length; ragged
+    batches come in LEFT-padded with ``start`` (B,) as in
+    generate_cached_uniform).  Returns ((B, num_beams, n_new) token
+    ids, (B, num_beams) total log-probs), best beam first per prompt.
+    The beams are the batch — (B·K) rows advance lockstep, and each
+    step reorders every prompt's K cache rows by parent with one
+    BLOCK-DIAGONAL gather (global row index b·K + parent).  Exact when
+    num_beams covers the frontier (tests compare against exhaustive
+    search on tiny models, and batched-vs-looped equality)."""
+    bsz = ids.shape[0]
+    K = num_beams
+    hidden, kc, vc = prefill(params, ids, n_head, eps, start=start,
                              moe_top_k=moe_top_k)
     last_h = jax.lax.dynamic_index_in_dim(
-        hidden, prompt_len - 1, axis=1, keepdims=False)
+        hidden, prompt_len - 1, axis=1, keepdims=False)      # (B, E)
     logp0 = jax.nn.log_softmax(
-        _logits(last_h[:, None, :], params)[0, 0].astype(jnp.float32))
-    V = logp0.shape[0]
-    k0 = min(num_beams, V)
-    top0, tok0 = jax.lax.top_k(logp0, k0)
+        _logits(last_h[:, None, :], params)[:, 0].astype(jnp.float32))
+    V = logp0.shape[-1]
+    k0 = min(K, V)
+    top0, tok0 = jax.lax.top_k(logp0, k0)                    # (B, k0)
     # pad the beam set if num_beams > V (dead beams at -inf)
-    pad = num_beams - k0
+    pad = K - k0
     scores = jnp.concatenate(
-        [top0, jnp.full((pad,), NEG_INF, jnp.float32)])
-    toks = jnp.concatenate([tok0, jnp.zeros((pad,), jnp.int32)])
-    # replicate the prompt caches across beams
-    kc = jnp.broadcast_to(kc[:, None], (kc.shape[0], num_beams)
-                          + kc.shape[1:]).reshape(
-        (kc.shape[0], num_beams * kc.shape[1]) + kc.shape[2:])
-    vc = jnp.broadcast_to(vc[:, None], (vc.shape[0], num_beams)
-                          + vc.shape[1:]).reshape(
-        (vc.shape[0], num_beams * vc.shape[1]) + vc.shape[2:])
-    seqs = jnp.zeros((num_beams, n_new), jnp.int32)
-    seqs = seqs.at[:, 0].set(toks)
+        [top0, jnp.full((bsz, pad), NEG_INF, jnp.float32)], axis=1)
+    toks = jnp.concatenate(
+        [tok0, jnp.zeros((bsz, pad), jnp.int32)], axis=1)    # (B, K)
+    # replicate the prompt caches across beams: (L, B, ...) ->
+    # (L, B*K, ...) in (b, k) row-major order
+    kc = jnp.repeat(kc, K, axis=1)
+    vc = jnp.repeat(vc, K, axis=1)
+    start_rows = None if start is None else jnp.repeat(start, K)
+    seqs = jnp.zeros((bsz, K, n_new), jnp.int32)
+    seqs = seqs.at[:, :, 0].set(toks)
 
     def step(carry, t):
         seqs, scores, toks, kc, vc = carry
         pos = prompt_len + t
-        x = jnp.take(params["wte"], toks, axis=0)[:, None, :] \
-            + params["wpe"][pos][None, None, :]
+        if start_rows is None:
+            pe = params["wpe"][pos][None, None, :]
+        else:
+            pe = jnp.take(params["wpe"], pos - start_rows,
+                          axis=0)[:, None, :]
+        x = jnp.take(params["wte"], toks.reshape(-1),
+                     axis=0)[:, None, :] + pe
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
-                                      eps, moe_top_k=moe_top_k)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))  # (B, V)
-        cand = scores[:, None] + logp                       # (B, V)
+                                      eps, start=start_rows,
+                                      moe_top_k=moe_top_k)
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32)).reshape(bsz, K, V)
+        cand = scores[:, :, None] + logp                 # (B, K, V)
         flat_scores, flat_idx = jax.lax.top_k(
-            cand.reshape(-1), num_beams)
-        parents = flat_idx // V
+            cand.reshape(bsz, K * V), K)                 # (B, K)
+        parents = flat_idx // V                          # (B, K) in [0,K)
         toks = (flat_idx % V).astype(jnp.int32)
-        seqs = seqs[parents].at[:, t + 1].set(toks)
-        kc = kc[:, parents]
-        vc = vc[:, parents]
+        seqs = jnp.take_along_axis(seqs, parents[:, :, None], axis=1)
+        seqs = seqs.at[:, :, t + 1].set(toks)
+        # block-diagonal cache reorder: beam rows only ever gather from
+        # their own prompt's block
+        glob = (jnp.arange(bsz)[:, None] * K + parents).reshape(-1)
+        kc = kc[:, glob]
+        vc = vc[:, glob]
         return (seqs, flat_scores, toks, kc, vc), None
 
     if n_new > 1:
@@ -526,40 +562,70 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
     return seqs, scores
 
 
+def _normalize_prompts(prompt_ids, max_new_tokens, cfg,
+                       over_length_hint=""):
+    """Shared prompt handling for generate/generate_beam: classify
+    single-vs-batch, coerce rows, length-check, and build the
+    LEFT-padded shared-end window.  Returns (single, rows, lens,
+    max_len, window, start) — ``start`` is None for equal-length
+    batches (every row already ends at max_len = its length)."""
+    if isinstance(prompt_ids, np.ndarray):
+        single = prompt_ids.ndim == 1
+        seq = [prompt_ids] if single else list(prompt_ids)
+    else:
+        seq = list(prompt_ids)
+        # ragged batches defeat np.ndim on the whole object; classify
+        # by the first element instead
+        single = not seq or np.ndim(seq[0]) == 0
+        if single:
+            seq = [prompt_ids]
+    rows = [np.asarray(r, np.int32).reshape(-1) for r in seq]
+    for r in rows:
+        if len(r) + max_new_tokens > cfg.n_positions:
+            raise ValueError(
+                f"prompt ({len(r)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds n_positions ({cfg.n_positions})"
+                + over_length_hint)
+    lens = np.asarray([len(r) for r in rows], np.int32)
+    max_len = int(lens.max()) if len(rows) else 0
+    window = np.zeros((len(rows), cfg.n_positions), np.int32)
+    for i, r in enumerate(rows):
+        window[i, max_len - len(r):max_len] = r
+    uniform = len(set(lens.tolist())) <= 1
+    start = None if uniform else jnp.asarray(max_len - lens)
+    return single, rows, lens, max_len, window, start
+
+
 def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
                   dtype=None):
     """Fixed-length beam search for a (optionally plan-sharded, possibly
     MoE) GPT2LMHead: returns the highest-total-log-prob continuation of
-    ``max_new_tokens`` tokens.  One prompt (the beams are the batch);
+    ``max_new_tokens`` tokens.  Takes one 1-D prompt (returns one
+    array) or a list/2-D batch, possibly ragged (returns a list) —
+    all (B·num_beams) rows advance in ONE compiled executable, each
+    prompt's beams reordering through a block-diagonal parent gather
+    (round 5); ragged batches ride the left-padding machinery.
     ``num_beams=1`` equals greedy decoding.  No EOS handling — this
     framework's models are tokenizer-free, so sequences are
     fixed-length and the length penalty cancels."""
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
-    params = extract_params(m, dtype=dtype)
     cfg = m.cfg
-    ids = np.asarray(prompt_ids, np.int32)
-    if ids.ndim > 1:
-        raise ValueError(
-            "generate_beam takes ONE 1-D prompt (the beams are the "
-            f"batch); got shape {ids.shape} — loop over rows for a "
-            "batch")
-    ids = ids.reshape(-1)
-    n0 = len(ids)
+    single, rows, lens, max_len, window, start = _normalize_prompts(
+        prompt_ids, max_new_tokens, cfg)
     if max_new_tokens <= 0:
-        return ids.copy()
-    if n0 + max_new_tokens > cfg.n_positions:
-        raise ValueError(
-            f"prompt ({n0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"n_positions ({cfg.n_positions})")
-    window = np.zeros((1, cfg.n_positions), np.int32)
-    window[0, :n0] = ids
+        out = [r.copy() for r in rows]
+        return out[0] if single else out
+    params = extract_params(m, dtype=dtype)
     seqs, _scores = _beam_search_cached(
-        params, jnp.asarray(window), n0, cfg.n_head,
+        params, jnp.asarray(window), max_len, cfg.n_head,
         float(cfg.layer_norm_eps), int(max_new_tokens),
         cfg.n_positions, int(num_beams),
-        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2))
-    return np.concatenate([ids, np.asarray(seqs[0])]).astype(np.int32)
+        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2), start=start)
+    seqs = np.asarray(seqs)
+    out = [np.concatenate([r, seqs[i, 0]]).astype(np.int32)
+           for i, r in enumerate(rows)]
+    return out[0] if single else out
 
 
 def _seed(temperature, rng):
@@ -579,7 +645,8 @@ def _seed(temperature, rng):
 
 def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
              top_k=0, top_p=None, dtype=None, _ragged_impl="left"):
-    """KV-cached sampling for a dense GPT2LMHead.  Requires
+    """KV-cached sampling for a GPT2LMHead (dense or MoE,
+    optionally plan-sharded).  Requires
     prompt_len + max_new_tokens <= cfg.n_positions (the windowed
     fallback in models/gpt2.py handles longer generations).
 
@@ -593,50 +660,26 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     distribution before sampling.  ``dtype=jnp.bfloat16`` runs
     inference in bf16 (≈2× steady-state throughput; see
     extract_params)."""
-    params = extract_params(m, dtype=dtype)
     cfg = m.cfg
-    if isinstance(prompt_ids, np.ndarray):
-        single = prompt_ids.ndim == 1
-        seq = [prompt_ids] if single else list(prompt_ids)
-    else:
-        seq = list(prompt_ids)
-        # ragged batches defeat np.ndim on the whole object; classify
-        # by the first element instead
-        single = not seq or np.ndim(seq[0]) == 0
-        if single:
-            seq = [prompt_ids]
-    rows = [np.asarray(r, np.int32).reshape(-1) for r in seq]
+    single, rows, lens, max_len, window, start = _normalize_prompts(
+        prompt_ids, max_new_tokens, cfg,
+        over_length_hint="; use the windowed GPT2LMHead.generate")
     if max_new_tokens <= 0:
         out = [r.copy() for r in rows]
         return out[0] if single else out
-    for r in rows:
-        if len(r) + max_new_tokens > cfg.n_positions:
-            raise ValueError(
-                f"prompt ({len(r)}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds n_positions ({cfg.n_positions}); use the "
-                "windowed GPT2LMHead.generate")
     if top_k and top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    params = extract_params(m, dtype=dtype)
     ctx = cfg.n_positions
     bsz = len(rows)
-    lens = np.asarray([len(r) for r in rows], np.int32)
-    uniform = len(set(int(n) for n in lens)) == 1
-    window = np.zeros((bsz, ctx), np.int32)
-    if uniform or _ragged_impl == "scatter":
+    uniform = start is None
+    if not uniform and _ragged_impl == "scatter":
+        # the oracle path wants RIGHT-padded rows
+        window = np.zeros((bsz, ctx), np.int32)
         for i, r in enumerate(rows):
             window[i, :len(r)] = r
-    else:
-        # LEFT-pad (round 5): align every prompt's END at max_len so
-        # the whole ragged batch shares one position and rides the
-        # uniform fast path (start carries each row's first live
-        # window position).  No extra length constraint: the longest
-        # row's (len + n_new <= ctx) check above already bounds
-        # max_len + n_new.
-        max_len = int(lens.max())
-        for i, r in enumerate(rows):
-            window[i, max_len - len(r):max_len] = r
     keys = jax.random.split(
         jax.random.PRNGKey(_seed(temperature, rng)), bsz)
     common = dict(
@@ -649,12 +692,12 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
                    jnp.float32(max(temperature, 1e-6)), keys)
     if uniform:
         new = generate_cached_uniform(
-            params, jnp.asarray(window), int(lens[0]), *sample_args,
+            params, jnp.asarray(window), max_len, *sample_args,
             **common)
     elif _ragged_impl == "left":
         new = generate_cached_uniform(
-            params, jnp.asarray(window), int(lens.max()), *sample_args,
-            start=jnp.asarray(int(lens.max()) - lens), **common)
+            params, jnp.asarray(window), max_len, *sample_args,
+            start=start, **common)
     elif _ragged_impl == "scatter":
         # per-row vmap oracle (see generate_cached docstring)
         new = generate_cached(

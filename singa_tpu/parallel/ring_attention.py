@@ -148,6 +148,171 @@ def ring_self_attention(q, k, v, axis_name, causal=False, kv_mask=None,
     return (acc / l[..., None]).astype(q.dtype)
 
 
+def zigzag_order(seqlen, world):
+    """Permutation putting a global sequence into ZIGZAG layout: the
+    sequence splits into 2W half-stripes; rank r holds half-stripes
+    [r, 2W-1-r] concatenated.  Returns the gather index array such
+    that ``x[..., order, ...]`` lays the sequence out rank-contiguously
+    for a P(axis) sharding."""
+    if seqlen % (2 * world):
+        raise ValueError(f"seqlen {seqlen} not divisible by 2*W={2*world}")
+    h = seqlen // (2 * world)
+    idx = []
+    for r in range(world):
+        idx.extend(range(r * h, (r + 1) * h))
+        idx.extend(range((2 * world - 1 - r) * h, (2 * world - r) * h))
+    import numpy as np
+
+    return np.asarray(idx, np.int32)
+
+
+def zigzag_ring_self_attention(q, k, v, axis_name, remat=True):
+    """CAUSAL ring attention with the load-balanced ZIGZAG layout
+    (round-5 verdict item 4).
+
+    The contiguous layout's causal skip (``ring_self_attention``
+    ``causal=True``) leaves rank i computing i+1 block-pairs per pass —
+    the last rank does W× the first's work, so the mesh's wall-clock is
+    the DENSE cost while half the chips idle.  Here each rank holds two
+    half-stripes of the sequence — stripe r and the mirrored stripe
+    2W−1−r — so every hop costs every rank exactly two dense
+    (S_local/2)² half-attentions:
+
+      * K/V from an earlier rank (src < rank): both the low and high
+        local query halves attend ONLY the visiting low half
+        (the visiting high half is entirely in their future) —
+        one dense (2h × h) attention;
+      * K/V from a later rank (src > rank): only the local high half
+        attends, but sees BOTH visiting halves — one dense (h × 2h);
+      * the diagonal hop (src == rank, once per pass) applies the exact
+        global-position causal mask over the full (2h × 2h) tile.
+
+    Per-rank cost is uniform at 2(W−1)+4 dense half-pairs per pass
+    (``ring_causal_half_pairs_per_rank`` is the analytic check), vs the
+    contiguous layout's 4(i+1) for rank i — total FLOPs match the
+    causal optimum within the diagonal tile's masked half.
+
+    Inputs are per-rank blocks inside ``shard_map``, (B, H, 2h, D) in
+    zigzag order (``zigzag_order`` produces the global permutation;
+    ``zigzag_ring_attention_sharded`` wraps all of it).  Causal only —
+    for non-causal use ``ring_self_attention``, where balance is free.
+    Differentiable (scan + cond + ppermute have exact VJPs); ``remat``
+    checkpoints each hop like the contiguous path."""
+    axis_size = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    b, nh, s2, d = q.shape
+    if s2 % 2:
+        raise ValueError(f"zigzag blocks need an even local length, "
+                         f"got {s2}")
+    h = s2 // 2
+    scale = 1.0 / math.sqrt(d)
+    # global positions of the local query halves (stripe r, stripe
+    # 2W-1-r) — also the visiting K/V's positions on the diagonal hop
+    q_pos = jnp.concatenate([
+        rank * h + jnp.arange(h),
+        (2 * axis_size - 1 - rank) * h + jnp.arange(h)])
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def part(q_, k_, v_, mask):
+        """Normalized partial attention (o_t f32, lse_t) over k_/v_."""
+        sc = jnp.einsum("bhsd,bhtd->bhst", q_ * scale, k_)
+        if mask is not None:
+            sc = jnp.where(mask, sc, NEG_INF)
+        m_c = jnp.maximum(jnp.max(sc, axis=-1), NEG_INF)
+        p = jnp.exp(sc - m_c[..., None])
+        l_c = jnp.sum(p, axis=-1)
+        l_safe = jnp.where(l_c == 0.0, 1.0, l_c)
+        o_t = jnp.einsum("bhst,bhtd->bhsd", p, v_) / l_safe[..., None]
+        return o_t.astype(jnp.float32), (m_c + jnp.log(l_safe)).astype(
+            jnp.float32)
+
+    def body(carry, t):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        src = (rank - t) % axis_size
+
+        def before(_):
+            # src < rank: every local query is after ALL of the visiting
+            # low half and before all of its high half
+            return part(q, k_cur[:, :, :h], v_cur[:, :, :h], None)
+
+        def after(_):
+            # src > rank: only the local high half attends; it is after
+            # BOTH visiting halves
+            o_h, lse_h = part(q[:, :, h:], k_cur, v_cur, None)
+            return (jnp.concatenate(
+                [jnp.zeros((b, nh, h, d), jnp.float32), o_h], axis=2),
+                jnp.concatenate(
+                    [jnp.full((b, nh, h), NEG_INF, jnp.float32), lse_h],
+                    axis=2))
+
+        def diag(_):
+            # src == rank: exact causal mask by global position over the
+            # full tile (once per pass; half the tile is masked)
+            mask = (q_pos[:, None] >= q_pos[None, :])[None, None]
+            return part(q, k_cur, v_cur, mask)
+
+        o_t, lse_t = lax.cond(
+            src < rank, before,
+            lambda op: lax.cond(src == rank, diag, after, op), None)
+        m_new = jnp.maximum(m_prev, lse_t)
+        alpha = jnp.exp(m_prev - m_new)
+        w = jnp.exp(lse_t - m_new)
+        acc = acc * alpha[..., None] + o_t * w[..., None]
+        l_new = l_prev * alpha + w
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m_new, l_new, k_next, v_next), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    init = (jnp.zeros((b, nh, s2, d), jnp.float32),
+            jnp.full((b, nh, s2), NEG_INF, jnp.float32),
+            jnp.zeros((b, nh, s2), jnp.float32),
+            k, v)
+    (acc, m, l, *_), _ = lax.scan(body, init, jnp.arange(axis_size))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_causal_half_pairs_per_rank(world, layout="zigzag"):
+    """Analytic per-rank work for one causal ring pass, in dense
+    (S_local/2)² half-pair units — the balance check the zigzag layout
+    exists for.  ``zigzag``: every rank computes 2 per off-diagonal hop
+    + 4 on its diagonal hop (half masked) → uniform.  ``contiguous``:
+    rank i computes 4·(i+1) (its causal skip drops hops above the
+    diagonal; each surviving hop is a full 4-half-pair tile)."""
+    if layout == "zigzag":
+        return [2 * (world - 1) + 4] * world
+    if layout == "contiguous":
+        return [4 * (i + 1) for i in range(world)]
+    raise ValueError(f"unknown layout {layout!r}")
+
+
+def zigzag_ring_attention_sharded(q, k, v, mesh=None, axis_name="seq"):
+    """Causal zigzag ring attention over GLOBAL (B, H, S, D) arrays:
+    permutes the sequence into zigzag order, shard_maps the balanced
+    ring, and permutes back.  The permutation costs one gather each
+    way — callers keeping activations in zigzag layout end-to-end
+    (the idiomatic long-context training loop) skip both."""
+    import numpy as np
+
+    if mesh is None:
+        mesh = Mesh(np.asarray(jax.devices()), (axis_name,))
+    world = mesh.shape[axis_name]
+    order = zigzag_order(q.shape[2], world)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(len(order), dtype=np.int32)
+    spec = P(None, None, axis_name, None)
+
+    f = jax.shard_map(
+        lambda q_, k_, v_: zigzag_ring_self_attention(q_, k_, v_,
+                                                      axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    out = f(q[:, :, order], k[:, :, order], v[:, :, order])
+    return out[:, :, inv]
+
+
 def ring_attention_sharded(q, k, v, mesh=None, axis_name="seq",
                            causal=False):
     """Global arrays (B, H, S, D) with S sharded over ``axis_name``."""

