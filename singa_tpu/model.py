@@ -38,10 +38,36 @@ from jax.sharding import PartitionSpec as P
 from . import autograd, layer, tensor
 from .tensor import Tensor
 
-# captured once at import (single-threaded): background save threads
-# must not race os.umask(), which is process-global
-_UMASK = os.umask(0)
-os.umask(_UMASK)
+# Default checkpoint file mode (0o666 & ~umask), probed WITHOUT calling
+# os.umask(): mutating the process-global umask — even briefly at
+# import — would race any other thread creating files (advisor r04).
+# Instead, the kernel applies the umask for us to a throwaway O_CREAT
+# file, whose stat we read.  Lazy + cached: the probe touches the
+# filesystem once per process, at first save.
+_CKPT_MODE = None
+
+
+def _ckpt_mode(ckpt_dir):
+    """Probe in the CHECKPOINT directory itself: it is known writable
+    (the save is about to mkstemp there) and carries the ACL defaults
+    the checkpoint will actually get — a tempdir probe would fail on
+    read-only /tmp sandboxes and could mismatch."""
+    global _CKPT_MODE
+    if _CKPT_MODE is None:
+        import stat as _stat
+        import uuid as _uuid
+
+        p = os.path.join(ckpt_dir, f".singa-tpu-mode-{_uuid.uuid4().hex}")
+        fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
+        try:
+            _CKPT_MODE = _stat.S_IMODE(os.fstat(fd).st_mode)
+        finally:
+            os.close(fd)
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    return _CKPT_MODE
 
 # registry of graph runners (for Device.ResetGraph / PrintTimeProfiling)
 _graph_runners = []
@@ -306,9 +332,10 @@ class Model(layer.Layer):
                 dir=os.path.dirname(os.path.abspath(fpath)) or ".",
             )
             try:
-                # mkstemp creates 0600; restore umask-derived mode so the
-                # checkpoint stays as readable as a plain open() would be
-                os.fchmod(fd, 0o666 & ~_UMASK)
+                # mkstemp creates 0600; restore the umask-derived mode so
+                # the checkpoint stays as readable as a plain open()
+                os.fchmod(fd, _ckpt_mode(
+                    os.path.dirname(os.path.abspath(fpath)) or "."))
                 with os.fdopen(fd, "wb") as fh:
                     with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
                         for k, v in states.items():

@@ -216,6 +216,9 @@ class GPT2LMHead(model.Model):
         # NaN on top_p=0 instead of raising
         if top_k and top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        # clamp like HF: top_k > vocab means no filter (the windowed
+        # np.sort path would IndexError otherwise — advisor r04)
+        top_k = min(int(top_k or 0), self.cfg.vocab_size)
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         was_training = getattr(self, "training", False)

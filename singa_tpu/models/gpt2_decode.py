@@ -669,6 +669,10 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
         return out[0] if single else out
     if top_k and top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
+    # HF behavior: top_k larger than the vocab means "no filter" — an
+    # unclamped value would die at trace time inside lax.top_k with an
+    # obscure shape error (advisor r04)
+    top_k = min(int(top_k or 0), cfg.vocab_size)
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     params = extract_params(m, dtype=dtype)

@@ -732,9 +732,27 @@ def _h_upsample(node, args):
 
         return _op(f, x, _name="Upsample")
     if mode in ("linear", "bilinear"):
-        return _op(lambda v: jax.image.resize(
-            v, out_shape, method="linear", antialias=False),
-            x, _name="Upsample")
+        # separable lerp with ASYMMETRIC coordinates (src = dst/scale),
+        # the Upsample-7/9 / ORT semantics — jax.image.resize('linear')
+        # would silently substitute half-pixel centers (advisor r04)
+        def f(v):
+            for ax in range(2, v.ndim):
+                n_in, n_out = v.shape[ax], out_shape[ax]
+                if n_in == n_out:
+                    continue
+                src = jnp.arange(n_out, dtype=jnp.float32) / scales[ax]
+                i0 = jnp.clip(jnp.floor(src).astype(jnp.int32), 0,
+                              n_in - 1)
+                i1 = jnp.minimum(i0 + 1, n_in - 1)
+                w = (src - i0.astype(jnp.float32)).astype(v.dtype)
+                shape = [1] * v.ndim
+                shape[ax] = n_out
+                w = w.reshape(shape)
+                v = jnp.take(v, i0, axis=ax) * (1 - w) \
+                    + jnp.take(v, i1, axis=ax) * w
+            return v
+
+        return _op(f, x, _name="Upsample")
     raise NotImplementedError(f"ONNX Upsample mode {mode!r}")
 
 
@@ -1157,6 +1175,138 @@ def _h_gather_elements(node, args):
         args[0], args[1], _name="GatherElements")
 
 
+def _h_trilu(node, args):
+    """Trilu-14: upper/lower triangular part of the last two dims; the
+    optional second input is the (constant) diagonal offset k — the
+    form HF causal-mask exports emit."""
+    upper = bool(node.attrs().get("upper", 1))
+    k = int(_np(args[1]).reshape(-1)[0]) if len(args) > 1 else 0
+
+    def f(x):
+        r, c = x.shape[-2], x.shape[-1]
+        rows = jnp.arange(r)[:, None]
+        cols = jnp.arange(c)[None, :]
+        mask = (cols - rows >= k) if upper else (cols - rows <= k)
+        return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+    return _op(f, args[0], _name="Trilu")
+
+
+def _scatter_ref(ref, upd, reduction, opname):
+    if reduction == "none":
+        return ref.set(upd)
+    if reduction == "add":
+        return ref.add(upd)
+    if reduction == "mul":
+        return ref.multiply(upd)
+    if reduction == "max":
+        return ref.max(upd)
+    if reduction == "min":
+        return ref.min(upd)
+    raise NotImplementedError(
+        f"ONNX {opname} reduction {reduction!r} is not supported")
+
+
+def _h_scatter_nd(node, args):
+    """ScatterND-11/16/18 (none/add/mul/max/min reductions).  Indices
+    stay a graph input (runtime indices are the detection-model
+    pattern); with duplicate indices and reduction 'none' the spec
+    leaves the result undefined — this backend takes XLA's scatter
+    order."""
+    red = node.attrs().get("reduction", "none")
+    if isinstance(red, bytes):
+        red = red.decode()
+
+    def f(data, idx, upd):
+        ii = tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))
+        return _scatter_ref(data.at[ii], upd, red, "ScatterND")
+
+    return _op(f, args[0], args[1], args[2], _name="ScatterND")
+
+
+def _h_scatter_elements(node, args):
+    """ScatterElements-11/16/18 (and legacy Scatter-9): the scatter
+    twin of GatherElements — per-element writes along ``axis``."""
+    axis = node.attrs().get("axis", 0)
+    red = node.attrs().get("reduction", "none")
+    if isinstance(red, bytes):
+        red = red.decode()
+
+    def f(data, idx, upd):
+        idx = idx.astype(jnp.int32)
+        grids = jnp.indices(idx.shape)
+        ii = tuple(idx if d == (axis % data.ndim) else grids[d]
+                   for d in range(data.ndim))
+        return _scatter_ref(data.at[ii], upd, red, "ScatterElements")
+
+    return _op(f, args[0], args[1], args[2], _name="ScatterElements")
+
+
+def _h_gather_nd(node, args):
+    """GatherND-11/12/13 with batch_dims."""
+    b = int(node.attrs().get("batch_dims", 0))
+
+    def f(data, idx):
+        idx = idx.astype(jnp.int32)
+
+        def core(d, i):
+            return d[tuple(jnp.moveaxis(i, -1, 0))]
+
+        fn = core
+        for _ in range(b):
+            fn = jax.vmap(fn)
+        return fn(data, idx)
+
+    return _op(f, args[0], args[1], _name="GatherND")
+
+
+def _h_nonzero(node, args):
+    """NonZero-9/13: (rank, N) indices of nonzero elements.  The output
+    shape is DATA-DEPENDENT, which XLA's static-shape model cannot
+    express — the op therefore works in eager execution (the normal
+    path for an imported ONNX graph) and raises jax's concretization
+    error inside jit/graph mode.  Index dtype is int32, the documented
+    x64-disabled divergence (see _h_arg_extremum)."""
+    def f(x):
+        return jnp.stack(jnp.nonzero(x)).astype(jnp.int32)
+
+    return _op(f, args[0], _name="NonZero")
+
+
+def _h_group_norm(node, args):
+    """GroupNormalization-18/21.  Opset 18 wrote scale/bias per GROUP
+    (num_groups,); opset 21 fixed them to per-channel (C,) — both
+    layouts are accepted, disambiguated by length (matching ORT)."""
+    a = node.attrs()
+    eps = a.get("epsilon", 1e-5)
+    g = int(a["num_groups"])
+
+    def f(x, s, b):
+        n, c = x.shape[0], x.shape[1]
+        if c % g:
+            raise ValueError(
+                f"GroupNormalization: channels {c} not divisible by "
+                f"num_groups {g}")
+        xg = x.reshape((n, g, c // g) + x.shape[2:])
+        ax = tuple(range(2, xg.ndim))
+        mu = jnp.mean(xg, axis=ax, keepdims=True)
+        var = jnp.var(xg, axis=ax, keepdims=True)
+        y = ((xg - mu) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+        if s.shape[0] == c:
+            pass  # per-channel (opset 21)
+        elif s.shape[0] == g:  # per-group (opset 18): expand to C
+            s = jnp.repeat(s, c // g)
+            b = jnp.repeat(b, c // g)
+        else:
+            raise ValueError(
+                f"GroupNormalization scale length {s.shape[0]} is "
+                f"neither C={c} nor num_groups={g}")
+        shape = (1, c) + (1,) * (x.ndim - 2)
+        return y * s.reshape(shape) + b.reshape(shape)
+
+    return _op(f, args[0], args[1], args[2], _name="GroupNormalization")
+
+
 # subgraph-carrying control-flow ops, dispatched in _exec_nodes (they
 # need the enclosing env for outer-scope capture, so they live outside
 # the flat handler table); the conformance sweep counts them as
@@ -1242,6 +1392,12 @@ _ONNX_OPS = {
     "DepthToSpace": _h_depth_space(True),
     "SpaceToDepth": _h_depth_space(False),
     "GatherElements": _h_gather_elements,
+    "Trilu": _h_trilu,
+    "ScatterND": _h_scatter_nd,
+    "ScatterElements": _h_scatter_elements,
+    "GatherND": _h_gather_nd,
+    "NonZero": _h_nonzero,
+    "GroupNormalization": _h_group_norm,
     "And": _handle_binary(jnp.logical_and),
     "Or": _handle_binary(jnp.logical_or),
     "Xor": _handle_binary(jnp.logical_xor),

@@ -20,3 +20,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests (subprocess multihost, CNN-zoo "
+        "training, >15s parity sweeps); `-m 'not slow'` is the fast "
+        "inner loop for builders")

@@ -293,6 +293,7 @@ def test_ring_attention_differentiable():
 
 
 @pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+@pytest.mark.slow
 def test_ring_attention_flash_matches_single_device():
     """Ring attention with per-shard flash partials (merged via each
     step's logsumexp) must equal the plain reference — forward and

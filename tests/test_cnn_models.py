@@ -4,6 +4,8 @@ unverified)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from singa_tpu import layer, opt, tensor
 from singa_tpu import device as device_module
 from singa_tpu.models.cnn import CNN

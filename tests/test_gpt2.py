@@ -93,6 +93,7 @@ def test_tied_head_gradient_reaches_embedding():
     assert not np.allclose(tensor.to_numpy(m.transformer.wte.W), w0)
 
 
+@pytest.mark.slow
 def test_parallel_gpt_moe_matches_serial():
     """dp2 x tp2 x sp2 GPT with a MoE block == serial twin (the serial
     oracle pins moe_groups=2 to reproduce the plan's grouped routing)."""
@@ -368,6 +369,7 @@ def test_windowed_path_rejects_bad_sampling_params():
                        use_cache=True, **kw)
 
 
+@pytest.mark.slow
 def test_tp_sharded_kv_decode_matches_serial():
     """Plan-sharded (tp=4) dense GPT-2 decodes through the KV cache:
     extract_params lays the weights out per the Megatron plan (asserted
@@ -413,6 +415,7 @@ def test_tp_sharded_kv_decode_matches_serial():
     np.testing.assert_array_equal(got2, ref)
 
 
+@pytest.mark.slow
 def test_beam_search_matches_exhaustive_and_greedy():
     """num_beams=1 == greedy; a beam wide enough to cover the frontier
     (num_beams = V^2 >= every level's node count for T=3) must find the
@@ -522,6 +525,7 @@ def test_uniform_decode_path_matches_ragged_and_windowed():
         np.testing.assert_array_equal(u, w)
 
 
+@pytest.mark.slow
 def test_tp_sharded_beam_search_matches_serial():
     """Beam search composes with plan-sharded params the same way
     sampling does (pure-jnp SPMD): tp=4 beam tokens equal serial."""
@@ -547,6 +551,7 @@ def test_tp_sharded_beam_search_matches_serial():
     np.testing.assert_array_equal(b_ser, b_par)
 
 
+@pytest.mark.slow
 def test_left_padded_ragged_decode_matches_scatter_oracle():
     """Round-5 fast path: a ragged batch routed through left-padding +
     the shared-position executable must be token-exact (f32) against
@@ -594,6 +599,7 @@ def _moe_model(top_k=2):
 
 
 @pytest.mark.parametrize("top_k", [1, 2])
+@pytest.mark.slow
 def test_moe_kv_decode_matches_windowed_greedy(top_k):
     """MoE KV-cached decode (capacity-free top-k routing) must equal
     the windowed full-forward sampler token for token when the windowed
@@ -609,6 +615,7 @@ def test_moe_kv_decode_matches_windowed_greedy(top_k):
     assert g_kv[:9].tolist() == prompt.tolist()
 
 
+@pytest.mark.slow
 def test_moe_kv_prefill_logits_match_forward():
     """Teacher-forced: MoE prefill logits == layer-stack forward at
     every position (routing decisions included)."""
@@ -630,6 +637,7 @@ def test_moe_kv_prefill_logits_match_forward():
                                rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_moe_ragged_batch_and_beam_decode():
     """MoE rides the full round-5 decode surface: ragged left-padded
     batches and beam search (beam=1 ≡ greedy)."""

@@ -140,6 +140,7 @@ def test_charrnn_perplexity_bound(dev):
     assert ppl < 2.0, f"char-RNN perplexity {ppl:.2f} >= 2.0 (|V|={vocab})"
 
 
+@pytest.mark.slow
 def test_unet_segments_rectangles_over_90(dev):
     """Segmentation family learning target: binary masks of axis-
     aligned bright rectangles on noisy backgrounds.  Chance pixel

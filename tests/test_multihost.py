@@ -18,6 +18,8 @@ import textwrap
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 _WORKER = textwrap.dedent("""
     import json, os, sys
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -514,10 +516,29 @@ def test_worker_death_clean_error_and_restart_matches_oracle(tmp_path):
     ]
     # wait for rank 0 to report the checkpoint barrier passed
     t0 = _time.time()
-    for line in procs[0].stdout:
+    # a reader thread enforces the 180s bound even if rank 0 produces
+    # NO output at all — a bare `for line in stdout` would block in
+    # readline() forever and hang the test instead of failing
+    # (advisor r04)
+    import queue as _queue
+    import threading as _threading
+
+    lines = _queue.Queue()
+
+    def _pump():
+        for line in procs[0].stdout:
+            lines.put(line)
+        lines.put(None)
+
+    _threading.Thread(target=_pump, daemon=True).start()
+    while True:
+        try:
+            line = lines.get(timeout=max(0.1, 180 - (_time.time() - t0)))
+        except _queue.Empty:
+            line = None
+        assert line is not None and _time.time() - t0 < 180,             "never reached CKPT_DONE"
         if "CKPT_DONE" in line:
             break
-        assert _time.time() - t0 < 180, "never reached CKPT_DONE"
     _time.sleep(1.0)          # let both ranks get into steady stepping
     procs[1].kill()           # SIGKILL the victim mid-collective
     procs[1].wait(timeout=30)

@@ -6,6 +6,13 @@ is vendored).
 
 A completeness guard asserts no supported op is missing from the sweep,
 so newly added handlers fail CI until they get a conformance case.
+
+Documented spec divergence (advisor r04): index-producing ops (ArgMax,
+ArgMin, TopK indices, NonZero) emit int32 where ONNX mandates int64 —
+this runtime disables x64, so an int64 cast would silently truncate and
+warn on every call.  Type-strict downstream consumers comparing against
+int64 constants must cast; values are identical for any real tensor
+dimension.
 """
 
 import numpy as np
@@ -245,6 +252,29 @@ CASES = {
         {"x": A}, {"axis": 1},
         (_init(np.asarray([[0, 2], [1, 0]], np.int64), "idx"),),
         [np.take_along_axis(A, np.asarray([[0, 2], [1, 0]]), axis=1)]),
+    "Trilu": lambda: (
+        {"x": rng.randn(2, 4, 4).astype(np.float32)}, {"upper": 1},
+        (_init(np.asarray([1], np.int64), "k"),), None),
+    "ScatterND": lambda: (
+        {"x": rng.randn(4, 3).astype(np.float32)}, {},
+        (_init(np.asarray([[0], [2]], np.int64), "idx"),
+         _init(rng.randn(2, 3).astype(np.float32), "upd")), None),
+    "ScatterElements": lambda: (
+        {"x": A.copy()}, {"axis": 1, "reduction": "add"},
+        (_init(np.asarray([[0, 2], [1, 0]], np.int64), "idx"),
+         _init(rng.randn(2, 2).astype(np.float32), "upd")), None),
+    "GatherND": lambda: (
+        {"x": rng.randn(2, 3, 4).astype(np.float32)},
+        {"batch_dims": 1},
+        (_init(np.asarray([[1], [2]], np.int64), "idx"),), None),
+    "NonZero": lambda: (
+        {"x": (A > 0).astype(np.float32)}, {}, (),
+        [np.stack(np.nonzero(A > 0)).astype(np.int32)]),
+    "GroupNormalization": lambda: (
+        {"x": rng.randn(2, 6, 3, 3).astype(np.float32)},
+        {"num_groups": 2, "epsilon": 1e-5},
+        (_init(rng.randn(6).astype(np.float32), "s"),
+         _init(rng.randn(6).astype(np.float32), "b")), None),
     "And": lambda: ({"a": A > 0, "b": B > 0}, {}, (),
                     [(A > 0) & (B > 0)]),
     "Or": lambda: ({"a": A > 0, "b": B > 0}, {}, (),
@@ -507,6 +537,30 @@ def test_onnx_node_conformance(op):
         golden = [np.asarray(A[:, :1]), np.asarray(A[:, 1:])]
     elif golden is None and op == "Gemm":
         golden = [2.0 * (A @ B.T) + 0.5 * np.asarray(inputs["c"])]
+    elif golden is None and op == "Trilu":
+        x = np.asarray(inputs["x"])
+        golden = [np.stack([np.triu(x[i], 1)
+                            for i in range(x.shape[0])])]
+    elif golden is None and op == "ScatterND":
+        y = np.asarray(inputs["x"]).copy()
+        idx = inits[0].to_numpy()
+        upd = inits[1].to_numpy()
+        for r in range(idx.shape[0]):
+            y[tuple(idx[r])] = upd[r]
+        golden = [y]
+    elif golden is None and op == "ScatterElements":
+        y = np.asarray(inputs["x"]).copy()
+        idx = inits[0].to_numpy()
+        upd = inits[1].to_numpy()
+        for i in range(idx.shape[0]):
+            for j in range(idx.shape[1]):
+                y[i, idx[i, j]] += upd[i, j]  # reduction="add"
+        golden = [y]
+    elif golden is None and op == "GatherND":
+        x = np.asarray(inputs["x"])
+        idx = inits[0].to_numpy()
+        golden = [np.stack([x[b][tuple(idx[b])]
+                            for b in range(x.shape[0])])]
     elif golden is None:
         torch = pytest.importorskip("torch")
         tx = {k: torch.from_numpy(np.asarray(v).copy())
@@ -526,6 +580,12 @@ def test_onnx_node_conformance(op):
         elif op == "Upsample":
             golden = [torch.nn.functional.interpolate(
                 tx["x"], scale_factor=2, mode="nearest").numpy()]
+        elif op == "GroupNormalization":
+            golden = [torch.nn.functional.group_norm(
+                tx["x"], 2,
+                weight=torch.from_numpy(inits[0].to_numpy()),
+                bias=torch.from_numpy(inits[1].to_numpy()),
+                eps=1e-5).numpy()]
     for got, want in zip(outs, golden):
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want, np.float32),
@@ -609,3 +669,29 @@ def test_reduce_logsumexp_stable():
     got = _run_node("ReduceLogSumExp", {"x": x}, {"axes": [1]})[0]
     assert np.isfinite(got).all()
     np.testing.assert_allclose(got, 100.0 + np.log(3.0), rtol=1e-5)
+
+
+def test_upsample_linear_asymmetric_coordinates():
+    """Legacy Upsample linear must use ASYMMETRIC source coordinates
+    (src = dst/scale), not half-pixel centers (advisor r04): golden is
+    a hand-rolled numpy lerp of the spec's arithmetic."""
+    x = rng.randn(1, 1, 3, 4).astype(np.float32)
+    scales = np.asarray([1.0, 1.0, 2.0, 2.0], np.float32)
+
+    def lerp_axis(v, ax, scale):
+        n_in = v.shape[ax]
+        n_out = int(np.floor(n_in * scale))
+        src = np.arange(n_out) / scale
+        i0 = np.clip(np.floor(src).astype(int), 0, n_in - 1)
+        i1 = np.minimum(i0 + 1, n_in - 1)
+        w = (src - i0).astype(np.float32)
+        shape = [1] * v.ndim
+        shape[ax] = n_out
+        w = w.reshape(shape)
+        return (np.take(v, i0, axis=ax) * (1 - w)
+                + np.take(v, i1, axis=ax) * w)
+
+    want = lerp_axis(lerp_axis(x, 2, 2.0), 3, 2.0)
+    (got,) = _run_node("Upsample", {"x": x}, {"mode": "linear"},
+                       initializers=(_init(scales, "scales"),))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

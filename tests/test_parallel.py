@@ -74,6 +74,7 @@ def _run_steps(m, nsteps=2):
 
 
 @pytest.mark.parametrize("dp,tp,sp", [(2, 2, 2), (1, 4, 1), (2, 1, 4)])
+@pytest.mark.slow
 def test_tp_sp_matches_serial(dp, tp, sp):
     mesh = shd.create_mesh(dp=dp, tp=tp, sp=sp)
     plan = shd.ShardingPlan(mesh)
@@ -168,6 +169,7 @@ def test_plan_state_spec_inheritance():
     assert plan.spec_for_state("__opt__w:momentum", o, {}) == shd.P()
 
 
+@pytest.mark.slow
 def test_sharded_model_checkpoint_roundtrip(tmp_path):
     """save_states on a planned (tp/sp-sharded) model gathers to host;
     load_states restores and the model resumes identically."""
@@ -204,6 +206,7 @@ def test_create_mesh_axes():
         shd.create_mesh(dp=16, tp=16)
 
 
+@pytest.mark.slow
 def test_parallel_mha_flash_under_seq_plan_matches_serial():
     """ParallelMHA(use_flash=True) under a seq-sharded plan routes each
     ring step through the flash kernel; losses must match the serial
@@ -292,6 +295,7 @@ def _peak_temp_bytes(m):
     return best
 
 
+@pytest.mark.slow
 def test_longctx_max_trainable_seqlen_scales_with_mesh():
     """SURVEY §5.7 / round-3 verdict item 1b: the max trainable S scales
     with the seq-mesh size.  At a fixed global S, the ring-attention
@@ -334,6 +338,7 @@ def test_longctx_max_trainable_seqlen_scales_with_mesh():
     assert np.isfinite(float(tensor.to_numpy(loss)))
 
 
+@pytest.mark.slow
 def test_longctx_ring_memory_linear_not_quadratic_in_seqlen():
     """Companion growth-law check: as the global S grows with the mesh
     (S_local fixed), per-rank ring memory grows ~LINEARLY (the O(S·D)
@@ -364,6 +369,7 @@ def test_longctx_ring_memory_linear_not_quadratic_in_seqlen():
     assert serial_ratio > 1.8 * ring_ratio, (serial_ratio, ring_ratio)
 
 
+@pytest.mark.slow
 def test_train_n_batches_under_plan_matches_serial_steps():
     """Multi-step dispatch on the GSPMD plan path: lax.scan over the
     planned step ≡ K single planned dispatches ≡ the serial model

@@ -2,6 +2,7 @@
 layer stack (losses + trained params), dp x pp composition."""
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -49,6 +50,7 @@ def _compile(m):
     return m
 
 
+@pytest.mark.slow
 def test_gpipe_matches_serial():
     mesh = shd.create_mesh(dp=2, pp=4)
     plan = shd.ShardingPlan(mesh)

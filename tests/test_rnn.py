@@ -123,6 +123,7 @@ def test_vanilla_rnn_relu(dev):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_lstm_layer_learns(dev):
     """Tiny copy task: predict class from last LSTM state."""
     rng = np.random.RandomState(3)
@@ -187,6 +188,7 @@ def test_lstm_layer_use_pallas_flag_ignored(dev):
     assert y.shape == (2, 5, 8)
 
 
+@pytest.mark.slow
 def test_charrnn_gru_and_vanilla_cells(dev):
     """The char-RNN model accepts every reference cuDNN RNN mode."""
     from singa_tpu.models.char_rnn import CharRNN, one_hot
