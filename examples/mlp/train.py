@@ -3,6 +3,12 @@ in BASELINE.json).  Trains a 2-layer MLP on a synthetic two-moon-style
 dataset, exactly mirroring the reference script's flow:
 
     python examples/mlp/train.py [--use-graph] [--epochs N] [--device tpu|cpu]
+
+``--steps-per-dispatch K`` (requires --use-graph) runs each epoch
+through ``Model.train_n_batches``: the epoch's batches are stacked with
+a leading K axis and all K optimizer steps execute in ONE compiled
+``lax.scan`` dispatch — the round-5 cure for per-step host round-trip
+latency (identical math; the loss history comes back as a (K,) array).
 """
 
 import argparse
@@ -51,16 +57,40 @@ def run(args):
         raise SystemExit(
             f"batch size {batch} exceeds training set size {n_train}")
 
+    multi = args.steps_per_dispatch > 1
+    if multi and not args.use_graph:
+        raise SystemExit("--steps-per-dispatch requires --use-graph")
     for epoch in range(args.epochs):
         t0 = time.time()
         tot_loss, correct, seen = 0.0, 0, 0
-        for i in range(0, n_train - batch + 1, batch):
-            xb = tensor.from_numpy(x_np[i:i + batch], dev)
-            yb = tensor.from_numpy(y_np[i:i + batch], dev)
-            out, loss = m(xb, yb)
-            tot_loss += float(loss.data)
-            correct += int((tensor.to_numpy(out).argmax(-1) == y_np[i:i + batch]).sum())
-            seen += batch
+        starts = list(range(0, n_train - batch + 1, batch))
+        tail = []
+        if multi:
+            # one dispatch per K batches: stack a leading steps axis;
+            # the epoch's remainder (fewer than K batches) runs through
+            # the single-step path below so NO batch is dropped
+            k = args.steps_per_dispatch
+            n_full = (len(starts) // k) * k
+            for j in range(0, n_full, k):
+                sl = starts[j:j + k]
+                xs = np.stack([x_np[i:i + batch] for i in sl])
+                ys = np.stack([y_np[i:i + batch] for i in sl])
+                outs, losses = m.train_n_batches(
+                    tensor.from_numpy(xs, dev), tensor.from_numpy(ys, dev))
+                tot_loss += float(np.asarray(losses.data).sum())
+                pred = np.asarray(outs.data).argmax(-1)
+                correct += int((pred == ys).sum())
+                seen += batch * k
+            tail = starts[n_full:]
+        if not multi or tail:
+            for i in (starts if not multi else tail):
+                xb = tensor.from_numpy(x_np[i:i + batch], dev)
+                yb = tensor.from_numpy(y_np[i:i + batch], dev)
+                out, loss = m(xb, yb)
+                tot_loss += float(loss.data)
+                correct += int((tensor.to_numpy(out).argmax(-1)
+                                == y_np[i:i + batch]).sum())
+                seen += batch
         print(f"epoch {epoch}: loss={tot_loss / max(1, seen // batch):.4f} "
               f"acc={correct / seen:.4f} time={time.time() - t0:.3f}s")
 
@@ -79,6 +109,9 @@ if __name__ == "__main__":
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--use-graph", action="store_true", default=False)
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="K>1: run K steps per compiled dispatch "
+                        "(train_n_batches; requires --use-graph)")
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()

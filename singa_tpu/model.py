@@ -44,30 +44,36 @@ from .tensor import Tensor
 # Instead, the kernel applies the umask for us to a throwaway O_CREAT
 # file, whose stat we read.  Lazy + cached: the probe touches the
 # filesystem once per process, at first save.
-_CKPT_MODE = None
+_CKPT_MODES = {}
 
 
 def _ckpt_mode(ckpt_dir):
     """Probe in the CHECKPOINT directory itself: it is known writable
     (the save is about to mkstemp there) and carries the ACL defaults
     the checkpoint will actually get — a tempdir probe would fail on
-    read-only /tmp sandboxes and could mismatch."""
-    global _CKPT_MODE
-    if _CKPT_MODE is None:
+    read-only /tmp sandboxes and could mismatch.  Cached PER
+    DIRECTORY, matching that rationale (a second save into a
+    directory with different default ACLs re-probes; a benign
+    double-probe between concurrent async saves just writes the same
+    value twice)."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    mode = _CKPT_MODES.get(ckpt_dir)
+    if mode is None:
         import stat as _stat
         import uuid as _uuid
 
         p = os.path.join(ckpt_dir, f".singa-tpu-mode-{_uuid.uuid4().hex}")
         fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
         try:
-            _CKPT_MODE = _stat.S_IMODE(os.fstat(fd).st_mode)
+            mode = _stat.S_IMODE(os.fstat(fd).st_mode)
         finally:
             os.close(fd)
             try:
                 os.unlink(p)
             except OSError:
                 pass
-    return _CKPT_MODE
+        _CKPT_MODES[ckpt_dir] = mode
+    return mode
 
 # registry of graph runners (for Device.ResetGraph / PrintTimeProfiling)
 _graph_runners = []

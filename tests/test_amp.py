@@ -45,6 +45,7 @@ def test_matmul_runs_bf16_params_stay_fp32(dev, bf16):
     assert a.data.dtype == jnp.float32  # inputs untouched
 
 
+@pytest.mark.slow
 def test_cnn_trains_one_step_bf16(dev, bf16):
     m = CNN(num_classes=10, num_channels=1)
     sgd = opt.SGD(lr=0.01, momentum=0.9)

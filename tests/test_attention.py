@@ -72,6 +72,7 @@ def test_flash_gradients_match_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
 
 
+@pytest.mark.slow
 def test_flash_multiblock_grads_mask_and_causal():
     """Exercise the REAL kernel grids (init/flush across the sequential
     block dim, causal block skipping, unequal block_q != block_k) — with
@@ -165,6 +166,7 @@ def test_flash_unaligned_seqlen_stays_on_kernel():
                                atol=5e-2, rtol=5e-2)
 
 
+@pytest.mark.slow
 def test_flash_general_mask_through_kernel():
     """A per-query (B, 1, S, S) additive mask streams through the kernel
     as (block_q, block_k) tiles instead of forcing the O(S²) fused
@@ -283,6 +285,7 @@ def test_ring_attention_causal_matches():
 
 
 @pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+@pytest.mark.slow
 def test_ring_attention_differentiable():
     s = 8 * N_DEV
     q, k, v = _qkv(b=1, h=1, s=s, d=8, seed=5)
@@ -376,6 +379,7 @@ def test_flash_attention_lse_grad_through_lse():
 
 
 @pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+@pytest.mark.slow
 def test_ring_attention_flash_kernel_path():
     """S_local = 128 puts each ring step on the REAL Pallas kernel
     (interpret mode on CPU) rather than the jnp fallback — exercising

@@ -47,6 +47,7 @@ def test_bert_attention_mask_changes_output(dev):
     assert not np.allclose(a, b)
 
 
+@pytest.mark.slow
 def test_bert_mlm_trains_graph_mode(dev):
     cfg = BertConfig.tiny(hidden_dropout=0.0, attn_dropout=0.0)
     m = BertForMaskedLM(cfg)
@@ -57,6 +58,7 @@ def test_bert_mlm_trains_graph_mode(dev):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_bert_base_param_count(dev):
     cfg = BertConfig.base()
     m = BertForMaskedLM(cfg)
@@ -67,6 +69,7 @@ def test_bert_base_param_count(dev):
     assert abs(n - 109_482_240) / 109_482_240 < 0.01, n
 
 
+@pytest.mark.slow
 def test_bert_parallel_plan_matches_serial(dev):
     """dp2 x tp2 x sp2 BERT == serial BERT (same state names, so a
     checkpoint moves between layouts)."""

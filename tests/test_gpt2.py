@@ -54,6 +54,7 @@ def test_trains_graph_mode():
     assert losses[-1] < losses[0] - 0.5, losses
 
 
+@pytest.mark.slow
 def test_ignore_index_mean_over_valid_positions():
     """label -1 positions contribute zero loss AND the mean divides by
     the valid count (standard ignore_index semantics) — a half-ignored
@@ -118,6 +119,7 @@ def test_parallel_gpt_moe_matches_serial():
                                    float(tensor.to_numpy(ls)), rtol=3e-4)
 
 
+@pytest.mark.slow
 def test_flash_attn_impl_matches_fused():
     """attn_impl="flash" (Pallas online softmax; interpret mode on CPU)
     must reproduce the fused S x S path's logits and one training
@@ -264,6 +266,7 @@ def test_batched_decode_matches_single_rows():
         assert got[:len(p)].tolist() == p.tolist()
 
 
+@pytest.mark.slow
 def test_topk_decode_restricts_support():
     """top_k=1 must equal greedy; top_k=k must only ever emit tokens
     whose teacher-forced logit ranks in the top k at that step."""
@@ -299,6 +302,7 @@ def test_topk_decode_restricts_support():
             (t, out[t], float(step_logits[out[t]]), float(kth))
 
 
+@pytest.mark.slow
 def test_topp_decode_restricts_support():
     """Tiny top_p must equal greedy; top_p=p must only emit tokens in
     the smallest nucleus with mass >= p at each step."""
@@ -483,6 +487,7 @@ def test_beam_search_matches_exhaustive_and_greedy():
     assert all(len(o) == 5 for o in outs)
 
 
+@pytest.mark.slow
 def test_uniform_decode_path_matches_ragged_and_windowed():
     """The equal-length fast path (one shared position, batched cache
     writes) must be token-exact (f32) against BOTH the ragged vmap path

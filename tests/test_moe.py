@@ -44,6 +44,7 @@ def _dense_ffn(x, w1, b1, w2, b2):
     return h @ w2 + b2
 
 
+@pytest.mark.slow
 def test_top2_identical_experts_equals_dense():
     """With identical experts and ample capacity, renormalized top-2
     gates sum to 1, so the MoE output equals the shared expert's FFN."""
@@ -97,6 +98,7 @@ def _data(seed=0):
     return x, y
 
 
+@pytest.mark.slow
 def test_ep_sharded_matches_serial():
     mesh = shd.create_mesh(dp=2, ep=4)
     plan = shd.ShardingPlan(mesh)
@@ -126,6 +128,7 @@ def test_ep_sharded_matches_serial():
             rtol=2e-3, atol=2e-4, err_msg=k)
 
 
+@pytest.mark.slow
 def test_aux_loss_trains_router():
     """The aux loss must flow gradients into the router weights."""
     m = MoEModel(plan=None, aux_weight=0.1)

@@ -519,18 +519,24 @@ def test_worker_death_clean_error_and_restart_matches_oracle(tmp_path):
     # a reader thread enforces the 180s bound even if rank 0 produces
     # NO output at all — a bare `for line in stdout` would block in
     # readline() forever and hang the test instead of failing
-    # (advisor r04)
+    # (advisor r04).  The pump OWNS the pipe until EOF (a later
+    # communicate() reading the same file object from this thread
+    # would race it), collecting every line; the post-mortem
+    # diagnostics below read from the collected buffer.
     import queue as _queue
     import threading as _threading
 
     lines = _queue.Queue()
+    all_lines = []
 
     def _pump():
         for line in procs[0].stdout:
+            all_lines.append(line)
             lines.put(line)
         lines.put(None)
 
-    _threading.Thread(target=_pump, daemon=True).start()
+    pump_thread = _threading.Thread(target=_pump, daemon=True)
+    pump_thread.start()
     while True:
         try:
             line = lines.get(timeout=max(0.1, 180 - (_time.time() - t0)))
@@ -552,7 +558,9 @@ def test_worker_death_clean_error_and_restart_matches_oracle(tmp_path):
     # TPU-native rebuild of the reference's NCCL semantics, where a
     # dead rank kills the job and restart-from-snapshot is the
     # recovery story (SURVEY.md §5.3).
-    out_rest = procs[0].communicate(timeout=120)[0]
+    procs[0].wait(timeout=120)
+    pump_thread.join(timeout=30)   # pump exits at pipe EOF
+    out_rest = "".join(all_lines)
     assert procs[0].returncode != 0, \
         f"survivor kept running after peer death:\n{out_rest[-2000:]}"
     assert ("SURVIVOR_ERROR" in out_rest

@@ -225,6 +225,7 @@ def test_parallel_mha_flash_under_seq_plan_matches_serial():
     np.testing.assert_allclose(loss_p, loss_s, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_parallel_mha_flash_without_seq_axis_warns_and_falls_back(caplog):
     """No seq axis: the flash request is dropped with a one-shot warning
     and the fused head-sharded path keeps training."""
@@ -416,6 +417,7 @@ def _serial_causal(q, k, v):
     return np.einsum("bhst,bhtd->bhsd", p, v)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_causal_matches_serial():
     import jax.numpy as jnp
     from singa_tpu.parallel.ring_attention import (
@@ -451,6 +453,7 @@ def test_zigzag_ring_balanced_work():
         assert sum(zz) == sum(cont)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_differentiable():
     """Gradients flow through scan+cond+ppermute (training path)."""
     import jax.numpy as jnp

@@ -85,6 +85,7 @@ def test_pipeline_validation():
         PipelinedTransformer(3, HEADS, INTER, plan=plan)  # 3 % 4 != 0
 
 
+@pytest.mark.slow
 def test_serial_stack_trains():
     m = _compile(PipeLM(plan=None))
     losses = []

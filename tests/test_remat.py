@@ -6,6 +6,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -16,6 +17,7 @@ from singa_tpu.parallel.pipeline import PipelinedTransformer
 B, S = 4, 16
 
 
+@pytest.mark.slow
 def test_gpt2_remat_matches_plain():
     rng = np.random.RandomState(0)
     base = GPT2LMHead(GPT2Config.tiny(dropout=0.0))
@@ -37,6 +39,7 @@ def test_gpt2_remat_matches_plain():
     np.testing.assert_allclose(lb, la, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_remat_matches_plain():
     from test_pipeline import PipeLM, _batch, _compile
 
@@ -58,6 +61,7 @@ def test_pipeline_remat_matches_plain():
                                    float(tensor.to_numpy(lp)), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_remat_matches_plain():
     from test_moe import MoEModel, _data
     from singa_tpu.parallel.moe import MoEFFN

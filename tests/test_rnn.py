@@ -42,6 +42,7 @@ def _pack_from_torch(handle, t_lstm):
 
 @pytest.mark.parametrize("bidirectional", [False, True])
 @pytest.mark.parametrize("num_layers", [1, 2])
+@pytest.mark.slow
 def test_lstm_forward_backward_vs_torch(dev, num_layers, bidirectional):
     T, B, I, H = 5, 3, 4, 6
     rng = np.random.RandomState(0)

@@ -762,6 +762,7 @@ def test_rnn_family_export_import_roundtrip(dev):
                                        err_msg=f"{node_type} {bidir}")
 
 
+@pytest.mark.slow
 def test_char_rnn_model_exports(dev):
     """The config-#3 model family round-trips through ONNX end to end
     (embedding-free one-hot input -> LSTM stack -> head)."""

@@ -30,6 +30,7 @@ def _roundtrip(m, inputs, tmp_path, extra_feeds=()):
     return rep.run(feeds)
 
 
+@pytest.mark.slow
 def test_bert_trunk_roundtrip(dev, tmp_path):
     cfg = BertConfig.tiny(hidden_dropout=0.0, attn_dropout=0.0)
     m = BertModel(cfg)
@@ -86,6 +87,7 @@ def test_bert_mlm_with_dropout_roundtrip(dev, tmp_path):
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_imported_gpt2_is_trainable(dev):
     """SONNXModel over an imported GPT-2: the decomposed graph (Gather
     embeddings, MatMul/Softmax attention with a frozen causal mask)
