@@ -560,6 +560,8 @@ def test_worker_death_clean_error_and_restart_matches_oracle(tmp_path):
     # recovery story (SURVEY.md §5.3).
     procs[0].wait(timeout=120)
     pump_thread.join(timeout=30)   # pump exits at pipe EOF
+    assert not pump_thread.is_alive(), \
+        "stdout pump still draining after 30s — output incomplete"
     out_rest = "".join(all_lines)
     assert procs[0].returncode != 0, \
         f"survivor kept running after peer death:\n{out_rest[-2000:]}"

@@ -488,3 +488,25 @@ def test_zigzag_order_roundtrip():
     assert sorted(order.tolist()) == list(range(32))
     # rank 0's block = first 8 entries: stripe 0 then stripe 7
     assert order[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
+
+
+@pytest.mark.slow
+def test_zigzag_ring_flash_matches_serial():
+    """use_flash=True: each zigzag half-pair runs through the Pallas
+    kernel as a square (h, h) call; must equal serial causal."""
+    import jax.numpy as jnp
+    from singa_tpu.parallel.ring_attention import (
+        zigzag_ring_attention_sharded)
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(3)
+    b, h, s, d = 1, 2, 32, 8
+    q, k, v = (rng.randn(b, h, s, d).astype(np.float32) for _ in range(3))
+    ref = _serial_causal(q, k, v)
+    for w in (2, 4):
+        mesh = Mesh(np.asarray(jax.devices()[:w]), ("seq",))
+        out = np.asarray(zigzag_ring_attention_sharded(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh=mesh,
+            use_flash=True))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"W={w}")
