@@ -1,0 +1,92 @@
+"""GPT-2 serving loop — the round-5 inference surface in one script.
+
+The reference has no inference machinery at all (SURVEY.md §2.4 runs
+full forwards); this example drives the TPU-native decode stack the way
+a serving process would:
+
+  * requests arrive as a RAGGED batch of prompts (mixed lengths) — the
+    left-padding fast path decodes them lockstep in ONE compiled
+    executable at the equal-length batch's throughput;
+  * weights are bf16-cast and SESSION-CACHED on the model: request 2
+    onward skips the per-call re-cast/re-shard entirely;
+  * ``--beams K`` switches to batched beam search (every prompt's beams
+    advance together, block-diagonal parent gather).
+
+    python examples/gpt2/serve.py [--model tiny|small] [--requests N]
+        [--batch B] [--new-tokens T] [--beams K] [--top-p P] [--seed S]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+from singa_tpu import device, tensor
+from singa_tpu.models import gpt2_decode
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+
+def make_requests(rng, cfg, batch):
+    """A ragged batch: prompt lengths drawn from [8, 64)."""
+    lens = rng.randint(8, 64, size=batch)
+    return [rng.randint(0, cfg.vocab_size, n).astype(np.int32)
+            for n in lens]
+
+
+def run(args):
+    import jax.numpy as jnp
+
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(args.seed)
+    cfg = (GPT2Config.tiny(dropout=0.0) if args.model == "tiny"
+           else GPT2Config.small(dropout=0.0, attn_impl="fused"))
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 8), np.int32), dev)],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(args.seed)
+    dts = []
+    for req in range(args.requests):
+        prompts = make_requests(rng, cfg, args.batch)
+        t0 = time.time()
+        if args.beams > 1:
+            outs = gpt2_decode.generate_beam(
+                m, prompts, max_new_tokens=args.new_tokens,
+                num_beams=args.beams, dtype=jnp.bfloat16)
+        else:
+            outs = gpt2_decode.generate(
+                m, prompts, max_new_tokens=args.new_tokens,
+                temperature=args.temperature, top_p=args.top_p,
+                rng=rng, dtype=jnp.bfloat16)
+        dt = time.time() - t0
+        dts.append(dt)
+        for p, o in zip(prompts, outs):
+            assert len(o) == len(p) + args.new_tokens
+            assert o[:len(p)].tolist() == p.tolist()
+        lens = [len(p) for p in prompts]
+        print(f"request {req}: batch={args.batch} "
+              f"prompt_lens={min(lens)}..{max(lens)} "
+              f"+{args.new_tokens} tok/row in {dt:.3f}s"
+              + ("  (compile+cache warm)" if req == 0 else ""))
+    # request 0 pays compile + the weight cast (cached after); steady
+    # state is everything after it
+    if len(dts) > 1:
+        warm = sum(dts[1:])
+        toks = args.batch * args.new_tokens * (len(dts) - 1)
+        print(f"steady-state: {toks / warm:.1f} tokens/sec over "
+              f"{len(dts) - 1} warm requests "
+              f"(request 0 took {dts[0]:.2f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=["tiny", "small"], default="tiny")
+    p.add_argument("--requests", type=int, default=3)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--new-tokens", type=int, default=16)
+    p.add_argument("--beams", type=int, default=1)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    raise SystemExit(run(p.parse_args()))
