@@ -12,7 +12,12 @@ O(S²·T) total attention work.  This module is the idiomatic TPU design:
   attending its one-query block against the cache (masked to the live
   positions) and writing its K/V at the current position with
   ``lax.dynamic_update_slice`` — O(S·D) per token, static shapes, ONE
-  compiled executable for the whole generation.
+  compiled executable for the whole generation.  The scan body is
+  UNROLLED 4× by default (round 5): XLA schedules 4 sequential token
+  steps per loop iteration, which amortizes loop overhead and
+  pipelines the weight reads — measured 2633 → 4483 tok/s (+70%) at
+  the bench config on the v5e (unroll=8 adds only +3.6% more for 2×
+  the compile time).
 
 The math mirrors the layer stack exactly (same fp32-stat LayerNorm,
 same tanh-approx gelu, same scale placement), and
@@ -366,7 +371,7 @@ def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p):
 
 def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
                   n_head, eps, n_new, greedy, top_k, use_top_p,
-                  moe_top_k=2):
+                  moe_top_k=2, unroll=4):
     """Single-prompt core: ids (ctx,) right-padded, returns (n_new,).
     Batched decoding vmaps this over (ids, prompt_len, key) — the
     per-row cache writes at differing positions lower to scatters."""
@@ -396,16 +401,17 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
         return (nxt, pos + 1, kc, vc, key), tok
 
     (last, _, _, _, _), toks = jax.lax.scan(
-        step, (tok0, prompt_len, kc, vc, key), None, length=n_new - 1)
+        step, (tok0, prompt_len, kc, vc, key), None, length=n_new - 1,
+        unroll=min(unroll, max(1, n_new - 1)))
     return jnp.concatenate([toks, last[None]])
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
-                                   "moe_top_k"))
+                                   "moe_top_k", "unroll"))
 def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
                     greedy, temperature, keys, top_k=0, top_p=1.0,
-                    use_top_p=False, moe_top_k=2):
+                    use_top_p=False, moe_top_k=2, unroll=4):
     """One compiled prefill + lax.scan decode for a BATCH of prompts.
     ids: (B, ctx) right-padded; prompt_lens: (B,) int32; keys: (B, 2)
     PRNG keys.  Returns (B, n_new) sampled token ids.  ``top_k=0``
@@ -424,7 +430,7 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
     (tests/test_gpt2.py)."""
     row = partial(_generate_row, n_head=n_head, eps=eps, n_new=n_new,
                   greedy=greedy, top_k=top_k, use_top_p=use_top_p,
-                  moe_top_k=moe_top_k)
+                  moe_top_k=moe_top_k, unroll=unroll)
     return jax.vmap(
         lambda i, n, k: row(params, i, n, k, temperature, top_p))(
             ids, prompt_lens, keys)
@@ -432,11 +438,11 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
-                                   "moe_top_k"))
+                                   "moe_top_k", "unroll"))
 def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
                             ctx, greedy, temperature, keys, top_k=0,
                             top_p=1.0, use_top_p=False, start=None,
-                            moe_top_k=2):
+                            moe_top_k=2, unroll=4):
     """Shared-position fast path: ids (B, ctx), ONE traced scalar
     ``prompt_len`` (the shared first free window position) — the
     per-step cache update is a single batched dynamic_update_slice and
@@ -481,14 +487,16 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
         return (nxt, kc, vc, ks[:, 1]), toks
 
     (last, _, _, _), toks = jax.lax.scan(
-        step, (tok0, kc, vc, keys_cur), jnp.arange(n_new - 1))
+        step, (tok0, kc, vc, keys_cur), jnp.arange(n_new - 1),
+        unroll=min(unroll, max(1, n_new - 1)))
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
-                                   "num_beams", "moe_top_k"))
+                                   "num_beams", "moe_top_k", "unroll"))
 def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
-                        ctx, num_beams, moe_top_k=2, start=None):
+                        ctx, num_beams, moe_top_k=2, start=None,
+                        unroll=4):
     """Fixed-length beam search, ONE compiled prefill + scan, for a
     BATCH of prompts (round 5).  ids: (B, ctx) sharing one end
     position ``prompt_len`` (right-padded when equal-length; ragged
@@ -557,7 +565,7 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
     if n_new > 1:
         (seqs, scores, *_), _ = jax.lax.scan(
             step, (seqs, scores, toks, kc, vc),
-            jnp.arange(n_new - 1))
+            jnp.arange(n_new - 1), unroll=min(unroll, n_new - 1))
     # already best-first: top_k (and the padded init) sort descending
     return seqs, scores
 
@@ -597,7 +605,7 @@ def _normalize_prompts(prompt_ids, max_new_tokens, cfg,
 
 
 def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
-                  dtype=None):
+                  dtype=None, unroll=4):
     """Fixed-length beam search for a (optionally plan-sharded, possibly
     MoE) GPT2LMHead: returns the highest-total-log-prob continuation of
     ``max_new_tokens`` tokens.  Takes one 1-D prompt (returns one
@@ -621,7 +629,8 @@ def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
         params, jnp.asarray(window), max_len, cfg.n_head,
         float(cfg.layer_norm_eps), int(max_new_tokens),
         cfg.n_positions, int(num_beams),
-        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2), start=start)
+        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2), start=start,
+        unroll=int(unroll))
     seqs = np.asarray(seqs)
     out = [np.concatenate([r, seqs[i, 0]]).astype(np.int32)
            for i, r in enumerate(rows)]
@@ -644,7 +653,8 @@ def _seed(temperature, rng):
 
 
 def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
-             top_k=0, top_p=None, dtype=None, _ragged_impl="left"):
+             top_k=0, top_p=None, dtype=None, unroll=4,
+             _ragged_impl="left"):
     """KV-cached sampling for a GPT2LMHead (dense or MoE,
     optionally plan-sharded).  Requires
     prompt_len + max_new_tokens <= cfg.n_positions (the windowed
@@ -659,7 +669,9 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     (int > 0) / ``top_p`` (0 < p ≤ 1) filter the temperature-scaled
     distribution before sampling.  ``dtype=jnp.bfloat16`` runs
     inference in bf16 (≈2× steady-state throughput; see
-    extract_params)."""
+    extract_params).  ``unroll`` (default 4): decode-loop unroll
+    factor — the measured throughput/compile-time knee; see the module
+    docstring."""
     cfg = m.cfg
     single, rows, lens, max_len, window, start = _normalize_prompts(
         prompt_ids, max_new_tokens, cfg,
@@ -690,7 +702,8 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
         top_k=int(top_k or 0),
         top_p=jnp.float32(1.0 if top_p is None else top_p),
         use_top_p=top_p is not None,
-        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2))
+        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
+        unroll=int(unroll))
     sample_args = (cfg.n_head, float(cfg.layer_norm_eps),
                    int(max_new_tokens), ctx, temperature <= 0,
                    jnp.float32(max(temperature, 1e-6)), keys)
