@@ -191,6 +191,11 @@ class GPT2LMHead(model.Model):
         """Greedy/temperature sampling with optional top-k / top-p
         (nucleus) filtering. prompt_ids: np.ndarray (S0,).
 
+        ``prompt_ids``: one 1-D prompt (returns a 1-D array), or —
+        round 5, KV-cached path only — a list/2-D batch of prompts,
+        possibly ragged (returns a list of 1-D arrays; rows decode
+        lockstep in one executable via models/gpt2_decode.generate).
+
         ``use_cache`` (default auto): dense single-device models whose
         generation fits n_positions decode through the KV-cached
         incremental path (models/gpt2_decode.py — one compiled
@@ -200,6 +205,31 @@ class GPT2LMHead(model.Model):
         round 5 (capacity-free expert routing — token-equal to the
         windowed path when its capacity drops nothing); over-length
         generations use the windowed path below."""
+        # batch detection mirrors gpt2_decode.generate: a list of
+        # rows or a 2-D array is a batch (KV-cached path only)
+        if isinstance(prompt_ids, np.ndarray):
+            batched = prompt_ids.ndim > 1
+        else:
+            seq = list(prompt_ids)
+            batched = bool(seq) and np.ndim(seq[0]) > 0
+        if batched:
+            if use_cache is False:
+                raise ValueError(
+                    "batched generate requires the KV-cached path "
+                    "(use_cache=False is single-prompt only); loop "
+                    "over rows for the windowed sampler")
+            from . import gpt2_decode
+
+            was_training = getattr(self, "training", False)
+            self.eval()
+            try:
+                return gpt2_decode.generate(
+                    self, prompt_ids, max_new_tokens=max_new_tokens,
+                    temperature=temperature, rng=rng, top_k=top_k,
+                    top_p=top_p)
+            finally:
+                if was_training:
+                    self.train(True)
         n0 = len(np.asarray(prompt_ids).reshape(-1))
         blocks = self.transformer.blocks
         initialized = bool(blocks) and blocks[0].mlp is not None

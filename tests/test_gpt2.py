@@ -713,3 +713,21 @@ def test_decode_param_session_cache():
                   for k, v in m.get_states().items()})
     p4 = gpt2_decode.extract_params(m, dtype=jnp.bfloat16)
     assert p4 is not p1b
+
+
+def test_model_generate_accepts_prompt_batches():
+    """GPT2LMHead.generate (the model method) takes ragged batches
+    since round 5, delegating to the KV-cached batch path."""
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    prompts = [np.arange(6) % cfg.vocab_size, np.asarray([2, 7, 1, 8])]
+    outs = m.generate(prompts, max_new_tokens=5, temperature=0)
+    assert isinstance(outs, list) and len(outs) == 2
+    for p, o in zip(prompts, outs):
+        single = m.generate(np.asarray(p), max_new_tokens=5,
+                            temperature=0)
+        np.testing.assert_array_equal(o, single)
+    with pytest.raises(ValueError, match="single-prompt"):
+        m.generate(prompts, max_new_tokens=5, use_cache=False)
