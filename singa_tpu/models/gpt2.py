@@ -205,25 +205,19 @@ class GPT2LMHead(model.Model):
         round 5 (capacity-free expert routing — token-equal to the
         windowed path when its capacity drops nothing); over-length
         generations use the windowed path below."""
-        # batch detection mirrors gpt2_decode.generate: a list of
-        # rows or a 2-D array is a batch (KV-cached path only)
-        if isinstance(prompt_ids, np.ndarray):
-            batched = prompt_ids.ndim > 1
-        else:
-            seq = list(prompt_ids)
-            batched = bool(seq) and np.ndim(seq[0]) > 0
-        if batched:
+        from . import gpt2_decode as _gd
+
+        # shared classification with gpt2_decode (KV-cached path only)
+        if _gd._is_batch(prompt_ids):
             if use_cache is False:
                 raise ValueError(
                     "batched generate requires the KV-cached path "
                     "(use_cache=False is single-prompt only); loop "
                     "over rows for the windowed sampler")
-            from . import gpt2_decode
-
             was_training = getattr(self, "training", False)
             self.eval()
             try:
-                return gpt2_decode.generate(
+                return _gd.generate(
                     self, prompt_ids, max_new_tokens=max_new_tokens,
                     temperature=temperature, rng=rng, top_k=top_k,
                     top_p=top_p)

@@ -570,6 +570,16 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
     return seqs, scores
 
 
+def _is_batch(prompt_ids):
+    """Shared batch-vs-single classification (a list of rows or a 2-D
+    array is a batch; ragged batches defeat np.ndim on the whole
+    object, so classify by the first element)."""
+    if isinstance(prompt_ids, np.ndarray):
+        return prompt_ids.ndim > 1
+    seq = list(prompt_ids)
+    return bool(seq) and np.ndim(seq[0]) > 0
+
+
 def _normalize_prompts(prompt_ids, max_new_tokens, cfg,
                        over_length_hint=""):
     """Shared prompt handling for generate/generate_beam: classify
@@ -577,16 +587,8 @@ def _normalize_prompts(prompt_ids, max_new_tokens, cfg,
     LEFT-padded shared-end window.  Returns (single, rows, lens,
     max_len, window, start) — ``start`` is None for equal-length
     batches (every row already ends at max_len = its length)."""
-    if isinstance(prompt_ids, np.ndarray):
-        single = prompt_ids.ndim == 1
-        seq = [prompt_ids] if single else list(prompt_ids)
-    else:
-        seq = list(prompt_ids)
-        # ragged batches defeat np.ndim on the whole object; classify
-        # by the first element instead
-        single = not seq or np.ndim(seq[0]) == 0
-        if single:
-            seq = [prompt_ids]
+    single = not _is_batch(prompt_ids)
+    seq = [prompt_ids] if single else list(prompt_ids)
     rows = [np.asarray(r, np.int32).reshape(-1) for r in seq]
     for r in rows:
         if len(r) + max_new_tokens > cfg.n_positions:
