@@ -166,6 +166,47 @@ def zigzag_order(seqlen, world):
     return np.asarray(idx, np.int32)
 
 
+def zigzag_repartition(x, axis_name, inverse=False):
+    """Convert CONTIGUOUS-sharded per-rank sequence blocks (B, H, 2h,
+    ...) into the ZIGZAG layout (or back) inside shard_map: rank s's
+    halves are the global half-stripes (2s, 2s+1); zigzag rank r wants
+    (r, 2W−1−r).  Four PARTIAL ppermutes move every half exactly once
+    (non-receiving slots contribute zeros, so the pairwise sums
+    reassemble each slot) — total wire per direction = one ring hop's
+    K-block, which the balanced causal ring amortizes after a single
+    hop's saved compute.  This is what lets the TRAINING stack
+    (ParallelMHA) run the balanced layout on contiguous-sharded
+    activations without relaying out the whole model."""
+    world = lax.psum(1, axis_name)
+    s2 = x.shape[2]
+    if s2 % 2:
+        raise ValueError(f"zigzag repartition needs an even local "
+                         f"sequence length, got {s2}")
+    h = s2 // 2
+    xa, xb = x[:, :, :h], x[:, :, h:]
+    pa_low = [(s, 2 * s) for s in range(world) if 2 * s < world]
+    pa_high = [(s, 2 * world - 1 - 2 * s) for s in range(world)
+               if 2 * s >= world]
+    pb_low = [(s, 2 * s + 1) for s in range(world) if 2 * s + 1 < world]
+    pb_high = [(s, 2 * world - 2 - 2 * s) for s in range(world)
+               if 2 * s + 1 >= world]
+    if not inverse:
+        low = lax.ppermute(xa, axis_name, pa_low) \
+            + lax.ppermute(xb, axis_name, pb_low)
+        high = lax.ppermute(xa, axis_name, pa_high) \
+            + lax.ppermute(xb, axis_name, pb_high)
+        return jnp.concatenate([low, high], axis=2)
+
+    def inv(p):
+        return [(d, s) for s, d in p]
+
+    a = lax.ppermute(xa, axis_name, inv(pa_low)) \
+        + lax.ppermute(xb, axis_name, inv(pa_high))
+    b = lax.ppermute(xa, axis_name, inv(pb_low)) \
+        + lax.ppermute(xb, axis_name, inv(pb_high))
+    return jnp.concatenate([a, b], axis=2)
+
+
 def zigzag_ring_self_attention(q, k, v, axis_name, remat=True,
                                use_flash=False):
     """CAUSAL ring attention with the load-balanced ZIGZAG layout

@@ -359,9 +359,36 @@ def _ring_attention_op(q, k, v, mask, plan, causal, use_flash=False):
     blockwise here — use seq_parallel=False for those."""
     import jax
 
-    from .ring_attention import ring_self_attention
+    from .ring_attention import (ring_self_attention,
+                                 zigzag_repartition,
+                                 zigzag_ring_self_attention)
 
     spec = P(DATA, MODEL, SEQ, None)
+    seq_world = plan.axis_size(SEQ)
+    s_local = q.shape[2] // seq_world
+    if causal and mask is None and seq_world > 1 and s_local % 2 == 0:
+        # round 5: causal rings run the load-BALANCED zigzag layout —
+        # repartition the contiguous-sharded blocks in (one hop of
+        # wire each way), attend balanced, repartition back.  The
+        # contiguous causal ring below is kept for odd local lengths
+        # and masked/non-causal cases.
+        def zz(q_, k_, v_):
+            q_ = zigzag_repartition(q_, SEQ)
+            k_ = zigzag_repartition(k_, SEQ)
+            v_ = zigzag_repartition(v_, SEQ)
+            # per-hop checkpointing stays ON (the zigzag callee's
+            # default): it is the ring path's O(S_local·D) backward-
+            # memory guarantee, deliberately NOT governed by
+            # ParallelMHA.remat (which checkpoints the non-seq _sdpa
+            # internals) — same contract as the contiguous ring below
+            o = zigzag_ring_self_attention(q_, k_, v_, SEQ,
+                                           use_flash=use_flash)
+            return zigzag_repartition(o, SEQ, inverse=True)
+
+        f = jax.shard_map(zz, mesh=plan.mesh,
+                          in_specs=(spec, spec, spec), out_specs=spec,
+                          check_vma=False)
+        return autograd._op(f, q, k, v, _name="ZigzagRingAttention")
     if mask is not None:
         if mask.shape[-2] != 1:
             raise NotImplementedError(
