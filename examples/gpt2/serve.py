@@ -38,8 +38,11 @@ def run(args):
 
     dev = device.create_tpu_device(0)
     dev.SetRandSeed(args.seed)
-    cfg = (GPT2Config.tiny(dropout=0.0) if args.model == "tiny"
-           else GPT2Config.small(dropout=0.0, attn_impl="fused"))
+    kw = {}
+    if args.kv_heads:  # GQA: n_head/kv_heads x smaller decode cache
+        kw["n_kv_head"] = args.kv_heads
+    cfg = (GPT2Config.tiny(dropout=0.0, **kw) if args.model == "tiny"
+           else GPT2Config.small(dropout=0.0, attn_impl="fused", **kw))
     m = GPT2LMHead(cfg)
     m.compile([tensor.from_numpy(np.zeros((1, 8), np.int32), dev)],
               is_train=False, use_graph=False)
@@ -86,6 +89,9 @@ if __name__ == "__main__":
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--new-tokens", type=int, default=16)
     p.add_argument("--beams", type=int, default=1)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="GQA: number of K/V heads (0 = full MHA); "
+                        "must divide the model's n_head")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)

@@ -325,6 +325,16 @@ def gelu(x, approximate=True):
                x, _name="Gelu", approximate=approximate)
 
 
+def repeat_kv(x, repeats):
+    """GQA K/V head broadcast: repeat (B, H_kv, S, D) heads ``repeats``×
+    along axis 1 (element-interleaved, so K/V head i serves query heads
+    [i·repeats, (i+1)·repeats)).  The op name and ``repeats`` param are
+    the ONNX export contract (sonnx._dec_repeat_kv decomposes it to
+    Reshape/Tile/Reshape) — both MHA variants must route through here."""
+    return _op(lambda a, repeats: jnp.repeat(a, repeats, axis=1),
+               x, _name="RepeatKV", repeats=repeats)
+
+
 def sigmoid(x):
     return _op(jax.nn.sigmoid, x, _name="Sigmoid")
 

@@ -30,12 +30,19 @@ class GPT2Config:
                  layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
                  moe_experts=8, moe_top_k=2, moe_aux_weight=0.01,
                  moe_capacity_factor=1.25, moe_groups=None, remat=False,
-                 attn_impl="auto"):
+                 attn_impl="auto", n_kv_head=None):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
         self.n_layer = n_layer
         self.n_head = n_head
+        # grouped-query attention: n_kv_head < n_head shares each K/V
+        # head across a group of n_head // n_kv_head query heads
+        # (n_head/n_kv_head× smaller KV cache at decode)
+        self.n_kv_head = int(n_kv_head or n_head)
+        if n_head % self.n_kv_head != 0:
+            raise ValueError(f"n_head {n_head} not divisible by "
+                             f"n_kv_head {self.n_kv_head}")
         self.n_inner = n_inner or 4 * n_embd
         self.dropout = dropout
         self.layer_norm_eps = layer_norm_eps
@@ -107,7 +114,7 @@ class GPT2Model(model.Model):
                    and (i + 1) % c.moe_every == 0)
             self.blocks.append(ParallelTransformerBlock(
                 c.n_head, c.n_inner, plan, dropout=c.dropout, causal=True,
-                eps=c.layer_norm_eps,
+                eps=c.layer_norm_eps, num_kv_heads=c.n_kv_head,
                 moe_experts=c.moe_experts if moe else None,
                 moe_top_k=c.moe_top_k,
                 moe_capacity_factor=c.moe_capacity_factor,

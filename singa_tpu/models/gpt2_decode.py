@@ -29,7 +29,11 @@ temperature, top-k, and top-p (nucleus) sampling.  Plan-sharded models
 decode here too (round 4): extract_params lays the weights out per the
 Megatron plan and the jitted generation runs SPMD.  MoE models decode
 here as well (round 5): per-token top-k expert routing with no capacity
-limit — see extract_params.
+limit — see extract_params.  GQA models (``GPT2Config(n_kv_head=K)``,
+round 5) keep their cache at K heads — the head counts are derived
+from the weight widths, and the decode step contracts each K/V head
+against its query group without materializing a repeat
+(``_block_decode``).
 """
 
 from __future__ import annotations
@@ -175,14 +179,22 @@ def _ln(x, s, b, eps):
 def _attn_full(q, k, v, n_head, start=None):
     """Causal attention over the full (B, S, E) prefill block.
     ``start``: optional (B,) first-live window position per row
-    (left-padded batch) — keys before it are masked out."""
+    (left-padded batch) — keys before it are masked out.  GQA models
+    arrive with k/v narrower than q (n_kv_head·D wide — the head count
+    is derived from the widths, never threaded); each K/V head is
+    broadcast over its query-head group, matching the training stack's
+    RepeatKV (parallel/tensor_parallel.py ParallelMHA)."""
     b, s, e = q.shape
     d = e // n_head
+    n_kv = k.shape[-1] // d
 
-    def heads(t):
-        return t.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+    def heads(t, nh):
+        return t.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
 
-    qh, kh, vh = heads(q), heads(k), heads(v)
+    qh, kh, vh = heads(q, n_head), heads(k, n_kv), heads(v, n_kv)
+    if n_kv != n_head:
+        kh = jnp.repeat(kh, n_head // n_kv, axis=1)
+        vh = jnp.repeat(vh, n_head // n_kv, axis=1)
     sc = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(d)
     cm = jnp.tril(jnp.ones((s, s), bool))[None, None]
     if start is not None:
@@ -211,27 +223,37 @@ def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2):
 
 def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
                   moe_top_k=2):
-    """x: (B, 1, E); k/v_cache: (B, H, ctx, D) with this step's K/V
+    """x: (B, 1, E); k/v_cache: (B, H_kv, ctx, D) with this step's K/V
     already written at ``pos``.  Attends to positions <= pos (and
-    >= ``start`` per row for left-padded batches)."""
+    >= ``start`` per row for left-padded batches).
+
+    GQA (H_kv < n_head): the cache stays at H_kv heads — THE point of
+    GQA at decode, n_head/H_kv× less cache traffic per token on a
+    cache-read-bound loop — and the query block reshapes to
+    (B, H_kv, G, D) so each K/V head serves its G-query group in one
+    grouped einsum (no repeat materialized).  H_kv == n_head makes
+    G=1 and this is exactly the ungrouped math."""
     b, _, e = x.shape
     d = e // n_head
+    n_kv = k_cache.shape[1]
+    g = n_head // n_kv
     ctx = k_cache.shape[2]
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
-    q = (h @ p["wq"] + p["bq"]).reshape(b, n_head, 1, d)
-    k_new = (h @ p["wk"] + p["bk"]).reshape(b, n_head, 1, d)
-    v_new = (h @ p["wv"] + p["bv"]).reshape(b, n_head, 1, d)
+    q = (h @ p["wq"] + p["bq"]).reshape(b, n_kv, g, d)
+    k_new = (h @ p["wk"] + p["bk"]).reshape(b, n_kv, 1, d)
+    v_new = (h @ p["wv"] + p["bv"]).reshape(b, n_kv, 1, d)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, (0, 0, pos, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, (0, 0, pos, 0))
-    sc = jnp.einsum("bhqd,bhtd->bhqt", q, k_cache) / math.sqrt(d)
+    sc = jnp.einsum("bkgd,bktd->bkgt", q, k_cache) / math.sqrt(d)
     live = jnp.arange(ctx)[None, None, None, :] <= pos
     if start is not None:
         live = live & (jnp.arange(ctx)[None, None, None, :]
                        >= start[:, None, None, None])
     sc = jnp.where(live, sc, NEG_INF)
     p_attn = jax.nn.softmax(sc, axis=-1)
-    a = jnp.einsum("bhqt,bhtd->bhqd", p_attn, v_cache)
-    a = a.transpose(0, 2, 1, 3).reshape(b, 1, e)
+    a = jnp.einsum("bkgt,bktd->bkgd", p_attn, v_cache)
+    # (B, H_kv, G, D) in head-major order == (B, 1, E) concat of heads
+    a = a.reshape(b, 1, e)
     x = x + (a @ p["wo"] + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
     x = x + _mlp(h, p, moe_top_k)
@@ -320,8 +342,9 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2):
                                  moe_top_k=moe_top_k)
         e = x.shape[-1]
         d = e // n_head
-        ks.append(k.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
-        vs.append(v.reshape(b, sp, n_head, d).transpose(0, 2, 1, 3))
+        n_kv = k.shape[-1] // d  # GQA caches hold n_kv_head heads
+        ks.append(k.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3))
+        vs.append(v.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3))
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
     return x, jnp.stack(ks), jnp.stack(vs)
 

@@ -49,12 +49,21 @@ def scaled_dot_product_attention(q, k, v, mask=None, use_flash=False,
 
 
 class MultiHeadAttention(Layer):
-    """Standard MHA over (B, S, E) inputs."""
+    """Standard MHA over (B, S, E) inputs.  ``num_kv_heads`` <
+    ``num_heads`` gives grouped-query attention: k/v project to
+    ``num_kv_heads`` heads, each broadcast over its query group before
+    the score contraction (RepeatKV — see the parallel variant,
+    parallel/tensor_parallel.py ParallelMHA, for the sharded story)."""
 
     def __init__(self, num_heads, dropout=0.0, use_flash=False,
-                 remat=False):
+                 remat=False, num_kv_heads=None):
         super().__init__()
         self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads or num_heads)
+        if self.num_heads % self.num_kv_heads != 0:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by "
+                f"num_kv_heads {self.num_kv_heads}")
         self.dropout = float(dropout)
         self.use_flash = use_flash
         self.remat = bool(remat)
@@ -66,21 +75,28 @@ class MultiHeadAttention(Layer):
     def initialize(self, x, mask=None):
         e = x.shape[-1]
         assert e % self.num_heads == 0
-        for proj in (self.q_proj, self.k_proj, self.v_proj, self.out_proj):
+        e_kv = (e // self.num_heads) * self.num_kv_heads
+        for proj in (self.q_proj, self.out_proj):
             proj.out_features = e
+        for proj in (self.k_proj, self.v_proj):
+            proj.out_features = e_kv
 
     def forward(self, x, mask=None):
         b, s, e = x.shape
         h = self.num_heads
+        h_kv = self.num_kv_heads
         d = e // h
 
-        def split_heads(t):
-            t = autograd.reshape(t, (b, s, h, d))
-            return autograd.transpose(t, (0, 2, 1, 3))
+        def split_heads(t, nh):
+            t = autograd.reshape(t, (b, s, nh, d))
+            t = autograd.transpose(t, (0, 2, 1, 3))
+            if nh != h:
+                t = autograd.repeat_kv(t, h // nh)
+            return t
 
-        q = split_heads(self.q_proj(x))
-        k = split_heads(self.k_proj(x))
-        v = split_heads(self.v_proj(x))
+        q = split_heads(self.q_proj(x), h)
+        k = split_heads(self.k_proj(x), h_kv)
+        v = split_heads(self.v_proj(x), h_kv)
         ctx = scaled_dot_product_attention(q, k, v, mask,
                                            use_flash=self.use_flash,
                                            remat=self.remat)

@@ -166,13 +166,27 @@ class ParallelMHA(Layer):
     attention (parallel/ring_attention.py) over the ICI ring — activations
     stay sharded (B@data, H@model, S@seq, D) end to end, so max sequence
     length scales with the seq-axis size (the long-context design the
-    reference lacks, SURVEY.md §5.7)."""
+    reference lacks, SURVEY.md §5.7).
+
+    ``num_kv_heads`` < ``num_heads`` gives grouped-query attention
+    (GQA): k/v project to ``num_kv_heads`` heads which each serve a
+    contiguous group of ``num_heads // num_kv_heads`` query heads.  In
+    training the K/V heads are broadcast up to the full head count
+    before the score contraction (the RepeatKV op — GQA's training
+    FLOPs match MHA; the win is the num_heads/num_kv_heads× smaller
+    K/V cache at inference, where decode is cache-read-bound — see
+    models/gpt2_decode.py)."""
 
     def __init__(self, num_heads, plan: ShardingPlan | None = None,
                  dropout=0.0, seq_parallel=None, causal=False,
-                 remat=False, use_flash=False):
+                 remat=False, use_flash=False, num_kv_heads=None):
         super().__init__()
         self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads or num_heads)
+        if self.num_heads % self.num_kv_heads != 0:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by "
+                f"num_kv_heads {self.num_kv_heads}")
         self.plan = plan
         self.dropout = float(dropout)
         self.causal = bool(causal)
@@ -185,18 +199,24 @@ class ParallelMHA(Layer):
         self.k_proj = ColumnParallelLinear(0, plan)
         self.v_proj = ColumnParallelLinear(0, plan)
         self.out_proj = RowParallelLinear(0, plan)
-        if plan is not None and self.num_heads % plan.axis_size(MODEL) != 0:
-            raise ValueError(
-                f"num_heads {self.num_heads} not divisible by model-axis "
-                f"size {plan.axis_size(MODEL)}")
+        if plan is not None:
+            for what, n in (("num_heads", self.num_heads),
+                            ("num_kv_heads", self.num_kv_heads)):
+                if n % plan.axis_size(MODEL) != 0:
+                    raise ValueError(
+                        f"{what} {n} not divisible by model-axis "
+                        f"size {plan.axis_size(MODEL)}")
 
     def initialize(self, x, mask=None):
         e = x.shape[-1]
         if e % self.num_heads != 0:
             raise ValueError(
                 f"embed dim {e} not divisible by num_heads {self.num_heads}")
-        for proj in (self.q_proj, self.k_proj, self.v_proj, self.out_proj):
+        e_kv = (e // self.num_heads) * self.num_kv_heads
+        for proj in (self.q_proj, self.out_proj):
             proj.out_features = e
+        for proj in (self.k_proj, self.v_proj):
+            proj.out_features = e_kv
 
     def _heads_spec(self):
         # (B, H, S, D): batch@data, heads@model, seq@seq when ring
@@ -205,19 +225,22 @@ class ParallelMHA(Layer):
     def forward(self, x, mask=None):
         b, s, e = x.shape
         h = self.num_heads
+        h_kv = self.num_kv_heads
         d = e // h
         plan = self.plan
 
-        def split_heads(t):
-            t = autograd.reshape(t, (b, s, h, d))
+        def split_heads(t, nh):
+            t = autograd.reshape(t, (b, s, nh, d))
             t = autograd.transpose(t, (0, 2, 1, 3))
+            if nh != h:  # GQA: broadcast each K/V head over its Q group
+                t = autograd.repeat_kv(t, h // nh)
             if plan is not None:
                 t = constrain(t, plan, self._heads_spec())
             return t
 
-        q = split_heads(self.q_proj(x))
-        k = split_heads(self.k_proj(x))
-        v = split_heads(self.v_proj(x))
+        q = split_heads(self.q_proj(x), h)
+        k = split_heads(self.k_proj(x), h_kv)
+        v = split_heads(self.v_proj(x), h_kv)
 
         if self.seq_parallel and plan is not None \
                 and sharding.plan_active():
@@ -263,14 +286,15 @@ class ParallelTransformerBlock(Layer):
     def __init__(self, num_heads, intermediate, plan=None, dropout=0.0,
                  causal=False, eps=1e-5, moe_experts=None, moe_top_k=2,
                  moe_capacity_factor=1.25, moe_groups=None, remat=False,
-                 use_flash=False):
+                 use_flash=False, num_kv_heads=None):
         super().__init__()
         from ..layer import LayerNorm
 
         self.ln1 = LayerNorm(eps)
         self.attn = ParallelMHA(num_heads, plan, dropout=dropout,
                                 causal=causal, remat=remat,
-                                use_flash=use_flash)
+                                use_flash=use_flash,
+                                num_kv_heads=num_kv_heads)
         self.ln2 = LayerNorm(eps)
         self.mlp = None  # needs hidden size; built at initialize
         self._intermediate = int(intermediate)

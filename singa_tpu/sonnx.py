@@ -1524,6 +1524,27 @@ def _dec_embedding(op, in_names, emit, out_name):
     emit.node("Gather", [in_names[1], in_names[0]], [out_name], axis=0)
 
 
+def _dec_repeat_kv(op, in_names, emit, out_name):
+    """GQA K/V head broadcast (B, H_kv, S, D) -> (B, H_kv·g, S, D):
+    an element-interleaved repeat on axis 1, which in ONNX is
+    Reshape(+1 axis) / Tile(g on the new axis) / Reshape(merge) —
+    Tile alone would cycle whole-head blocks, the wrong order."""
+    g = int((getattr(op, "params", {}) or {}).get("repeats", 1))
+    b, hkv, s, d = op.src[0][2].shape
+    u = emit.uniq("RepeatKV")
+    r5 = f"{u}_r5d"
+    emit.node("Reshape", [in_names[0], emit.const(
+        f"const_shape_{b}x{hkv}x1x{s}x{d}",
+        np.asarray([b, hkv, 1, s, d], np.int64))], [r5])
+    t5 = f"{u}_tiled"
+    emit.node("Tile", [r5, emit.const(
+        f"const_reps_11{g}11", np.asarray([1, 1, g, 1, 1], np.int64))],
+        [t5])
+    emit.node("Reshape", [t5, emit.const(
+        f"const_shape_{b}x{hkv * g}x{s}x{d}",
+        np.asarray([b, hkv * g, s, d], np.int64))], [out_name])
+
+
 def _dec_attn_mask(op, in_names, emit, out_name):
     """BERT (1-m)*-1e9 [:,None,None,:] -> Sub/Mul/Unsqueeze."""
     u = emit.uniq("AttnMask")
@@ -1700,6 +1721,7 @@ _EXPORT_DECOMPOSE = {
     "Attention": _dec_attention,
     "TPAttention": _dec_attention,
     "Embedding": _dec_embedding,
+    "RepeatKV": _dec_repeat_kv,
     "AttnMask": _dec_attn_mask,
     "FirstToken": _dec_first_token,
     "MulScalar": _dec_mul_scalar,

@@ -406,3 +406,43 @@ def test_ring_attention_flash_kernel_path():
     g2 = jax.grad(lambda q: jnp.sum(_ref(q, k, v, cm) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                atol=5e-2, rtol=5e-2)
+
+
+def test_gqa_mha_equals_full_mha_with_repeated_kv_weights(dev):
+    """Functional identity: a GQA MultiHeadAttention (num_kv_heads <
+    num_heads) computes exactly what a full MHA computes when the full
+    model's K/V projection weights are the GQA weights repeated per
+    query group — so grouping is pure weight sharing, no new math."""
+    from singa_tpu.ops.attention import MultiHeadAttention
+
+    b, s, e, h, h_kv = 2, 8, 32, 4, 2
+    g, d = h // h_kv, e // h
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(b, s, e).astype(np.float32), dev)
+
+    gqa = MultiHeadAttention(h, num_kv_heads=h_kv)
+    y_gqa = tensor.to_numpy(gqa(x))
+    assert gqa.k_proj.W.shape == (e, h_kv * d)
+
+    full = MultiHeadAttention(h)
+    full(x)  # deferred init
+    for name in ("q_proj", "out_proj"):
+        for p in ("W", "b"):
+            getattr(getattr(full, name), p).copy_from_numpy(
+                tensor.to_numpy(getattr(getattr(gqa, name), p)))
+    for name in ("k_proj", "v_proj"):
+        wn = tensor.to_numpy(getattr(gqa, name).W)      # (E, h_kv*d)
+        bn = tensor.to_numpy(getattr(gqa, name).b)      # (h_kv*d,)
+        w_full = np.repeat(wn.reshape(e, h_kv, d), g, axis=1)
+        b_full = np.repeat(bn.reshape(h_kv, d), g, axis=0)
+        getattr(full, name).W.copy_from_numpy(w_full.reshape(e, e))
+        getattr(full, name).b.copy_from_numpy(b_full.reshape(e))
+    y_full = tensor.to_numpy(full(x))
+    np.testing.assert_allclose(y_gqa, y_full, rtol=1e-6, atol=1e-6)
+
+
+def test_gqa_mha_validates_group():
+    from singa_tpu.ops.attention import MultiHeadAttention
+
+    with pytest.raises(ValueError):
+        MultiHeadAttention(4, num_kv_heads=3)

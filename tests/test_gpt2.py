@@ -181,6 +181,118 @@ def test_kv_cache_generate_matches_windowed_greedy():
     assert g_kv[:9].tolist() == prompt.tolist()
 
 
+def test_gqa_trains_and_kv_decode_matches_windowed():
+    """Grouped-query attention (n_kv_head < n_head): k/v project to
+    n_kv_head heads, training converges, and the KV-cached decoder —
+    whose cache stays at n_kv_head heads, the whole point of GQA at
+    decode — reproduces the windowed full-forward sampler token for
+    token under greedy decoding."""
+    import jax.numpy as jnp
+
+    from singa_tpu.models import gpt2_decode
+
+    cfg = _cfg(n_kv_head=2)  # tiny: n_head=4 -> query groups of 2
+    m = GPT2LMHead(cfg)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    ids, labels = _batch(cfg)
+    x = tensor.from_numpy(ids)
+    m.compile([x], is_train=True, use_graph=True)
+    losses = []
+    for _ in range(15):
+        _, loss = m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        losses.append(float(tensor.to_numpy(loss)))
+    assert losses[-1] < losses[0] - 0.5, losses
+    # the K/V projections really are narrower (E -> E/2 here)
+    attn = m.transformer.blocks[0].attn
+    assert attn.k_proj.W.shape[1] * 2 == attn.q_proj.W.shape[1]
+    assert attn.v_proj.W.shape[1] * 2 == attn.q_proj.W.shape[1]
+
+    m.eval()
+    prompt = np.arange(9) % cfg.vocab_size
+    g_win = m.generate(prompt, max_new_tokens=12, temperature=0,
+                       use_cache=False)
+    g_kv = m.generate(prompt, max_new_tokens=12, temperature=0,
+                      use_cache=True)
+    np.testing.assert_array_equal(g_win, g_kv)
+    # the decode cache holds n_kv_head heads, not n_head
+    params = gpt2_decode.extract_params(m)
+    _, kc, vc = gpt2_decode.prefill(
+        params, jnp.asarray(ids[:1]), cfg.n_head, cfg.layer_norm_eps)
+    assert kc.shape[2] == cfg.n_kv_head, kc.shape
+    assert vc.shape[2] == cfg.n_kv_head, vc.shape
+
+
+def test_gqa_batched_and_beam_paths_match_oracle():
+    """The uniform fast path, ragged left-padded path, and batched beam
+    search all run the grouped cache math; each must agree with its
+    per-row/windowed oracle on a GQA model."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg = _cfg(n_kv_head=1)  # extreme grouping: MQA (4 Q : 1 KV)
+    m = GPT2LMHead(cfg)
+    x = tensor.from_numpy(np.zeros((1, 16), np.int32))
+    m.compile([x], is_train=False, use_graph=False)
+    m.eval()
+    prompts = [np.arange(5) % cfg.vocab_size,
+               np.arange(9) % cfg.vocab_size]  # ragged pair
+    batched = m.generate(prompts, max_new_tokens=8, temperature=0)
+    singles = [m.generate(p, max_new_tokens=8, temperature=0)
+               for p in prompts]
+    for row, single, p in zip(batched, singles, prompts):
+        np.testing.assert_array_equal(row[len(p):len(p) + 8],
+                                      single[len(p):])
+    # beam search runs the same grouped cache math; num_beams=1 is
+    # contractually greedy
+    beam1 = gpt2_decode.generate_beam(m, prompts[1], max_new_tokens=8,
+                                      num_beams=1)
+    np.testing.assert_array_equal(beam1, singles[1])
+    beam4 = gpt2_decode.generate_beam(m, prompts[1], max_new_tokens=8,
+                                      num_beams=4)
+    assert beam4.shape == singles[1].shape
+
+
+def test_gqa_config_validates_group():
+    with pytest.raises(ValueError):
+        GPT2Config.tiny(n_kv_head=3)  # 4 % 3 != 0
+
+
+def test_parallel_gqa_matches_serial():
+    """GQA under an active ShardingPlan (dp2 x tp2 x sp2): the
+    RepeatKV-then-constrain resharding and the KV-head/model-axis split
+    must reproduce the serial GQA twin's losses — both K/V heads land
+    on different model shards (n_kv_head=2 == tp axis size)."""
+    cfg = _cfg(n_kv_head=2)
+    mesh = shd.create_mesh(dp=2, tp=2, sp=2)
+    plan = shd.ShardingPlan(mesh)
+
+    serial = GPT2LMHead(cfg)
+    par = GPT2LMHead(cfg, plan=plan)
+    par.set_sharding_plan(plan)
+    ids, labels = _batch(cfg)
+    for m in (serial, par):
+        m.set_optimizer(opt.SGD(lr=0.05))
+        m.compile([tensor.from_numpy(ids)], is_train=True, use_graph=True)
+    par.set_states({k: tensor.to_numpy(v)
+                    for k, v in serial.get_states().items()})
+    for i in range(2):
+        ids, labels = _batch(cfg, seed=i)
+        _, ls = serial(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        _, lp = par(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        np.testing.assert_allclose(float(tensor.to_numpy(lp)),
+                                   float(tensor.to_numpy(ls)), rtol=3e-4)
+
+
+def test_gqa_kv_heads_must_divide_model_axis():
+    """n_kv_head not divisible by the model-axis size must fail loudly
+    at construction, not mis-shard."""
+    from singa_tpu.parallel.tensor_parallel import ParallelMHA
+
+    mesh = shd.create_mesh(dp=2, tp=4)
+    plan = shd.ShardingPlan(mesh)
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        ParallelMHA(8, plan, num_kv_heads=2)  # 2 % 4 != 0
+
+
 def test_kv_cache_prefill_logits_match_forward():
     """Teacher-forced check with no argmax involved: the pure-jnp
     prefill logits must match the layer-stack forward at every

@@ -164,6 +164,28 @@ def test_exported_constants_frozen_and_shared(dev):
     assert not any(n.startswith("const_") for n in trainable), trainable
 
 
+def test_gqa_gpt2_roundtrip(dev, tmp_path):
+    """Grouped-query attention exports: the RepeatKV head broadcast
+    decomposes to Reshape/Tile/Reshape (element-interleaved, NOT a
+    plain Tile, which would cycle whole-head blocks) and the imported
+    graph reproduces the native logits."""
+    cfg = GPT2Config.tiny(dropout=0.0, n_kv_head=2)
+    m = GPT2LMHead(cfg)
+    rng = np.random.RandomState(7)
+    ids = tensor.from_numpy(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32), dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    logits = m.forward(ids)
+    proto = sonnx.to_onnx(m, [ids])
+    types = [n.op_type for n in proto.graph.node]
+    assert "Tile" in types, types  # the RepeatKV decomposition ran
+    outs = _roundtrip(m, [ids], tmp_path)
+    np.testing.assert_allclose(tensor.to_numpy(outs[0]),
+                               tensor.to_numpy(logits), rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_gpt2_roundtrip(dev, tmp_path):
     """Causal attention exports with a baked additive tril mask; tied
     lm_head exports as Transpose(wte)+MatMul."""
