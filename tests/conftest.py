@@ -18,6 +18,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -28,3 +29,22 @@ def pytest_configure(config):
         "slow: long-running tests (subprocess multihost, CNN-zoo "
         "training, >15s parity sweeps); `-m 'not slow'` is the fast "
         "inner loop for builders")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jax_executable_maps():
+    """Release compiled executables between test MODULES.
+
+    One pytest process compiles thousands of XLA:CPU executables (each
+    eager `_op` primitive application of a new shape caches one);
+    their code mappings accumulate against the kernel's
+    ``vm.max_map_count`` (65530 default) until, near the end of the
+    full suite, an mmap fails inside ``backend_compile_and_load`` and
+    XLA SEGFAULTS (observed twice at the same 88% mark, in whichever
+    test compiled next — reproduced and measured: the map count grows
+    ~4k/min through the ONNX-conformance module).  Clearing jax's
+    caches per module returns the maps to baseline; within-module
+    compilation reuse — where nearly all the cache hits are — is
+    unaffected."""
+    yield
+    jax.clear_caches()
