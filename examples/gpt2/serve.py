@@ -52,15 +52,17 @@ def run(args):
     for req in range(args.requests):
         prompts = make_requests(rng, cfg, args.batch)
         t0 = time.time()
+        cache_dtype = "int8" if args.cache_int8 else None
         if args.beams > 1:
             outs = gpt2_decode.generate_beam(
                 m, prompts, max_new_tokens=args.new_tokens,
-                num_beams=args.beams, dtype=jnp.bfloat16)
+                num_beams=args.beams, dtype=jnp.bfloat16,
+                cache_dtype=cache_dtype)
         else:
             outs = gpt2_decode.generate(
                 m, prompts, max_new_tokens=args.new_tokens,
                 temperature=args.temperature, top_p=args.top_p,
-                rng=rng, dtype=jnp.bfloat16)
+                rng=rng, dtype=jnp.bfloat16, cache_dtype=cache_dtype)
         dt = time.time() - t0
         dts.append(dt)
         for p, o in zip(prompts, outs):
@@ -92,6 +94,9 @@ if __name__ == "__main__":
     p.add_argument("--kv-heads", type=int, default=0,
                    help="GQA: number of K/V heads (0 = full MHA); "
                         "must divide the model's n_head")
+    p.add_argument("--cache-int8", action="store_true",
+                   help="quantize the KV cache to int8 (~2x less "
+                        "cache traffic; argmax near-ties may flip)")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)

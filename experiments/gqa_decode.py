@@ -19,7 +19,8 @@ import numpy as np
 sys.path.insert(0, ".")
 
 
-def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3):
+def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3,
+            quant_cache=False, ctx=1024):
     import jax
     import jax.numpy as jnp
 
@@ -29,7 +30,7 @@ def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3):
 
     dev = device.create_tpu_device(0)
     dev.SetRandSeed(0)
-    cfg = GPT2Config.small(n_positions=1024, dropout=0.0,
+    cfg = GPT2Config.small(n_positions=ctx, dropout=0.0,
                            attn_impl="fused", n_kv_head=n_kv_head)
     m = GPT2LMHead(cfg)
     m.compile([tensor.from_numpy(np.zeros((1, 8), np.int32), dev)],
@@ -37,7 +38,6 @@ def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3):
     params = gpt2_decode.extract_params(m, dtype=jnp.bfloat16)
 
     rng = np.random.RandomState(0)
-    ctx = cfg.n_positions
     window = np.zeros((batch, ctx), np.int32)
     window[:, :prompt_len] = rng.randint(0, cfg.vocab_size,
                                          (batch, prompt_len))
@@ -48,7 +48,7 @@ def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3):
         out = gpt2_decode.generate_cached_uniform(
             params, ids, prompt_len, cfg.n_head,
             float(cfg.layer_norm_eps), nn, ctx, True,
-            jnp.float32(1.0), keys)
+            jnp.float32(1.0), keys, quant_cache=quant_cache)
         np.asarray(out)
 
     def warm(nn, tries=3):
@@ -74,14 +74,31 @@ def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3):
     ests = sorted(
         batch * (n_new - n_new // 2) / (timed(n_new) - timed(n_new // 2))
         for _ in range(3))
+    d = cfg.n_embd // cfg.n_head
+    # bf16 values are 2 bytes; int8 is 1 byte plus a 4-byte f32 scale
+    # per (token, head) row of D values
+    bytes_per = 1 + 4.0 / d if quant_cache else 2
     cache_mib = (2 * cfg.n_layer * batch * cfg.n_kv_head * ctx
-                 * (cfg.n_embd // cfg.n_head) * 2) / 2**20
+                 * d * bytes_per) / 2**20
     return ests[1], ests[0], ests[-1], cache_mib
 
 
 if __name__ == "__main__":
     for n_kv in (12, 4, 2, 1):
-        med, lo, hi, cache = measure(n_kv)
-        print(f"n_kv_head={n_kv:2d}: {med:7.1f} tok/s "
-              f"[{lo:.1f}, {hi:.1f}]  kv_cache={cache:.0f} MiB",
-              flush=True)
+        for quant in (False, True):
+            med, lo, hi, cache = measure(n_kv, quant_cache=quant)
+            tag = "int8" if quant else "bf16"
+            print(f"n_kv_head={n_kv:2d} cache={tag}: {med:7.1f} tok/s "
+                  f"[{lo:.1f}, {hi:.1f}]  kv_cache={cache:.0f} MiB",
+                  flush=True)
+    # long-context rows: at ctx=4096 the cache dominates the weight
+    # reads (1152 vs ~250 MiB at full heads) — the regime the int8
+    # cache targets
+    for n_kv in (12, 4):
+        for quant in (False, True):
+            med, lo, hi, cache = measure(n_kv, quant_cache=quant,
+                                         ctx=4096)
+            tag = "int8" if quant else "bf16"
+            print(f"ctx=4096 n_kv_head={n_kv:2d} cache={tag}: "
+                  f"{med:7.1f} tok/s [{lo:.1f}, {hi:.1f}]  "
+                  f"kv_cache={cache:.0f} MiB", flush=True)

@@ -176,6 +176,41 @@ def _ln(x, s, b, eps):
     return ((xf - mu) * jax.lax.rsqrt(var + eps) * s + b).astype(x.dtype)
 
 
+# -- int8 KV cache (round 5) ------------------------------------------------
+# The GQA measurement (PERF.md §8) showed decode tokens/sec scales
+# near-linearly with cache BYTES — so halving bytes/element is the same
+# lever: the cache stores (int8 values, one f32 scale per (token, head)
+# row over D), cutting cache traffic ~2× vs bf16.  XLA fuses the
+# dequantize into the score/value einsums, so HBM sees int8 + scales
+# only.  A quantized cache is a (values, scales) tuple everywhere a
+# dense cache is an array; the helpers below keep every decode path
+# shape-agnostic between the two.
+
+def _quantize_kv(x):
+    """(…, D) float -> ((…, D) int8, (…) f32 scale), symmetric per-row."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_kv(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def _cache_layer(c, li):
+    """Layer li of a stacked cache (dense array or (values, scales))."""
+    return (c[0][li], c[1][li]) if isinstance(c, tuple) else c[li]
+
+
+def _cache_stack(layers):
+    if isinstance(layers[0], tuple):
+        return (jnp.stack([l[0] for l in layers]),
+                jnp.stack([l[1] for l in layers]))
+    return jnp.stack(layers)
+
+
 def _attn_full(q, k, v, n_head, start=None):
     """Causal attention over the full (B, S, E) prefill block.
     ``start``: optional (B,) first-live window position per row
@@ -232,26 +267,56 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
     cache-read-bound loop — and the query block reshapes to
     (B, H_kv, G, D) so each K/V head serves its G-query group in one
     grouped einsum (no repeat materialized).  H_kv == n_head makes
-    G=1 and this is exactly the ungrouped math."""
+    G=1 and this is exactly the ungrouped math.
+
+    int8 caches arrive as (values, scales) tuples: reads dequantize
+    into the einsums (XLA fuses — HBM traffic stays int8), writes
+    quantize this step's K/V row."""
+    quant = isinstance(k_cache, tuple)
+    kq = k_cache[0] if quant else k_cache
     b, _, e = x.shape
     d = e // n_head
-    n_kv = k_cache.shape[1]
+    n_kv = kq.shape[1]
     g = n_head // n_kv
-    ctx = k_cache.shape[2]
+    ctx = kq.shape[2]
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = (h @ p["wq"] + p["bq"]).reshape(b, n_kv, g, d)
     k_new = (h @ p["wk"] + p["bk"]).reshape(b, n_kv, 1, d)
     v_new = (h @ p["wv"] + p["bv"]).reshape(b, n_kv, 1, d)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, (0, 0, pos, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, (0, 0, pos, 0))
-    sc = jnp.einsum("bkgd,bktd->bkgt", q, k_cache) / math.sqrt(d)
+    if quant:
+        # scale-FOLDED quantized attention: contract against the raw
+        # int8 arrays (the convert rides the einsum operand; no
+        # dequantized cache is materialized) and apply the per-token
+        # scales outside the contractions —
+        #   scores[t] = (q · k8[t]) · kscale[t];
+        #   out = Σ_t (p[t]·vscale[t]) · v8[t]
+        (kqv, ksc), (vqv, vsc) = k_cache, v_cache
+        k8, k8s = _quantize_kv(k_new)
+        v8, v8s = _quantize_kv(v_new)
+        kqv = jax.lax.dynamic_update_slice(kqv, k8, (0, 0, pos, 0))
+        ksc = jax.lax.dynamic_update_slice(ksc, k8s, (0, 0, pos))
+        vqv = jax.lax.dynamic_update_slice(vqv, v8, (0, 0, pos, 0))
+        vsc = jax.lax.dynamic_update_slice(vsc, v8s, (0, 0, pos))
+        k_cache, v_cache = (kqv, ksc), (vqv, vsc)
+        sc = jnp.einsum("bkgd,bktd->bkgt", q, kqv.astype(x.dtype))
+        sc = sc * ksc[:, :, None, :].astype(sc.dtype) / math.sqrt(d)
+    else:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k_new,
+                                               (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v_new,
+                                               (0, 0, pos, 0))
+        sc = jnp.einsum("bkgd,bktd->bkgt", q, k_cache) / math.sqrt(d)
     live = jnp.arange(ctx)[None, None, None, :] <= pos
     if start is not None:
         live = live & (jnp.arange(ctx)[None, None, None, :]
                        >= start[:, None, None, None])
     sc = jnp.where(live, sc, NEG_INF)
     p_attn = jax.nn.softmax(sc, axis=-1)
-    a = jnp.einsum("bkgt,bktd->bkgd", p_attn, v_cache)
+    if quant:
+        pv = p_attn * vsc[:, :, None, :].astype(p_attn.dtype)
+        a = jnp.einsum("bkgt,bktd->bkgd", pv, vqv.astype(x.dtype))
+    else:
+        a = jnp.einsum("bkgt,bktd->bkgd", p_attn, v_cache)
     # (B, H_kv, G, D) in head-major order == (B, 1, E) concat of heads
     a = a.reshape(b, 1, e)
     x = x + (a @ p["wo"] + p["bo"])
@@ -314,7 +379,8 @@ def _logits(x, params):
     return x @ head
 
 
-def prefill(params, ids, n_head, eps, start=None, moe_top_k=2):
+def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
+            quant_cache=False):
     """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
     v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
     rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
@@ -343,10 +409,14 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2):
         e = x.shape[-1]
         d = e // n_head
         n_kv = k.shape[-1] // d  # GQA caches hold n_kv_head heads
-        ks.append(k.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3))
-        vs.append(v.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3))
+        kh = k.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3)
+        vh = v.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3)
+        if quant_cache:
+            kh, vh = _quantize_kv(kh), _quantize_kv(vh)
+        ks.append(kh)
+        vs.append(vh)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
-    return x, jnp.stack(ks), jnp.stack(vs)
+    return x, _cache_stack(ks), _cache_stack(vs)
 
 
 def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
@@ -358,12 +428,13 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
     the paths cannot drift."""
     new_kc, new_vc = [], []
     for li, p in enumerate(params["blocks"]):
-        x, kl, vl = _block_decode(x, p, kc[li], vc[li], pos, n_head,
+        x, kl, vl = _block_decode(x, p, _cache_layer(kc, li),
+                                  _cache_layer(vc, li), pos, n_head,
                                   eps, start=start, moe_top_k=moe_top_k)
         new_kc.append(kl)
         new_vc.append(vl)
-    kc = jnp.stack(new_kc)
-    vc = jnp.stack(new_vc)
+    kc = _cache_stack(new_kc)
+    vc = _cache_stack(new_vc)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
     return _logits(x, params)[:, 0], kc, vc
 
@@ -394,12 +465,12 @@ def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p):
 
 def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
                   n_head, eps, n_new, greedy, top_k, use_top_p,
-                  moe_top_k=2, unroll=4):
+                  moe_top_k=2, unroll=4, quant_cache=False):
     """Single-prompt core: ids (ctx,) right-padded, returns (n_new,).
     Batched decoding vmaps this over (ids, prompt_len, key) — the
     per-row cache writes at differing positions lower to scatters."""
     hidden, kc, vc = prefill(params, ids[None, :], n_head, eps,
-                             moe_top_k=moe_top_k)
+                             moe_top_k=moe_top_k, quant_cache=quant_cache)
     # caches preallocated at ctx; prefill already spans ctx here.
     # Vocab-project ONLY the last live row — (1, V), not (ctx, V)
     last_h = jax.lax.dynamic_index_in_dim(
@@ -431,10 +502,11 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
-                                   "moe_top_k", "unroll"))
+                                   "moe_top_k", "unroll", "quant_cache"))
 def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
                     greedy, temperature, keys, top_k=0, top_p=1.0,
-                    use_top_p=False, moe_top_k=2, unroll=4):
+                    use_top_p=False, moe_top_k=2, unroll=4,
+                    quant_cache=False):
     """One compiled prefill + lax.scan decode for a BATCH of prompts.
     ids: (B, ctx) right-padded; prompt_lens: (B,) int32; keys: (B, 2)
     PRNG keys.  Returns (B, n_new) sampled token ids.  ``top_k=0``
@@ -453,7 +525,8 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
     (tests/test_gpt2.py)."""
     row = partial(_generate_row, n_head=n_head, eps=eps, n_new=n_new,
                   greedy=greedy, top_k=top_k, use_top_p=use_top_p,
-                  moe_top_k=moe_top_k, unroll=unroll)
+                  moe_top_k=moe_top_k, unroll=unroll,
+                  quant_cache=quant_cache)
     return jax.vmap(
         lambda i, n, k: row(params, i, n, k, temperature, top_p))(
             ids, prompt_lens, keys)
@@ -461,11 +534,11 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
-                                   "moe_top_k", "unroll"))
+                                   "moe_top_k", "unroll", "quant_cache"))
 def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
                             ctx, greedy, temperature, keys, top_k=0,
                             top_p=1.0, use_top_p=False, start=None,
-                            moe_top_k=2, unroll=4):
+                            moe_top_k=2, unroll=4, quant_cache=False):
     """Shared-position fast path: ids (B, ctx), ONE traced scalar
     ``prompt_len`` (the shared first free window position) — the
     per-step cache update is a single batched dynamic_update_slice and
@@ -479,7 +552,7 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
     writes and GEMMs stay batched.  Token-exact vs the per-row scatter
     path in f32 (the oracle test); bf16 may flip argmax near-ties."""
     hidden, kc, vc = prefill(params, ids, n_head, eps, start=start,
-                             moe_top_k=moe_top_k)
+                             moe_top_k=moe_top_k, quant_cache=quant_cache)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)     # (B, E)
     logits0 = _logits(last_h[:, None, :], params)[:, 0]     # (B, V)
@@ -516,10 +589,11 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
-                                   "num_beams", "moe_top_k", "unroll"))
+                                   "num_beams", "moe_top_k", "unroll",
+                                   "quant_cache"))
 def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
                         ctx, num_beams, moe_top_k=2, start=None,
-                        unroll=4):
+                        unroll=4, quant_cache=False):
     """Fixed-length beam search, ONE compiled prefill + scan, for a
     BATCH of prompts (round 5).  ids: (B, ctx) sharing one end
     position ``prompt_len`` (right-padded when equal-length; ragged
@@ -534,7 +608,7 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
     bsz = ids.shape[0]
     K = num_beams
     hidden, kc, vc = prefill(params, ids, n_head, eps, start=start,
-                             moe_top_k=moe_top_k)
+                             moe_top_k=moe_top_k, quant_cache=quant_cache)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)      # (B, E)
     logp0 = jax.nn.log_softmax(
@@ -549,9 +623,10 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
     toks = jnp.concatenate(
         [tok0, jnp.zeros((bsz, pad), jnp.int32)], axis=1)    # (B, K)
     # replicate the prompt caches across beams: (L, B, ...) ->
-    # (L, B*K, ...) in (b, k) row-major order
-    kc = jnp.repeat(kc, K, axis=1)
-    vc = jnp.repeat(vc, K, axis=1)
+    # (L, B*K, ...) in (b, k) row-major order (tree-mapped: int8
+    # caches are (values, scales) tuples)
+    kc = jax.tree.map(lambda a: jnp.repeat(a, K, axis=1), kc)
+    vc = jax.tree.map(lambda a: jnp.repeat(a, K, axis=1), vc)
     start_rows = None if start is None else jnp.repeat(start, K)
     seqs = jnp.zeros((bsz, K, n_new), jnp.int32)
     seqs = seqs.at[:, :, 0].set(toks)
@@ -581,8 +656,8 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
         # block-diagonal cache reorder: beam rows only ever gather from
         # their own prompt's block
         glob = (jnp.arange(bsz)[:, None] * K + parents).reshape(-1)
-        kc = kc[:, glob]
-        vc = vc[:, glob]
+        kc = jax.tree.map(lambda a: a[:, glob], kc)
+        vc = jax.tree.map(lambda a: a[:, glob], vc)
         return (seqs, flat_scores, toks, kc, vc), None
 
     if n_new > 1:
@@ -630,7 +705,7 @@ def _normalize_prompts(prompt_ids, max_new_tokens, cfg,
 
 
 def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
-                  dtype=None, unroll=4):
+                  dtype=None, unroll=4, cache_dtype=None):
     """Fixed-length beam search for a (optionally plan-sharded, possibly
     MoE) GPT2LMHead: returns the highest-total-log-prob continuation of
     ``max_new_tokens`` tokens.  Takes one 1-D prompt (returns one
@@ -655,11 +730,24 @@ def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
         float(cfg.layer_norm_eps), int(max_new_tokens),
         cfg.n_positions, int(num_beams),
         moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2), start=start,
-        unroll=int(unroll))
+        unroll=int(unroll), quant_cache=_quant_flag(cache_dtype))
     seqs = np.asarray(seqs)
     out = [np.concatenate([r, seqs[i, 0]]).astype(np.int32)
            for i, r in enumerate(rows)]
     return out[0] if single else out
+
+
+def _quant_flag(cache_dtype):
+    """Map the user-facing ``cache_dtype`` to the static jit flag.
+    Only None (cache in the compute dtype) and "int8" exist — dtype
+    strings that would not change behavior are rejected rather than
+    silently accepted."""
+    if cache_dtype is None:
+        return False
+    if cache_dtype == "int8":
+        return True
+    raise ValueError(f"cache_dtype must be None or 'int8', "
+                     f"got {cache_dtype!r}")
 
 
 def _seed(temperature, rng):
@@ -679,7 +767,7 @@ def _seed(temperature, rng):
 
 def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
              top_k=0, top_p=None, dtype=None, unroll=4,
-             _ragged_impl="left"):
+             cache_dtype=None, _ragged_impl="left"):
     """KV-cached sampling for a GPT2LMHead (dense or MoE,
     optionally plan-sharded).  Requires
     prompt_len + max_new_tokens <= cfg.n_positions (the windowed
@@ -694,9 +782,13 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     (int > 0) / ``top_p`` (0 < p ≤ 1) filter the temperature-scaled
     distribution before sampling.  ``dtype=jnp.bfloat16`` runs
     inference in bf16 (≈2× steady-state throughput; see
-    extract_params).  ``unroll`` (default 4): decode-loop unroll
-    factor — the measured throughput/compile-time knee; see the module
-    docstring."""
+    extract_params).  ``cache_dtype="int8"`` quantizes the KV cache
+    (symmetric per-(token, head) scales over D) — ~2× less cache
+    traffic on a cache-read-bound loop, at the cost of quantization
+    noise in the attention scores (argmax near-ties can flip; sampled
+    distributions shift by the score error).  ``unroll`` (default 4):
+    decode-loop unroll factor — the measured throughput/compile-time
+    knee; see the module docstring."""
     cfg = m.cfg
     single, rows, lens, max_len, window, start = _normalize_prompts(
         prompt_ids, max_new_tokens, cfg,
@@ -728,7 +820,7 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
         top_p=jnp.float32(1.0 if top_p is None else top_p),
         use_top_p=top_p is not None,
         moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
-        unroll=int(unroll))
+        unroll=int(unroll), quant_cache=_quant_flag(cache_dtype))
     sample_args = (cfg.n_head, float(cfg.layer_norm_eps),
                    int(max_new_tokens), ctx, temperature <= 0,
                    jnp.float32(max(temperature, 1e-6)), keys)

@@ -256,6 +256,98 @@ def test_gqa_config_validates_group():
         GPT2Config.tiny(n_kv_head=3)  # 4 % 3 != 0
 
 
+def test_int8_cache_decode_matches_dense_on_trained_model():
+    """cache_dtype="int8" stores the KV cache as (int8, per-row f32
+    scale).  On a TRAINED model (decisive logits — quantization noise
+    in the scores must not flip the argmax) greedy decoding matches
+    the dense-cache path token for token, and the cache arrays really
+    are int8."""
+    import jax.numpy as jnp
+
+    from singa_tpu.models import gpt2_decode
+
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    ids, labels = _batch(cfg)
+    x = tensor.from_numpy(ids)
+    m.compile([x], is_train=True, use_graph=True)
+    for _ in range(15):
+        m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+    m.eval()
+    prompt = ids[0, :9]
+    g_dense = gpt2_decode.generate(m, prompt, max_new_tokens=12,
+                                   temperature=0)
+    g_int8 = gpt2_decode.generate(m, prompt, max_new_tokens=12,
+                                  temperature=0, cache_dtype="int8")
+    np.testing.assert_array_equal(g_dense, g_int8)
+
+    params = gpt2_decode.extract_params(m)
+    _, kc, vc = gpt2_decode.prefill(
+        params, jnp.asarray(ids[:1]), cfg.n_head, cfg.layer_norm_eps,
+        quant_cache=True)
+    assert isinstance(kc, tuple) and kc[0].dtype == jnp.int8
+    assert kc[1].dtype == jnp.float32 and kc[1].shape == kc[0].shape[:-1]
+    assert isinstance(vc, tuple) and vc[0].dtype == jnp.int8
+
+
+def test_int8_cache_prefill_logits_close():
+    """Teacher-forced bound on the quantization error: int8-cache
+    prefill hidden states equal the dense ones (quantization only
+    touches what DECODE reads back; prefill attention uses the
+    unquantized k/v), and a quantize/dequantize round trip of the
+    cache itself stays within the symmetric-int8 error bound."""
+    import jax.numpy as jnp
+
+    from singa_tpu.models import gpt2_decode
+
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (1, 16)).astype(np.int32)
+    m.compile([tensor.from_numpy(ids)], is_train=False, use_graph=False)
+    m.eval()
+    params = gpt2_decode.extract_params(m)
+    h_dense, kc, _ = gpt2_decode.prefill(
+        params, jnp.asarray(ids), cfg.n_head, cfg.layer_norm_eps)
+    h_quant, kcq, _ = gpt2_decode.prefill(
+        params, jnp.asarray(ids), cfg.n_head, cfg.layer_norm_eps,
+        quant_cache=True)
+    np.testing.assert_allclose(np.asarray(h_quant), np.asarray(h_dense),
+                               rtol=1e-6, atol=1e-6)
+    deq = gpt2_decode._dequantize_kv(kcq[0], kcq[1], jnp.float32)
+    err = np.abs(np.asarray(deq) - np.asarray(kc))
+    bound = np.asarray(kcq[1])[..., None] * 0.5 + 1e-6
+    assert (err <= bound).all(), err.max()
+
+
+def test_int8_cache_composes_with_gqa_ragged_and_beams():
+    """int8 cache x GQA x ragged batch x beam search all in one: the
+    quantized grouped cache decodes a ragged batch and a beam search
+    without shape errors, and num_beams=1 equals greedy under the SAME
+    cache_dtype (both paths see identical quantization noise)."""
+    from singa_tpu.models import gpt2_decode
+
+    cfg = _cfg(n_kv_head=2)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    m.eval()
+    prompts = [np.arange(5) % cfg.vocab_size,
+               np.arange(9) % cfg.vocab_size]
+    outs = gpt2_decode.generate(m, prompts, max_new_tokens=6,
+                                temperature=0, cache_dtype="int8")
+    assert [len(o) for o in outs] == [11, 15]
+    g = gpt2_decode.generate(m, prompts[1], max_new_tokens=6,
+                             temperature=0, cache_dtype="int8")
+    b1 = gpt2_decode.generate_beam(m, prompts[1], max_new_tokens=6,
+                                   num_beams=1, cache_dtype="int8")
+    np.testing.assert_array_equal(b1, g)
+    with pytest.raises(ValueError, match="cache_dtype"):
+        gpt2_decode.generate(m, prompts[1], max_new_tokens=2,
+                             cache_dtype="int4")
+
+
 def test_parallel_gqa_matches_serial():
     """GQA under an active ShardingPlan (dp2 x tp2 x sp2): the
     RepeatKV-then-constrain resharding and the KV-head/model-axis split
