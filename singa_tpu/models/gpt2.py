@@ -194,7 +194,8 @@ class GPT2LMHead(model.Model):
     # -- sampling (fixed-shape, jit-friendly: full-context forward per
     #    emitted token, like examples/rnn's fixed-shape sampling) --------
     def generate(self, prompt_ids, max_new_tokens=20, temperature=1.0,
-                 rng=None, use_cache=None, top_k=0, top_p=None):
+                 rng=None, use_cache=None, top_k=0, top_p=None,
+                 min_p=None, repetition_penalty=None):
         """Greedy/temperature sampling with optional top-k / top-p
         (nucleus) filtering. prompt_ids: np.ndarray (S0,).
 
@@ -227,7 +228,8 @@ class GPT2LMHead(model.Model):
                 return _gd.generate(
                     self, prompt_ids, max_new_tokens=max_new_tokens,
                     temperature=temperature, rng=rng, top_k=top_k,
-                    top_p=top_p)
+                    top_p=top_p, min_p=min_p,
+                    repetition_penalty=repetition_penalty)
             finally:
                 if was_training:
                     self.train(True)
@@ -252,6 +254,11 @@ class GPT2LMHead(model.Model):
         top_k = min(int(top_k or 0), self.cfg.vocab_size)
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if min_p is not None and not 0.0 < min_p <= 1.0:
+            raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+        if repetition_penalty is not None and repetition_penalty <= 0.0:
+            raise ValueError(f"repetition_penalty must be > 0, "
+                             f"got {repetition_penalty}")
         was_training = getattr(self, "training", False)
         self.eval()
         try:
@@ -261,7 +268,8 @@ class GPT2LMHead(model.Model):
                 return gpt2_decode.generate(
                     self, prompt_ids, max_new_tokens=max_new_tokens,
                     temperature=temperature, rng=rng, top_k=top_k,
-                    top_p=top_p)
+                    top_p=top_p, min_p=min_p,
+                    repetition_penalty=repetition_penalty)
             ids = list(np.asarray(prompt_ids).tolist())
             ctx = self.cfg.n_positions
             wte = self.transformer.wte
@@ -282,10 +290,22 @@ class GPT2LMHead(model.Model):
                 x = tensor.from_numpy(window, dev)
                 logits = self.forward(x)
                 last = tensor.to_numpy(logits)[0, len(live) - 1]
+                last = last.astype(np.float64)
+                if repetition_penalty is not None \
+                        and repetition_penalty != 1.0:
+                    # CTRL/HF semantics: seen tokens (the WHOLE
+                    # sequence so far, prompt included) are divided
+                    # when positive, multiplied when negative —
+                    # applied before greedy argmax too
+                    seen = np.unique(np.asarray(ids, np.int64))
+                    pen = np.where(last[seen] > 0,
+                                   last[seen] / repetition_penalty,
+                                   last[seen] * repetition_penalty)
+                    last[seen] = pen
                 if temperature <= 0:
                     nxt = int(np.argmax(last))
                 else:
-                    logit = last.astype(np.float64) / temperature
+                    logit = last / temperature
                     if top_k:
                         kth = np.sort(logit)[-int(top_k)]
                         logit = np.where(logit < kth, -np.inf, logit)
@@ -297,6 +317,11 @@ class GPT2LMHead(model.Model):
                         keep = np.zeros(len(logit), bool)
                         keep[order] = (cum - sp) < top_p
                         logit = np.where(keep, logit, -np.inf)
+                    if min_p is not None:
+                        # keep p >= min_p·p_max
+                        logit = np.where(
+                            logit < logit.max() + np.log(min_p),
+                            -np.inf, logit)
                     p = np.exp(logit - logit.max())
                     p /= p.sum()
                     r = rng or np.random

@@ -439,14 +439,27 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
     return _logits(x, params)[:, 0], kc, vc
 
 
-def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p):
+def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p,
+            min_p=1.0, use_min_p=False, rep_mask=None, rep_penalty=1.0):
     """One token from a (V,) logit row.  ``greedy``/``top_k``/
-    ``use_top_p`` are static; ``temperature``/``top_p`` are traced.
-    Filter order follows the de-facto standard (HF generate):
-    temperature → top-k → top-p (nucleus) → categorical."""
+    ``use_top_p``/``use_min_p`` are static; ``temperature``/``top_p``/
+    ``min_p``/``rep_penalty`` are traced.  Filter order follows the
+    de-facto standard (HF generate): repetition penalty (a processor —
+    applies before greedy argmax too) → temperature → top-k → top-p
+    (nucleus) → min-p → categorical.
+
+    ``rep_mask`` (V,) bool marks tokens already in the sequence
+    (prompt + emitted); their logits are divided by ``rep_penalty``
+    when positive and multiplied when negative (CTRL semantics, as in
+    HF)."""
+    logit = logit.astype(jnp.float32)
+    if rep_mask is not None:
+        pen = jnp.where(logit > 0, logit / rep_penalty,
+                        logit * rep_penalty)
+        logit = jnp.where(rep_mask, pen, logit)
     if greedy:
         return jnp.argmax(logit).astype(jnp.int32)
-    logit = logit.astype(jnp.float32) / temperature
+    logit = logit / temperature
     if top_k:
         kth = jax.lax.top_k(logit, top_k)[0][-1]
         logit = jnp.where(logit < kth, NEG_INF, logit)
@@ -460,15 +473,28 @@ def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p):
         keep_sorted = (cum - sp) < top_p
         keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
         logit = jnp.where(keep, logit, NEG_INF)
+    if use_min_p:
+        # keep p >= min_p·p_max  ⇔  logit >= max + ln(min_p)
+        logit = jnp.where(logit < jnp.max(logit) + jnp.log(min_p),
+                          NEG_INF, logit)
     return jax.random.categorical(key, logit).astype(jnp.int32)
+
+
+def _rep_mask_init(ids, live, vocab):
+    """(ctx,) ids + (ctx,) live mask -> (V,) bool presence mask."""
+    return jnp.zeros((vocab,), bool).at[ids].max(live)
 
 
 def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
                   n_head, eps, n_new, greedy, top_k, use_top_p,
-                  moe_top_k=2, unroll=4, quant_cache=False):
+                  moe_top_k=2, unroll=4, quant_cache=False,
+                  min_p=1.0, use_min_p=False, rep_penalty=1.0,
+                  use_rep=False):
     """Single-prompt core: ids (ctx,) right-padded, returns (n_new,).
     Batched decoding vmaps this over (ids, prompt_len, key) — the
-    per-row cache writes at differing positions lower to scatters."""
+    per-row cache writes at differing positions lower to scatters.
+    With ``use_rep`` a (V,) presence mask (prompt tokens + everything
+    emitted) rides the scan carry for the repetition penalty."""
     hidden, kc, vc = prefill(params, ids[None, :], n_head, eps,
                              moe_top_k=moe_top_k, quant_cache=quant_cache)
     # caches preallocated at ctx; prefill already spans ctx here.
@@ -477,36 +503,49 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
         hidden, prompt_len - 1, axis=1, keepdims=False)    # (1, E)
     first_logit = _logits(last_h[:, None, :], params)[0, 0]  # (V,)
 
-    def sample(logit, k):
+    def sample(logit, k, rep):
         return _sample(logit, k, temperature, top_p, greedy, top_k,
-                       use_top_p)
+                       use_top_p, min_p=min_p, use_min_p=use_min_p,
+                       rep_mask=rep, rep_penalty=rep_penalty)
 
+    rep = None
+    if use_rep:
+        vocab = params["wte"].shape[0]
+        rep = _rep_mask_init(ids, jnp.arange(ids.shape[0]) < prompt_len,
+                             vocab)
     k0, key = jax.random.split(key)
-    tok0 = sample(first_logit, k0)
+    tok0 = sample(first_logit, k0, rep)
+    if rep is not None:
+        rep = rep.at[tok0].set(True)
 
+    # ``rep`` rides the carry as None (an empty pytree leaf) when the
+    # penalty is off — one scan body serves both modes
     def step(carry, _):
-        tok, pos, kc, vc, key = carry
+        tok, pos, kc, vc, key, rep = carry
         x = params["wte"][tok][None, None, :] + \
             params["wpe"][pos][None, None, :]
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
                                       eps, moe_top_k=moe_top_k)
         k, key = jax.random.split(key)
-        nxt = sample(logits[0], k)
-        return (nxt, pos + 1, kc, vc, key), tok
+        nxt = sample(logits[0], k, rep)
+        new_rep = None if rep is None else rep.at[nxt].set(True)
+        return (nxt, pos + 1, kc, vc, key, new_rep), tok
 
-    (last, _, _, _, _), toks = jax.lax.scan(
-        step, (tok0, prompt_len, kc, vc, key), None, length=n_new - 1,
-        unroll=min(unroll, max(1, n_new - 1)))
+    (last, *_), toks = jax.lax.scan(
+        step, (tok0, prompt_len, kc, vc, key, rep), None,
+        length=n_new - 1, unroll=min(unroll, max(1, n_new - 1)))
     return jnp.concatenate([toks, last[None]])
 
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
-                                   "moe_top_k", "unroll", "quant_cache"))
+                                   "moe_top_k", "unroll", "quant_cache",
+                                   "use_min_p", "use_rep"))
 def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
                     greedy, temperature, keys, top_k=0, top_p=1.0,
                     use_top_p=False, moe_top_k=2, unroll=4,
-                    quant_cache=False):
+                    quant_cache=False, min_p=1.0, use_min_p=False,
+                    rep_penalty=1.0, use_rep=False):
     """One compiled prefill + lax.scan decode for a BATCH of prompts.
     ids: (B, ctx) right-padded; prompt_lens: (B,) int32; keys: (B, 2)
     PRNG keys.  Returns (B, n_new) sampled token ids.  ``top_k=0``
@@ -526,7 +565,9 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
     row = partial(_generate_row, n_head=n_head, eps=eps, n_new=n_new,
                   greedy=greedy, top_k=top_k, use_top_p=use_top_p,
                   moe_top_k=moe_top_k, unroll=unroll,
-                  quant_cache=quant_cache)
+                  quant_cache=quant_cache, min_p=min_p,
+                  use_min_p=use_min_p, rep_penalty=rep_penalty,
+                  use_rep=use_rep)
     return jax.vmap(
         lambda i, n, k: row(params, i, n, k, temperature, top_p))(
             ids, prompt_lens, keys)
@@ -534,11 +575,14 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
-                                   "moe_top_k", "unroll", "quant_cache"))
+                                   "moe_top_k", "unroll", "quant_cache",
+                                   "use_min_p", "use_rep"))
 def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
                             ctx, greedy, temperature, keys, top_k=0,
                             top_p=1.0, use_top_p=False, start=None,
-                            moe_top_k=2, unroll=4, quant_cache=False):
+                            moe_top_k=2, unroll=4, quant_cache=False,
+                            min_p=1.0, use_min_p=False, rep_penalty=1.0,
+                            use_rep=False):
     """Shared-position fast path: ids (B, ctx), ONE traced scalar
     ``prompt_len`` (the shared first free window position) — the
     per-step cache update is a single batched dynamic_update_slice and
@@ -557,17 +601,37 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
         hidden, prompt_len - 1, axis=1, keepdims=False)     # (B, E)
     logits0 = _logits(last_h[:, None, :], params)[:, 0]     # (B, V)
 
-    def sample(logits, keys_):
+    def sample(logits, keys_, rep):
         return jax.vmap(
-            lambda lg, k: _sample(lg, k, temperature, top_p, greedy,
-                                  top_k, use_top_p))(logits, keys_)
+            lambda lg, k, r: _sample(lg, k, temperature, top_p, greedy,
+                                     top_k, use_top_p, min_p=min_p,
+                                     use_min_p=use_min_p, rep_mask=r,
+                                     rep_penalty=rep_penalty),
+            in_axes=(0, 0, None if rep is None else 0))(
+                logits, keys_, rep)
 
+    rep = None
+    if use_rep:
+        vocab = params["wte"].shape[0]
+        bsz = ids.shape[0]
+        span = jnp.arange(ctx)[None, :]
+        live = span < prompt_len
+        if start is not None:  # left-padded: pads sit BEFORE start_i
+            live = live & (span >= start[:, None])
+        else:
+            live = jnp.broadcast_to(live, (bsz, ctx))
+        rep = jax.vmap(_rep_mask_init, in_axes=(0, 0, None))(
+            ids, live, vocab)
     keys0 = jax.vmap(lambda k: jax.random.split(k))(keys)
-    tok0 = sample(logits0, keys0[:, 0])
+    tok0 = sample(logits0, keys0[:, 0], rep)
     keys_cur = keys0[:, 1]
+    if rep is not None:
+        rep = rep.at[jnp.arange(ids.shape[0]), tok0].set(True)
 
+    # ``rep`` rides the carry as None (an empty pytree leaf) when the
+    # penalty is off — one scan body serves both modes
     def step(carry, t):
-        toks, kc, vc, keys_cur = carry
+        toks, kc, vc, keys_cur, rep = carry
         pos = prompt_len + t
         if start is None:
             pe = params["wpe"][pos][None, None, :]
@@ -579,11 +643,13 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
                                       eps, start=start,
                                       moe_top_k=moe_top_k)
         ks = jax.vmap(lambda k: jax.random.split(k))(keys_cur)
-        nxt = sample(logits, ks[:, 0])
-        return (nxt, kc, vc, ks[:, 1]), toks
+        nxt = sample(logits, ks[:, 0], rep)
+        new_rep = (None if rep is None
+                   else rep.at[jnp.arange(nxt.shape[0]), nxt].set(True))
+        return (nxt, kc, vc, ks[:, 1], new_rep), toks
 
-    (last, _, _, _), toks = jax.lax.scan(
-        step, (tok0, kc, vc, keys_cur), jnp.arange(n_new - 1),
+    (last, *_), toks = jax.lax.scan(
+        step, (tok0, kc, vc, keys_cur, rep), jnp.arange(n_new - 1),
         unroll=min(unroll, max(1, n_new - 1)))
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
 
@@ -766,8 +832,9 @@ def _seed(temperature, rng):
 
 
 def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
-             top_k=0, top_p=None, dtype=None, unroll=4,
-             cache_dtype=None, _ragged_impl="left"):
+             top_k=0, top_p=None, min_p=None, repetition_penalty=None,
+             dtype=None, unroll=4, cache_dtype=None,
+             _ragged_impl="left"):
     """KV-cached sampling for a GPT2LMHead (dense or MoE,
     optionally plan-sharded).  Requires
     prompt_len + max_new_tokens <= cfg.n_positions (the windowed
@@ -804,6 +871,13 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     top_k = min(int(top_k or 0), cfg.vocab_size)
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if min_p is not None and not 0.0 < min_p <= 1.0:
+        raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+    if repetition_penalty is not None and repetition_penalty <= 0.0:
+        raise ValueError(f"repetition_penalty must be > 0, "
+                         f"got {repetition_penalty}")
+    use_rep = (repetition_penalty is not None
+               and repetition_penalty != 1.0)
     params = extract_params(m, dtype=dtype)
     ctx = cfg.n_positions
     bsz = len(rows)
@@ -819,6 +893,11 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
         top_k=int(top_k or 0),
         top_p=jnp.float32(1.0 if top_p is None else top_p),
         use_top_p=top_p is not None,
+        min_p=jnp.float32(1.0 if min_p is None else min_p),
+        use_min_p=min_p is not None,
+        rep_penalty=jnp.float32(1.0 if repetition_penalty is None
+                                else repetition_penalty),
+        use_rep=use_rep,
         moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
         unroll=int(unroll), quant_cache=_quant_flag(cache_dtype))
     sample_args = (cfg.n_head, float(cfg.layer_norm_eps),

@@ -256,6 +256,66 @@ def test_gqa_config_validates_group():
         GPT2Config.tiny(n_kv_head=3)  # 4 % 3 != 0
 
 
+def test_repetition_penalty_breaks_loops_and_paths_match():
+    """repetition_penalty (CTRL semantics: seen tokens divided when
+    positive, multiplied when negative — applied before greedy argmax)
+    must act identically on the KV-cached and windowed paths (greedy ⇒
+    deterministic), change the output of a looping greedy generation,
+    and work for ragged batches (the presence mask must ignore the
+    left-pad zeros)."""
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    m.eval()
+    prompt = np.arange(9) % cfg.vocab_size
+    plain = m.generate(prompt, max_new_tokens=10, temperature=0)
+    kv = m.generate(prompt, max_new_tokens=10, temperature=0,
+                    repetition_penalty=1.5)
+    win = m.generate(prompt, max_new_tokens=10, temperature=0,
+                     repetition_penalty=1.5, use_cache=False)
+    np.testing.assert_array_equal(kv, win)
+    assert not np.array_equal(plain, kv)
+    # ragged batch: each row must equal its single-prompt generation
+    # (start-aware presence init — pad zeros are NOT marked seen)
+    prompts = [prompt[:5], prompt]
+    outs = m.generate(prompts, max_new_tokens=8, temperature=0,
+                      repetition_penalty=1.5)
+    for row, p in zip(outs, prompts):
+        single = m.generate(p, max_new_tokens=8, temperature=0,
+                            repetition_penalty=1.5)
+        np.testing.assert_array_equal(row, single)
+
+
+def test_min_p_one_equals_greedy():
+    """min_p=1.0 keeps only tokens tied with the max-probability token,
+    so sampling at any temperature reduces to greedy."""
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    m.eval()
+    prompt = np.arange(9) % cfg.vocab_size
+    greedy = m.generate(prompt, max_new_tokens=10, temperature=0)
+    sampled = m.generate(prompt, max_new_tokens=10, temperature=1.0,
+                         min_p=1.0, rng=np.random.RandomState(0))
+    np.testing.assert_array_equal(greedy, sampled)
+
+
+def test_sampling_extras_validate():
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    m.eval()
+    p = np.arange(5)
+    for kw in ({"min_p": 0.0}, {"min_p": 1.5},
+               {"repetition_penalty": 0.0},
+               {"repetition_penalty": -2.0}):
+        with pytest.raises(ValueError):
+            m.generate(p, max_new_tokens=2, **kw)
+
+
 def test_int8_cache_decode_matches_dense_on_trained_model():
     """cache_dtype="int8" stores the KV cache as (int8, per-row f32
     scale).  On a TRAINED model (decisive logits — quantization noise
