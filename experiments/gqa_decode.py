@@ -20,7 +20,7 @@ sys.path.insert(0, ".")
 
 
 def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3,
-            quant_cache=False, ctx=1024):
+            quant_cache=False, ctx=1024, attn_window=None):
     import jax
     import jax.numpy as jnp
 
@@ -31,7 +31,8 @@ def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3,
     dev = device.create_tpu_device(0)
     dev.SetRandSeed(0)
     cfg = GPT2Config.small(n_positions=ctx, dropout=0.0,
-                           attn_impl="fused", n_kv_head=n_kv_head)
+                           attn_impl="fused", n_kv_head=n_kv_head,
+                           attn_window=attn_window)
     m = GPT2LMHead(cfg)
     m.compile([tensor.from_numpy(np.zeros((1, 8), np.int32), dev)],
               is_train=False, use_graph=False)
@@ -48,7 +49,8 @@ def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3,
         out = gpt2_decode.generate_cached_uniform(
             params, ids, prompt_len, cfg.n_head,
             float(cfg.layer_norm_eps), nn, ctx, True,
-            jnp.float32(1.0), keys, quant_cache=quant_cache)
+            jnp.float32(1.0), keys, quant_cache=quant_cache,
+            window=gpt2_decode._norm_window(cfg))
         np.asarray(out)
 
     def warm(nn, tries=3):
@@ -78,7 +80,8 @@ def measure(n_kv_head, batch=8, prompt_len=128, n_new=512, repeats=3,
     # bf16 values are 2 bytes; int8 is 1 byte plus a 4-byte f32 scale
     # per (token, head) row of D values
     bytes_per = 1 + 4.0 / d if quant_cache else 2
-    cache_mib = (2 * cfg.n_layer * batch * cfg.n_kv_head * ctx
+    span = ctx if attn_window is None else min(attn_window, ctx)
+    cache_mib = (2 * cfg.n_layer * batch * cfg.n_kv_head * span
                  * d * bytes_per) / 2**20
     return ests[1], ests[0], ests[-1], cache_mib
 
@@ -102,3 +105,9 @@ if __name__ == "__main__":
             print(f"ctx=4096 n_kv_head={n_kv:2d} cache={tag}: "
                   f"{med:7.1f} tok/s [{lo:.1f}, {hi:.1f}]  "
                   f"kv_cache={cache:.0f} MiB", flush=True)
+    # sliding window at long context: the O(W) rolling cache should
+    # put ctx=4096 decode back at ~ctx=W cost
+    med, lo, hi, cache = measure(12, ctx=4096, attn_window=1024)
+    print(f"ctx=4096 window=1024 cache=bf16: {med:7.1f} tok/s "
+          f"[{lo:.1f}, {hi:.1f}]  kv_cache={cache:.0f} MiB",
+          flush=True)

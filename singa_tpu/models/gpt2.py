@@ -30,7 +30,7 @@ class GPT2Config:
                  layer_norm_eps=1e-5, tie_weights=True, moe_every=None,
                  moe_experts=8, moe_top_k=2, moe_aux_weight=0.01,
                  moe_capacity_factor=1.25, moe_groups=None, remat=False,
-                 attn_impl="auto", n_kv_head=None):
+                 attn_impl="auto", n_kv_head=None, attn_window=None):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -43,6 +43,13 @@ class GPT2Config:
         if n_head % self.n_kv_head != 0:
             raise ValueError(f"n_head {n_head} not divisible by "
                              f"n_kv_head {self.n_kv_head}")
+        # sliding-window (Mistral-style) causal attention: each query
+        # sees the previous attn_window positions only; the KV-cached
+        # decoder keeps an O(attn_window) rolling cache
+        self.attn_window = None if attn_window is None else int(attn_window)
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(f"attn_window must be >= 1, "
+                             f"got {attn_window}")
         self.n_inner = n_inner or 4 * n_embd
         self.dropout = dropout
         self.layer_norm_eps = layer_norm_eps
@@ -115,6 +122,7 @@ class GPT2Model(model.Model):
             self.blocks.append(ParallelTransformerBlock(
                 c.n_head, c.n_inner, plan, dropout=c.dropout, causal=True,
                 eps=c.layer_norm_eps, num_kv_heads=c.n_kv_head,
+                window=c.attn_window,
                 moe_experts=c.moe_experts if moe else None,
                 moe_top_k=c.moe_top_k,
                 moe_capacity_factor=c.moe_capacity_factor,

@@ -33,7 +33,11 @@ limit — see extract_params.  GQA models (``GPT2Config(n_kv_head=K)``,
 round 5) keep their cache at K heads — the head counts are derived
 from the weight widths, and the decode step contracts each K/V head
 against its query group without materializing a repeat
-(``_block_decode``).
+(``_block_decode``).  Sliding-window models
+(``GPT2Config(attn_window=W)``, round 5) decode from an O(W) ROLLING
+cache — position p lives in slot p % W — and the int8 cache
+(``cache_dtype="int8"``) stores (values, scales) tuples with the
+scales folded into the score/prob contractions; all of these compose.
 """
 
 from __future__ import annotations
@@ -211,14 +215,16 @@ def _cache_stack(layers):
     return jnp.stack(layers)
 
 
-def _attn_full(q, k, v, n_head, start=None):
+def _attn_full(q, k, v, n_head, start=None, window=None):
     """Causal attention over the full (B, S, E) prefill block.
     ``start``: optional (B,) first-live window position per row
     (left-padded batch) — keys before it are masked out.  GQA models
     arrive with k/v narrower than q (n_kv_head·D wide — the head count
     is derived from the widths, never threaded); each K/V head is
     broadcast over its query-head group, matching the training stack's
-    RepeatKV (parallel/tensor_parallel.py ParallelMHA)."""
+    RepeatKV (parallel/tensor_parallel.py ParallelMHA).  ``window``:
+    sliding-window band (query i sees keys [i-window+1, i]), matching
+    the training stack's banded _sdpa."""
     b, s, e = q.shape
     d = e // n_head
     n_kv = k.shape[-1] // d
@@ -231,7 +237,12 @@ def _attn_full(q, k, v, n_head, start=None):
         kh = jnp.repeat(kh, n_head // n_kv, axis=1)
         vh = jnp.repeat(vh, n_head // n_kv, axis=1)
     sc = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(d)
-    cm = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    cm = jnp.tril(jnp.ones((s, s), bool))
+    if window is not None:
+        i = jnp.arange(s)[:, None]
+        j = jnp.arange(s)[None, :]
+        cm = cm & (i - j < window)
+    cm = cm[None, None]
     if start is not None:
         live = jnp.arange(s)[None, :] >= start[:, None]  # (B, S) keys
         cm = cm & live[:, None, None, :]
@@ -244,12 +255,13 @@ def _attn_full(q, k, v, n_head, start=None):
     return o.transpose(0, 2, 1, 3).reshape(b, s, e)
 
 
-def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2):
+def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2,
+                   window=None):
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = h @ p["wq"] + p["bq"]
     k = h @ p["wk"] + p["bk"]
     v = h @ p["wv"] + p["bv"]
-    a = _attn_full(q, k, v, n_head, start=start)
+    a = _attn_full(q, k, v, n_head, start=start, window=window)
     x = x + (a @ p["wo"] + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
     x = x + _mlp(h, p, moe_top_k)
@@ -257,7 +269,7 @@ def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2):
 
 
 def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
-                  moe_top_k=2):
+                  moe_top_k=2, window=None):
     """x: (B, 1, E); k/v_cache: (B, H_kv, ctx, D) with this step's K/V
     already written at ``pos``.  Attends to positions <= pos (and
     >= ``start`` per row for left-padded batches).
@@ -271,7 +283,14 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
 
     int8 caches arrive as (values, scales) tuples: reads dequantize
     into the einsums (XLA fuses — HBM traffic stays int8), writes
-    quantize this step's K/V row."""
+    quantize this step's K/V row.
+
+    ``window`` (static): ROLLING cache of exactly ``window`` slots —
+    position pos lives in slot pos % window, so each write overwrites
+    the slot that just fell out of the band, and the live mask
+    reconstructs each slot's position from (pos, slot index) with no
+    extra state.  O(window) cache reads per token regardless of how
+    long the generation runs."""
     quant = isinstance(k_cache, tuple)
     kq = k_cache[0] if quant else k_cache
     b, _, e = x.shape
@@ -279,6 +298,12 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
     n_kv = kq.shape[1]
     g = n_head // n_kv
     ctx = kq.shape[2]
+    if window is not None:
+        assert ctx == window, (
+            f"rolling cache dim {ctx} != window {window}")
+        slot = pos % window
+    else:
+        slot = pos
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = (h @ p["wq"] + p["bq"]).reshape(b, n_kv, g, d)
     k_new = (h @ p["wk"] + p["bk"]).reshape(b, n_kv, 1, d)
@@ -293,23 +318,33 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
         (kqv, ksc), (vqv, vsc) = k_cache, v_cache
         k8, k8s = _quantize_kv(k_new)
         v8, v8s = _quantize_kv(v_new)
-        kqv = jax.lax.dynamic_update_slice(kqv, k8, (0, 0, pos, 0))
-        ksc = jax.lax.dynamic_update_slice(ksc, k8s, (0, 0, pos))
-        vqv = jax.lax.dynamic_update_slice(vqv, v8, (0, 0, pos, 0))
-        vsc = jax.lax.dynamic_update_slice(vsc, v8s, (0, 0, pos))
+        kqv = jax.lax.dynamic_update_slice(kqv, k8, (0, 0, slot, 0))
+        ksc = jax.lax.dynamic_update_slice(ksc, k8s, (0, 0, slot))
+        vqv = jax.lax.dynamic_update_slice(vqv, v8, (0, 0, slot, 0))
+        vsc = jax.lax.dynamic_update_slice(vsc, v8s, (0, 0, slot))
         k_cache, v_cache = (kqv, ksc), (vqv, vsc)
         sc = jnp.einsum("bkgd,bktd->bkgt", q, kqv.astype(x.dtype))
         sc = sc * ksc[:, :, None, :].astype(sc.dtype) / math.sqrt(d)
     else:
         k_cache = jax.lax.dynamic_update_slice(k_cache, k_new,
-                                               (0, 0, pos, 0))
+                                               (0, 0, slot, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v_new,
-                                               (0, 0, pos, 0))
+                                               (0, 0, slot, 0))
         sc = jnp.einsum("bkgd,bktd->bkgt", q, k_cache) / math.sqrt(d)
-    live = jnp.arange(ctx)[None, None, None, :] <= pos
-    if start is not None:
-        live = live & (jnp.arange(ctx)[None, None, None, :]
-                       >= start[:, None, None, None])
+    if window is not None:
+        # slot s currently holds position pos - ((pos - s) mod window)
+        # (<= pos, within the band by construction; negative = never
+        # written)
+        p_slot = pos - ((pos - jnp.arange(ctx)) % window)
+        live = (p_slot >= 0)[None, None, None, :]
+        if start is not None:
+            live = live & (p_slot[None, None, None, :]
+                           >= start[:, None, None, None])
+    else:
+        live = jnp.arange(ctx)[None, None, None, :] <= pos
+        if start is not None:
+            live = live & (jnp.arange(ctx)[None, None, None, :]
+                           >= start[:, None, None, None])
     sc = jnp.where(live, sc, NEG_INF)
     p_attn = jax.nn.softmax(sc, axis=-1)
     if quant:
@@ -380,7 +415,7 @@ def _logits(x, params):
 
 
 def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
-            quant_cache=False):
+            quant_cache=False, window=None, prompt_end=None):
     """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
     v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
     rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
@@ -402,15 +437,29 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
                        - start[:, None], 0, None)
     x = jnp.take(params["wte"], ids, axis=0) + \
         jnp.take(params["wpe"], pos, axis=0)
+    roll = None
+    if window is not None and window < sp:
+        # ROLLING cache (sliding window): slot w <- the last prompt
+        # position p < prompt_end with p ≡ w (mod window); decode
+        # writes position pos into slot pos % window, so the slot
+        # mapping must be position-mod from the start.  Gathering by
+        # prompt_end (not the padded width sp) keeps right-pad
+        # garbage from overwriting real prompt K/V in its slot.
+        pe_ = (sp if prompt_end is None else prompt_end) - 1
+        w = jnp.arange(window)
+        roll = jnp.clip(pe_ - ((pe_ - w) % window), 0, sp - 1)
     ks, vs = [], []
     for p in params["blocks"]:
         x, k, v = _block_prefill(x, p, n_head, eps, start=start,
-                                 moe_top_k=moe_top_k)
+                                 moe_top_k=moe_top_k, window=window)
         e = x.shape[-1]
         d = e // n_head
         n_kv = k.shape[-1] // d  # GQA caches hold n_kv_head heads
         kh = k.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3)
         vh = v.reshape(b, sp, n_kv, d).transpose(0, 2, 1, 3)
+        if roll is not None:
+            kh = jnp.take(kh, roll, axis=2)
+            vh = jnp.take(vh, roll, axis=2)
         if quant_cache:
             kh, vh = _quantize_kv(kh), _quantize_kv(vh)
         ks.append(kh)
@@ -420,7 +469,7 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
 
 
 def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
-                 moe_top_k=2):
+                 moe_top_k=2, window=None):
     """Advance one decode step through every block: x (B, 1, E) at
     position ``pos`` against caches (L, B, H, ctx, D).  Returns
     ((B, V) logits, new kc, new vc).  Shared by sampling
@@ -430,7 +479,8 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
     for li, p in enumerate(params["blocks"]):
         x, kl, vl = _block_decode(x, p, _cache_layer(kc, li),
                                   _cache_layer(vc, li), pos, n_head,
-                                  eps, start=start, moe_top_k=moe_top_k)
+                                  eps, start=start, moe_top_k=moe_top_k,
+                                  window=window)
         new_kc.append(kl)
         new_vc.append(vl)
     kc = _cache_stack(new_kc)
@@ -489,15 +539,17 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
                   n_head, eps, n_new, greedy, top_k, use_top_p,
                   moe_top_k=2, unroll=4, quant_cache=False,
                   min_p=1.0, use_min_p=False, rep_penalty=1.0,
-                  use_rep=False):
+                  use_rep=False, window=None):
     """Single-prompt core: ids (ctx,) right-padded, returns (n_new,).
     Batched decoding vmaps this over (ids, prompt_len, key) — the
     per-row cache writes at differing positions lower to scatters.
     With ``use_rep`` a (V,) presence mask (prompt tokens + everything
     emitted) rides the scan carry for the repetition penalty."""
     hidden, kc, vc = prefill(params, ids[None, :], n_head, eps,
-                             moe_top_k=moe_top_k, quant_cache=quant_cache)
-    # caches preallocated at ctx; prefill already spans ctx here.
+                             moe_top_k=moe_top_k, quant_cache=quant_cache,
+                             window=window, prompt_end=prompt_len)
+    # dense caches span ctx (prefill processed the full padded row);
+    # windowed models return an O(window) ROLLING cache instead.
     # Vocab-project ONLY the last live row — (1, V), not (ctx, V)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)    # (1, E)
@@ -525,7 +577,8 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
         x = params["wte"][tok][None, None, :] + \
             params["wpe"][pos][None, None, :]
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
-                                      eps, moe_top_k=moe_top_k)
+                                      eps, moe_top_k=moe_top_k,
+                                      window=window)
         k, key = jax.random.split(key)
         nxt = sample(logits[0], k, rep)
         new_rep = None if rep is None else rep.at[nxt].set(True)
@@ -540,12 +593,12 @@ def _generate_row(params, ids, prompt_len, key, temperature, top_p, *,
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
                                    "moe_top_k", "unroll", "quant_cache",
-                                   "use_min_p", "use_rep"))
+                                   "use_min_p", "use_rep", "window"))
 def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
                     greedy, temperature, keys, top_k=0, top_p=1.0,
                     use_top_p=False, moe_top_k=2, unroll=4,
                     quant_cache=False, min_p=1.0, use_min_p=False,
-                    rep_penalty=1.0, use_rep=False):
+                    rep_penalty=1.0, use_rep=False, window=None):
     """One compiled prefill + lax.scan decode for a BATCH of prompts.
     ids: (B, ctx) right-padded; prompt_lens: (B,) int32; keys: (B, 2)
     PRNG keys.  Returns (B, n_new) sampled token ids.  ``top_k=0``
@@ -567,7 +620,7 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
                   moe_top_k=moe_top_k, unroll=unroll,
                   quant_cache=quant_cache, min_p=min_p,
                   use_min_p=use_min_p, rep_penalty=rep_penalty,
-                  use_rep=use_rep)
+                  use_rep=use_rep, window=window)
     return jax.vmap(
         lambda i, n, k: row(params, i, n, k, temperature, top_p))(
             ids, prompt_lens, keys)
@@ -576,13 +629,13 @@ def generate_cached(params, ids, prompt_lens, n_head, eps, n_new, ctx,
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "greedy", "top_k", "use_top_p",
                                    "moe_top_k", "unroll", "quant_cache",
-                                   "use_min_p", "use_rep"))
+                                   "use_min_p", "use_rep", "window"))
 def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
                             ctx, greedy, temperature, keys, top_k=0,
                             top_p=1.0, use_top_p=False, start=None,
                             moe_top_k=2, unroll=4, quant_cache=False,
                             min_p=1.0, use_min_p=False, rep_penalty=1.0,
-                            use_rep=False):
+                            use_rep=False, window=None):
     """Shared-position fast path: ids (B, ctx), ONE traced scalar
     ``prompt_len`` (the shared first free window position) — the
     per-step cache update is a single batched dynamic_update_slice and
@@ -596,7 +649,8 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
     writes and GEMMs stay batched.  Token-exact vs the per-row scatter
     path in f32 (the oracle test); bf16 may flip argmax near-ties."""
     hidden, kc, vc = prefill(params, ids, n_head, eps, start=start,
-                             moe_top_k=moe_top_k, quant_cache=quant_cache)
+                             moe_top_k=moe_top_k, quant_cache=quant_cache,
+                             window=window, prompt_end=prompt_len)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)     # (B, E)
     logits0 = _logits(last_h[:, None, :], params)[:, 0]     # (B, V)
@@ -641,7 +695,8 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
         x = jnp.take(params["wte"], toks, axis=0)[:, None, :] + pe
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
                                       eps, start=start,
-                                      moe_top_k=moe_top_k)
+                                      moe_top_k=moe_top_k,
+                                      window=window)
         ks = jax.vmap(lambda k: jax.random.split(k))(keys_cur)
         nxt = sample(logits, ks[:, 0], rep)
         new_rep = (None if rep is None
@@ -656,10 +711,10 @@ def generate_cached_uniform(params, ids, prompt_len, n_head, eps, n_new,
 
 @partial(jax.jit, static_argnames=("n_head", "eps", "n_new", "ctx",
                                    "num_beams", "moe_top_k", "unroll",
-                                   "quant_cache"))
+                                   "quant_cache", "window"))
 def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
                         ctx, num_beams, moe_top_k=2, start=None,
-                        unroll=4, quant_cache=False):
+                        unroll=4, quant_cache=False, window=None):
     """Fixed-length beam search, ONE compiled prefill + scan, for a
     BATCH of prompts (round 5).  ids: (B, ctx) sharing one end
     position ``prompt_len`` (right-padded when equal-length; ragged
@@ -674,7 +729,8 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
     bsz = ids.shape[0]
     K = num_beams
     hidden, kc, vc = prefill(params, ids, n_head, eps, start=start,
-                             moe_top_k=moe_top_k, quant_cache=quant_cache)
+                             moe_top_k=moe_top_k, quant_cache=quant_cache,
+                             window=window, prompt_end=prompt_len)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)      # (B, E)
     logp0 = jax.nn.log_softmax(
@@ -709,7 +765,8 @@ def _beam_search_cached(params, ids, prompt_len, n_head, eps, n_new,
                      axis=0)[:, None, :] + pe
         logits, kc, vc = _advance_one(params, x, kc, vc, pos, n_head,
                                       eps, start=start_rows,
-                                      moe_top_k=moe_top_k)
+                                      moe_top_k=moe_top_k,
+                                      window=window)
         logp = jax.nn.log_softmax(
             logits.astype(jnp.float32)).reshape(bsz, K, V)
         cand = scores[:, :, None] + logp                 # (B, K, V)
@@ -762,12 +819,12 @@ def _normalize_prompts(prompt_ids, max_new_tokens, cfg,
                 + over_length_hint)
     lens = np.asarray([len(r) for r in rows], np.int32)
     max_len = int(lens.max()) if len(rows) else 0
-    window = np.zeros((len(rows), cfg.n_positions), np.int32)
+    padded = np.zeros((len(rows), cfg.n_positions), np.int32)
     for i, r in enumerate(rows):
-        window[i, max_len - len(r):max_len] = r
+        padded[i, max_len - len(r):max_len] = r
     uniform = len(set(lens.tolist())) <= 1
     start = None if uniform else jnp.asarray(max_len - lens)
-    return single, rows, lens, max_len, window, start
+    return single, rows, lens, max_len, padded, start
 
 
 def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
@@ -785,22 +842,33 @@ def generate_beam(m, prompt_ids, max_new_tokens=20, num_beams=4,
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
     cfg = m.cfg
-    single, rows, lens, max_len, window, start = _normalize_prompts(
+    single, rows, lens, max_len, padded, start = _normalize_prompts(
         prompt_ids, max_new_tokens, cfg)
     if max_new_tokens <= 0:
         out = [r.copy() for r in rows]
         return out[0] if single else out
     params = extract_params(m, dtype=dtype)
     seqs, _scores = _beam_search_cached(
-        params, jnp.asarray(window), max_len, cfg.n_head,
+        params, jnp.asarray(padded), max_len, cfg.n_head,
         float(cfg.layer_norm_eps), int(max_new_tokens),
         cfg.n_positions, int(num_beams),
         moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2), start=start,
-        unroll=int(unroll), quant_cache=_quant_flag(cache_dtype))
+        unroll=int(unroll), quant_cache=_quant_flag(cache_dtype),
+        window=_norm_window(cfg))
     seqs = np.asarray(seqs)
     out = [np.concatenate([r, seqs[i, 0]]).astype(np.int32)
            for i, r in enumerate(rows)]
     return out[0] if single else out
+
+
+def _norm_window(cfg):
+    """The decode-effective sliding window: None when the model has no
+    window or the window covers the whole position space (a rolling
+    cache would then be the dense cache with extra index math)."""
+    w = getattr(cfg, "attn_window", None)
+    if w is None or w >= cfg.n_positions:
+        return None
+    return int(w)
 
 
 def _quant_flag(cache_dtype):
@@ -857,7 +925,7 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     decode-loop unroll factor — the measured throughput/compile-time
     knee; see the module docstring."""
     cfg = m.cfg
-    single, rows, lens, max_len, window, start = _normalize_prompts(
+    single, rows, lens, max_len, padded, start = _normalize_prompts(
         prompt_ids, max_new_tokens, cfg,
         over_length_hint="; use the windowed GPT2LMHead.generate")
     if max_new_tokens <= 0:
@@ -884,9 +952,9 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     uniform = start is None
     if not uniform and _ragged_impl == "scatter":
         # the oracle path wants RIGHT-padded rows
-        window = np.zeros((bsz, ctx), np.int32)
+        padded = np.zeros((bsz, ctx), np.int32)
         for i, r in enumerate(rows):
-            window[i, :len(r)] = r
+            padded[i, :len(r)] = r
     keys = jax.random.split(
         jax.random.PRNGKey(_seed(temperature, rng)), bsz)
     common = dict(
@@ -899,22 +967,23 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
                                 else repetition_penalty),
         use_rep=use_rep,
         moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
-        unroll=int(unroll), quant_cache=_quant_flag(cache_dtype))
+        unroll=int(unroll), quant_cache=_quant_flag(cache_dtype),
+        window=_norm_window(cfg))
     sample_args = (cfg.n_head, float(cfg.layer_norm_eps),
                    int(max_new_tokens), ctx, temperature <= 0,
                    jnp.float32(max(temperature, 1e-6)), keys)
     if uniform:
         new = generate_cached_uniform(
-            params, jnp.asarray(window), max_len, *sample_args,
+            params, jnp.asarray(padded), max_len, *sample_args,
             **common)
     elif _ragged_impl == "left":
         new = generate_cached_uniform(
-            params, jnp.asarray(window), max_len, *sample_args,
+            params, jnp.asarray(padded), max_len, *sample_args,
             start=start, **common)
     elif _ragged_impl == "scatter":
         # per-row vmap oracle (see generate_cached docstring)
         new = generate_cached(
-            params, jnp.asarray(window), jnp.asarray(lens),
+            params, jnp.asarray(padded), jnp.asarray(lens),
             *sample_args, **common)
     else:
         raise ValueError(f"unknown _ragged_impl {_ragged_impl!r}; "

@@ -179,7 +179,8 @@ class ParallelMHA(Layer):
 
     def __init__(self, num_heads, plan: ShardingPlan | None = None,
                  dropout=0.0, seq_parallel=None, causal=False,
-                 remat=False, use_flash=False, num_kv_heads=None):
+                 remat=False, use_flash=False, num_kv_heads=None,
+                 window=None):
         super().__init__()
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads or num_heads)
@@ -187,6 +188,11 @@ class ParallelMHA(Layer):
             raise ValueError(
                 f"num_heads {self.num_heads} not divisible by "
                 f"num_kv_heads {self.num_kv_heads}")
+        if window is not None and (not causal or int(window) < 1):
+            raise ValueError("window requires causal attention and "
+                             f"window >= 1, got {window} "
+                             f"(causal={causal})")
+        self.window = None if window is None else int(window)
         self.plan = plan
         self.dropout = float(dropout)
         self.causal = bool(causal)
@@ -244,6 +250,13 @@ class ParallelMHA(Layer):
 
         if self.seq_parallel and plan is not None \
                 and sharding.plan_active():
+            if self.window is not None:
+                raise NotImplementedError(
+                    "sliding-window attention is not implemented on "
+                    "the ring sequence-parallel path (a band never "
+                    "needs most of the ring's hops — use a plan "
+                    "without a seq axis for windowed models, or drop "
+                    "window for ring attention)")
             # use_flash composes here: inside shard_map the Pallas
             # kernel runs per device (manual mode), so each ring step's
             # local-Q x visiting-K/V attention is the flash kernel
@@ -267,8 +280,19 @@ class ParallelMHA(Layer):
                     "for pallas_call outside shard_map); using the "
                     "fused head-sharded path — shard the seq axis to "
                     "get ring attention with per-shard flash kernels")
+            if use_flash and self.window is not None:
+                # the Pallas kernel has no band support; the fused
+                # path builds the band in-kernel at the same O(S²)
+                # score cost the kernel would pay for these shapes
+                if not getattr(self, "_warned_window_flash", False):
+                    self._warned_window_flash = True
+                    logging.getLogger("singa_tpu").warning(
+                        "ParallelMHA: use_flash ignored with "
+                        "window=%d (no band support in the flash "
+                        "kernel); using the fused path", self.window)
+                use_flash = False
             ctx = _sdpa(q, k, v, mask, self.causal, remat=self.remat,
-                        use_flash=use_flash)
+                        use_flash=use_flash, window=self.window)
         ctx = autograd.transpose(ctx, (0, 2, 1, 3))
         ctx = autograd.reshape(ctx, (b, s, e))
         if plan is not None:
@@ -286,7 +310,7 @@ class ParallelTransformerBlock(Layer):
     def __init__(self, num_heads, intermediate, plan=None, dropout=0.0,
                  causal=False, eps=1e-5, moe_experts=None, moe_top_k=2,
                  moe_capacity_factor=1.25, moe_groups=None, remat=False,
-                 use_flash=False, num_kv_heads=None):
+                 use_flash=False, num_kv_heads=None, window=None):
         super().__init__()
         from ..layer import LayerNorm
 
@@ -294,7 +318,8 @@ class ParallelTransformerBlock(Layer):
         self.attn = ParallelMHA(num_heads, plan, dropout=dropout,
                                 causal=causal, remat=remat,
                                 use_flash=use_flash,
-                                num_kv_heads=num_kv_heads)
+                                num_kv_heads=num_kv_heads,
+                                window=window)
         self.ln2 = LayerNorm(eps)
         self.mlp = None  # needs hidden size; built at initialize
         self._intermediate = int(intermediate)
@@ -338,28 +363,44 @@ class ParallelTransformerBlock(Layer):
 # attention kernels (taped)
 # ---------------------------------------------------------------------------
 
-def _sdpa(q, k, v, mask, causal, remat=False, use_flash=False):
+def _sdpa(q, k, v, mask, causal, remat=False, use_flash=False,
+          window=None):
     """Plain scaled-dot-product attention (B,H,S,D); heads may be sharded
     — the einsums are head-local so GSPMD keeps them collective-free.
-    scale/causal ride op.params for sonnx's decomposed export; remat
-    recomputes the S x S tensors in backward (jax.checkpoint);
+    scale/causal/window ride op.params for sonnx's decomposed export;
+    remat recomputes the S x S tensors in backward (jax.checkpoint);
     use_flash routes to the Pallas online-softmax kernel, whose HBM
     footprint is O(S·D) instead of O(S²) (the long-context lever —
-    see LONGCTX.json for the measured crossover)."""
+    see LONGCTX.json for the measured crossover).
+
+    ``window`` (causal only): sliding-window attention — query i sees
+    keys in [i-window+1, i] (Mistral-style band).  The band is built
+    in-kernel (XLA fuses it into the softmax chain; nothing extra in
+    HBM).  The matching decode side keeps an O(window) rolling KV
+    cache (models/gpt2_decode.py)."""
     if use_flash:
+        if window is not None:
+            raise NotImplementedError(
+                "the flash kernel has no band support; call _sdpa "
+                "with use_flash=False for windowed attention "
+                "(ParallelMHA falls back automatically)")
         from ..ops.pallas.flash_attention import flash_attention_op
 
         return flash_attention_op(q, k, v, mask, causal=causal,
                                   remat=remat)
     scale = 1.0 / math.sqrt(q.shape[-1])
 
-    def f(qv, kv, vv, *rest, scale, causal):
+    def f(qv, kv, vv, *rest, scale, causal, window):
         sc = jnp.einsum("bhsd,bhtd->bhst", qv, kv) * scale
         if rest:
             sc = sc + rest[0]
         if causal:
             s_, t_ = sc.shape[-2:]
             cm = jnp.tril(jnp.ones((s_, t_), bool))
+            if window is not None:
+                i = jnp.arange(s_)[:, None]
+                j = jnp.arange(t_)[None, :]
+                cm = cm & (i - j < window)
             sc = jnp.where(cm[None, None], sc, -1e30)
         p = jnp.exp(sc - sc.max(-1, keepdims=True))
         p = p / p.sum(-1, keepdims=True)
@@ -368,7 +409,7 @@ def _sdpa(q, k, v, mask, causal, remat=False, use_flash=False):
     xs = (q, k, v) if mask is None else (q, k, v, mask)
     apply = autograd.checkpoint_op if remat else autograd._op
     return apply(f, *xs, _name="TPAttention", scale=scale,
-                 causal=causal)
+                 causal=causal, window=window)
 
 
 def _ring_attention_op(q, k, v, mask, plan, causal, use_flash=False):

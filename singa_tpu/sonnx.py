@@ -1507,12 +1507,18 @@ def _dec_attention(op, in_names, emit, out_name):
         emit.node("Add", [cur, in_names[3]], [nxt])
         cur = nxt
     if causal:
-        cm = np.where(np.tril(np.ones((s, t), bool)), 0.0,
-                      -1e9).astype(np.float32)
+        keep = np.tril(np.ones((s, t), bool))
+        window = p.get("window")
+        if window is not None:  # sliding-window band
+            i, j = np.arange(s)[:, None], np.arange(t)[None, :]
+            keep &= (i - j) < int(window)
+        cm = np.where(keep, 0.0, -1e9).astype(np.float32)
         nxt = f"{u}_causal"
-        # shape-keyed name: every layer shares ONE mask constant
-        emit.node("Add", [cur, emit.const(f"const_causal_{s}x{t}", cm)],
-                  [nxt])
+        # shape-keyed name (window-qualified): every layer shares ONE
+        # mask constant
+        wtag = "" if window is None else f"_w{int(window)}"
+        emit.node("Add", [cur, emit.const(
+            f"const_causal_{s}x{t}{wtag}", cm)], [nxt])
         cur = nxt
     pr = f"{u}_probs"
     emit.node("Softmax", [cur], [pr], axis=-1)
