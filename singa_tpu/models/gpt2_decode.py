@@ -489,6 +489,80 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
     return _logits(x, params)[:, 0], kc, vc
 
 
+def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
+                 moe_top_k=2):
+    """Chunked cache advance: x (B, K, E) are K consecutive tokens at
+    positions pos..pos+K-1.  Writes all K K/V rows in one contiguous
+    dynamic_update_slice and attends the K queries against the cache
+    with a per-query position mask (query i sees positions
+    <= pos + i).  The speculative verify step: ONE cache read serves
+    K token positions, which is where the speedup over K sequential
+    decode steps comes from on a cache-read-bound loop.  Dense or
+    int8 caches; GQA via the same grouped layout as _block_decode."""
+    quant = isinstance(k_cache, tuple)
+    kq0 = k_cache[0] if quant else k_cache
+    b, klen, e = x.shape
+    d = e // n_head
+    n_kv = kq0.shape[1]
+    g = n_head // n_kv
+    ctx = kq0.shape[2]
+    h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
+    q = (h @ p["wq"] + p["bq"]).reshape(b, klen, n_kv, g, d) \
+        .transpose(0, 2, 3, 1, 4)                       # (B,kv,g,K,d)
+    k_new = (h @ p["wk"] + p["bk"]).reshape(b, klen, n_kv, d) \
+        .transpose(0, 2, 1, 3)                          # (B,kv,K,d)
+    v_new = (h @ p["wv"] + p["bv"]).reshape(b, klen, n_kv, d) \
+        .transpose(0, 2, 1, 3)
+    if quant:
+        (kqv, ksc), (vqv, vsc) = k_cache, v_cache
+        k8, k8s = _quantize_kv(k_new)
+        v8, v8s = _quantize_kv(v_new)
+        kqv = jax.lax.dynamic_update_slice(kqv, k8, (0, 0, pos, 0))
+        ksc = jax.lax.dynamic_update_slice(ksc, k8s, (0, 0, pos))
+        vqv = jax.lax.dynamic_update_slice(vqv, v8, (0, 0, pos, 0))
+        vsc = jax.lax.dynamic_update_slice(vsc, v8s, (0, 0, pos))
+        k_cache, v_cache = (kqv, ksc), (vqv, vsc)
+        sc = jnp.einsum("bkgqd,bktd->bkgqt", q, kqv.astype(x.dtype))
+        sc = sc * ksc[:, :, None, None, :].astype(sc.dtype) \
+            / math.sqrt(d)
+    else:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k_new,
+                                               (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v_new,
+                                               (0, 0, pos, 0))
+        sc = jnp.einsum("bkgqd,bktd->bkgqt", q, k_cache) \
+            / math.sqrt(d)
+    live = (jnp.arange(ctx)[None, :]
+            <= (pos + jnp.arange(klen))[:, None])       # (K, ctx)
+    sc = jnp.where(live[None, None, None], sc, NEG_INF)
+    p_attn = jax.nn.softmax(sc, axis=-1)
+    if quant:
+        pv = p_attn * vsc[:, :, None, None, :].astype(p_attn.dtype)
+        a = jnp.einsum("bkgqt,bktd->bkgqd", pv, vqv.astype(x.dtype))
+    else:
+        a = jnp.einsum("bkgqt,bktd->bkgqd", p_attn, v_cache)
+    a = a.transpose(0, 3, 1, 2, 4).reshape(b, klen, e)
+    x = x + (a @ p["wo"] + p["bo"])
+    h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
+    x = x + _mlp(h, p, moe_top_k)
+    return x, k_cache, v_cache
+
+
+def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2):
+    """Advance every block by a K-token chunk (x: (B, K, E) embedded
+    inputs at positions pos..pos+K-1).  Returns ((B, K, V) logits,
+    new kc, new vc)."""
+    new_kc, new_vc = [], []
+    for li, p in enumerate(params["blocks"]):
+        x, kl, vl = _block_chunk(x, p, _cache_layer(kc, li),
+                                 _cache_layer(vc, li), pos, n_head,
+                                 eps, moe_top_k=moe_top_k)
+        new_kc.append(kl)
+        new_vc.append(vl)
+    x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
+    return _logits(x, params), _cache_stack(new_kc), _cache_stack(new_vc)
+
+
 def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p,
             min_p=1.0, use_min_p=False, rep_mask=None, rep_penalty=1.0):
     """One token from a (V,) logit row.  ``greedy``/``top_k``/
@@ -992,3 +1066,171 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
     out = [np.concatenate([r, new[i]]).astype(np.int32)
            for i, r in enumerate(rows)]
     return out[0] if single else out
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (greedy draft-and-verify, round 5)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("spec_k", "n_new", "t_static",
+                                   "d_static", "quant_cache"))
+def _speculative_loop(t_params, d_params, ids, prompt_len, spec_k,
+                      n_new, t_static, d_static, quant_cache=False):
+    """Greedy speculative decoding, ONE compiled executable.
+
+    Per chunk: the draft decodes ``spec_k - 1`` tokens sequentially
+    (cheap model, cheap cache), then the target verifies the whole
+    chunk with ONE chunked cache advance (_advance_chunk — one big
+    cache read serves spec_k positions).  The emitted tokens are
+    always the TARGET's greedy choices, so the output is exactly
+    target-greedy whatever the draft proposes; the draft only decides
+    how many positions each target read amortizes over.
+
+    Cache rollback is FREE by design: both caches gate reads on
+    position (live = slot <= pos), and every chunk's contiguous write
+    at the new position overwrites any rows a rejected proposal left
+    behind before they can ever become live again.
+
+    ``t_static``/``d_static``: (n_head, eps, moe_top_k) per model.
+    Returns (out tokens (n_new + spec_k,), n_chunks, n_accepted_draft)
+    — acceptance rate = n_accepted_draft / (n_chunks * (spec_k - 1)).
+    """
+    tn, te, tm = t_static
+    dn, de, dm = d_static
+    t_hidden, t_kc, t_vc = prefill(t_params, ids, tn, te,
+                                   moe_top_k=tm,
+                                   quant_cache=quant_cache)
+    _, d_kc, d_vc = prefill(d_params, ids, dn, de, moe_top_k=dm,
+                            quant_cache=quant_cache)
+    last_h = jax.lax.dynamic_index_in_dim(
+        t_hidden, prompt_len - 1, axis=1, keepdims=False)
+    first = jnp.argmax(
+        _logits(last_h[:, None, :], t_params)[0, 0]).astype(jnp.int32)
+    out = jnp.zeros((n_new + spec_k,), jnp.int32)
+    out = out.at[0].set(first)
+
+    def cond(c):
+        return c[1] < n_new
+
+    def body(c):
+        out, n_emit, pos, last, t_kc, t_vc, d_kc, d_vc, chunks, acc = c
+
+        def dstep(dc, _):
+            d_kc, d_vc, tok, dpos = dc
+            x = (d_params["wte"][tok] + d_params["wpe"][dpos])[None, None]
+            lg, d_kc, d_vc = _advance_one(d_params, x, d_kc, d_vc,
+                                          dpos, dn, de, moe_top_k=dm)
+            nxt = jnp.argmax(lg[0]).astype(jnp.int32)
+            return (d_kc, d_vc, nxt, dpos + 1), nxt
+
+        # spec_k steps, spec_k - 1 proposals: the extra step processes
+        # the LAST proposal as an input so the draft cache always has
+        # a row for position pos + spec_k - 1 — without it, a
+        # full-accept chunk (whose bonus advances past every draft
+        # write) leaves the next chunk's draft reading a stale prefill
+        # row (caught by the self-draft acceptance test: acceptance
+        # was 0.83, not 1.0, on a trained model)
+        (d_kc, d_vc, _, _), props = jax.lax.scan(
+            dstep, (d_kc, d_vc, last, pos), None, length=spec_k)
+        props = props[:-1]
+
+        chunk_toks = jnp.concatenate([last[None], props])   # (spec_k,)
+        xs = (jnp.take(t_params["wte"], chunk_toks, axis=0)
+              + jnp.take(t_params["wpe"],
+                         pos + jnp.arange(spec_k), axis=0))[None]
+        lg, t_kc, t_vc = _advance_chunk(t_params, xs, t_kc, t_vc, pos,
+                                        tn, te, moe_top_k=tm)
+        cands = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)  # c_1..c_k
+        match = props == cands[:-1]
+        # first mismatch index = number of ACCEPTED draft tokens; all
+        # matched -> spec_k - 1 accepted + the bonus candidate
+        a_draft = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((1,), bool)]))
+        a = a_draft + 1                     # tokens emitted this chunk
+        # write the whole candidate block at n_emit; entries beyond
+        # ``a`` are overwritten by the next chunk before they can
+        # count (same argument as the cache rows)
+        out = jax.lax.dynamic_update_slice(out, cands, (n_emit,))
+        last = cands[a_draft]
+        return (out, n_emit + a, pos + a, last, t_kc, t_vc, d_kc,
+                d_vc, chunks + 1, acc + a_draft)
+
+    out, n_emit, pos, last, *_, chunks, acc = jax.lax.while_loop(
+        cond, body,
+        (out, jnp.int32(1), jnp.asarray(prompt_len, jnp.int32), first,
+         t_kc, t_vc, d_kc, d_vc, jnp.int32(0), jnp.int32(0)))
+    return out, chunks, acc
+
+
+def generate_speculative(target, draft, prompt_ids, max_new_tokens=20,
+                         spec_k=4, dtype=None, cache_dtype=None):
+    """Greedy speculative decoding: ``draft`` (a smaller GPT2LMHead)
+    proposes ``spec_k - 1`` tokens per chunk, ``target`` verifies the
+    chunk in one cache read, and every emitted token is the TARGET's
+    greedy choice — the draft only changes the speed.  Matches
+    ``target.generate(prompt, temperature=0)`` token for token up to
+    argmax near-ties: the chunked verify computes the same logits as
+    sequential decode to ~1e-7 (einsum order), so only a model whose
+    top-2 logits tie within that can flip (tested exact on trained
+    models; with ``cache_dtype="int8"`` the comparison point is int8
+    sequential decode).  Returns ``(ids, stats)`` where ids is
+    prompt + continuation and stats carries ``acceptance_rate`` (the
+    fraction of draft proposals the target kept; None when nothing
+    was verified), ``chunks``, and ``tokens_per_chunk``.
+
+    Speedup condition: decode is cache/weight-read-bound, so one
+    verify read amortized over ``a`` accepted positions beats ``a``
+    sequential target steps whenever the draft is cheap and agrees
+    often (acceptance is a property of the MODEL PAIR and data, not
+    of this mechanism).  Single prompt, greedy only; sliding-window
+    models are not supported (the rolling cache's slot arithmetic
+    does not admit the chunked overwrite-rollback trick)."""
+    cfg_t, cfg_d = target.cfg, draft.cfg
+    if cfg_t.vocab_size != cfg_d.vocab_size:
+        raise ValueError(
+            f"target/draft vocab mismatch: {cfg_t.vocab_size} vs "
+            f"{cfg_d.vocab_size}")
+    for name, cfg in (("target", cfg_t), ("draft", cfg_d)):
+        if getattr(cfg, "attn_window", None) is not None:
+            raise NotImplementedError(
+                f"speculative decoding does not support sliding-window "
+                f"models ({name} has attn_window={cfg.attn_window})")
+    if spec_k < 2:
+        raise ValueError(f"spec_k must be >= 2, got {spec_k}")
+    prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+    ctx = min(cfg_t.n_positions, cfg_d.n_positions)
+    # the verify chunk may run up to spec_k - 1 positions past the
+    # last emitted token, so reserve that headroom in the window
+    if len(prompt) + max_new_tokens + spec_k - 1 > ctx:
+        raise ValueError(
+            f"prompt ({len(prompt)}) + max_new_tokens "
+            f"({max_new_tokens}) + spec_k-1 ({spec_k - 1}) exceeds "
+            f"n_positions ({ctx})")
+    if max_new_tokens <= 0:
+        return prompt.copy(), {"acceptance_rate": None, "chunks": 0,
+                               "tokens_per_chunk": None}
+    t_params = extract_params(target, dtype=dtype)
+    d_params = extract_params(draft, dtype=dtype)
+    ids = np.zeros((1, ctx), np.int32)
+    ids[0, :len(prompt)] = prompt
+    out, chunks, acc = _speculative_loop(
+        t_params, d_params, jnp.asarray(ids), len(prompt),
+        int(spec_k), int(max_new_tokens),
+        (cfg_t.n_head, float(cfg_t.layer_norm_eps),
+         int(getattr(cfg_t, "moe_top_k", 2) or 2)),
+        (cfg_d.n_head, float(cfg_d.layer_norm_eps),
+         int(getattr(cfg_d, "moe_top_k", 2) or 2)),
+        quant_cache=_quant_flag(cache_dtype))
+    chunks = int(chunks)
+    acc = int(acc)
+    # chunks == 0 (max_new_tokens == 1: the prefill token was enough)
+    # verified zero proposals — report None, not an arbitrary rate
+    stats = {
+        "acceptance_rate": (acc / (chunks * (spec_k - 1))
+                            if chunks else None),
+        "chunks": chunks,
+        "tokens_per_chunk": ((max_new_tokens - 1) / chunks
+                             if chunks else None),
+    }
+    new = np.asarray(out)[:max_new_tokens]
+    return np.concatenate([prompt, new]).astype(np.int32), stats

@@ -1127,3 +1127,82 @@ def test_model_generate_accepts_prompt_batches():
         np.testing.assert_array_equal(o, single)
     with pytest.raises(ValueError, match="single-prompt"):
         m.generate(prompts, max_new_tokens=5, use_cache=False)
+
+
+def _trained_pair(seed=0, draft_layers=1, steps=15, **cfgkw):
+    """A trained tiny target and a draft trained on the same batches
+    (decisive logits — speculative tests must not ride argmax
+    near-ties, which flip between the chunked and sequential einsum
+    orders at ~1e-7 on random models)."""
+    from singa_tpu import device as device_module
+
+    device_module.get_default_device().SetRandSeed(seed)
+    cfg_t = _cfg(**cfgkw)
+    target = GPT2LMHead(cfg_t)
+    cfg_d = _cfg(n_layer=draft_layers, **cfgkw)
+    draft = GPT2LMHead(cfg_d)
+    ids, labels = _batch(cfg_t)
+    for m in (target, draft):
+        m.set_optimizer(opt.Adam(lr=1e-3))
+        m.compile([tensor.from_numpy(ids)], is_train=True,
+                  use_graph=True)
+        for _ in range(steps):
+            m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        m.eval()
+    return target, draft, ids
+
+
+def test_speculative_decode_matches_target_greedy():
+    """generate_speculative emits EXACTLY target-greedy tokens — the
+    draft only changes speed.  Trained pair: acceptance must be
+    meaningfully positive (both models learned the same loops)."""
+    from singa_tpu.models import gpt2_decode
+
+    target, draft, ids = _trained_pair()
+    p = ids[0, :9]
+    ref = target.generate(p, max_new_tokens=16, temperature=0)
+    spec, stats = gpt2_decode.generate_speculative(
+        target, draft, p, max_new_tokens=16, spec_k=4)
+    np.testing.assert_array_equal(ref, spec)
+    assert stats["chunks"] >= 1
+    assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+
+def test_speculative_self_draft_accepts_everything():
+    """Draft == target on a trained model: every proposal verifies, so
+    acceptance is 1.0 and each chunk emits spec_k tokens (spec_k - 1
+    proposals + the bonus candidate)."""
+    from singa_tpu.models import gpt2_decode
+
+    target, _, ids = _trained_pair()
+    p = ids[0, :9]
+    ref = target.generate(p, max_new_tokens=15, temperature=0)
+    spec, stats = gpt2_decode.generate_speculative(
+        target, target, p, max_new_tokens=15, spec_k=4)
+    np.testing.assert_array_equal(ref, spec)
+    assert stats["acceptance_rate"] == 1.0, stats
+    assert stats["tokens_per_chunk"] >= 3.0, stats
+
+
+def test_speculative_validates_and_composes():
+    from singa_tpu.models import gpt2_decode
+
+    target, draft, ids = _trained_pair()
+    p = ids[0, :9]
+    with pytest.raises(ValueError, match="spec_k"):
+        gpt2_decode.generate_speculative(target, draft, p, spec_k=1)
+    small_vocab = GPT2LMHead(_cfg(vocab_size=128))
+    with pytest.raises(ValueError, match="vocab"):
+        gpt2_decode.generate_speculative(target, small_vocab, p)
+    win = GPT2LMHead(_cfg(attn_window=6, n_positions=64))
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        gpt2_decode.generate_speculative(win, draft, p)
+    with pytest.raises(ValueError, match="exceeds"):
+        gpt2_decode.generate_speculative(
+            target, draft, p, max_new_tokens=10_000)
+    # int8 cache composes; parity still exact on the trained pair
+    ref = target.generate(p, max_new_tokens=10, temperature=0)
+    spec, _ = gpt2_decode.generate_speculative(
+        target, draft, p, max_new_tokens=10, spec_k=3,
+        cache_dtype="int8")
+    np.testing.assert_array_equal(ref, spec)
