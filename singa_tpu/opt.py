@@ -267,6 +267,18 @@ class Adam(Optimizer):
         self.epsilon = float(epsilon)
         self.weight_decay = _as_scheduler(weight_decay)
 
+    def _direction(self, param_name, param, g, t):
+        """Bias-corrected adaptive direction m̂/(√v̂+ε) — shared by the
+        coupled (Adam) and decoupled (AdamW) decay variants so the
+        moment math can never diverge between them."""
+        m = self._state(f"{param_name}:m", param)
+        v = self._state(f"{param_name}:v", param)
+        m.data = self.beta_1 * m.data.astype(jnp.float32) + (1 - self.beta_1) * g
+        v.data = self.beta_2 * v.data.astype(jnp.float32) + (1 - self.beta_2) * g * g
+        m_hat = m.data / (1 - self.beta_1**t)
+        v_hat = v.data / (1 - self.beta_2**t)
+        return m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+
     def apply(self, param_name, param, grad):
         step = self._step_on(param)
         lr = self.lr(step)
@@ -274,14 +286,56 @@ class Adam(Optimizer):
         t = step.astype(jnp.float32) + 1.0
         g = grad.data.astype(jnp.float32)
         p = param.data.astype(jnp.float32)
-        g = g + wd * p
+        g = g + wd * p  # coupled decay rides the gradient
+        self._assign(param, p - lr * self._direction(param_name, param,
+                                                     g, t))
+
+
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter): the
+    decay term subtracts lr·wd·p directly from the parameter instead
+    of riding the gradient through the adaptive denominator (Adam's
+    coupled decay shrinks large-|v| coordinates less — the reason
+    AdamW generalizes better and is the de-facto transformer
+    default).  Beyond the reference's optimizer list (it stops at
+    Adam); same states/scheduler machinery."""
+
+    def apply(self, param_name, param, grad):
+        step = self._step_on(param)
+        lr = self.lr(step)
+        wd = self.weight_decay(step)
+        t = step.astype(jnp.float32) + 1.0
+        g = grad.data.astype(jnp.float32)
+        p = param.data.astype(jnp.float32)
+        self._assign(param, p - lr * (self._direction(param_name, param,
+                                                      g, t) + wd * p))
+
+
+class Lion(Optimizer):
+    """Lion (Chen et al., 2023): sign of an interpolated momentum —
+    ONE state tensor per parameter (vs Adam's two) and every update
+    coordinate has magnitude exactly lr, which makes it robust in
+    low precision (the sign survives bf16 where Adam's v underflows).
+    Decay is decoupled as in AdamW."""
+
+    def __init__(self, lr=1e-4, beta_1=0.9, beta_2=0.99,
+                 weight_decay=0.0):
+        super().__init__(lr)
+        self.beta_1 = float(beta_1)
+        self.beta_2 = float(beta_2)
+        self.weight_decay = _as_scheduler(weight_decay)
+
+    def apply(self, param_name, param, grad):
+        step = self._step_on(param)
+        lr = self.lr(step)
+        wd = self.weight_decay(step)
+        g = grad.data.astype(jnp.float32)
+        p = param.data.astype(jnp.float32)
         m = self._state(f"{param_name}:m", param)
-        v = self._state(f"{param_name}:v", param)
-        m.data = self.beta_1 * m.data.astype(jnp.float32) + (1 - self.beta_1) * g
-        v.data = self.beta_2 * v.data.astype(jnp.float32) + (1 - self.beta_2) * g * g
-        m_hat = m.data / (1 - self.beta_1**t)
-        v_hat = v.data / (1 - self.beta_2**t)
-        self._assign(param, p - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon))
+        mf = m.data.astype(jnp.float32)
+        update = jnp.sign(self.beta_1 * mf + (1 - self.beta_1) * g)
+        self._assign(param, p - lr * (update + wd * p))
+        m.data = self.beta_2 * mf + (1 - self.beta_2) * g
 
 
 # DistOpt lives with the communicator; re-exported here to match the

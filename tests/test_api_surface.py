@@ -91,6 +91,7 @@ def test_opt_api():
     from singa import opt
 
     _has(opt, ["Optimizer", "SGD", "RMSProp", "AdaGrad", "Adam",
+               "AdamW", "Lion",
                "DistOpt", "Constant", "ExponentialDecay", "StepDecay"])
     sig = inspect.signature(opt.SGD.__init__)
     for p in ("lr", "momentum", "nesterov", "weight_decay", "dampening"):

@@ -109,3 +109,73 @@ def test_optimizer_state_roundtrip(dev):
     assert float(sgd2.step_counter.data) == 1.0
     k = [k for k in states if k.endswith(":momentum")][0]
     np.testing.assert_allclose(states[k], [1.0, 1.0])
+
+
+def test_adamw_equals_adam_without_decay_and_decouples_with(dev):
+    """wd=0: AdamW == Adam exactly.  wd>0 with ZERO gradient: AdamW
+    shrinks the parameter by lr·wd·p immediately (decoupled), while
+    Adam's coupled decay routes wd·p through m/v and moves by the
+    bias-corrected sign instead — the two must differ on step 1."""
+    arr = np.array([1.0, -2.0], np.float32)
+    g = _grad(np.array([0.5, -0.25], np.float32), dev)
+    outs = {}
+    for cls in (opt.Adam, opt.AdamW):
+        p = _param(arr.copy(), dev, "p")
+        o = cls(lr=0.01, weight_decay=0.0)
+        o.update(p, g)
+        outs[cls.__name__] = tensor.to_numpy(p)
+    np.testing.assert_allclose(outs["Adam"], outs["AdamW"], rtol=1e-7)
+
+    zero = _grad(np.zeros((2,), np.float32), dev)
+    got = {}
+    for cls in (opt.Adam, opt.AdamW):
+        p = _param(arr.copy(), dev, "p")
+        o = cls(lr=0.01, weight_decay=0.1)
+        o.update(p, zero)
+        got[cls.__name__] = tensor.to_numpy(p)
+    # decoupled: p - lr·wd·p exactly
+    np.testing.assert_allclose(got["AdamW"], arr * (1 - 0.01 * 0.1),
+                               rtol=1e-6)
+    assert not np.allclose(got["Adam"], got["AdamW"])
+
+
+def test_lion_update_is_sign_scaled(dev):
+    """Every Lion update coordinate has magnitude exactly lr (sign of
+    the interpolated momentum); the momentum state updates with
+    beta_2."""
+    arr = np.array([1.0, -2.0, 3.0], np.float32)
+    p = _param(arr.copy(), dev, "p")
+    g = _grad(np.array([0.5, -4.0, 1e-3], np.float32), dev)
+    o = opt.Lion(lr=0.01, beta_1=0.9, beta_2=0.99)
+    o.update(p, g)
+    # step 1: m=0 ⇒ update = sign((1-b1)·g) = sign(g)
+    np.testing.assert_allclose(
+        tensor.to_numpy(p), arr - 0.01 * np.sign([0.5, -4.0, 1e-3]),
+        rtol=1e-6)
+    k = [k for k in o.get_states() if k.endswith(":m")][0]
+    np.testing.assert_allclose(o.get_states()[k],
+                               0.01 * np.asarray([0.5, -4.0, 1e-3]),
+                               rtol=1e-5)
+
+
+def test_adamw_lion_train_a_model(dev):
+    """Both new optimizers drive real training end to end."""
+    from singa_tpu.models.mlp import MLP
+    from singa_tpu import model as model_mod
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    for o in (opt.AdamW(lr=1e-2, weight_decay=0.01),
+              opt.Lion(lr=3e-3, weight_decay=0.01)):
+        dev.SetRandSeed(0)
+        m = MLP(data_size=8, perceptron_size=16, num_classes=2)
+        m.set_optimizer(o)
+        xt = tensor.from_numpy(x, dev)
+        m.compile([xt], is_train=True, use_graph=True)
+        losses = []
+        for _ in range(25):
+            _, loss = m(tensor.from_numpy(x, dev),
+                        tensor.from_numpy(y, dev))
+            losses.append(float(tensor.to_numpy(loss)))
+        assert losses[-1] < losses[0], (type(o).__name__, losses)
