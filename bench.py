@@ -237,6 +237,39 @@ def _chip_tflops(size=4096, k0=200, k1=1200, repeats=5):
     return round(2 * size ** 3 * (k1 - k0) / dt / 1e12, 1)
 
 
+def _device_reachable(timeout_s=120):
+    """Fail fast when the device never answers (observed round 5: the
+    axon tunnel can wedge so hard that even a tiny matmul blocks
+    forever — a bench run would then hang until the driver's outer
+    timeout with no diagnostic).  The probe runs in a daemon thread so
+    a hung backend can't block bench exit.  Returns None when the
+    device answered, else a diagnostic string (hang vs init error are
+    reported distinctly)."""
+    import threading
+
+    ok, err = [], []
+
+    def probe():
+        try:
+            import jax.numpy as jnp
+
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+            ok.append(True)
+        except Exception as e:  # init error ≠ hang: diagnose correctly
+            err.append(f"{type(e).__name__}: {e}")
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if ok:
+        return None
+    if err:
+        return f"device probe raised {err[0]}"
+    return (f"device unreachable: no response to an 8x8 matmul within "
+            f"{timeout_s}s (axon tunnel down?) — rerun when the device "
+            f"answers")
+
+
 def _dispatch_rtt_ms(n=20):
     """Per-session host→device dispatch round-trip (tiny no-op jit +
     scalar readback, median of n).  The axon tunnel makes this vary
@@ -484,6 +517,13 @@ def main():
     bf16 = os.environ.get("BENCH_BF16", "1") != "0"
     skip = set(os.environ.get("BENCH_SKIP", "").split(","))
 
+    probe_err = _device_reachable()
+    if probe_err is not None:
+        print(json.dumps({
+            "metric": "resnet50_train_throughput", "value": 0,
+            "unit": "samples/sec/chip", "vs_baseline": 0,
+            "error": probe_err}))
+        sys.exit(1)
     rtt_ms = _dispatch_rtt_ms()
     try:
         chip_tflops = _chip_tflops()
