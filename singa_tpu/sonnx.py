@@ -14,6 +14,7 @@ ordinary singa_tpu autograd ops, so a prepared model can be wrapped in
 from __future__ import annotations
 
 import contextvars
+import functools
 
 import numpy as np
 import jax
@@ -1410,6 +1411,49 @@ _ONNX_OPS = {
     "Round": _handle_unary(jnp.round),
     "Sin": _handle_unary(jnp.sin),
     "Cos": _handle_unary(jnp.cos),
+    "Tan": _handle_unary(jnp.tan),
+    "Asin": _handle_unary(jnp.arcsin),
+    "Acos": _handle_unary(jnp.arccos),
+    "Atan": _handle_unary(jnp.arctan),
+    "Sinh": _handle_unary(jnp.sinh),
+    "Cosh": _handle_unary(jnp.cosh),
+    "Asinh": _handle_unary(jnp.arcsinh),
+    "Acosh": _handle_unary(jnp.arccosh),
+    "Atanh": _handle_unary(jnp.arctanh),
+    "IsNaN": _handle_unary(jnp.isnan),
+    "IsInf": lambda node, args: _op(
+        lambda x, neg, pos: (jnp.isinf(x)
+                             & ((x > 0) if not neg else
+                                ((x < 0) if not pos else (x == x)))),
+        args[0], _name="IsInf",
+        neg=bool(node.attrs().get("detect_negative", 1)),
+        pos=bool(node.attrs().get("detect_positive", 1))),
+    "ReduceLogSum": _h_reduce(
+        lambda x, axis, keepdims: jnp.log(
+            jnp.sum(x, axis=axis, keepdims=keepdims))),
+    # opset-13 Hardmax: one-hot of the argmax along ``axis`` (default
+    # -1); the opset<13 flatten-at-axis form is not accepted by modern
+    # exporters and is not implemented
+    "Hardmax": lambda node, args: _op(
+        lambda x, axis: jax.nn.one_hot(
+            jnp.argmax(x, axis=axis), x.shape[axis],
+            dtype=x.dtype, axis=axis),
+        args[0], _name="Hardmax", axis=node.attrs().get("axis", -1)),
+    # n-ary elementwise (broadcasting folds pairwise)
+    "Sum": lambda node, args: _op(
+        lambda *xs: functools.reduce(jnp.add, xs), *args, _name="Sum"),
+    "Mean": lambda node, args: _op(
+        lambda *xs: functools.reduce(jnp.add, xs) / len(xs), *args,
+        _name="Mean"),
+    "Size": lambda node, args: _op(
+        lambda x: jnp.asarray(x.size, jnp.int32), args[0],
+        _name="Size"),
+    "EyeLike": lambda node, args: _op(
+        lambda x, k, dt: jnp.eye(
+            x.shape[0], x.shape[1], k=k,
+            dtype=x.dtype if dt is None else onnx_pb.DTYPE_TO_NP[dt]),
+        args[0], _name="EyeLike", k=node.attrs().get("k", 0),
+        dt=node.attrs().get("dtype")),
     "Softsign": _handle_unary(lambda x: x / (1 + jnp.abs(x))),
     "HardSigmoid": lambda node, args: _op(
         lambda x, alpha, beta: jnp.clip(alpha * x + beta, 0.0, 1.0),

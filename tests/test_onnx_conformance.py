@@ -121,6 +121,39 @@ CASES = {
     "Reshape": lambda: ({"x": A}, {}, (_init([3, 2], "shp"),),
                         [A.reshape(3, 2)]),
     "Transpose": lambda: ({"x": A}, {"perm": [1, 0]}, (), [A.T]),
+    "Tan": lambda: ({"x": A}, {}, (), [np.tan(A)]),
+    "Asin": lambda: ({"x": A / 4}, {}, (), [np.arcsin(A / 4)]),
+    "Acos": lambda: ({"x": A / 4}, {}, (), [np.arccos(A / 4)]),
+    "Atan": lambda: ({"x": A}, {}, (), [np.arctan(A)]),
+    "Sinh": lambda: ({"x": A}, {}, (), [np.sinh(A)]),
+    "Cosh": lambda: ({"x": A}, {}, (), [np.cosh(A)]),
+    "Asinh": lambda: ({"x": A}, {}, (), [np.arcsinh(A)]),
+    "Acosh": lambda: ({"x": POS + 1.0}, {}, (),
+                      [np.arccosh(POS + 1.0)]),
+    "Atanh": lambda: ({"x": A / 4}, {}, (), [np.arctanh(A / 4)]),
+    "IsNaN": lambda: ({"x": np.asarray([[0.0, np.nan, 1.0],
+                                        [np.nan, 2.0, 3.0]],
+                                       np.float32)}, {}, (),
+                      [np.asarray([[False, True, False],
+                                   [True, False, False]])]),
+    "IsInf": lambda: ({"x": np.asarray([[np.inf, -np.inf, 1.0],
+                                        [0.0, np.inf, -2.0]],
+                                       np.float32)},
+                      {"detect_negative": 0}, (),
+                      [np.asarray([[True, False, False],
+                                   [False, True, False]])]),
+    "ReduceLogSum": lambda: ({"x": POS}, {"axes": [1], "keepdims": 0},
+                             (), [np.log(POS.sum(1))]),
+    "Hardmax": lambda: ({"x": A}, {"axis": -1}, (),
+                        [np.eye(3, dtype=np.float32)[A.argmax(-1)]]),
+    "Sum": lambda: ({"a": A, "b": B, "c": POS}, {}, (),
+                    [A + B + POS]),
+    "Mean": lambda: ({"a": A, "b": B, "c": POS}, {}, (),
+                     [(A + B + POS) / 3]),
+    "Size": lambda: ({"x": A}, {}, (),
+                     [np.asarray(A.size, np.int32)]),
+    "EyeLike": lambda: ({"x": A}, {"k": 1}, (),
+                        [np.eye(2, 3, k=1, dtype=np.float32)]),
     "Concat": lambda: ({"a": A, "b": B}, {"axis": 1}, (),
                        [np.concatenate([A, B], 1)]),
     "Squeeze": lambda: ({"x": A[None]}, {"axes": [0]}, (), [A]),
