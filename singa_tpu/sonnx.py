@@ -1423,8 +1423,7 @@ _ONNX_OPS = {
     "IsNaN": _handle_unary(jnp.isnan),
     "IsInf": lambda node, args: _op(
         lambda x, neg, pos: (jnp.isinf(x)
-                             & ((x > 0) if not neg else
-                                ((x < 0) if not pos else (x == x)))),
+                             & ((pos & (x > 0)) | (neg & (x < 0)))),
         args[0], _name="IsInf",
         neg=bool(node.attrs().get("detect_negative", 1)),
         pos=bool(node.attrs().get("detect_positive", 1))),

@@ -728,3 +728,21 @@ def test_upsample_linear_asymmetric_coordinates():
     (got,) = _run_node("Upsample", {"x": x}, {"mode": "linear"},
                        initializers=(_init(scales, "scales"),))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_isinf_flag_combinations():
+    """All four detect_negative/positive combinations — notably BOTH
+    zero, which must return all-False (a nested-conditional bug once
+    detected +inf there; caught in review, pinned here)."""
+    x = np.asarray([np.inf, -np.inf, 1.0], np.float32)
+    for neg, pos, want in (
+            (1, 1, [True, True, False]),
+            (0, 1, [True, False, False]),
+            (1, 0, [False, True, False]),
+            (0, 0, [False, False, False])):
+        outs = _run_node("IsInf", {"x": x},
+                         {"detect_negative": neg,
+                          "detect_positive": pos})
+        np.testing.assert_array_equal(
+            np.asarray(tensor.to_numpy(outs[0]), bool), want,
+            err_msg=f"neg={neg} pos={pos}")
