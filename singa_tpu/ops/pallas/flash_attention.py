@@ -80,19 +80,41 @@ def _causal_skip(qi, kj, block_q, block_k):
     return kj * block_k > qi * block_q + (block_q - 1)
 
 
-def _apply_causal(s, qi, kj, block_q, block_k):
+def _band_skip(qi, kj, block_q, block_k, window):
+    """True iff key block kj lies entirely BELOW the sliding-window
+    band of query block qi (last key position < first query position −
+    window + 1) — with causal+window the kernel touches only
+    O(S·window) score tiles instead of O(S²/2)."""
+    return kj * block_k + (block_k - 1) < qi * block_q - (window - 1)
+
+
+def _block_run(qi, kj, block_q, block_k, causal, window):
+    """Grid-level skip predicate shared by all four kernels."""
+    run = True
+    if causal:
+        run = jnp.logical_not(_causal_skip(qi, kj, block_q, block_k))
+        if window is not None:
+            run = run & jnp.logical_not(
+                _band_skip(qi, kj, block_q, block_k, window))
+    return run
+
+
+def _apply_causal(s, qi, kj, block_q, block_k, window=None):
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    keep = q_pos >= k_pos
+    if window is not None:  # band: query i sees keys [i-window+1, i]
+        keep = keep & (q_pos - k_pos < window)
+    return jnp.where(keep, s, NEG_INF)
 
 
 # ---------------------------------------------------------------- forward
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, *rest, scale, causal,
-                block_q, block_k, has_qmask):
+                block_q, block_k, has_qmask, window=None):
     if has_qmask:
         qmask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -107,9 +129,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, *rest, scale, causal,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    run = True
-    if causal:
-        run = jnp.logical_not(_causal_skip(qi, kj, block_q, block_k))
+    run = _block_run(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
@@ -123,7 +143,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, *rest, scale, causal,
         if has_qmask:
             s = s + qmask_ref[0].astype(jnp.float32)
         if causal:
-            s = _apply_causal(s, qi, kj, block_q, block_k)
+            s = _apply_causal(s, qi, kj, block_q, block_k,
+                              window=window)
         m_prev = m_ref[:, 0]
         l_prev = l_ref[:, 0]
         m_cur = jnp.max(s, axis=-1)
@@ -160,7 +181,7 @@ def _qmask_specs(qdiv, qmod, block_q, block_k, swap=False):
 
 
 def _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal, block_q,
-                      block_k, qmap):
+                      block_k, qmap, window=None):
     """q,k,v: (BH, S, D); mask: (BH, S) additive key mask; qmask:
     optional (M, S, S) additive general mask addressed by qmap =
     (qdiv, qmod) (see _qmask_specs).  Returns (o, lse) with lse:
@@ -169,7 +190,8 @@ def _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal, block_q,
     grid = (bh, s // block_q, s // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               has_qmask=qmask is not None)
+                               has_qmask=qmask is not None,
+                               window=window)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -205,7 +227,7 @@ def _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal, block_q,
 
 
 def _recompute_p(q, k, mask_row, qmask_tile, lse_row, qi, kj, scale,
-                 causal, block_q, block_k):
+                 causal, block_q, block_k, window=None):
     """Recompute the (block_q, block_k) probability tile from saved
     logsumexp: p = exp(s·scale + mask − lse)."""
     s = jax.lax.dot_general(
@@ -215,12 +237,13 @@ def _recompute_p(q, k, mask_row, qmask_tile, lse_row, qi, kj, scale,
     if qmask_tile is not None:
         s = s + qmask_tile.astype(jnp.float32)
     if causal:
-        s = _apply_causal(s, qi, kj, block_q, block_k)
+        s = _apply_causal(s, qi, kj, block_q, block_k, window=window)
     return jnp.exp(s - lse_row[:, None])
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
-               *rest, scale, causal, block_q, block_k, has_qmask):
+               *rest, scale, causal, block_q, block_k, has_qmask,
+               window=None):
     if has_qmask:
         qmask_ref, dq_ref, dq_acc = rest
     else:
@@ -233,9 +256,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = True
-    if causal:
-        run = jnp.logical_not(_causal_skip(qi, kj, block_q, block_k))
+    run = _block_run(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
@@ -244,7 +265,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
         p = _recompute_p(q, k, mask_ref[0, 0],
                          None if qmask_ref is None else qmask_ref[0],
                          lse_ref[0, 0], qi, kj,
-                         scale, causal, block_q, block_k)
+                         scale, causal, block_q, block_k,
+                         window=window)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -259,7 +281,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref, lse_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
                 lse_ref, *rest, scale, causal, block_q, block_k,
-                has_qmask):
+                has_qmask, window=None):
     if has_qmask:
         qmask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
@@ -273,9 +295,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = True
-    if causal:
-        run = jnp.logical_not(_causal_skip(qi, kj, block_q, block_k))
+    run = _block_run(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
@@ -284,7 +304,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
         p = _recompute_p(q, k, mask_ref[0, 0],
                          None if qmask_ref is None else qmask_ref[0],
                          lse_ref[0, 0], qi, kj,
-                         scale, causal, block_q, block_k)
+                         scale, causal, block_q, block_k,
+                         window=window)
         # dv += pᵀ·dO  — contract the query dim without materializing pᵀ
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -304,7 +325,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, mask, qmask, o, lse, do, scale, causal,
-                      block_q, block_k, qmap, dlse=None):
+                      block_q, block_k, qmap, dlse=None, window=None):
     bh, s, d = q.shape
     # δ = rowsum(dO ∘ O): one O(S·D) pass, shared by both kernels.
     # A direct cotangent on the logsumexp output enters the softmax
@@ -319,7 +340,7 @@ def _flash_bwd_pallas(q, k, v, mask, qmask, o, lse, do, scale, causal,
 
     dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
                                   block_q=block_q, block_k=block_k,
-                                  has_qmask=has_qmask)
+                                  has_qmask=has_qmask, window=window)
     dq_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -345,7 +366,8 @@ def _flash_bwd_pallas(q, k, v, mask, qmask, o, lse, do, scale, causal,
 
     dkv_kernel = functools.partial(_dkv_kernel, scale=scale,
                                    causal=causal, block_q=block_q,
-                                   block_k=block_k, has_qmask=has_qmask)
+                                   block_k=block_k, has_qmask=has_qmask,
+                                   window=window)
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -381,44 +403,46 @@ def _flash_bwd_pallas(q, k, v, mask, qmask, o, lse, do, scale, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_core(q, k, v, mask, qmask, scale, causal, block_q, block_k,
-                qmap):
+                qmap, window=None):
     """Differentiable (o, lse) pair — lse carries a real cotangent
     (ring attention's partial merge differentiates through it)."""
     return _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal,
-                             block_q, block_k, qmap)
+                             block_q, block_k, qmap, window=window)
 
 
 def _flash_core_fwd(q, k, v, mask, qmask, scale, causal, block_q,
-                    block_k, qmap):
+                    block_k, qmap, window=None):
     o, lse = _flash_fwd_pallas(q, k, v, mask, qmask, scale, causal,
-                               block_q, block_k, qmap)
+                               block_q, block_k, qmap, window=window)
     return (o, lse), (q, k, v, mask, qmask, o, lse)
 
 
-def _flash_core_bwd(scale, causal, block_q, block_k, qmap, res, cts):
+def _flash_core_bwd(scale, causal, block_q, block_k, qmap, window,
+                    res, cts):
     q, k, v, mask, qmask, o, lse = res
     do, dlse = cts
     dq, dk, dv = _flash_bwd_pallas(q, k, v, mask, qmask, o, lse, do,
                                    scale, causal, block_q, block_k,
-                                   qmap, dlse=dlse)
+                                   qmap, dlse=dlse, window=window)
     return dq, dk, dv, None, None
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def _flash(q, k, v, mask, qmask, scale, causal, block_q, block_k, qmap):
+def _flash(q, k, v, mask, qmask, scale, causal, block_q, block_k,
+           qmap, window=None):
     # o-only view: indexing the custom_vjp pair feeds dlse = 0
     return _flash_core(q, k, v, mask, qmask, scale, causal, block_q,
-                       block_k, qmap)[0]
+                       block_k, qmap, window)[0]
 
 
 # ------------------------------------------------- non-kernel reference
 
 
-def _blockwise_reference(q, k, v, mask, causal, block_k):
+def _blockwise_reference(q, k, v, mask, causal, block_k, window=None):
     """Numerically identical online-softmax attention built from a
     lax.scan over key blocks — kept as the ``force_reference`` oracle the
     kernel tests compare against.  NOTE its VJP reverses the scan by
@@ -440,7 +464,10 @@ def _blockwise_reference(q, k, v, mask, causal, block_k):
         sc = jnp.einsum("bqd,bkd->bqk", qs, kb) + mb[:, None, :]
         if causal:
             k_pos = kb_idx * block_k + jnp.arange(block_k)[None, None, :]
-            sc = jnp.where(q_pos >= k_pos, sc, NEG_INF)
+            keep = q_pos >= k_pos
+            if window is not None:
+                keep = keep & (q_pos - k_pos < window)
+            sc = jnp.where(keep, sc, NEG_INF)
         m_cur = jnp.max(sc, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(sc - m_new[..., None])
@@ -457,7 +484,7 @@ def _blockwise_reference(q, k, v, mask, causal, block_k):
     return (acc / l[..., None]).astype(q.dtype)
 
 
-def _fused_reference(q, k, v, mask, causal):
+def _fused_reference(q, k, v, mask, causal, window=None):
     """Plain softmax(QKᵀ)V with the full (broadcast) mask, f32 compute —
     the ``force_reference`` oracle for general-mask shapes."""
     b, h, s, d = q.shape
@@ -468,6 +495,10 @@ def _fused_reference(q, k, v, mask, causal):
         sc = sc + mask.astype(jnp.float32)
     if causal:
         cm = jnp.tril(jnp.ones((s, s), bool))
+        if window is not None:
+            i = jnp.arange(s)[:, None]
+            j = jnp.arange(s)[None, :]
+            cm = cm & (i - j < window)
         sc = jnp.where(cm[None, None], sc, NEG_INF)
     p = jax.nn.softmax(sc, axis=-1)
     return jnp.einsum("bhst,bhtd->bhsd", p,
@@ -575,19 +606,26 @@ def _prep_kernel(q, k, v, mask, block_q, block_k):
 
 def flash_attention(q, k, v, mask=None, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    force_reference=False):
+                    force_reference=False, window=None):
     """q,k,v: (B, H, S, D) raw jax arrays; mask: additive, broadcastable
     to (B, H, S, S) — key masks (B, 1, 1, S) take the cheap row layout,
     anything else streams as (block_q, block_k) tiles.  Any S and D are
     accepted (padded to kernel-legal shapes internally).  Returns
     (B, H, S, D)."""
     b, h, s, d = q.shape
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window requires causal=True and window >= 1 "
+            f"(got causal={causal}, window={window}) — window<1 would "
+            f"mask every in-band score to the finite NEG_INF floor and "
+            f"silently return uniform attention")
     prep = None if force_reference else _prep_kernel(
         q, k, v, mask, block_q, block_k)
     if prep is None:
         mf = _key_mask_flat(mask, b, h, s)
         if mask is not None and mf is None:
-            return _fused_reference(q, k, v, mask, causal)
+            return _fused_reference(q, k, v, mask, causal,
+                                    window=window)
         bk = _fit_block(block_k, s)
         if bk == 0:
             bk = s
@@ -595,10 +633,12 @@ def flash_attention(q, k, v, mask=None, causal=False,
         if mf is None:
             mf = jnp.zeros((bh, s), q.dtype)
         o = _blockwise_reference(q.reshape(bh, s, d), k.reshape(bh, s, d),
-                                 v.reshape(bh, s, d), mf, causal, bk)
+                                 v.reshape(bh, s, d), mf, causal, bk,
+                                 window=window)
         return o.reshape(b, h, s, d)
     qf, kf, vf, mf, qmask, qmap, scale, bq, bk = prep
-    o = _flash(qf, kf, vf, mf, qmask, scale, causal, bq, bk, qmap)
+    o = _flash(qf, kf, vf, mf, qmask, scale, causal, bq, bk, qmap,
+               window)
     return o[:, :s, :d].reshape(b, h, s, d)
 
 
@@ -640,7 +680,8 @@ def flash_attention_lse(q, k, v, mask=None, causal=False,
     return o.astype(q.dtype), (m + jnp.log(l_safe))[..., 0]
 
 
-def flash_attention_op(q, k, v, mask=None, causal=False, remat=False):
+def flash_attention_op(q, k, v, mask=None, causal=False, remat=False,
+                       window=None):
     """Tensor-level autograd op (used by ops/attention.py and the
     tensor_parallel flash path).
 
@@ -659,10 +700,12 @@ def flash_attention_op(q, k, v, mask=None, causal=False, remat=False):
     scale = 1.0 / math.sqrt(q.shape[-1])
     if mask is None:
         return _op(
-            lambda qv, kv, vv, scale, causal: flash_attention(
-                qv, kv, vv, causal=causal),
-            q, k, v, _name="TPAttention", scale=scale, causal=causal)
+            lambda qv, kv, vv, scale, causal, window: flash_attention(
+                qv, kv, vv, causal=causal, window=window),
+            q, k, v, _name="TPAttention", scale=scale, causal=causal,
+            window=window)
     return _op(
-        lambda qv, kv, vv, mv, scale, causal: flash_attention(
-            qv, kv, vv, mv, causal=causal),
-        q, k, v, mask, _name="TPAttention", scale=scale, causal=causal)
+        lambda qv, kv, vv, mv, scale, causal, window: flash_attention(
+            qv, kv, vv, mv, causal=causal, window=window),
+        q, k, v, mask, _name="TPAttention", scale=scale, causal=causal,
+        window=window)

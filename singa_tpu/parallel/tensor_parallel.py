@@ -280,17 +280,6 @@ class ParallelMHA(Layer):
                     "for pallas_call outside shard_map); using the "
                     "fused head-sharded path — shard the seq axis to "
                     "get ring attention with per-shard flash kernels")
-            if use_flash and self.window is not None:
-                # the Pallas kernel has no band support; the fused
-                # path builds the band in-kernel at the same O(S²)
-                # score cost the kernel would pay for these shapes
-                if not getattr(self, "_warned_window_flash", False):
-                    self._warned_window_flash = True
-                    logging.getLogger("singa_tpu").warning(
-                        "ParallelMHA: use_flash ignored with "
-                        "window=%d (no band support in the flash "
-                        "kernel); using the fused path", self.window)
-                use_flash = False
             ctx = _sdpa(q, k, v, mask, self.causal, remat=self.remat,
                         use_flash=use_flash, window=self.window)
         ctx = autograd.transpose(ctx, (0, 2, 1, 3))
@@ -379,15 +368,10 @@ def _sdpa(q, k, v, mask, causal, remat=False, use_flash=False,
     HBM).  The matching decode side keeps an O(window) rolling KV
     cache (models/gpt2_decode.py)."""
     if use_flash:
-        if window is not None:
-            raise NotImplementedError(
-                "the flash kernel has no band support; call _sdpa "
-                "with use_flash=False for windowed attention "
-                "(ParallelMHA falls back automatically)")
         from ..ops.pallas.flash_attention import flash_attention_op
 
         return flash_attention_op(q, k, v, mask, causal=causal,
-                                  remat=remat)
+                                  remat=remat, window=window)
     scale = 1.0 / math.sqrt(q.shape[-1])
 
     def f(qv, kv, vv, *rest, scale, causal, window):
