@@ -1206,3 +1206,14 @@ def test_speculative_validates_and_composes():
         target, draft, p, max_new_tokens=10, spec_k=3,
         cache_dtype="int8")
     np.testing.assert_array_equal(ref, spec)
+    # MoE target: _block_chunk routes through the same capacity-free
+    # expert MLP as single-token decode — parity must hold with a
+    # dense draft
+    moe_t, _, moe_ids = _trained_pair(
+        seed=2, moe_every=2, moe_experts=4,
+        moe_capacity_factor=4.0)
+    pm = moe_ids[0, :9]
+    ref_m = moe_t.generate(pm, max_new_tokens=10, temperature=0)
+    spec_m, _ = gpt2_decode.generate_speculative(
+        moe_t, draft, pm, max_new_tokens=10, spec_k=3)
+    np.testing.assert_array_equal(ref_m, spec_m)
