@@ -1072,11 +1072,9 @@ def generate(m, prompt_ids, max_new_tokens=20, temperature=1.0, rng=None,
 # speculative decoding (greedy draft-and-verify, round 5)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("spec_k", "n_new", "t_static",
-                                   "d_static", "quant_cache"))
-def _speculative_loop(t_params, d_params, ids, prompt_len, spec_k,
-                      n_new, t_static, d_static, quant_cache=False):
-    """Greedy speculative decoding, ONE compiled executable.
+def _spec_row(t_params, d_params, ids, prompt_len, spec_k,
+              n_new, t_static, d_static, quant_cache=False):
+    """Greedy speculative decoding ROW CORE (ids: (1, ctx)).
 
     Per chunk: the draft decodes ``spec_k - 1`` tokens sequentially
     (cheap model, cheap cache), then the target verifies the whole
@@ -1162,6 +1160,41 @@ def _speculative_loop(t_params, d_params, ids, prompt_len, spec_k,
     return out, chunks, acc
 
 
+@partial(jax.jit, static_argnames=("spec_k", "n_new", "t_static",
+                                   "d_static", "quant_cache"))
+def _speculative_loop(t_params, d_params, ids, prompt_lens, spec_k,
+                      n_new, t_static, d_static, quant_cache=False):
+    """Batched speculative decoding: vmap of the row core over (B, ctx)
+    right-padded prompts with per-row lengths.  Rows accept at
+    different rates, so each runs its own chunk loop — JAX's
+    while_loop batching executes until every row has emitted n_new
+    tokens, freezing finished rows' carries (their discarded body
+    re-executions index past their window; jax gathers clip, and the
+    headroom check in generate_speculative keeps live rows in
+    bounds).  Per-row caches mean per-row scatters, like the ragged
+    scatter oracle — speculation is a latency device for SMALL
+    batches, which is exactly where that cost is irrelevant.
+    Returns ((B, n_new + spec_k) tokens, (B,) chunks, (B,)
+    accepted).
+
+    B == 1 (the primary latency case) dispatches the UNBATCHED row
+    core: the batched while_loop rule rewrites every chunk as
+    carry = select(done, carry, body(carry)) over the full K/V cache
+    carries, an elementwise cache copy per chunk that a single prompt
+    need not pay."""
+    if ids.shape[0] == 1:
+        out, chunks, acc = _spec_row(
+            t_params, d_params, ids, prompt_lens[0], spec_k, n_new,
+            t_static, d_static, quant_cache=quant_cache)
+        return (out[None], jnp.asarray(chunks)[None],
+                jnp.asarray(acc)[None])
+    return jax.vmap(
+        lambda row, n: _spec_row(t_params, d_params, row[None, :], n,
+                                 spec_k, n_new, t_static, d_static,
+                                 quant_cache=quant_cache))(
+                                     ids, prompt_lens)
+
+
 def generate_speculative(target, draft, prompt_ids, max_new_tokens=20,
                          spec_k=4, dtype=None, cache_dtype=None):
     """Greedy speculative decoding: ``draft`` (a smaller GPT2LMHead)
@@ -1182,9 +1215,14 @@ def generate_speculative(target, draft, prompt_ids, max_new_tokens=20,
     verify read amortized over ``a`` accepted positions beats ``a``
     sequential target steps whenever the draft is cheap and agrees
     often (acceptance is a property of the MODEL PAIR and data, not
-    of this mechanism).  Single prompt, greedy only; sliding-window
-    models are not supported (the rolling cache's slot arithmetic
-    does not admit the chunked overwrite-rollback trick)."""
+    of this mechanism).  Takes one 1-D prompt (returns one array) or
+    a list/2-D batch, possibly ragged (returns a list): rows accept
+    at different rates, so each runs its own vmapped chunk loop
+    until every row finishes — per-row cache scatters like the
+    ragged oracle path, which is irrelevant at the small batches
+    speculation targets.  Greedy only; sliding-window models are not
+    supported (the rolling cache's slot arithmetic does not admit
+    the chunked overwrite-rollback trick)."""
     cfg_t, cfg_d = target.cfg, draft.cfg
     if cfg_t.vocab_size != cfg_d.vocab_size:
         raise ValueError(
@@ -1197,40 +1235,54 @@ def generate_speculative(target, draft, prompt_ids, max_new_tokens=20,
                 f"models ({name} has attn_window={cfg.attn_window})")
     if spec_k < 2:
         raise ValueError(f"spec_k must be >= 2, got {spec_k}")
-    prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+    single = not _is_batch(prompt_ids)
+    rows = ([prompt_ids] if single else list(prompt_ids))
+    rows = [np.asarray(r, np.int32).reshape(-1) for r in rows]
     ctx = min(cfg_t.n_positions, cfg_d.n_positions)
     # the verify chunk may run up to spec_k - 1 positions past the
     # last emitted token, so reserve that headroom in the window
-    if len(prompt) + max_new_tokens + spec_k - 1 > ctx:
-        raise ValueError(
-            f"prompt ({len(prompt)}) + max_new_tokens "
-            f"({max_new_tokens}) + spec_k-1 ({spec_k - 1}) exceeds "
-            f"n_positions ({ctx})")
+    for r in rows:
+        if len(r) + max_new_tokens + spec_k - 1 > ctx:
+            raise ValueError(
+                f"prompt ({len(r)}) + max_new_tokens "
+                f"({max_new_tokens}) + spec_k-1 ({spec_k - 1}) exceeds "
+                f"n_positions ({ctx})")
     if max_new_tokens <= 0:
-        return prompt.copy(), {"acceptance_rate": None, "chunks": 0,
-                               "tokens_per_chunk": None}
+        outs = [r.copy() for r in rows]
+        stats = {"acceptance_rate": None, "chunks": 0,
+                 "tokens_per_chunk": None,
+                 "per_row_chunks": [0] * len(rows)}
+        return (outs[0] if single else outs), stats
     t_params = extract_params(target, dtype=dtype)
     d_params = extract_params(draft, dtype=dtype)
-    ids = np.zeros((1, ctx), np.int32)
-    ids[0, :len(prompt)] = prompt
+    bsz = len(rows)
+    ids = np.zeros((bsz, ctx), np.int32)
+    for i, r in enumerate(rows):
+        ids[i, :len(r)] = r
+    lens = jnp.asarray([len(r) for r in rows], jnp.int32)
     out, chunks, acc = _speculative_loop(
-        t_params, d_params, jnp.asarray(ids), len(prompt),
+        t_params, d_params, jnp.asarray(ids), lens,
         int(spec_k), int(max_new_tokens),
         (cfg_t.n_head, float(cfg_t.layer_norm_eps),
          int(getattr(cfg_t, "moe_top_k", 2) or 2)),
         (cfg_d.n_head, float(cfg_d.layer_norm_eps),
          int(getattr(cfg_d, "moe_top_k", 2) or 2)),
         quant_cache=_quant_flag(cache_dtype))
-    chunks = int(chunks)
-    acc = int(acc)
+    out = np.asarray(out)
+    chunks = np.asarray(chunks)
+    acc = np.asarray(acc)
+    total_chunks = int(chunks.sum())
     # chunks == 0 (max_new_tokens == 1: the prefill token was enough)
     # verified zero proposals — report None, not an arbitrary rate
     stats = {
-        "acceptance_rate": (acc / (chunks * (spec_k - 1))
-                            if chunks else None),
-        "chunks": chunks,
-        "tokens_per_chunk": ((max_new_tokens - 1) / chunks
-                             if chunks else None),
+        "acceptance_rate": (float(acc.sum())
+                            / (total_chunks * (spec_k - 1))
+                            if total_chunks else None),
+        "chunks": total_chunks,
+        "tokens_per_chunk": (bsz * (max_new_tokens - 1) / total_chunks
+                             if total_chunks else None),
+        "per_row_chunks": chunks.tolist(),
     }
-    new = np.asarray(out)[:max_new_tokens]
-    return np.concatenate([prompt, new]).astype(np.int32), stats
+    outs = [np.concatenate([r, out[i, :max_new_tokens]]).astype(np.int32)
+            for i, r in enumerate(rows)]
+    return (outs[0] if single else outs), stats

@@ -1217,3 +1217,25 @@ def test_speculative_validates_and_composes():
     spec_m, _ = gpt2_decode.generate_speculative(
         moe_t, draft, pm, max_new_tokens=10, spec_k=3)
     np.testing.assert_array_equal(ref_m, spec_m)
+
+
+def test_speculative_batched_matches_per_row():
+    """A ragged prompt BATCH through speculative decoding: every row
+    equals its single-prompt run (greedy determinism), and the
+    aggregate stats cover all rows."""
+    from singa_tpu.models import gpt2_decode
+
+    target, draft, ids = _trained_pair()
+    prompts = [ids[0, :9], ids[1, :5], ids[2, :12]]
+    outs, stats = gpt2_decode.generate_speculative(
+        target, draft, prompts, max_new_tokens=12, spec_k=3)
+    assert len(outs) == 3
+    assert len(stats["per_row_chunks"]) == 3
+    assert stats["chunks"] == sum(stats["per_row_chunks"])
+    for row, p in zip(outs, prompts):
+        single, _ = gpt2_decode.generate_speculative(
+            target, draft, p, max_new_tokens=12, spec_k=3)
+        np.testing.assert_array_equal(row, single)
+        # and still exactly target-greedy
+        ref = target.generate(p, max_new_tokens=12, temperature=0)
+        np.testing.assert_array_equal(row, ref)
