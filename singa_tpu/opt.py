@@ -85,9 +85,20 @@ class Optimizer:
     consume the ``autograd.backward`` generator; ``step()`` advances the
     schedule."""
 
-    def __init__(self, lr, dtype=tensor.float32):
+    def __init__(self, lr, dtype=tensor.float32, clip_norm=None):
         self.lr = _as_scheduler(lr)
         self.dtype = dtype
+        # global-norm gradient clipping (the transformer standard):
+        # grads are scaled by min(1, clip_norm/||g||_global) BEFORE the
+        # update rule.  Requires materializing the whole gradient set
+        # per step (the norm is global), so backward_and_update
+        # two-passes when it is set and streams otherwise.  NOTE:
+        # DistOpt's sync modes drive the wrapped optimizer through
+        # apply() directly and do NOT clip — clipping synced gradients
+        # would need the clip between sync and apply.
+        if clip_norm is not None and clip_norm <= 0:
+            raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
+        self.clip_norm = None if clip_norm is None else float(clip_norm)
         # step counter is a Tensor so lr schedules stay correct inside a
         # compiled graph-mode step
         self.step_counter = Tensor(shape=(), dtype=tensor.float32,
@@ -150,18 +161,39 @@ class Optimizer:
                 # later math follows the param's placement
                 self._states[k] = tensor.from_numpy(np.asarray(v))
 
+    # -- gradient clipping -------------------------------------------------
+    def _clip_pairs(self, pairs):
+        """Scale every grad by min(1, clip_norm/||g||_global).  The
+        tiny-eps guard keeps a zero-gradient step finite."""
+        sq = sum(jnp.sum(jnp.square(g.data.astype(jnp.float32)))
+                 for _, g in pairs)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+        for _, g in pairs:
+            g.data = (g.data.astype(jnp.float32)
+                      * scale).astype(g.data.dtype)
+        return pairs
+
     # -- the reference API -------------------------------------------------
     def __call__(self, loss):
         self.backward_and_update(loss)
 
+    def _grad_pairs(self, loss):
+        """The (param, grad) stream: the raw generator when unclipped
+        (apply interleaves with backward as grads finalize), or the
+        materialized-and-clipped list when clip_norm is set."""
+        if self.clip_norm is None:
+            return autograd.backward(loss)
+        return self._clip_pairs(list(autograd.backward(loss)))
+
     def backward_and_update(self, loss):
-        for p, g in autograd.backward(loss):
+        for p, g in self._grad_pairs(loss):
             self.apply(self._param_name(p), p, g)
         self.step()
 
     def call_with_returns(self, loss):
         pn_p_g = []
-        for p, g in autograd.backward(loss):
+        for p, g in self._grad_pairs(loss):
             self.apply(self._param_name(p), p, g)
             pn_p_g.append((self._param_name(p), p, g))
         self.step()
@@ -189,8 +221,8 @@ class SGD(Optimizer):
     """Reference opt.SGD: momentum, dampening, nesterov, weight decay."""
 
     def __init__(self, lr=0.1, momentum=0.0, dampening=0.0, weight_decay=0.0,
-                 nesterov=False, dtype=tensor.float32):
-        super().__init__(lr, dtype)
+                 nesterov=False, dtype=tensor.float32, clip_norm=None):
+        super().__init__(lr, dtype, clip_norm=clip_norm)
         self.momentum = _as_scheduler(momentum)
         self.dampening = _as_scheduler(dampening)
         self.weight_decay = _as_scheduler(weight_decay)
@@ -220,8 +252,9 @@ class SGD(Optimizer):
 class RMSProp(Optimizer):
     """Reference opt.RMSProp: running mean of squared grads."""
 
-    def __init__(self, lr=0.1, rho=0.9, epsilon=1e-8, weight_decay=0.0):
-        super().__init__(lr)
+    def __init__(self, lr=0.1, rho=0.9, epsilon=1e-8, weight_decay=0.0,
+                 clip_norm=None):
+        super().__init__(lr, clip_norm=clip_norm)
         self.rho = float(rho)
         self.epsilon = float(epsilon)
         self.weight_decay = _as_scheduler(weight_decay)
@@ -239,8 +272,9 @@ class RMSProp(Optimizer):
 
 
 class AdaGrad(Optimizer):
-    def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0):
-        super().__init__(lr)
+    def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0,
+                 clip_norm=None):
+        super().__init__(lr, clip_norm=clip_norm)
         self.epsilon = float(epsilon)
         self.weight_decay = _as_scheduler(weight_decay)
 
@@ -260,8 +294,8 @@ class Adam(Optimizer):
     """Reference opt.Adam with bias correction."""
 
     def __init__(self, lr=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
-                 weight_decay=0.0):
-        super().__init__(lr)
+                 weight_decay=0.0, clip_norm=None):
+        super().__init__(lr, clip_norm=clip_norm)
         self.beta_1 = float(beta_1)
         self.beta_2 = float(beta_2)
         self.epsilon = float(epsilon)
@@ -319,8 +353,8 @@ class Lion(Optimizer):
     Decay is decoupled as in AdamW."""
 
     def __init__(self, lr=1e-4, beta_1=0.9, beta_2=0.99,
-                 weight_decay=0.0):
-        super().__init__(lr)
+                 weight_decay=0.0, clip_norm=None):
+        super().__init__(lr, clip_norm=clip_norm)
         self.beta_1 = float(beta_1)
         self.beta_2 = float(beta_2)
         self.weight_decay = _as_scheduler(weight_decay)

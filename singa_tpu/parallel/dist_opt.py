@@ -47,6 +47,16 @@ class DistOpt:
 
     def __init__(self, opt, mesh=None, axis_name="data", num_devices=None,
                  communicator=None, **unused_reference_args):
+        if getattr(opt, "clip_norm", None) is not None:
+            # every sync mode drives the wrapped optimizer through
+            # apply() AFTER the gradient sync, bypassing
+            # Optimizer.backward_and_update where global-norm clipping
+            # lives — silently un-clipped distributed training would
+            # diverge from the single-device run the user tuned
+            raise ValueError(
+                "clip_norm is not supported under DistOpt (the sync "
+                "modes bypass the clipping pass); construct the "
+                "wrapped optimizer without clip_norm")
         self.opt = opt
         self.communicator = communicator if communicator is not None else \
             Communicator(mesh=mesh, axis_name=axis_name,

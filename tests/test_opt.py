@@ -179,3 +179,61 @@ def test_adamw_lion_train_a_model(dev):
                         tensor.from_numpy(y, dev))
             losses.append(float(tensor.to_numpy(loss)))
         assert losses[-1] < losses[0], (type(o).__name__, losses)
+
+
+def test_clip_norm_scales_to_the_ball(dev):
+    """||g||_global > clip_norm ⇒ the applied update equals SGD on
+    g·(clip_norm/||g||); under the norm ⇒ untouched."""
+    import singa_tpu.autograd as ag
+
+    g1 = np.array([3.0, 0.0], np.float32)
+    g2 = np.array([0.0, 4.0], np.float32)  # global norm 5
+
+    def run(clip):
+        ag.set_training(True)
+        try:
+            p1 = _param(np.zeros(2, np.float32), dev, "p1")
+            p2 = _param(np.zeros(2, np.float32), dev, "p2")
+            y = ag.add(ag.mul(p1, _grad(g1, dev)),
+                       ag.mul(p2, _grad(g2, dev)))
+            loss = ag.reduce_sum(y)
+            o = opt.SGD(lr=1.0, clip_norm=clip)
+            o.backward_and_update(loss)
+            return tensor.to_numpy(p1), tensor.to_numpy(p2)
+        finally:
+            ag.set_training(False)
+
+    a1, a2 = run(clip=2.5)         # norm 5 -> scale 0.5
+    np.testing.assert_allclose(a1, -g1 * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(a2, -g2 * 0.5, rtol=1e-6)
+    b1, b2 = run(clip=100.0)       # under the ball -> untouched
+    np.testing.assert_allclose(b1, -g1, rtol=1e-6)
+    np.testing.assert_allclose(b2, -g2, rtol=1e-6)
+    with pytest.raises(ValueError):
+        opt.Adam(clip_norm=0.0)
+
+
+def test_clip_norm_trains_in_graph_mode(dev):
+    """clip_norm works inside the jitted graph-mode step (the clip is
+    pure jnp, so it traces into the step executable)."""
+    from singa_tpu.models.mlp import MLP
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    dev.SetRandSeed(0)
+    m = MLP(data_size=8, perceptron_size=16, num_classes=2)
+    m.set_optimizer(opt.AdamW(lr=1e-2, clip_norm=1.0))
+    m.compile([tensor.from_numpy(x, dev)], is_train=True, use_graph=True)
+    ls = []
+    for _ in range(25):
+        _, loss = m(tensor.from_numpy(x, dev), tensor.from_numpy(y, dev))
+        ls.append(float(tensor.to_numpy(loss)))
+    assert ls[-1] < ls[0], ls
+
+
+def test_distopt_refuses_clipped_inner_optimizer(dev):
+    """DistOpt's sync modes bypass the clipping pass; a clipped inner
+    optimizer must be refused, not silently un-clipped."""
+    with pytest.raises(ValueError, match="clip_norm"):
+        opt.DistOpt(opt.SGD(lr=0.1, clip_norm=1.0), num_devices=1)
