@@ -1,0 +1,174 @@
+"""Serving benchmark — continuous batching vs the static-batch path.
+
+Replays a FIXED-SEED synthetic ragged workload (ragged prompt lengths,
+ragged arrival steps, heavily skewed output lengths — the
+short-requests-behind-a-straggler shape that motivates iteration-level
+scheduling) through two paths:
+
+* **engine** — singa_tpu.serve.InferenceEngine: requests arrive over
+  the first steps of the run, slots retire and backfill per step;
+* **static_batch** — the offline path: requests grouped in arrival
+  order into max_slots-sized batches, each batch run through
+  ``gpt2_decode.generate`` to the LONGEST row's budget (rows that
+  wanted fewer tokens discard the excess — exactly what a caller
+  without an engine does today), next batch only after the whole
+  batch drains.
+
+Both paths warm up on the full workload once (compiles), then run
+timed.  Throughput counts USEFUL tokens only (each request's own
+max_new_tokens) so the static path is not credited for straggler
+padding it generates and throws away.  Token parity of the engine
+against single-prompt ``generate`` is asserted for every request —
+the bench is invalid if the engine is fast but wrong.
+
+Writes BENCH_SERVE.json (schema: workload/config/engine/static_batch/
+speedup/parity) so future PRs have a serving perf trajectory, and
+prints the same JSON to stdout.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# palette of output budgets: mostly short, a long tail — E[max of a
+# batch] >> E[mean], which is the static path's straggler tax.  A
+# small palette also bounds how many scan lengths the offline path
+# compiles.
+_NEW_PALETTE = [2, 4, 6, 8, 48, 64]
+_NEW_WEIGHTS = [0.22, 0.22, 0.22, 0.14, 0.10, 0.10]
+
+
+def make_workload(n_requests=40, seed=0, n_positions=128):
+    rng = np.random.RandomState(seed)
+    reqs = []
+    arrival = 0
+    for i in range(n_requests):
+        plen = int(rng.randint(4, 25))
+        prompt = rng.randint(0, 512, plen).astype(np.int32)
+        n_new = int(rng.choice(_NEW_PALETTE, p=_NEW_WEIGHTS))
+        arrival += int(rng.randint(0, 2))  # ragged arrivals, ~2/step
+        reqs.append(dict(prompt=prompt, n_new=n_new,
+                         arrival_step=arrival))
+    return reqs
+
+
+def run_engine(m, workload, max_slots):
+    from singa_tpu.serve import GenerationRequest
+
+    eng = m.serve(max_slots=max_slots)
+    handles = []
+    pending = list(workload)
+    t0 = time.perf_counter()
+    while pending or eng.pending:
+        while pending and pending[0]["arrival_step"] <= eng.step_count:
+            w = pending.pop(0)
+            handles.append(eng.submit(GenerationRequest(
+                w["prompt"], max_new_tokens=w["n_new"])))
+        eng.step()
+    wall = time.perf_counter() - t0
+    outs = [h.result() for h in handles]
+    return wall, outs, eng.stats.snapshot()
+
+
+def run_static(m, workload, max_slots):
+    """Arrival-order batches of max_slots, each to its longest row."""
+    from singa_tpu.models import gpt2_decode
+
+    t0 = time.perf_counter()
+    outs, ttfts = [], []
+    for i in range(0, len(workload), max_slots):
+        group = workload[i:i + max_slots]
+        n_max = max(w["n_new"] for w in group)
+        rows = gpt2_decode.generate(
+            m, [w["prompt"] for w in group], max_new_tokens=n_max,
+            temperature=0)
+        t_done = time.perf_counter() - t0
+        for w, row in zip(group, rows):
+            keep = len(w["prompt"]) + w["n_new"]
+            outs.append(np.asarray(row[:keep]))
+            ttfts.append(t_done)  # tokens only exist once the batch drains
+    wall = time.perf_counter() - t0
+    return wall, outs, ttfts
+
+
+def main():
+    import jax
+
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.utils.metrics import percentile
+
+    max_slots = 8
+    cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=192,
+                     n_layer=4, n_head=4, n_inner=384, dropout=0.0,
+                     attn_impl="fused")
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    workload = make_workload(n_positions=cfg.n_positions)
+    useful = sum(w["n_new"] for w in workload)
+
+    # warmup: compile both paths on the exact workload
+    run_engine(m, workload, max_slots)
+    run_static(m, workload, max_slots)
+
+    wall_e, outs_e, snap = run_engine(m, workload, max_slots)
+    wall_s, outs_s, ttfts_s = run_static(m, workload, max_slots)
+
+    # parity: every engine stream == its single-prompt generate output
+    parity = True
+    for w, res in zip(workload, outs_e):
+        want = m.generate(w["prompt"], max_new_tokens=w["n_new"],
+                          temperature=0)
+        if not np.array_equal(res.tokens, want):
+            parity = False
+            break
+    # the static rows are the same offline math — sanity-check one path
+    # against the other instead of recomputing 40 more oracles
+    static_parity = all(
+        np.array_equal(a.tokens, b) for a, b in zip(outs_e, outs_s))
+
+    report = {
+        "bench": "serve_continuous_batching",
+        "device": jax.devices()[0].device_kind,
+        "config": {
+            "model": {"n_embd": cfg.n_embd, "n_layer": cfg.n_layer,
+                      "n_head": cfg.n_head, "vocab": cfg.vocab_size,
+                      "n_positions": cfg.n_positions},
+            "max_slots": max_slots,
+        },
+        "workload": {
+            "requests": len(workload),
+            "useful_tokens": useful,
+            "seed": 0,
+            "new_token_palette": _NEW_PALETTE,
+        },
+        "engine": {
+            "wall_s": wall_e,
+            "tokens_per_s": useful / wall_e,
+            "ttft_p50_s": snap["latency"]["ttft"]["p50"],
+            "ttft_p99_s": snap["latency"]["ttft"]["p99"],
+            "tpot_p50_s": snap["latency"]["tpot"]["p50"],
+            "decode_steps": snap["throughput"]["decode_steps"],
+            "slot_occupancy_mean": snap["slots"]["occupancy_mean"],
+        },
+        "static_batch": {
+            "wall_s": wall_s,
+            "tokens_per_s": useful / wall_s,
+            "ttft_p50_s": percentile(ttfts_s, 50),
+            "ttft_p99_s": percentile(ttfts_s, 99),
+        },
+        "speedup_tokens_per_s": wall_s / wall_e,
+        "ttft_p50_improvement": (percentile(ttfts_s, 50)
+                                 / snap["latency"]["ttft"]["p50"]),
+        "parity": bool(parity and static_parity),
+    }
+    line = json.dumps(report)
+    print(line)
+    with open("BENCH_SERVE.json", "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

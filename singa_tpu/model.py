@@ -283,6 +283,18 @@ class Model(layer.Layer):
     def optimizer(self, opt):
         self.set_optimizer(opt)
 
+    def set_states(self, states: dict):
+        """Layer.set_states plus decode-cache invalidation: the KV-decode
+        session cache (models/gpt2_decode.extract_params) holds strong
+        refs to the weight buffers it was built from, so after a weight
+        swap the SUPERSEDED copy would stay pinned in device memory
+        until the next generate call rebuilt the entry (ADVICE round
+        5).  Dropping the entry here releases the old buffers
+        immediately; the id-keyed signature already guaranteed the
+        stale entry could never be *served*, only *retained*."""
+        super().set_states(states)
+        self.__dict__.pop("_decode_param_cache", None)
+
     # -- state (params + layer states + optimizer states) ------------------
     def persistent_tensors(self) -> dict:
         """Ordered name->Tensor map of everything that survives across

@@ -230,6 +230,24 @@ class GPT2LMHead(model.Model):
                     "batched generate requires the KV-cached path "
                     "(use_cache=False is single-prompt only); loop "
                     "over rows for the windowed sampler")
+            rows = [np.asarray(r, np.int32).reshape(-1)
+                    for r in list(prompt_ids)]
+            over = any(len(r) + max_new_tokens > self.cfg.n_positions
+                       for r in rows)
+            if over and use_cache is not True:
+                # a batch that exceeds n_positions cannot ride the KV
+                # cache; loop EVERY row through the windowed fallback
+                # (all rows on one path — mixing cached and windowed
+                # rows would sample from different RNG streams), the
+                # exact loop the old error message told the caller to
+                # write (round-6 fix; use_cache=True keeps the
+                # explicit-request ValueError below)
+                return [self.generate(
+                    r, max_new_tokens=max_new_tokens,
+                    temperature=temperature, rng=rng, use_cache=False,
+                    top_k=top_k, top_p=top_p, min_p=min_p,
+                    repetition_penalty=repetition_penalty)
+                    for r in rows]
             was_training = getattr(self, "training", False)
             self.eval()
             try:
@@ -339,6 +357,20 @@ class GPT2LMHead(model.Model):
         finally:
             if was_training:
                 self.train(True)
+
+
+    # -- serving (round 6): iteration-level continuous batching --------
+    def serve(self, **kw):
+        """An in-process continuous-batching inference engine over this
+        model's KV-cached decoder (singa_tpu.serve.InferenceEngine):
+        asynchronous request admission, a fixed-shape slot pool (no
+        recompiles), per-step retirement and backfill.  Keyword args
+        pass through to the engine (``max_slots``, ``max_len``,
+        ``dtype``, ``top_k``, ``top_p``, ``scheduler``, ``clock``).
+        See docs/SERVING.md."""
+        from ..serve import InferenceEngine
+
+        return InferenceEngine(self, **kw)
 
 
 def create_model(size="small", plan=None, **kw):

@@ -80,7 +80,10 @@ def extract_params(m, dtype=None):
     re-cast/re-shard (a full weight upload per request under a plan).
     Any state mutation (a training step, ``set_states``,
     ``load_states``) replaces the underlying ``jax.Array`` buffers, so
-    the identity signature misses and the cache rebuilds."""
+    the identity signature misses and the cache rebuilds; since round 6
+    ``Model.set_states`` additionally DROPS the entry eagerly, so the
+    superseded weight copy the entry's strong refs pinned is released
+    at swap time, not at the next generate call."""
     bufs = [t_.data for _, t_ in sorted(m.get_states().items())]
     sig = (str(dtype), id(m.plan), tuple(id(b) for b in bufs))
     cache = getattr(m, "_decode_param_cache", None)
@@ -487,6 +490,25 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
     vc = _cache_stack(new_vc)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
     return _logits(x, params)[:, 0], kc, vc
+
+
+def decode_step(params, x, kc, vc, pos, n_head, eps, *, start=None,
+                moe_top_k=2, window=None):
+    """PUBLIC single-step decode core with an EXTERNALIZED cache carry
+    (the serve engine's contract; round 6).  The generation loops in
+    this module own their KV cache inside a ``lax.scan`` carry; an
+    iteration-level scheduler (singa_tpu/serve) instead owns the cache
+    arena across steps and calls this once per engine iteration.
+
+    ``x``: (B, 1, E) embedded inputs at position ``pos`` (traced
+    scalar, or per-row under vmap); ``kc``/``vc``: (L, B, H_kv, ctx, D)
+    caches — this step's K/V rows are written at ``pos`` and the new
+    caches RETURNED (functional carry; the caller rebinds).  Returns
+    ``((B, V) logits, new kc, new vc)``.  Exactly the math every
+    sampling/beam/speculative path here uses (_advance_one), so an
+    external cache owner cannot drift from ``generate``."""
+    return _advance_one(params, x, kc, vc, pos, n_head, eps,
+                        start=start, moe_top_k=moe_top_k, window=window)
 
 
 def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,

@@ -1,0 +1,22 @@
+"""singa_tpu.serve — continuous-batching inference engine (round 6).
+
+The serving half of the north star: asynchronous generation requests
+flow through a FIFO scheduler into a fixed-shape slot pool and advance
+one token per engine iteration, with finished rows retired and their
+slots backfilled the same step.  See docs/SERVING.md for the
+architecture and engine.py for the design rationale.
+
+Entry points::
+
+    from singa_tpu.serve import InferenceEngine, GenerationRequest
+    eng = model.serve(max_slots=8)            # == InferenceEngine(model)
+    h = eng.submit(GenerationRequest(prompt, max_new_tokens=32))
+    eng.run_until_complete()
+    h.result().tokens
+"""
+
+from .engine import InferenceEngine  # noqa: F401
+from .request import (DeadlineExceededError, GenerationRequest,  # noqa: F401
+                      GenerationResult, QueueFullError, RequestHandle)
+from .scheduler import FIFOScheduler  # noqa: F401
+from .stats import EngineStats  # noqa: F401

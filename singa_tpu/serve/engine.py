@@ -1,0 +1,389 @@
+"""Continuous-batching inference engine over the KV-cached GPT-2
+decoder (the Orca/vLLM iteration-level scheduler, shape-stable for
+TPU; round 6).
+
+The offline path (models/gpt2_decode.generate) assembles one static
+batch and runs prefill + a compiled scan to the LAST row's length:
+every caller blocks until the slowest row finishes, and a new prompt
+cannot enter until the whole batch drains.  This engine inverts that
+control flow:
+
+* **slot pool** — a fixed pool of ``max_slots`` rows backed by ONE
+  preallocated KV-cache arena of shape ``(L, max_slots, H_kv,
+  max_len, D)`` per K/V.  Every jitted function below is keyed only on
+  ``(max_slots, max_len)`` and the model statics, so the engine NEVER
+  recompiles at runtime — admission, decode, and retirement all happen
+  inside the same three executables;
+* **iteration-level step loop** — each ``step()`` advances every live
+  slot by one token (one batched call over the whole pool), retires
+  rows that hit their token budget IMMEDIATELY, and backfills the
+  freed slots from the scheduler queue in the SAME step (prefill one
+  row, write it into the arena at the free slot index);
+* **exactness** — a slot runs the same per-row math as single-prompt
+  ``generate``: prefill over a (1, max_len) padded row, then
+  gpt2_decode.decode_step per token, with the request's private
+  sampling-key chain split exactly as the offline path splits it.
+  tests/test_serve.py asserts token-for-token identity against
+  ``generate`` for greedy AND seeded-sampling requests.
+
+Why it wins: the static batch pays ``Σ_batches max(new_tokens)``
+pool-wide steps while the engine pays ~``Σ new_tokens / max_slots`` —
+the gap is the per-batch straggler tail plus the slots that sat idle
+behind it (bench_serve.py measures it on a ragged workload).
+
+v1 scope: dense/GQA/MoE models (everything _advance_one supports with
+a position-indexed dense cache).  Sliding-window models (rolling cache
+slot arithmetic) and int8 cache arenas are rejected with
+NotImplementedError; repetition_penalty/min_p are offline-only knobs.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt2_decode import (_logits, _norm_window, _sample,
+                                  decode_step, extract_params, prefill)
+from ..utils.logging import get_channel
+from .request import (DeadlineExceededError, GenerationRequest,
+                      GenerationResult, RequestHandle)
+from .scheduler import FIFOScheduler
+from .stats import EngineStats
+
+
+def _select_sample(logit, key, temp, top_k, top_p, use_top_p):
+    """Per-row sampling with a TRACED greedy flag.  The offline paths
+    bake ``greedy`` in as a static (one compile per mode); a slot pool
+    mixes greedy and sampled requests in one executable, so compute
+    both branches of the SAME ``_sample`` the offline path uses and
+    select — the greedy branch is argmax over the identical f32 logit,
+    the sampled branch divides by max(temp, 1e-6) exactly as
+    ``generate`` does, so either way the chosen token matches the
+    offline token bit for bit."""
+    g = _sample(logit, key, temp, top_p, True, top_k, use_top_p)
+    s = _sample(logit, key, jnp.maximum(temp, 1e-6), top_p, False,
+                top_k, use_top_p)
+    return jnp.where(temp <= 0.0, g, s).astype(jnp.int32)
+
+
+@partial(jax.jit,
+         static_argnames=("n_head", "eps", "moe_top_k", "top_k",
+                          "use_top_p"),
+         donate_argnums=(1, 2))
+def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
+                      top_p, n_head, eps, moe_top_k, top_k, use_top_p):
+    """Advance EVERY slot one token: toks/pos/live/temps (S,), keys
+    (S, 2), arenas (L, S, H_kv, max_len, D) — donated, so the arena
+    updates in place across steps.  Dead slots run the same math on
+    clamped inputs (fixed shapes; their cache rows are garbage that
+    the next admission's full-row prefill write overwrites) and their
+    outputs are ignored host-side.  Returns (next_toks, kc, vc,
+    new_keys)."""
+
+    def row(kc_r, vc_r, tok, pos_r, live_r, key, temp):
+        # kc_r/vc_r: (L, H_kv, max_len, D) — one slot's cache rows
+        p_c = jnp.where(live_r, pos_r, 0)
+        t_c = jnp.where(live_r, tok, 0)
+        x = (params["wte"][t_c] + params["wpe"][p_c])[None, None, :]
+        logits, kc2, vc2 = decode_step(
+            params, x, kc_r[:, None], vc_r[:, None], p_c, n_head, eps,
+            moe_top_k=moe_top_k)
+        ks = jax.random.split(key)
+        nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
+                             use_top_p)
+        return nxt, kc2[:, 0], vc2[:, 0], ks[1]
+
+    return jax.vmap(row, in_axes=(1, 1, 0, 0, 0, 0, 0),
+                    out_axes=(0, 1, 1, 0))(kc, vc, toks, pos, live,
+                                           keys, temps)
+
+
+@partial(jax.jit,
+         static_argnames=("n_head", "eps", "moe_top_k", "top_k",
+                          "use_top_p"))
+def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
+                 eps, moe_top_k, top_k, use_top_p):
+    """Admission prefill for ONE request: ids (1, max_len)
+    right-padded.  Returns (first token, carried key, kc_row, vc_row)
+    with cache rows (L, 1, H_kv, max_len, D) ready to write into the
+    arena.  ``prompt_len`` is traced, so every admission reuses one
+    executable regardless of prompt length."""
+    hidden, kc, vc = prefill(params, ids, n_head, eps,
+                             moe_top_k=moe_top_k)
+    last_h = jax.lax.dynamic_index_in_dim(
+        hidden, prompt_len - 1, axis=1, keepdims=False)      # (1, E)
+    logit0 = _logits(last_h[:, None, :], params)[0, 0]       # (V,)
+    ks = jax.random.split(key)
+    tok0 = _select_sample(logit0, ks[0], temp, top_k, top_p, use_top_p)
+    return tok0, ks[1], kc, vc
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _write_slot(kc_arena, vc_arena, kc_row, vc_row, slot):
+    """Install an admitted request's prefilled cache rows at ``slot``
+    (traced index — one executable for every slot)."""
+    kc_arena = jax.lax.dynamic_update_slice(
+        kc_arena, kc_row, (0, slot, 0, 0, 0))
+    vc_arena = jax.lax.dynamic_update_slice(
+        vc_arena, vc_row, (0, slot, 0, 0, 0))
+    return kc_arena, vc_arena
+
+
+class _Slot:
+    """Host-side bookkeeping for one pool row (the decode position
+    lives in the engine's per-slot arrays — the jitted step's
+    inputs — not here)."""
+
+    __slots__ = ("handle", "emitted", "remaining",
+                 "first_token_time", "admit_time", "admitted_step")
+
+    def __init__(self, handle, max_new, now, step):
+        self.handle = handle
+        self.emitted = []
+        self.remaining = max_new
+        self.first_token_time = None
+        self.admit_time = now
+        self.admitted_step = step
+
+
+class InferenceEngine:
+    """In-process continuous-batching engine for a ``GPT2LMHead``.
+
+    >>> eng = model.serve(max_slots=8)
+    >>> h = eng.submit(GenerationRequest(prompt, max_new_tokens=32))
+    >>> eng.run_until_complete()
+    >>> h.result().tokens      # == model.generate(prompt, ...) exactly
+
+    ``max_len`` defaults to ``cfg.n_positions`` — the same padded width
+    single-prompt ``generate`` uses, which is what makes engine logits
+    (and therefore tokens) identical to the offline path.  ``top_k``/
+    ``top_p`` are ENGINE-level statics (one executable for the pool);
+    per-request knobs are temperature/seed/max_new_tokens/deadline.
+    ``clock`` is injectable for deterministic scheduling tests."""
+
+    def __init__(self, model, max_slots=8, max_len=None, dtype=None,
+                 scheduler=None, top_k=0, top_p=None,
+                 clock=time.monotonic):
+        cfg = model.cfg
+        if _norm_window(cfg) is not None:
+            raise NotImplementedError(
+                "serve engine does not support sliding-window models "
+                f"(attn_window={cfg.attn_window}): the rolling cache's "
+                "slot arithmetic assumes a scan-carried cache")
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.model = model
+        self.cfg = cfg
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len or cfg.n_positions)
+        if self.max_len > cfg.n_positions:
+            raise ValueError(
+                f"max_len ({self.max_len}) exceeds n_positions "
+                f"({cfg.n_positions})")
+        if top_k and top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        self._top_k = min(int(top_k or 0), cfg.vocab_size)
+        self._top_p = jnp.float32(1.0 if top_p is None else top_p)
+        self._use_top_p = top_p is not None
+        self._clock = clock
+        self.scheduler = scheduler or FIFOScheduler()
+        self.stats = EngineStats(self.max_slots, clock)
+        self._log = get_channel("serve")
+
+        model.eval()
+        self._params = extract_params(model, dtype=dtype)
+        self._statics = dict(
+            n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
+            moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
+            top_k=self._top_k, use_top_p=self._use_top_p)
+        # fixed-shape KV arena keyed on (max_slots, max_len): L layers,
+        # H_kv heads (GQA keeps the narrow cache), compute dtype
+        L, S, W = cfg.n_layer, self.max_slots, self.max_len
+        H_kv = cfg.n_kv_head
+        D = cfg.n_embd // cfg.n_head
+        cdt = self._params["wte"].dtype
+        self._kc = jnp.zeros((L, S, H_kv, W, D), cdt)
+        self._vc = jnp.zeros((L, S, H_kv, W, D), cdt)
+        # per-slot host state + device sampling keys
+        self._slots = [None] * S            # _Slot or None
+        self._toks = np.zeros(S, np.int32)  # last emitted token
+        self._pos = np.zeros(S, np.int32)
+        self._temps = np.zeros(S, np.float32)
+        self._keys = jnp.zeros((S, 2), jnp.uint32)
+        self._handles = {}
+        self.step_count = 0
+        self._log.info(
+            "engine up: slots=%d max_len=%d arena=%s x2 (%s)",
+            S, W, self._kc.shape, cdt)
+
+    # -- submission ------------------------------------------------------
+    def submit(self, request) -> RequestHandle:
+        """Queue a request; returns immediately with a handle.  Raises
+        QueueFullError under back-pressure and ValueError for requests
+        that could never fit the arena."""
+        if not isinstance(request, GenerationRequest):
+            request = GenerationRequest(np.asarray(request))
+        need = len(request.prompt_ids) + request.max_new_tokens
+        if need > self.max_len:
+            raise ValueError(
+                f"prompt ({len(request.prompt_ids)}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds the engine arena "
+                f"max_len ({self.max_len}); use the offline windowed "
+                f"GPT2LMHead.generate for over-length generations")
+        if request.request_id in self._handles:
+            # an in-flight duplicate would orphan the earlier handle
+            # (the id is the engine's completion-routing key); finished
+            # requests are evicted at retire/reject, so an id may be
+            # REUSED once its predecessor resolved
+            raise ValueError(
+                f"request_id {request.request_id!r} is already "
+                f"in flight")
+        handle = RequestHandle(request)
+        self.stats.on_submit()
+        try:
+            self.scheduler.enqueue(request)
+        except Exception:
+            self.stats.on_queue_full(request.request_id)
+            raise
+        handle._submit_time = self._clock()
+        self._handles[request.request_id] = handle
+        return handle
+
+    @property
+    def pending(self) -> bool:
+        """True while any request is queued or occupying a slot."""
+        return (self.scheduler.queue_depth > 0
+                or any(s is not None for s in self._slots))
+
+    @property
+    def live_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    # -- the iteration-level step loop -----------------------------------
+    def step(self) -> bool:
+        """One engine iteration: decode every live slot by one token,
+        retire finished rows, then backfill freed slots from the queue
+        (so backfill lands on the very step a row retires).  Returns
+        ``pending``."""
+        if any(s is not None for s in self._slots):
+            self._decode_once()
+        self._schedule(self._clock())
+        self.stats.on_schedule(self.scheduler.queue_depth)
+        self.step_count += 1
+        return self.pending
+
+    def run_until_complete(self, max_steps=None):
+        """Drive ``step()`` until every submitted request resolves.
+        ``max_steps`` guards tests against scheduling bugs."""
+        steps = 0
+        while self.pending:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps "
+                    f"(queue={self.scheduler.queue_depth}, "
+                    f"live={self.live_slots})")
+
+    # -- internals -------------------------------------------------------
+    def _decode_once(self):
+        live = np.asarray([s is not None for s in self._slots])
+        next_toks, self._kc, self._vc, self._keys = _pool_decode_step(
+            self._params, self._kc, self._vc,
+            jnp.asarray(self._toks), jnp.asarray(self._pos),
+            jnp.asarray(live), self._keys,
+            jnp.asarray(self._temps), self._top_p, **self._statics)
+        next_toks = np.asarray(next_toks)
+        self.stats.on_decode_step(int(live.sum()))
+        t_emit = self._clock()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._emit(i, slot, int(next_toks[i]), t_emit)
+            self._toks[i] = next_toks[i]
+            self._pos[i] += 1
+
+    def _emit(self, idx, slot, token, now):
+        slot.emitted.append(token)
+        slot.remaining -= 1
+        req = slot.handle.request
+        self.stats.on_token()
+        if slot.first_token_time is None:
+            slot.first_token_time = now
+        if req.on_token is not None:
+            req.on_token(req, token)
+        if slot.remaining <= 0:
+            self._retire(idx, slot, now)
+
+    def _retire(self, idx, slot, now):
+        req = slot.handle.request
+        n = len(slot.emitted)
+        submit_t = getattr(slot.handle, "_submit_time", slot.admit_time)
+        ttft = slot.first_token_time - submit_t
+        tpot = ((now - slot.first_token_time) / (n - 1)
+                if n > 1 else None)
+        result = GenerationResult(
+            request_id=req.request_id,
+            tokens=np.concatenate(
+                [req.prompt_ids,
+                 np.asarray(slot.emitted, np.int32)]),
+            finish_reason="length",
+            ttft=ttft, tpot=tpot,
+            queue_time=slot.admit_time - submit_t,
+            admitted_step=slot.admitted_step,
+            finished_step=self.step_count)
+        slot.handle._finish(result)
+        self.stats.on_complete(result)
+        self._slots[idx] = None
+        # the caller's handle owns the result now; dropping the routing
+        # entry keeps a long-lived engine's memory flat under sustained
+        # traffic
+        self._handles.pop(req.request_id, None)
+
+    def _schedule(self, now):
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free and self.scheduler.queue_depth == 0:
+            return
+        admit, expired = self.scheduler.schedule(len(free), now)
+        for req in expired:
+            self.stats.on_deadline_expired(req.request_id)
+            self._handles.pop(req.request_id)._reject(
+                DeadlineExceededError(
+                    f"{req.request_id}: deadline {req.deadline} passed "
+                    f"at {now} before a slot was available"))
+        for req in admit:
+            self._admit(free.pop(0), req, now)
+
+    def _admit(self, idx, req, now):
+        """Prefill one request into slot ``idx`` and emit its first
+        token.  Mirrors the offline key chain exactly: generate() makes
+        per-row keys with split(PRNGKey(seed), B)[row]; a single-prompt
+        call is B=1, row 0."""
+        handle = self._handles[req.request_id]
+        plen = len(req.prompt_ids)
+        ids = np.zeros((1, self.max_len), np.int32)
+        ids[0, :plen] = req.prompt_ids
+        key0 = jax.random.split(
+            jax.random.PRNGKey(int(req.seed)), 1)[0]
+        temp = np.float32(req.temperature)
+        tok0, carry_key, kc_row, vc_row = _prefill_one(
+            self._params, jnp.asarray(ids), plen, key0, temp,
+            self._top_p, **self._statics)
+        self._kc, self._vc = _write_slot(self._kc, self._vc,
+                                         kc_row, vc_row,
+                                         jnp.int32(idx))
+        self.stats.on_prefill()
+        slot = _Slot(handle, req.max_new_tokens, now, self.step_count)
+        self._slots[idx] = slot
+        tok0 = int(np.asarray(tok0))
+        self._toks[idx] = tok0
+        self._pos[idx] = plen
+        self._temps[idx] = temp
+        self._keys = self._keys.at[idx].set(carry_key)
+        self._emit(idx, slot, tok0, self._clock())

@@ -1,0 +1,117 @@
+"""Request/result surface of the serving engine.
+
+A :class:`GenerationRequest` is what callers submit; the engine hands
+back a :class:`RequestHandle` immediately (admission is asynchronous —
+the request sits in the scheduler queue until a slot frees).  Results
+arrive as :class:`GenerationResult` on the handle once the row retires;
+streaming consumers pass ``on_token`` and receive every token the
+moment the engine emits it (the prefill token included).
+
+Rejections are DISTINCT error types so callers can tell back-pressure
+(:class:`QueueFullError` — retry later, shed load) from staleness
+(:class:`DeadlineExceededError` — the answer is no longer wanted) —
+the two need opposite client reactions.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+_req_counter = itertools.count()
+
+
+class QueueFullError(RuntimeError):
+    """Admission control: the scheduler queue is at max_queue_depth.
+    Raised synchronously by ``submit`` — the request was never
+    accepted, so there is no handle to poll."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before a slot could run it.  The
+    scheduler drops it at schedule time; the handle's ``result()``
+    re-raises this."""
+
+
+@dataclass
+class GenerationRequest:
+    """One generation job.
+
+    ``prompt_ids``: 1-D int token ids.  ``temperature <= 0`` is greedy
+    decoding; otherwise ``seed`` keys the request's private sampling
+    chain — the SAME chain single-prompt ``generate`` derives from its
+    seed, which is what makes engine output token-identical to the
+    offline path (tests/test_serve.py).  ``deadline`` is an absolute
+    time on the engine's clock (default ``time.monotonic``); a request
+    still queued past it is rejected, never silently served late.
+    ``on_token(request, token)`` streams each emitted token."""
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int = 20
+    temperature: float = 0.0
+    seed: int = 0
+    deadline: Optional[float] = None
+    on_token: Optional[Callable] = None
+    request_id: str = field(
+        default_factory=lambda: f"req-{next(_req_counter)}")
+
+    def __post_init__(self):
+        self.prompt_ids = np.asarray(self.prompt_ids,
+                                     np.int32).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise ValueError("prompt_ids must be non-empty")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+                " (a serve request that generates nothing is a no-op)")
+
+
+@dataclass
+class GenerationResult:
+    """Terminal state of a request.  ``tokens`` is prompt +
+    continuation (the exact array single-prompt ``generate`` would
+    return); ``finish_reason`` is ``"length"`` for normal completion.
+    Latency fields are on the engine clock: ``ttft`` measures submit →
+    first token, ``tpot`` the mean inter-token time after it."""
+
+    request_id: str
+    tokens: np.ndarray
+    finish_reason: str
+    ttft: float
+    tpot: Optional[float]
+    queue_time: float
+    admitted_step: int
+    finished_step: int
+
+
+class RequestHandle:
+    """Caller-side view of a submitted request.  ``done()`` flips when
+    the engine retires or rejects the row; ``result()`` returns the
+    :class:`GenerationResult` or re-raises the rejection error."""
+
+    def __init__(self, request: GenerationRequest):
+        self.request = request
+        self._result: Optional[GenerationResult] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._result is not None or self._error is not None
+
+    def result(self) -> GenerationResult:
+        if self._error is not None:
+            raise self._error
+        if self._result is None:
+            raise RuntimeError(
+                f"{self.request.request_id} not finished; drive the "
+                "engine (step()/run_until_complete()) first")
+        return self._result
+
+    # engine-side completion hooks
+    def _finish(self, result: GenerationResult):
+        self._result = result
+
+    def _reject(self, error: BaseException):
+        self._error = error

@@ -1,0 +1,89 @@
+"""Iteration-level scheduling policy for the serving engine.
+
+The engine asks the scheduler ONE question per step: "these slots are
+free — which queued requests run next?".  Everything the Orca/vLLM
+literature calls continuous batching falls out of asking that question
+every iteration instead of once per batch: rows retire one by one and
+the very same step's schedule() backfills their slots.
+
+Policy here is deliberately simple and exact:
+
+* **FIFO admission** — requests run in arrival order (no reordering,
+  so per-request results are reproducible for a given arrival order);
+* **prefill/decode interleave** — at most ``max_prefills_per_step``
+  admissions per schedule() call, so a burst of arrivals cannot starve
+  the decode loop (each prefill is an O(ctx²) forward; each decode
+  step is O(ctx)).  Freed-slot backfill beyond the cap waits a step;
+* **admission control** — ``enqueue`` rejects at ``max_queue_depth``
+  (QueueFullError, synchronous back-pressure), and ``schedule`` drops
+  queued requests whose deadline passed (DeadlineExceededError via the
+  expired list) BEFORE admitting, so a stale request never occupies a
+  slot that a live one could use.
+
+The scheduler owns no device state and never touches jax — it is plain
+host code, which is what makes the policy unit-testable with a fake
+clock (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Tuple
+
+from .request import GenerationRequest, QueueFullError
+
+
+class FIFOScheduler:
+    """FIFO queue + the admission policy described in the module
+    docstring.  ``max_queue_depth``: back-pressure bound (requests, not
+    tokens).  ``max_prefills_per_step``: prefill/decode interleave
+    knob; None means "fill every free slot immediately"."""
+
+    def __init__(self, max_queue_depth: int = 64,
+                 max_prefills_per_step=None):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        if max_prefills_per_step is not None \
+                and max_prefills_per_step < 1:
+            raise ValueError(
+                f"max_prefills_per_step must be >= 1 or None, got "
+                f"{max_prefills_per_step}")
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_prefills_per_step = max_prefills_per_step
+        self._queue: deque = deque()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def enqueue(self, request: GenerationRequest):
+        if len(self._queue) >= self.max_queue_depth:
+            raise QueueFullError(
+                f"scheduler queue full ({self.max_queue_depth} "
+                f"requests); rejecting {request.request_id}")
+        self._queue.append(request)
+
+    def schedule(self, free_slots: int, now: float
+                 ) -> Tuple[List[GenerationRequest],
+                            List[GenerationRequest]]:
+        """One scheduling decision: returns ``(admit, expired)``.
+        ``admit`` is FIFO order, capped by free_slots and
+        max_prefills_per_step; ``expired`` are deadline-passed requests
+        removed from the queue (in queue order).  Expiry is checked for
+        the WHOLE queue, not just the admissible prefix — a stale
+        request deep in the queue should fail fast, not age further
+        behind back-pressure."""
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now > r.deadline]
+        if expired:
+            dead = {id(r) for r in expired}
+            self._queue = deque(r for r in self._queue
+                                if id(r) not in dead)
+        budget = free_slots
+        if self.max_prefills_per_step is not None:
+            budget = min(budget, self.max_prefills_per_step)
+        admit = []
+        while self._queue and len(admit) < budget:
+            admit.append(self._queue.popleft())
+        return admit, expired

@@ -1,0 +1,119 @@
+"""Serving telemetry: per-request latency, queue/slot gauges, token
+throughput.
+
+Built on ``singa_tpu.utils.metrics`` (LatencySeries gives the
+count/mean/p50/p99/max summary every latency here reports) and logged
+through the ``serve`` channel of ``singa_tpu.utils.logging``.  The
+``snapshot()`` schema is STABLE — tests/test_serve.py asserts the
+exact key set, and bench_serve.py writes it into BENCH_SERVE.json so
+future PRs have a comparable perf trajectory — extend it by adding
+keys, never by renaming.
+
+Metric definitions (the serving-standard ones):
+
+* **TTFT** (time to first token): submit → the prefill token, queue
+  wait included — the user-visible "how long until it starts".
+* **TPOT** (time per output token): mean inter-token gap AFTER the
+  first token; requests emitting one token have no TPOT sample.
+* **slot occupancy**: live slots / max_slots, sampled once per decode
+  step — how full the fixed-shape batch actually runs.
+* **queue depth**: sampled after each step's scheduling pass.
+"""
+
+from __future__ import annotations
+
+from ..utils.logging import get_channel
+from ..utils.metrics import LatencySeries
+
+
+class EngineStats:
+    """Accumulated over an engine's lifetime; ``snapshot()`` at any
+    point.  All wall-clock numbers come from the engine's clock so a
+    fake clock makes the whole schema deterministic in tests."""
+
+    def __init__(self, max_slots: int, clock):
+        self.max_slots = int(max_slots)
+        self._clock = clock
+        self._t0 = clock()
+        self.ttft = LatencySeries()
+        self.tpot = LatencySeries()
+        self.completed = 0
+        self.rejected_deadline = 0
+        self.rejected_queue_full = 0
+        self.submitted = 0
+        self.prefills = 0
+        self.decode_steps = 0
+        self.tokens_out = 0
+        self._queue_depth_sum = 0
+        self._queue_depth_max = 0
+        self._queue_samples = 0
+        self._occupancy_sum = 0.0
+        self._log = get_channel("serve")
+
+    # -- recording hooks (called by the engine) -------------------------
+    def on_submit(self):
+        self.submitted += 1
+
+    def on_queue_full(self, request_id):
+        self.rejected_queue_full += 1
+        self._log.warning("queue full: rejected %s", request_id)
+
+    def on_deadline_expired(self, request_id):
+        self.rejected_deadline += 1
+        self._log.warning("deadline expired: rejected %s", request_id)
+
+    def on_prefill(self):
+        self.prefills += 1
+
+    def on_token(self):
+        self.tokens_out += 1
+
+    def on_decode_step(self, live_slots: int):
+        self.decode_steps += 1
+        self._occupancy_sum += live_slots / self.max_slots
+
+    def on_schedule(self, queue_depth: int):
+        self._queue_samples += 1
+        self._queue_depth_sum += queue_depth
+        self._queue_depth_max = max(self._queue_depth_max, queue_depth)
+
+    def on_complete(self, result):
+        self.completed += 1
+        self.ttft.record(result.ttft)
+        if result.tpot is not None:
+            self.tpot.record(result.tpot)
+
+    # -- reporting ------------------------------------------------------
+    def snapshot(self) -> dict:
+        wall = max(self._clock() - self._t0, 1e-9)
+        return {
+            "requests": {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected_deadline": self.rejected_deadline,
+                "rejected_queue_full": self.rejected_queue_full,
+            },
+            "throughput": {
+                "tokens_out": self.tokens_out,
+                "wall_s": wall,
+                "tokens_per_s": self.tokens_out / wall,
+                "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+            },
+            "latency": {
+                "ttft": self.ttft.summary(),
+                "tpot": self.tpot.summary(),
+            },
+            "queue": {
+                "mean_depth": (self._queue_depth_sum
+                               / self._queue_samples
+                               if self._queue_samples else 0.0),
+                "max_depth": self._queue_depth_max,
+            },
+            "slots": {
+                "max_slots": self.max_slots,
+                "occupancy_mean": (self._occupancy_sum
+                                   / self.decode_steps
+                                   if self.decode_steps else 0.0),
+            },
+        }

@@ -1178,19 +1178,31 @@ def _h_gather_elements(node, args):
 
 def _h_trilu(node, args):
     """Trilu-14: upper/lower triangular part of the last two dims; the
-    optional second input is the (constant) diagonal offset k — the
-    form HF causal-mask exports emit."""
+    optional second input is the diagonal offset k.  Constant k (the
+    form HF causal-mask exports emit — an initializer or Constant
+    output) folds into the mask at build time; a RUNTIME-computed k
+    (e.g. Shape-arithmetic feeding Trilu, or any k under jit tracing,
+    where ``_np`` would die on the tracer) stays a graph input and the
+    mask comparison traces through jnp (round-6 fix)."""
     upper = bool(node.attrs().get("upper", 1))
-    k = int(_np(args[1]).reshape(-1)[0]) if len(args) > 1 else 0
 
-    def f(x):
+    def f(x, k):
         r, c = x.shape[-2], x.shape[-1]
         rows = jnp.arange(r)[:, None]
         cols = jnp.arange(c)[None, :]
         mask = (cols - rows >= k) if upper else (cols - rows <= k)
         return jnp.where(mask, x, jnp.zeros((), x.dtype))
 
-    return _op(f, args[0], _name="Trilu")
+    if len(args) <= 1:
+        return _op(lambda x: f(x, 0), args[0], _name="Trilu")
+    try:
+        k = int(_np(args[1]).reshape(-1)[0])
+    except Exception:
+        # traced/runtime k: jnp comparisons handle a traced scalar
+        return _op(
+            lambda x, kt: f(x, kt.reshape(-1)[0].astype(jnp.int32)),
+            args[0], args[1], _name="Trilu")
+    return _op(lambda x: f(x, k), args[0], _name="Trilu")
 
 
 def _scatter_ref(ref, upd, reduction, opname):

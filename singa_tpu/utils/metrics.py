@@ -51,6 +51,56 @@ def scaling_efficiency(throughput_n_chips, throughput_1_chip, n_chips):
     return throughput_n_chips / (n_chips * throughput_1_chip)
 
 
+def percentile(values, p) -> float:
+    """Nearest-rank percentile (p in [0, 100]) over a sequence.  The
+    nearest-rank definition returns an OBSERVED value (p99 of 3 samples
+    is the max, not an interpolation between two latencies that never
+    happened), which is the convention serving dashboards use."""
+    vals = sorted(values)
+    if not vals:
+        return float("nan")
+    if p <= 0:
+        return float(vals[0])
+    import math
+
+    rank = math.ceil(p / 100.0 * len(vals))
+    return float(vals[min(len(vals), max(1, rank)) - 1])
+
+
+class LatencySeries:
+    """Accumulates per-event latencies (seconds) and summarizes them in
+    the schema serving metrics report everywhere: count/mean/p50/p99/
+    max.  Used by serve/stats.py for TTFT and TPOT; generic enough for
+    any per-event timing."""
+
+    def __init__(self):
+        self.values = []
+
+    def record(self, seconds: float):
+        self.values.append(float(seconds))
+
+    @property
+    def count(self) -> int:
+        return len(self.values)
+
+    def mean(self) -> float:
+        return (sum(self.values) / len(self.values)
+                if self.values else float("nan"))
+
+    def percentile(self, p) -> float:
+        return percentile(self.values, p)
+
+    def summary(self) -> dict:
+        """Stable-schema dict (tests assert the exact key set)."""
+        return {
+            "count": self.count,
+            "mean": self.mean(),
+            "p50": self.percentile(50),
+            "p99": self.percentile(99),
+            "max": (max(self.values) if self.values else float("nan")),
+        }
+
+
 def accuracy(logits, labels):
     import numpy as np
 

@@ -1239,3 +1239,28 @@ def test_speculative_batched_matches_per_row():
         # and still exactly target-greedy
         ref = target.generate(p, max_new_tokens=12, temperature=0)
         np.testing.assert_array_equal(row, ref)
+
+
+def test_over_length_batched_generate_falls_back_windowed():
+    """A prompt BATCH whose prompt+max_new exceeds n_positions used to
+    raise with a hint pointing at the very function the caller was in;
+    it must instead loop every row through the windowed fallback
+    (round-6 fix).  Explicitly forcing the cache keeps the error."""
+    cfg = _cfg()
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    long_p = np.zeros(cfg.n_positions - 2, np.int32)
+    short_p = np.arange(5) % cfg.vocab_size
+    outs = m.generate([long_p, short_p], max_new_tokens=5,
+                      temperature=0)
+    assert isinstance(outs, list) and len(outs) == 2
+    for o, p in zip(outs, (long_p, short_p)):
+        assert len(o) == len(p) + 5
+        # row-for-row equal to the single-prompt windowed sampler
+        single = m.generate(p, max_new_tokens=5, temperature=0,
+                            use_cache=False)
+        np.testing.assert_array_equal(o, single)
+    with pytest.raises(ValueError, match="n_positions"):
+        m.generate([long_p, short_p], max_new_tokens=5,
+                   temperature=0, use_cache=True)

@@ -881,3 +881,44 @@ def test_foreign_trilu_scatternd_fixture(dev):
     (out,) = rep.run([tensor.from_numpy(io["x"], dev)])
     np.testing.assert_allclose(tensor.to_numpy(out), io["y"], rtol=2e-5,
                                atol=1e-6)
+
+
+def test_trilu_runtime_diagonal_k(dev):
+    """Trilu whose diagonal offset k is a graph INPUT, not a constant
+    initializer: under jit the handler cannot fold k at build time
+    (_np dies on the tracer) and must trace the mask through jnp
+    (round-6 fix).  The same executable serves different k values."""
+    import jax
+
+    node = onnx_pb.NodeProto(op_type="Trilu", name="tri",
+                             input=["x", "k"], output=["y"],
+                             attribute=[onnx_pb.AttributeProto.make(
+                                 "upper", 1)])
+    model = _graph_model(
+        [node], [],
+        [onnx_pb.ValueInfoProto("x", onnx_pb.FLOAT, [4, 4]),
+         onnx_pb.ValueInfoProto("k", onnx_pb.INT64, [1])],
+        [onnx_pb.ValueInfoProto("y", onnx_pb.FLOAT, [4, 4])])
+    rep = sonnx.prepare(model, dev)
+    x_np = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+
+    # eager runtime k still works (concrete value, static fold)
+    (y,) = rep.run({"x": tensor.from_numpy(x_np, dev),
+                    "k": tensor.from_numpy(
+                        np.asarray([1], np.int64), dev)})
+    np.testing.assert_allclose(tensor.to_numpy(y), np.triu(x_np, 1),
+                               rtol=1e-6)
+
+    def f(x_arr, k_arr):
+        xt = tensor._wrap(x_arr, dev)
+        kt = tensor._wrap(k_arr, dev)
+        (out,) = rep.run({"x": xt, "k": kt})
+        return out.data
+
+    jf = jax.jit(f)
+    import jax.numpy as jnp
+    for k in (0, 1, -1, 2):
+        got = np.asarray(jf(jnp.asarray(x_np),
+                            jnp.asarray([k], jnp.int32)))
+        np.testing.assert_allclose(got, np.triu(x_np, k), rtol=1e-6,
+                                   err_msg=f"k={k}")
