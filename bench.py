@@ -21,6 +21,7 @@ same-config regression now drops the ratio below 1.0 (round-2 verdict
 fix; the old fp32/b32 round-0 value is kept under ``history``).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -510,6 +511,19 @@ def _load_baseline():
 
 
 def main():
+    from singa_tpu import observe
+
+    ap = argparse.ArgumentParser(
+        description="singa_tpu training benchmark harness")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="trace the whole bench run (compile spans with "
+                         "XLA cost tables, train/step dispatches, "
+                         "opt/update traces) and write a Chrome "
+                         "trace-event JSON there")
+    cli = ap.parse_args()
+    if cli.trace_out:
+        observe.enable()
+
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
@@ -633,6 +647,16 @@ def main():
         out["longctx_impl"] = top["impl"]
     except (OSError, KeyError, ValueError):
         pass
+    # observe registry: graph cache hit/miss, train.steps, opt.updates —
+    # the attribution surface for "where did this bench's time go"
+    out["registry"] = observe.registry().snapshot()
+    if cli.trace_out:
+        observe.disable()
+        out["trace"] = {
+            "path": cli.trace_out,
+            "trace_events": observe.export.write_chrome_trace(
+                cli.trace_out, metadata={"bench": "train"}),
+        }
     print(json.dumps(out))
 
 

@@ -23,9 +23,16 @@ the bench is invalid if the engine is fast but wrong.
 
 Writes BENCH_SERVE.json (schema: workload/config/engine/static_batch/
 speedup/parity) so future PRs have a serving perf trajectory, and
-prints the same JSON to stdout.
+prints the same JSON to stdout.  The ``registry`` key embeds the
+process-wide ``singa_tpu.observe`` metrics snapshot; ``--trace-out
+PATH`` additionally traces the timed engine run and writes a Chrome
+trace-event JSON there (open in https://ui.perfetto.dev — expect
+serve/prefill, serve/decode_step and serve/retire rows).  Tracing is
+off unless the flag is given, so the default throughput numbers are
+untouched.
 """
 
+import argparse
 import json
 import time
 
@@ -53,7 +60,7 @@ def make_workload(n_requests=40, seed=0, n_positions=128):
     return reqs
 
 
-def run_engine(m, workload, max_slots):
+def run_engine(m, workload, max_slots, close_after=False):
     from singa_tpu.serve import GenerationRequest
 
     eng = m.serve(max_slots=max_slots)
@@ -68,7 +75,13 @@ def run_engine(m, workload, max_slots):
         eng.step()
     wall = time.perf_counter() - t0
     outs = [h.result() for h in handles]
-    return wall, outs, eng.stats.snapshot()
+    snap = eng.stats.snapshot()
+    if close_after:
+        # warmup engines unregister their compile-polluted serve.*
+        # metrics so the registry snapshot in the report reflects the
+        # TIMED engine only
+        eng.close()
+    return wall, outs, snap
 
 
 def run_static(m, workload, max_slots):
@@ -95,9 +108,15 @@ def run_static(m, workload, max_slots):
 def main():
     import jax
 
-    from singa_tpu import tensor
+    from singa_tpu import observe, tensor
     from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
     from singa_tpu.utils.metrics import percentile
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome trace-event JSON of the timed "
+                         "engine run (Perfetto/chrome://tracing)")
+    args = ap.parse_args()
 
     max_slots = 8
     cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=192,
@@ -110,10 +129,14 @@ def main():
     useful = sum(w["n_new"] for w in workload)
 
     # warmup: compile both paths on the exact workload
-    run_engine(m, workload, max_slots)
+    run_engine(m, workload, max_slots, close_after=True)
     run_static(m, workload, max_slots)
 
+    if args.trace_out:
+        observe.clear()  # drop warmup events; trace the timed run only
+        observe.enable()
     wall_e, outs_e, snap = run_engine(m, workload, max_slots)
+    observe.disable()
     wall_s, outs_s, ttfts_s = run_static(m, workload, max_slots)
 
     # parity: every engine stream == its single-prompt generate output
@@ -163,7 +186,16 @@ def main():
         "ttft_p50_improvement": (percentile(ttfts_s, 50)
                                  / snap["latency"]["ttft"]["p50"]),
         "parity": bool(parity and static_parity),
+        # process-wide observe registry (serve counters/gauges/latency
+        # histograms across every run this process made)
+        "registry": observe.registry().snapshot(),
     }
+    if args.trace_out:
+        n_events = observe.export.write_chrome_trace(
+            args.trace_out,
+            metadata={"bench": "serve_continuous_batching"})
+        report["trace"] = {"path": args.trace_out,
+                           "trace_events": n_events}
     line = json.dumps(report)
     print(line)
     with open("BENCH_SERVE.json", "w") as f:

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from . import autograd, layer, tensor
+from .observe import trace as _trace
+from .observe.registry import registry as _obs_registry
 from .tensor import Tensor
 
 # Default checkpoint file mode (0o666 & ~umask), probed WITHOUT calling
@@ -77,6 +79,28 @@ def _ckpt_mode(ckpt_dir):
 
 # registry of graph runners (for Device.ResetGraph / PrintTimeProfiling)
 _graph_runners = []
+
+
+def _key_digest(key, width=96) -> str:
+    """Compact, human-scannable form of a graph-cache key for trace
+    args (the full nested tuple can run to kilobytes)."""
+    s = str(key)
+    return s if len(s) <= width else s[:width - 3] + "..."
+
+
+def _cost_args(cost) -> dict:
+    """Scalar entries of an XLA cost-analysis table, keyed safely for
+    trace span args (spaces -> underscores); {} when unavailable."""
+    c = cost[0] if isinstance(cost, (list, tuple)) and cost else cost
+    if not isinstance(c, dict):
+        return {}
+    out = {}
+    for k in ("flops", "bytes accessed", "transcendentals",
+              "optimal_seconds"):
+        v = c.get(k)
+        if isinstance(v, (int, float)):
+            out[k.replace(" ", "_")] = float(v)
+    return out
 
 
 def _clear_compiled_caches(device=None):
@@ -330,17 +354,26 @@ class Model(layer.Layer):
                 return _host_array(a)
             return jnp.copy(a)  # shield from graph-mode buffer donation
 
-        captured = {k: snap(v.data) for k, v in self.get_states().items()}
-        if self._optimizer is not None:
-            # state_tensors (not get_states): keep the transfer off this
-            # thread; snap() shields the buffers from step donation
-            for k, v in self._optimizer.state_tensors().items():
-                captured[f"__opt__{k}"] = snap(v.data)
-        if aux_states:
-            for k, v in aux_states.items():
-                captured[f"__aux__{k}"] = np.asarray(v)
+        with _trace.span("snapshot/capture", cat="snapshot",
+                         path=str(fpath), async_save=bool(async_save)):
+            captured = {k: snap(v.data)
+                        for k, v in self.get_states().items()}
+            if self._optimizer is not None:
+                # state_tensors (not get_states): keep the transfer off
+                # this thread; snap() shields the buffers from donation
+                for k, v in self._optimizer.state_tensors().items():
+                    captured[f"__opt__{k}"] = snap(v.data)
+            if aux_states:
+                for k, v in aux_states.items():
+                    captured[f"__aux__{k}"] = np.asarray(v)
 
         def _write():
+            with _trace.span("snapshot/write", cat="snapshot",
+                             path=str(fpath), tensors=len(captured),
+                             async_save=bool(async_save)):
+                _write_inner()
+
+        def _write_inner():
             states = {k: _host_array(v) for k, v in captured.items()}
             # unique temp per call: two overlapping async saves to the
             # same fpath must not interleave writes into one temp file
@@ -440,6 +473,16 @@ class _GraphRunner:
         self._compiled = {}  # key -> (jit_fn, state_names)
         self._plan_layouts = {}  # key -> (names, state/in/rng shardings)
         self._warm_keys = set()  # step signatures already state-probed
+        # observe: compile-cache hit/miss + step counters (process-wide
+        # registry; cached here so the hot replay path pays one integer
+        # add, not a registry lookup)
+        reg = _obs_registry()
+        self._m_hit = reg.counter(
+            "graph.cache_hit", help="graph-step executable replays")
+        self._m_miss = reg.counter(
+            "graph.cache_miss", help="graph-step compiles (new signature)")
+        self._m_steps = reg.counter(
+            "train.steps", help="optimizer steps dispatched via graph mode")
 
     def clear(self):
         self._compiled.clear()
@@ -644,18 +687,35 @@ class _GraphRunner:
             trace_ctx = contextlib.nullcontext()
         with trace_ctx:
             if key not in self._compiled or self._compiled[key][1] != names:
-                fn = self._build(key_args, key_kwargs, names,
-                                 n_steps=n_steps, repeat=repeat)
-                cost = None
-                try:
-                    compiled = fn.lower(state_arrays, in_arrays).compile()
-                    cost = compiled.cost_analysis()
-                    fn = compiled
-                except Exception:
-                    pass  # fall back to on-demand jit compile
-                self._compiled[key] = (fn, names, cost)
+                self._m_miss.inc()
+                _trace.event("graph/cache_miss", cat="train",
+                             key=_key_digest(key))
+                with _trace.span("graph/compile", cat="train",
+                                 key=_key_digest(key),
+                                 steps=n_steps or 1) as sp:
+                    fn = self._build(key_args, key_kwargs, names,
+                                     n_steps=n_steps, repeat=repeat)
+                    cost = None
+                    try:
+                        compiled = fn.lower(state_arrays,
+                                            in_arrays).compile()
+                        cost = compiled.cost_analysis()
+                        fn = compiled
+                    except Exception:
+                        pass  # fall back to on-demand jit compile
+                    self._compiled[key] = (fn, names, cost)
+                    sp.set(**_cost_args(cost))
+            else:
+                self._m_hit.inc()
+            self._m_steps.inc(n_steps or 1)
             fn = self._compiled[key][0]
-            new_state, out_tree = fn(state_arrays, in_arrays)
+            with _trace.span("train/step", cat="train",
+                             steps=n_steps or 1):
+                # host-side dispatch time: device execution is async, so
+                # the span closes when XLA accepts the work, not when the
+                # step finishes — the caller's readback sync (loss fetch)
+                # carries the device tail
+                new_state, out_tree = fn(state_arrays, in_arrays)
         for t, a in zip(tensors, new_state[:-1]):
             t.data = a
             t.creator = None

@@ -1,0 +1,31 @@
+"""singa_tpu.observe — unified tracing + metrics for train, serve, comms.
+
+The telemetry layer of the ROADMAP north star: one event model
+(``trace.py`` spans/instants), one process-wide metrics surface
+(``registry.py`` Counter/Gauge/Histogram, adopting the
+``utils.metrics`` percentile machinery), and three exporters
+(``export.py``: JSONL, Chrome trace-event JSON for Perfetto,
+Prometheus text).  Instrumented out of the box: graph-mode compile vs
+replay (``model._GraphRunner``), optimizer updates (``opt``),
+collectives (``parallel.communicator``), checkpoints (``snapshot`` /
+``Model.save_states``), and the serving engine's prefill / decode /
+retire loop (``serve.engine``, whose ``EngineStats`` registers its
+counters here).
+
+Tracing is OFF by default and costs one flag check per site when off;
+the registry is always on (counter bumps, vLLM-style).  See
+docs/OBSERVABILITY.md.
+
+    from singa_tpu import observe
+    observe.enable()
+    ...train / serve...
+    observe.export.write_chrome_trace("/tmp/trace.json")
+    print(observe.export.prometheus_text())
+"""
+
+from . import export  # noqa: F401
+from . import trace  # noqa: F401
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, registry)
+from .trace import (clear, disable, drain, enable, event,  # noqa: F401
+                    events, is_enabled, set_max_events, span, traced)

@@ -1,0 +1,198 @@
+"""Exporters for the ``observe`` buffer and registry.
+
+Three formats, three audiences:
+
+* :func:`write_jsonl` / :func:`jsonl_lines` — the raw span/event
+  records, one JSON object per line; greppable, streamable, the
+  machine-diffable archive format.
+* :func:`chrome_trace` / :func:`write_chrome_trace` — Chrome
+  trace-event JSON (the ``{"traceEvents": [...]}`` object form),
+  loadable directly in Perfetto (https://ui.perfetto.dev) or
+  ``chrome://tracing``.  Tracks: one row per SUBSYSTEM (the span
+  ``cat`` — train/serve/comms/snapshot/...), named via ``thread_name``
+  metadata events, with the originating Python thread preserved in
+  each span's args.  Timestamps are microseconds per the spec.
+* :func:`prometheus_text` — text exposition of a
+  :class:`~singa_tpu.observe.registry.MetricsRegistry` (counters,
+  gauges, histograms-as-summaries), scrapeable by any Prometheus
+  agent.  Metric names are sanitized to the exposition charset and
+  prefixed ``singa_tpu_``.
+
+All exporters take explicit ``events``/``reg`` arguments and default
+to the live trace buffer / default registry, so tests can run them on
+synthetic data.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+
+from . import trace as _trace
+from .registry import Counter, Histogram, registry as _registry
+
+__all__ = ["jsonl_lines", "write_jsonl", "chrome_trace",
+           "write_chrome_trace", "prometheus_text",
+           "write_prometheus"]
+
+
+# ---------------------------------------------------------------------------
+# JSONL
+# ---------------------------------------------------------------------------
+
+def jsonl_lines(events=None):
+    """Yield one JSON line per buffered event (record schema as
+    documented in ``trace.py``)."""
+    if events is None:
+        events = _trace.events()
+    for rec in events:
+        yield json.dumps(rec, default=str)
+
+
+def write_jsonl(path, events=None):
+    """Write the event log as JSONL; returns the event count."""
+    n = 0
+    with open(path, "w") as f:
+        for line in jsonl_lines(events):
+            f.write(line + "\n")
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event JSON (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+def chrome_trace(events=None, metadata=None) -> dict:
+    """Build the trace-event object: spans as complete ("X") events,
+    instants as "i", one tid per subsystem category with a
+    ``thread_name`` row label.  ``metadata`` is merged into the
+    top-level ``otherData``."""
+    if events is None:
+        events = _trace.events()
+    cats = []
+    for rec in events:
+        if rec["cat"] not in cats:
+            cats.append(rec["cat"])
+    tid_of = {c: i for i, c in enumerate(cats)}
+    out = []
+    for c, tid in tid_of.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                    "tid": tid, "args": {"name": c}})
+    for rec in events:
+        args = dict(rec["args"] or {})
+        args["thread"] = rec["tid"]
+        if rec.get("parent"):
+            args["parent"] = rec["parent"]
+        ev = {"name": rec["name"], "cat": rec["cat"], "ph": rec["ph"],
+              "pid": 0, "tid": tid_of[rec["cat"]],
+              "ts": rec["ts"] * 1e6, "args": args}
+        if rec["ph"] == "X":
+            ev["dur"] = rec["dur"] * 1e6
+        else:
+            ev["s"] = "t"  # instant scoped to its track
+        out.append(ev)
+    doc = {"traceEvents": out, "displayTimeUnit": "ms",
+           "otherData": {"source": "singa_tpu.observe",
+                         "dropped_events": _trace.dropped()}}
+    if metadata:
+        doc["otherData"].update(metadata)
+    return doc
+
+
+def write_chrome_trace(path, events=None, metadata=None) -> int:
+    """Write the Chrome trace JSON; returns the trace-event count
+    (metadata rows included)."""
+    doc = chrome_trace(events, metadata)
+    with open(path, "w") as f:
+        # default=str: span args routinely carry numpy/jax scalars; a
+        # trace must never be lost at export time over a dtype
+        json.dump(doc, f, default=str)
+    return len(doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "singa_tpu_"
+
+
+def _prom_name(name: str) -> str:
+    n = _NAME_OK.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return _PREFIX + n
+
+
+def _prom_labels(labels, extra=()):
+    items = list(labels) + list(extra)
+    if not items:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (_NAME_OK.sub("_", k),
+                     str(v).replace("\\", r"\\").replace('"', r'\"'))
+        for k, v in items)
+    return "{" + body + "}"
+
+
+def _prom_num(v) -> str:
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v) if v != int(v) else str(int(v))
+
+
+def prometheus_text(reg=None) -> str:
+    """Render a registry in the Prometheus text exposition format.
+    Histograms are exposed as summaries (quantile series + ``_sum`` /
+    ``_count``), matching their nearest-rank p50/p99 summary schema."""
+    if reg is None:
+        reg = _registry()
+    by_name = {}
+    for m in reg.metrics():
+        by_name.setdefault(m.name, []).append(m)
+    lines = []
+    for name in sorted(by_name):
+        group = by_name[name]
+        pname = _prom_name(name)
+        kind = group[0].KIND
+        kind = {"histogram": "summary"}.get(kind, kind)
+        # counter samples carry the _total suffix, and the classic
+        # text format (prometheus_client convention) declares TYPE/
+        # HELP under the SAMPLE name — a TYPE under the bare name
+        # would describe a family with zero samples
+        decl = pname + "_total" if kind == "counter" else pname
+        help_ = next((m.help for m in group if m.help), "")
+        if help_:
+            lines.append(f"# HELP {decl} {help_}")
+        lines.append(f"# TYPE {decl} {kind}")
+        for m in group:
+            if isinstance(m, Histogram):
+                s = m.series
+                for q in (0.5, 0.99):
+                    lines.append(
+                        pname
+                        + _prom_labels(m.labels,
+                                       [("quantile", q)])
+                        + " " + _prom_num(s.percentile(q * 100)))
+                lines.append(pname + "_sum" + _prom_labels(m.labels)
+                             + " " + _prom_num(sum(s.values)))
+                lines.append(pname + "_count" + _prom_labels(m.labels)
+                             + " " + _prom_num(s.count))
+            else:
+                suffix = "_total" if isinstance(m, Counter) else ""
+                lines.append(pname + suffix + _prom_labels(m.labels)
+                             + " " + _prom_num(m.value))
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path, reg=None) -> str:
+    text = prometheus_text(reg)
+    with open(path, "w") as f:
+        f.write(text)
+    return text

@@ -1,0 +1,222 @@
+"""Process-wide metrics registry — the state half of ``singa_tpu.observe``.
+
+Three metric kinds, the Prometheus trinity:
+
+* :class:`Counter` — monotone count (cache misses, tokens emitted,
+  collectives issued).
+* :class:`Gauge` — last-written level (queue depth, slot occupancy).
+* :class:`Histogram` — per-event value distribution; adopts the
+  existing :class:`~singa_tpu.utils.metrics.LatencySeries` wholesale,
+  so its ``summary()`` is the same count/mean/p50/p99/max schema the
+  serving stats already report (nearest-rank percentiles, see
+  ``utils.metrics.percentile``).
+
+A metric is identified by ``(name, frozen label set)`` — asking the
+registry for the same identity returns the SAME object (get-or-create),
+which is what lets independent subsystems (``serve.EngineStats``, the
+graph runner, the communicator) accumulate into one process-wide
+surface without coordination.  Re-registering an identity as a
+different kind raises: silent type morphing is how dashboards break.
+
+The default process registry is reachable via :func:`registry`;
+isolated registries (tests, per-bench snapshots) are just
+``MetricsRegistry()`` instances.  Export: ``snapshot()`` here (stable
+JSON-able dict), Prometheus text exposition in ``export.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.metrics import LatencySeries
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry"]
+
+
+class _Metric:
+    __slots__ = ("name", "labels", "help", "_lock")
+
+    KIND = "metric"
+
+    def __init__(self, name, labels, help=""):
+        self.name = name
+        self.labels = labels  # tuple of (key, value) pairs, sorted
+        self.help = help
+        # per-metric lock: `value += n` is a read-modify-write across
+        # bytecodes, and the observe layer promises concurrent use
+        # (async-checkpoint writer thread + main loop)
+        self._lock = threading.Lock()
+
+    @property
+    def key(self):
+        return (self.name, self.labels)
+
+
+class Counter(_Metric):
+    """Monotonically increasing count."""
+
+    __slots__ = ("value",)
+
+    KIND = "counter"
+
+    def __init__(self, name, labels=(), help=""):
+        super().__init__(name, labels, help)
+        self.value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease "
+                             f"(inc({n})); use a Gauge")
+        with self._lock:
+            self.value += n
+        return self
+
+
+class Gauge(_Metric):
+    """Last-written level; ``set``/``inc``/``dec``."""
+
+    __slots__ = ("value",)
+
+    KIND = "gauge"
+
+    def __init__(self, name, labels=(), help=""):
+        super().__init__(name, labels, help)
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = v
+        return self
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+        return self
+
+    def dec(self, n=1):
+        with self._lock:
+            self.value -= n
+        return self
+
+
+class Histogram(_Metric):
+    """Value distribution over a :class:`LatencySeries` (count/mean/
+    p50/p99/max summary schema)."""
+
+    __slots__ = ("series",)
+
+    KIND = "histogram"
+
+    def __init__(self, name, labels=(), help="", series=None):
+        super().__init__(name, labels, help)
+        self.series = series if series is not None else LatencySeries()
+
+    def observe(self, v):
+        self.series.record(v)
+        return self
+
+    @property
+    def count(self):
+        return self.series.count
+
+    def summary(self) -> dict:
+        return self.series.summary()
+
+
+def _label_key(labels: dict):
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class MetricsRegistry:
+    """Name+labels -> metric map with get-or-create semantics."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._kinds = {}  # name -> metric class (one kind per name)
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, labels, help, **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                # one kind per NAME, not just per (name, labels): a
+                # Prometheus family declares a single TYPE, so a
+                # counter x{op=a} next to a gauge x{op=b} would render
+                # an exposition conformant scrapers reject
+                prior = self._kinds.get(name)
+                if prior is not None and prior is not cls:
+                    raise TypeError(
+                        f"metric name {name!r} already registered as "
+                        f"{prior.KIND}, requested {cls.KIND} (one kind "
+                        f"per name — Prometheus families share a TYPE)")
+                m = cls(name, key[1], help=help, **kw)
+                self._metrics[key] = m
+                self._kinds[name] = cls
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r}{dict(key[1])} already registered "
+                    f"as {m.KIND}, requested {cls.KIND}")
+            return m
+
+    def counter(self, name, help="", **labels) -> Counter:
+        return self._get_or_create(Counter, name, labels, help)
+
+    def gauge(self, name, help="", **labels) -> Gauge:
+        return self._get_or_create(Gauge, name, labels, help)
+
+    def histogram(self, name, help="", series=None, **labels) -> Histogram:
+        """``series``: adopt an existing LatencySeries as the backing
+        store (EngineStats hands its TTFT/TPOT series over this way —
+        one copy of the data, two views)."""
+        return self._get_or_create(Histogram, name, labels, help,
+                                   series=series)
+
+    def metrics(self) -> list:
+        """All registered metrics, in stable (name, labels) order."""
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def remove(self, *metrics):
+        """Unregister metric objects (e.g. a retired engine's
+        ``serve.*`` set — see ``EngineStats.unregister``) so a
+        process-lifetime registry doesn't pin dead subsystems'
+        histograms forever.  Unknown metrics are ignored.  A name
+        whose last metric is removed frees its kind reservation too."""
+        with self._lock:
+            for m in metrics:
+                self._metrics.pop(m.key, None)
+            names = {name for name, _ in self._metrics}
+            for name in [n for n in self._kinds if n not in names]:
+                del self._kinds[name]
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+            self._kinds.clear()
+
+    def snapshot(self) -> dict:
+        """JSON-able view: ``{"counters": {...}, "gauges": {...},
+        "histograms": {...}}`` keyed ``name{k=v,...}`` (labels sorted,
+        braces omitted when unlabeled)."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.metrics():
+            k = m.name
+            if m.labels:
+                k += "{" + ",".join(f"{lk}={lv}"
+                                    for lk, lv in m.labels) + "}"
+            if isinstance(m, Counter):
+                out["counters"][k] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][k] = m.summary()
+            else:
+                out["gauges"][k] = m.value
+        return out
+
+
+_default = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry."""
+    return _default

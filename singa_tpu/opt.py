@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import autograd, tensor
+from .observe import trace as _trace
+from .observe.registry import registry as _obs_registry
 from .tensor import Tensor
 
 
@@ -103,6 +105,16 @@ class Optimizer:
         # compiled graph-mode step
         self.step_counter = Tensor(shape=(), dtype=tensor.float32,
                                    requires_grad=False)
+        # observe: resolved once — eager mode runs an update per step,
+        # so the hot path pays one integer add, not a registry lookup.
+        # Under graph mode the update fuses into the compiled step, so
+        # (like comms.*) this counts once per COMPILE, not per replayed
+        # step — train.steps is the per-step count there.
+        self._m_updates = _obs_registry().counter(
+            "opt.updates",
+            help="optimizer update passes (eager: per step; graph "
+                 "mode: at trace time, once per compile)",
+            optimizer=type(self).__name__)
         self._states = {}  # name -> Tensor (momentum buffers etc.)
         self._name_of = {}  # id(param Tensor) -> assigned name
 
@@ -187,16 +199,29 @@ class Optimizer:
         return self._clip_pairs(list(autograd.backward(loss)))
 
     def backward_and_update(self, loss):
-        for p, g in self._grad_pairs(loss):
-            self.apply(self._param_name(p), p, g)
-        self.step()
+        # the span measures HOST time — eager dispatch in eager mode,
+        # trace construction under graph mode's jit (where the update
+        # math fuses into the step and has no separable device cost)
+        with _trace.span("opt/update", cat="train",
+                         optimizer=type(self).__name__) as sp:
+            n = 0
+            for p, g in self._grad_pairs(loss):
+                self.apply(self._param_name(p), p, g)
+                n += 1
+            self.step()
+            sp.set(params=n)
+        self._m_updates.inc()
 
     def call_with_returns(self, loss):
         pn_p_g = []
-        for p, g in self._grad_pairs(loss):
-            self.apply(self._param_name(p), p, g)
-            pn_p_g.append((self._param_name(p), p, g))
-        self.step()
+        with _trace.span("opt/update", cat="train",
+                         optimizer=type(self).__name__) as sp:
+            for p, g in self._grad_pairs(loss):
+                self.apply(self._param_name(p), p, g)
+                pn_p_g.append((self._param_name(p), p, g))
+            self.step()
+            sp.set(params=len(pn_p_g))
+        self._m_updates.inc()
         return pn_p_g
 
     def step(self):

@@ -29,9 +29,35 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..observe import trace as _otrace
+from ..observe.registry import registry as _obs_registry
+
 __all__ = ["Communicator", "get_mesh", "initialize_distributed", "is_tracing"]
 
 _DEFAULT_AXIS = "data"
+
+
+def _record_collective(op, arrs):
+    """Observe hook for one collective issue: per-op count + payload
+    bytes (registry ``comms.collectives``/``comms.bytes``) and a trace
+    instant.  Collectives execute inside compiled steps, so this fires
+    at TRACE time — counts are per-compile, not per-replayed-step
+    (a replay issues the same collectives XLA baked in)."""
+    n = 0
+    for a in arrs:
+        try:
+            n += int(np.prod(a.shape or (1,))) * a.dtype.itemsize
+        except (AttributeError, TypeError):
+            pass
+    reg = _obs_registry()
+    reg.counter("comms.collectives",
+                help="collective ops issued (at trace time)",
+                op=op).inc()
+    reg.counter("comms.bytes",
+                help="collective payload bytes (at trace time)",
+                op=op).inc(n)
+    _otrace.event(f"comms/{op}", cat="comms", bytes=n,
+                  arrays=len(arrs))
 
 
 def _wait_for_coordinator(address, timeout):
@@ -134,6 +160,7 @@ class Communicator:
     def all_reduce(self, arr, average=False):
         if not self._in_step(arr):
             return arr  # eager / unsharded: world-1 identity (see above)
+        _record_collective("all_reduce", [arr])
         out = lax.psum(arr, self.axis_name)
         return out / self.world_size if average else out
 
@@ -148,6 +175,7 @@ class Communicator:
             return []
         if not self._in_step(arrs[0]):
             return list(arrs)
+        _record_collective("fused_synch", arrs)
         shapes = [a.shape for a in arrs]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
         flat = jnp.concatenate([a.reshape(-1) for a in arrs])
@@ -165,6 +193,7 @@ class Communicator:
     def synch_half(self, arr, average=False):
         if not self._in_step(arr):
             return arr.astype(jnp.bfloat16).astype(arr.dtype)
+        _record_collective("synch_half", [arr])
         red = lax.psum(arr.astype(jnp.bfloat16), self.axis_name)
         red = red.astype(arr.dtype)
         return red / self.world_size if average else red
@@ -174,6 +203,7 @@ class Communicator:
             return []
         if not self._in_step(arrs[0]):
             return [a.astype(jnp.bfloat16).astype(a.dtype) for a in arrs]
+        _record_collective("fused_synch_half", arrs)
         shapes = [a.shape for a in arrs]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
         flat = jnp.concatenate([a.reshape(-1) for a in arrs]).astype(jnp.bfloat16)
@@ -197,6 +227,9 @@ class Communicator:
                           average=False):
         """Returns (synced, new_residual); both shaped like arr."""
         in_step = self._in_step(arr)
+        if in_step:
+            _record_collective(
+                "sparse_topk" if topK else "sparse_threshold", [arr])
         acc = residual + arr
         flat = acc.reshape(-1)
         n = flat.shape[0]

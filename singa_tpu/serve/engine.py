@@ -48,6 +48,7 @@ import numpy as np
 
 from ..models.gpt2_decode import (_logits, _norm_window, _sample,
                                   decode_step, extract_params, prefill)
+from ..observe import trace as _trace
 from ..utils.logging import get_channel
 from .request import (DeadlineExceededError, GenerationRequest,
                       GenerationResult, RequestHandle)
@@ -217,6 +218,7 @@ class InferenceEngine:
         self._temps = np.zeros(S, np.float32)
         self._keys = jnp.zeros((S, 2), jnp.uint32)
         self._handles = {}
+        self._closed = False
         self.step_count = 0
         self._log.info(
             "engine up: slots=%d max_len=%d arena=%s x2 (%s)",
@@ -227,6 +229,9 @@ class InferenceEngine:
         """Queue a request; returns immediately with a handle.  Raises
         QueueFullError under back-pressure and ValueError for requests
         that could never fit the arena."""
+        if self._closed:
+            raise RuntimeError(
+                "engine is closed; build a new one with model.serve()")
         if not isinstance(request, GenerationRequest):
             request = GenerationRequest(np.asarray(request))
         need = len(request.prompt_ids) + request.max_new_tokens
@@ -261,6 +266,40 @@ class InferenceEngine:
         return (self.scheduler.queue_depth > 0
                 or any(s is not None for s in self._slots))
 
+    # -- lifecycle -------------------------------------------------------
+    def close(self):
+        """Retire the engine: unregister its ``serve.*{engine=n}``
+        metrics from the process-wide observe registry (they would
+        otherwise be pinned — TTFT/TPOT value lists included — for
+        process lifetime) and drop the KV arena references.  Idempotent;
+        the engine must be drained (``not pending``) first.  Also the
+        context-manager exit: ``with model.serve(...) as eng: ...``."""
+        if self.pending:
+            raise RuntimeError(
+                f"close() with work in flight (queue="
+                f"{self.scheduler.queue_depth}, live={self.live_slots});"
+                f" drain with run_until_complete() first")
+        self.stats.unregister()
+        self._kc = self._vc = None
+        self._params = None
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is None:
+            self.close()
+        else:
+            # don't let the drained-first check mask the in-flight
+            # exception; still release the registry entries AND the
+            # arena/params (the pinning close() exists to prevent)
+            self.stats.unregister()
+            self._kc = self._vc = None
+            self._params = None
+            self._closed = True
+        return False
+
     @property
     def live_slots(self) -> int:
         return sum(s is not None for s in self._slots)
@@ -271,6 +310,9 @@ class InferenceEngine:
         retire finished rows, then backfill freed slots from the queue
         (so backfill lands on the very step a row retires).  Returns
         ``pending``."""
+        if self._closed:
+            raise RuntimeError(
+                "engine is closed; build a new one with model.serve()")
         if any(s is not None for s in self._slots):
             self._decode_once()
         self._schedule(self._clock())
@@ -294,13 +336,16 @@ class InferenceEngine:
     # -- internals -------------------------------------------------------
     def _decode_once(self):
         live = np.asarray([s is not None for s in self._slots])
-        next_toks, self._kc, self._vc, self._keys = _pool_decode_step(
-            self._params, self._kc, self._vc,
-            jnp.asarray(self._toks), jnp.asarray(self._pos),
-            jnp.asarray(live), self._keys,
-            jnp.asarray(self._temps), self._top_p, **self._statics)
-        next_toks = np.asarray(next_toks)
-        self.stats.on_decode_step(int(live.sum()))
+        n_live = int(live.sum())
+        with _trace.span("serve/decode_step", cat="serve",
+                         step=self.step_count, live=n_live):
+            next_toks, self._kc, self._vc, self._keys = _pool_decode_step(
+                self._params, self._kc, self._vc,
+                jnp.asarray(self._toks), jnp.asarray(self._pos),
+                jnp.asarray(live), self._keys,
+                jnp.asarray(self._temps), self._top_p, **self._statics)
+            next_toks = np.asarray(next_toks)
+        self.stats.on_decode_step(n_live)
         t_emit = self._clock()
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -324,6 +369,9 @@ class InferenceEngine:
     def _retire(self, idx, slot, now):
         req = slot.handle.request
         n = len(slot.emitted)
+        _trace.event("serve/retire", cat="serve",
+                     request=req.request_id, slot=idx, tokens=n,
+                     step=self.step_count)
         submit_t = getattr(slot.handle, "_submit_time", slot.admit_time)
         ttft = slot.first_token_time - submit_t
         tpot = ((now - slot.first_token_time) / (n - 1)
@@ -367,17 +415,20 @@ class InferenceEngine:
         call is B=1, row 0."""
         handle = self._handles[req.request_id]
         plen = len(req.prompt_ids)
-        ids = np.zeros((1, self.max_len), np.int32)
-        ids[0, :plen] = req.prompt_ids
-        key0 = jax.random.split(
-            jax.random.PRNGKey(int(req.seed)), 1)[0]
-        temp = np.float32(req.temperature)
-        tok0, carry_key, kc_row, vc_row = _prefill_one(
-            self._params, jnp.asarray(ids), plen, key0, temp,
-            self._top_p, **self._statics)
-        self._kc, self._vc = _write_slot(self._kc, self._vc,
-                                         kc_row, vc_row,
-                                         jnp.int32(idx))
+        with _trace.span("serve/prefill", cat="serve",
+                         request=req.request_id, slot=idx,
+                         prompt_len=plen, step=self.step_count):
+            ids = np.zeros((1, self.max_len), np.int32)
+            ids[0, :plen] = req.prompt_ids
+            key0 = jax.random.split(
+                jax.random.PRNGKey(int(req.seed)), 1)[0]
+            temp = np.float32(req.temperature)
+            tok0, carry_key, kc_row, vc_row = _prefill_one(
+                self._params, jnp.asarray(ids), plen, key0, temp,
+                self._top_p, **self._statics)
+            self._kc, self._vc = _write_slot(self._kc, self._vc,
+                                             kc_row, vc_row,
+                                             jnp.int32(idx))
         self.stats.on_prefill()
         slot = _Slot(handle, req.max_new_tokens, now, self.step_count)
         self._slots[idx] = slot

@@ -1,10 +1,20 @@
 """Serving telemetry: per-request latency, queue/slot gauges, token
 throughput.
 
-Built on ``singa_tpu.utils.metrics`` (LatencySeries gives the
-count/mean/p50/p99/max summary every latency here reports) and logged
-through the ``serve`` channel of ``singa_tpu.utils.logging``.  The
-``snapshot()`` schema is STABLE — tests/test_serve.py asserts the
+Since the ``singa_tpu.observe`` round, every number here lives in the
+process-wide metrics registry (``observe.registry``) instead of a
+private attribute soup: counters/gauges/histograms are registered
+under ``serve.*`` with an ``engine=<n>`` label (one label value per
+engine instance, so two engines in one process never collide), which
+makes the serving surface exportable over Prometheus text alongside
+the train-side metrics without any extra glue.  The TTFT/TPOT
+histograms still ride :class:`~singa_tpu.utils.metrics.LatencySeries`:
+the registry's Histogram owns the series and ``self.ttft``/``self.tpot``
+are the same object (one copy of the data, two views).  Engines are
+process-lifetime in the registry; call :meth:`unregister` when
+retiring one in a long-lived process.
+
+The ``snapshot()`` schema is STABLE — tests/test_serve.py asserts the
 exact key set, and bench_serve.py writes it into BENCH_SERVE.json so
 future PRs have a comparable perf trajectory — extend it by adding
 keys, never by renaming.
@@ -22,8 +32,12 @@ Metric definitions (the serving-standard ones):
 
 from __future__ import annotations
 
+import itertools
+
+from ..observe.registry import registry
 from ..utils.logging import get_channel
-from ..utils.metrics import LatencySeries
+
+_engine_ids = itertools.count()
 
 
 class EngineStats:
@@ -31,54 +45,126 @@ class EngineStats:
     point.  All wall-clock numbers come from the engine's clock so a
     fake clock makes the whole schema deterministic in tests."""
 
-    def __init__(self, max_slots: int, clock):
+    def __init__(self, max_slots: int, clock, reg=None):
         self.max_slots = int(max_slots)
         self._clock = clock
         self._t0 = clock()
-        self.ttft = LatencySeries()
-        self.tpot = LatencySeries()
-        self.completed = 0
-        self.rejected_deadline = 0
-        self.rejected_queue_full = 0
-        self.submitted = 0
-        self.prefills = 0
-        self.decode_steps = 0
-        self.tokens_out = 0
+        reg = reg if reg is not None else registry()
+        self.registry = reg
+        self.engine_label = str(next(_engine_ids))
+        lbl = dict(engine=self.engine_label)
+        self._submitted = reg.counter(
+            "serve.submitted",
+            help="submit() calls (queue-full rejections included)", **lbl)
+        self._completed = reg.counter(
+            "serve.completed", help="requests retired normally", **lbl)
+        self._rej_deadline = reg.counter(
+            "serve.rejected_deadline",
+            help="requests dropped past their deadline", **lbl)
+        self._rej_queue = reg.counter(
+            "serve.rejected_queue_full",
+            help="requests rejected by back-pressure", **lbl)
+        self._prefills = reg.counter(
+            "serve.prefills", help="admission prefills run", **lbl)
+        self._decode_steps = reg.counter(
+            "serve.decode_steps", help="pool decode steps run", **lbl)
+        self._tokens_out = reg.counter(
+            "serve.tokens_out", help="tokens emitted", **lbl)
+        self._h_ttft = reg.histogram(
+            "serve.ttft", help="submit->first-token seconds", **lbl)
+        self._h_tpot = reg.histogram(
+            "serve.tpot", help="mean inter-token seconds", **lbl)
+        self.ttft = self._h_ttft.series
+        self.tpot = self._h_tpot.series
+        self._queue_depth = reg.gauge(
+            "serve.queue_depth", help="scheduler queue depth", **lbl)
+        self._occupancy = reg.gauge(
+            "serve.occupancy",
+            help="live slots / max_slots, last decode step", **lbl)
+        # mean/max accumulators (a gauge only keeps the last sample)
         self._queue_depth_sum = 0
         self._queue_depth_max = 0
         self._queue_samples = 0
         self._occupancy_sum = 0.0
         self._log = get_channel("serve")
+        self._registered = [
+            self._submitted, self._completed, self._rej_deadline,
+            self._rej_queue, self._prefills, self._decode_steps,
+            self._tokens_out, self._queue_depth, self._occupancy,
+            self._h_ttft, self._h_tpot,
+        ]
+
+    def unregister(self):
+        """Remove this engine's metrics from the registry.  Call when
+        retiring an engine in a long-lived process (per-tenant engines,
+        reload loops): the registry is process-lifetime, so without
+        this each discarded engine pins its serve.* set — including
+        the unbounded TTFT/TPOT value lists — forever.  The stats
+        object itself keeps working (snapshot() reads the same
+        objects); they just stop being exported."""
+        self.registry.remove(*self._registered)
+
+    # registry-backed counts, readable as plain attributes
+    @property
+    def submitted(self):
+        return self._submitted.value
+
+    @property
+    def completed(self):
+        return self._completed.value
+
+    @property
+    def rejected_deadline(self):
+        return self._rej_deadline.value
+
+    @property
+    def rejected_queue_full(self):
+        return self._rej_queue.value
+
+    @property
+    def prefills(self):
+        return self._prefills.value
+
+    @property
+    def decode_steps(self):
+        return self._decode_steps.value
+
+    @property
+    def tokens_out(self):
+        return self._tokens_out.value
 
     # -- recording hooks (called by the engine) -------------------------
     def on_submit(self):
-        self.submitted += 1
+        self._submitted.inc()
 
     def on_queue_full(self, request_id):
-        self.rejected_queue_full += 1
+        self._rej_queue.inc()
         self._log.warning("queue full: rejected %s", request_id)
 
     def on_deadline_expired(self, request_id):
-        self.rejected_deadline += 1
+        self._rej_deadline.inc()
         self._log.warning("deadline expired: rejected %s", request_id)
 
     def on_prefill(self):
-        self.prefills += 1
+        self._prefills.inc()
 
     def on_token(self):
-        self.tokens_out += 1
+        self._tokens_out.inc()
 
     def on_decode_step(self, live_slots: int):
-        self.decode_steps += 1
-        self._occupancy_sum += live_slots / self.max_slots
+        self._decode_steps.inc()
+        occ = live_slots / self.max_slots
+        self._occupancy_sum += occ
+        self._occupancy.set(occ)
 
     def on_schedule(self, queue_depth: int):
         self._queue_samples += 1
         self._queue_depth_sum += queue_depth
         self._queue_depth_max = max(self._queue_depth_max, queue_depth)
+        self._queue_depth.set(queue_depth)
 
     def on_complete(self, result):
-        self.completed += 1
+        self._completed.inc()
         self.ttft.record(result.ttft)
         if result.tpot is not None:
             self.tpot.record(result.tpot)

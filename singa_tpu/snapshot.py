@@ -15,6 +15,7 @@ import numpy as np
 
 from . import tensor
 from .io.binfile import BinFileReader, BinFileWriter
+from .observe import trace as _trace
 from .tensor import Tensor
 
 
@@ -53,15 +54,19 @@ class Snapshot:
     def write(self, key, t):
         assert self._writer is not None, "snapshot opened for reading"
         arr = tensor.to_numpy(t) if isinstance(t, Tensor) else np.asarray(t)
-        self._writer.put(key, _encode(arr))
+        with _trace.span("snapshot/write_record", cat="snapshot",
+                         key=str(key), bytes=int(arr.nbytes)):
+            self._writer.put(key, _encode(arr))
 
     # reference alias
     Write = write
 
     def read(self) -> dict:
         assert self._reader is not None, "snapshot opened for writing"
-        return {k: tensor.from_numpy(_decode(v))
-                for k, v in self._reader.items()}
+        with _trace.span("snapshot/read", cat="snapshot",
+                         path=self.path):
+            return {k: tensor.from_numpy(_decode(v))
+                    for k, v in self._reader.items()}
 
     Read = read
 

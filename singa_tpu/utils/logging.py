@@ -14,21 +14,29 @@ _stderr_default = True
 
 
 def init_channel(argv0="singa_tpu", dir="", stderr=True):
-    """Reference: InitChannel — set the channel output directory."""
+    """Reference: InitChannel — set the channel output directory.
+
+    Channels created BEFORE this call are reconfigured in place:
+    their handlers are rebuilt against the new dir/stderr settings
+    (previously a cached logger silently kept its stale handlers — no
+    file handler, wrong stderr teeing — because ``get_channel`` only
+    configures on first creation)."""
     global _channel_dir, _stderr_default
     _channel_dir = dir or None
     _stderr_default = stderr
     if _channel_dir:
         os.makedirs(_channel_dir, exist_ok=True)
+    for name, logger in _channels.items():
+        _configure(logger, name)
 
 
-def get_channel(name="global") -> logging.Logger:
-    """Named channel; logs to <dir>/<name>.log and/or stderr."""
-    if name in _channels:
-        return _channels[name]
-    logger = logging.getLogger(f"singa_tpu.{name}")
-    logger.setLevel(logging.INFO)
-    logger.propagate = False
+def _configure(logger, name):
+    """(Re)build a channel's handlers from the current module config,
+    closing any file handlers the old config opened."""
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+        if isinstance(h, logging.FileHandler):
+            h.close()
     fmt = logging.Formatter(
         "[%(asctime)s %(levelname).1s %(name)s] %(message)s", "%H:%M:%S")
     if _stderr_default:
@@ -41,6 +49,16 @@ def get_channel(name="global") -> logging.Logger:
         logger.addHandler(fh)
     if not logger.handlers:
         logger.addHandler(logging.NullHandler())
+
+
+def get_channel(name="global") -> logging.Logger:
+    """Named channel; logs to <dir>/<name>.log and/or stderr."""
+    if name in _channels:
+        return _channels[name]
+    logger = logging.getLogger(f"singa_tpu.{name}")
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    _configure(logger, name)
     _channels[name] = logger
     return logger
 

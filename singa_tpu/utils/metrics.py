@@ -39,7 +39,12 @@ class StepTimer:
         return sum(s) / len(s) if s else float("nan")
 
     def samples_per_sec(self, batch_size) -> float:
-        return batch_size / self.mean_step_seconds()
+        """nan when no steps were recorded or the mean is zero (a
+        zero-duration clock in tests) — never ZeroDivisionError."""
+        m = self.mean_step_seconds()
+        if m != m or m == 0.0:  # nan or zero mean
+            return float("nan")
+        return batch_size / m
 
     def samples_per_sec_per_chip(self, batch_size, num_chips=1) -> float:
         return self.samples_per_sec(batch_size) / num_chips
@@ -58,12 +63,14 @@ def percentile(values, p) -> float:
     happened), which is the convention serving dashboards use."""
     vals = sorted(values)
     if not vals:
+        # empty in == nan out, matching LatencySeries.mean(); callers
+        # never have to special-case "no samples yet"
         return float("nan")
     if p <= 0:
         return float(vals[0])
     import math
 
-    rank = math.ceil(p / 100.0 * len(vals))
+    rank = math.ceil(min(p, 100) / 100.0 * len(vals))
     return float(vals[min(len(vals), max(1, rank)) - 1])
 
 

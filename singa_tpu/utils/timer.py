@@ -4,9 +4,17 @@ import time
 
 
 class Timer:
-    """t = Timer(); ...; t.elapsed() -> seconds.  Also a context manager."""
+    """t = Timer(); ...; t.elapsed() -> seconds.  Also a context manager.
+
+    ``seconds`` is the frozen context-manager result: ``None`` until a
+    ``with`` block exits (it used to not exist at all — reading it
+    before exit raised AttributeError), then the block's duration; a
+    re-entered timer overwrites it.  Use ``elapsed()`` for a live
+    reading at any point.
+    """
 
     def __init__(self):
+        self.seconds = None
         self.reset()
 
     def reset(self):

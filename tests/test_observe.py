@@ -1,0 +1,455 @@
+"""singa_tpu.observe: span tracing (deterministic clock), metrics
+registry, exporters (Chrome trace / JSONL / Prometheus), EngineStats
+registry adoption, and the disabled-mode overhead contract."""
+
+import json
+import threading
+
+import pytest
+
+from singa_tpu import observe
+from singa_tpu.observe import export
+from singa_tpu.observe.registry import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Each test starts with tracing off and an empty buffer; the
+    process registry is shared (get-or-create), so tests below use
+    private MetricsRegistry instances for exact-value asserts."""
+    observe.disable()
+    observe.clear()
+    yield
+    observe.disable()
+    observe.clear()
+
+
+# ---------------------------------------------------------------------------
+# trace core
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_with_deterministic_clock():
+    clk = FakeClock()
+    observe.enable(clock=clk)
+    with observe.span("outer", cat="train", step=7) as sp:
+        clk.advance(1.0)
+        with observe.span("inner", cat="train"):
+            clk.advance(0.5)
+        sp.set(loss=0.25)
+        clk.advance(2.0)
+    evs = observe.events()
+    assert [e["name"] for e in evs] == ["inner", "outer"]  # exit order
+    inner, outer = evs
+    assert inner["parent"] == "outer" and inner["depth"] == 1
+    assert outer["parent"] is None and outer["depth"] == 0
+    assert inner["ts"] == 1.0 and inner["dur"] == 0.5
+    assert outer["ts"] == 0.0 and outer["dur"] == 3.5
+    assert outer["args"] == {"step": 7, "loss": 0.25}
+
+
+def test_event_instant_and_stack_attribution():
+    clk = FakeClock(5.0)
+    observe.enable(clock=clk)
+    with observe.span("scope", cat="serve"):
+        observe.event("tick", cat="serve", slot=3)
+    ev = [e for e in observe.events() if e["ph"] == "i"][0]
+    assert ev["name"] == "tick" and ev["parent"] == "scope"
+    assert ev["ts"] == 5.0 and ev["args"] == {"slot": 3}
+
+
+def test_traced_decorator_names_and_args():
+    observe.enable(clock=FakeClock())
+
+    @observe.traced
+    def plain():
+        return 41
+
+    @observe.traced(name="custom/name", cat="serve")
+    def named():
+        return 1
+
+    assert plain() + named() == 42
+    names = {(e["name"], e["cat"]) for e in observe.events()}
+    assert ("custom/name", "serve") in names
+    assert any(n.endswith("plain") for n, _ in names)
+
+
+def test_disabled_mode_is_noop_singleton():
+    """The overhead contract: disabled span() returns ONE shared
+    object (no allocation) and records nothing."""
+    assert not observe.is_enabled()
+    s1 = observe.span("a", cat="x", big_arg=list(range(100)))
+    s2 = observe.span("b")
+    assert s1 is s2  # the shared null span
+    with s1 as s:
+        s.set(anything=1)
+    observe.event("nope")
+
+    @observe.traced
+    def f():
+        return 3
+
+    for _ in range(10_000):
+        with observe.span("hot"):
+            pass
+        f()
+    assert observe.events() == []
+
+
+def test_disable_mid_span_records_nothing():
+    clk = FakeClock(1000.0)
+    observe.enable(clock=clk)
+    with observe.span("crossing"):
+        observe.disable()  # swaps the clock back to perf_counter
+    # the half-open span must NOT be emitted with a garbage duration
+    assert observe.events() == []
+
+
+def test_buffer_cap_drops_not_grows():
+    observe.enable(clock=FakeClock())
+    observe.set_max_events(10)
+    try:
+        for i in range(25):
+            observe.event(f"e{i}")
+        assert len(observe.events()) == 10
+        assert observe.trace.dropped() == 15
+    finally:
+        observe.set_max_events(1_000_000)
+
+
+def test_threaded_spans_keep_separate_stacks():
+    observe.enable(clock=FakeClock())
+    done = threading.Event()
+
+    def worker():
+        with observe.span("w", cat="bg"):
+            pass
+        done.set()
+
+    with observe.span("main", cat="fg"):
+        t = threading.Thread(target=worker, name="bg-thread")
+        t.start()
+        t.join()
+    assert done.is_set()
+    w = [e for e in observe.events() if e["name"] == "w"][0]
+    # the worker's span must not see the main thread's open span
+    assert w["parent"] is None and w["depth"] == 0
+    assert w["tid"] == "bg-thread"
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_get_or_create_and_kinds():
+    reg = MetricsRegistry()
+    c = reg.counter("x.count", op="sum")
+    assert reg.counter("x.count", op="sum") is c
+    c.inc().inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("x.level")
+    g.set(3.5)
+    g.dec(0.5)
+    assert g.value == 3.0
+    h = reg.histogram("x.lat")
+    h.observe(0.1)
+    h.observe(0.3)
+    assert h.count == 2 and h.summary()["p50"] == 0.1
+    with pytest.raises(TypeError):
+        reg.gauge("x.count", op="sum")  # kind morph forbidden
+    with pytest.raises(TypeError):
+        # even under DIFFERENT labels: a Prometheus family shares one
+        # TYPE declaration, so kind is enforced per name
+        reg.gauge("x.count", op="other")
+
+
+def test_registry_snapshot_schema():
+    reg = MetricsRegistry()
+    reg.counter("a").inc(2)
+    reg.gauge("b", k="v").set(1)
+    reg.histogram("c").observe(2.0)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"a": 2}
+    assert snap["gauges"] == {"b{k=v}": 1}
+    assert snap["histograms"]["c"]["count"] == 1
+    json.dumps(snap)  # JSON-able end to end
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def _sample_events():
+    clk = FakeClock()
+    observe.enable(clock=clk)
+    with observe.span("train/step", cat="train", step=1):
+        clk.advance(0.25)
+    with observe.span("serve/decode_step", cat="serve", live=4):
+        clk.advance(0.001)
+    observe.event("graph/cache_miss", cat="train", key="k0")
+    observe.disable()
+    return observe.events()
+
+
+def test_chrome_trace_schema_roundtrip(tmp_path):
+    evs = _sample_events()
+    path = tmp_path / "trace.json"
+    n = export.write_chrome_trace(str(path), evs)
+    doc = json.loads(path.read_text())
+    tes = doc["traceEvents"]
+    assert isinstance(tes, list) and len(tes) == n
+    # one thread_name metadata row per subsystem (cat)
+    meta = {e["args"]["name"]: e["tid"] for e in tes if e["ph"] == "M"}
+    assert set(meta) == {"train", "serve"}
+    xs = [e for e in tes if e["ph"] == "X"]
+    for e in xs:
+        assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["tid"] == meta[e["cat"]]  # track per subsystem
+    step = next(e for e in xs if e["name"] == "train/step")
+    assert step["ts"] == 0.0 and step["dur"] == 0.25 * 1e6  # µs
+    assert step["args"]["step"] == 1
+    inst = next(e for e in tes if e["ph"] == "i")
+    assert inst["name"] == "graph/cache_miss" and inst["s"] == "t"
+
+
+def test_jsonl_roundtrip(tmp_path):
+    evs = _sample_events()
+    path = tmp_path / "events.jsonl"
+    n = export.write_jsonl(str(path), evs)
+    lines = path.read_text().splitlines()
+    assert len(lines) == n == len(evs)
+    back = [json.loads(ln) for ln in lines]
+    assert back == json.loads(json.dumps(evs))
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("graph.cache_miss", help="compiles").inc(3)
+    reg.gauge("serve.queue_depth", engine="0").set(2)
+    h = reg.histogram("serve.ttft", engine="0")
+    h.observe(0.2)
+    h.observe(0.4)
+    text = export.prometheus_text(reg)
+    lines = text.splitlines()
+    # counter TYPE/HELP declared under the _total SAMPLE name
+    # (prometheus_client classic-format convention)
+    assert "# HELP singa_tpu_graph_cache_miss_total compiles" in lines
+    assert "# TYPE singa_tpu_graph_cache_miss_total counter" in lines
+    assert "singa_tpu_graph_cache_miss_total 3" in lines
+    assert "# TYPE singa_tpu_serve_queue_depth gauge" in lines
+    assert 'singa_tpu_serve_queue_depth{engine="0"} 2' in lines
+    assert "# TYPE singa_tpu_serve_ttft summary" in lines
+    assert ('singa_tpu_serve_ttft{engine="0",quantile="0.5"} 0.2'
+            in lines)
+    assert 'singa_tpu_serve_ttft_count{engine="0"} 2' in lines
+    # exposition charset: no dots/slashes survive in metric names
+    for ln in lines:
+        if not ln.startswith("#"):
+            assert "." not in ln.split("{")[0].split(" ")[0]
+
+
+# ---------------------------------------------------------------------------
+# EngineStats adoption
+# ---------------------------------------------------------------------------
+
+def test_engine_stats_registers_into_registry():
+    from singa_tpu.serve.stats import EngineStats
+
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    st = EngineStats(max_slots=4, clock=clk, reg=reg)
+    st.on_submit()
+    st.on_submit()
+    st.on_prefill()
+    st.on_decode_step(live_slots=3)
+    st.on_token()
+    st.on_schedule(queue_depth=5)
+    st.on_queue_full("r-1")
+
+    lbl = dict(engine=st.engine_label)
+    assert reg.counter("serve.submitted", **lbl).value == 2
+    assert reg.counter("serve.prefills", **lbl).value == 1
+    assert reg.counter("serve.tokens_out", **lbl).value == 1
+    assert reg.counter("serve.rejected_queue_full", **lbl).value == 1
+    assert reg.gauge("serve.queue_depth", **lbl).value == 5
+    assert reg.gauge("serve.occupancy", **lbl).value == 0.75
+    # the registry ADOPTED the TTFT series: same object, two views
+    assert reg.histogram("serve.ttft", **lbl).series is st.ttft
+
+    class R:
+        ttft = 0.5
+        tpot = 0.01
+
+    st.on_complete(R())
+    assert reg.histogram("serve.ttft", **lbl).count == 1
+    # snapshot schema unchanged by the registry rebase
+    snap = st.snapshot()
+    assert snap["requests"]["submitted"] == 2
+    assert snap["queue"]["max_depth"] == 5
+    assert snap["slots"]["occupancy_mean"] == 0.75
+    json.dumps(snap)
+
+
+def test_two_engines_do_not_collide():
+    from singa_tpu.serve.stats import EngineStats
+
+    reg = MetricsRegistry()
+    a = EngineStats(2, FakeClock(), reg=reg)
+    b = EngineStats(2, FakeClock(), reg=reg)
+    a.on_submit()
+    a.on_submit()
+    b.on_submit()
+    assert a.submitted == 2 and b.submitted == 1
+
+
+def test_engine_stats_unregister_releases_metrics():
+    from singa_tpu.serve.stats import EngineStats
+
+    reg = MetricsRegistry()
+    a = EngineStats(2, FakeClock(), reg=reg)
+    b = EngineStats(2, FakeClock(), reg=reg)
+    a.on_submit()
+    assert len(reg.metrics()) == 22  # 11 per engine
+    a.unregister()
+    remaining = reg.metrics()
+    assert len(remaining) == 11
+    assert all(("engine", b.engine_label) in m.labels
+               for m in remaining)
+    # a fully-removed NAME frees its kind reservation
+    c = reg.counter("ephemeral")
+    reg.remove(c)
+    reg.gauge("ephemeral")  # no TypeError: the name was freed
+    # the retired stats object still reads its own counters
+    assert a.submitted == 1 and a.snapshot()["requests"]["submitted"] == 1
+
+
+def test_engine_close_unregisters_and_requires_drain():
+    import numpy as np
+
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.serve import GenerationRequest
+
+    cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=16,
+                     n_layer=1, n_head=2, n_inner=32, dropout=0.0,
+                     attn_impl="fused")
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 4), np.int32))],
+              is_train=False, use_graph=False)
+    with m.serve(max_slots=2) as eng:
+        lbl = dict(engine=eng.stats.engine_label)
+        eng.submit(GenerationRequest(np.asarray([1, 2, 3]),
+                                     max_new_tokens=2))
+        with pytest.raises(RuntimeError):
+            eng.close()  # work in flight
+        eng.run_until_complete(max_steps=20)
+    # context exit closed it: serve.* metrics for THIS engine are gone
+    assert not any(dict(mm.labels).get("engine") == lbl["engine"]
+                   for mm in registry().metrics())
+    eng.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(GenerationRequest(np.asarray([1]), max_new_tokens=1))
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.step()
+
+
+def test_drain_swaps_buffer():
+    observe.enable(clock=FakeClock())
+    observe.event("a")
+    observe.event("b")
+    out = observe.drain()
+    assert [e["name"] for e in out] == ["a", "b"]
+    assert observe.events() == []
+    observe.event("c")
+    assert [e["name"] for e in observe.events()] == ["c"]
+
+
+def test_chrome_trace_survives_numpy_args(tmp_path):
+    import numpy as np
+
+    observe.enable(clock=FakeClock())
+    with observe.span("s", cat="x", loss=np.float32(0.5)):
+        pass
+    observe.disable()
+    path = tmp_path / "np_trace.json"
+    export.write_chrome_trace(str(path), observe.events())
+    doc = json.loads(path.read_text())
+    ev = next(e for e in doc["traceEvents"] if e["ph"] == "X")
+    assert ev["args"]["loss"] == "0.5"  # stringified, not crashed
+
+
+# ---------------------------------------------------------------------------
+# instrumented sites
+# ---------------------------------------------------------------------------
+
+def test_communicator_records_collective_metrics():
+    import numpy as np
+
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.parallel.communicator import _record_collective
+
+    reg = registry()
+    before = reg.counter("comms.collectives", op="all_reduce").value
+    before_b = reg.counter("comms.bytes", op="all_reduce").value
+    observe.enable(clock=FakeClock())
+    _record_collective("all_reduce", [np.zeros((4, 8), np.float32)])
+    assert reg.counter("comms.collectives",
+                       op="all_reduce").value == before + 1
+    assert reg.counter("comms.bytes",
+                       op="all_reduce").value == before_b + 4 * 8 * 4
+    ev = [e for e in observe.events() if e["cat"] == "comms"][-1]
+    assert ev["name"] == "comms/all_reduce"
+    assert ev["args"]["bytes"] == 128
+
+
+def test_graph_runner_counts_compiles_and_replays():
+    import numpy as np
+
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models.mlp import MLP
+    from singa_tpu.observe.registry import registry
+
+    dev = device.create_tpu_device(0)
+    dev.SetRandSeed(0)
+    m = MLP(data_size=8, perceptron_size=4, num_classes=3)
+    m.set_optimizer(opt.SGD(lr=0.05))
+    rng = np.random.RandomState(0)
+    x = tensor.from_numpy(rng.randn(4, 8).astype(np.float32), dev)
+    y = tensor.from_numpy(rng.randint(0, 3, (4,)).astype(np.int32), dev)
+    m.compile([x], is_train=True, use_graph=True)
+
+    reg = registry()
+    h0 = reg.counter("graph.cache_hit").value
+    m0 = reg.counter("graph.cache_miss").value
+    s0 = reg.counter("train.steps").value
+    observe.enable(clock=FakeClock())
+    m(x, y)          # compile
+    m(x, y)          # replay
+    m(x, y)          # replay
+    observe.disable()
+    assert reg.counter("graph.cache_miss").value == m0 + 1
+    assert reg.counter("graph.cache_hit").value == h0 + 2
+    assert reg.counter("train.steps").value == s0 + 3
+    names = [e["name"] for e in observe.events()]
+    assert names.count("graph/compile") == 1
+    assert names.count("train/step") == 3
+    assert "graph/cache_miss" in names
+    compile_span = next(e for e in observe.events()
+                        if e["name"] == "graph/compile")
+    # XLA cost-table estimates ride the span args (flops present on
+    # the CPU backend too)
+    assert "flops" in compile_span["args"]

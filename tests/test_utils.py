@@ -1,0 +1,121 @@
+"""utils satellites: logging channel reconfiguration, metrics guards,
+Timer.seconds initialization."""
+
+import math
+import time
+
+import pytest
+
+from singa_tpu.utils import logging as slog
+from singa_tpu.utils.metrics import LatencySeries, StepTimer, percentile
+from singa_tpu.utils.timer import Timer
+
+
+# ---------------------------------------------------------------------------
+# logging: init_channel after get_channel must reconfigure cached loggers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _restore_logging():
+    """Snapshot and restore the module's channel config so this test
+    can't leak a file handler into other tests' channels."""
+    saved = (slog._channel_dir, slog._stderr_default)
+    yield
+    slog.init_channel(dir=saved[0] or "", stderr=saved[1])
+
+
+def test_init_channel_reconfigures_cached_channels(tmp_path,
+                                                   _restore_logging):
+    # pin the starting config (an earlier test in the suite may have
+    # left a channel dir set)
+    slog.init_channel(dir="", stderr=True)
+    ch = slog.get_channel("reconfig_test")
+    assert not any(hasattr(h, "baseFilename") for h in ch.handlers)
+
+    slog.init_channel(dir=str(tmp_path), stderr=False)
+    # the CACHED logger must have picked up the new config: a file
+    # handler into tmp_path, no stderr stream handler
+    ch2 = slog.get_channel("reconfig_test")
+    assert ch2 is ch
+    files = [h.baseFilename for h in ch.handlers
+             if hasattr(h, "baseFilename")]
+    assert files and files[0].startswith(str(tmp_path))
+    import logging as _pylog
+    assert not any(type(h) is _pylog.StreamHandler for h in ch.handlers)
+
+    ch.info("hello reconfig")
+    for h in ch.handlers:
+        h.flush()
+    log_file = tmp_path / "reconfig_test.log"
+    assert "hello reconfig" in log_file.read_text()
+
+    # flipping back to stderr-only must close + drop the file handler
+    slog.init_channel(dir="", stderr=True)
+    assert not any(hasattr(h, "baseFilename") for h in ch.handlers)
+
+
+def test_new_channel_after_init_uses_current_config(tmp_path,
+                                                    _restore_logging):
+    slog.init_channel(dir=str(tmp_path), stderr=False)
+    ch = slog.get_channel("fresh_after_init")
+    ch.info("to file")
+    for h in ch.handlers:
+        h.flush()
+    assert "to file" in (tmp_path / "fresh_after_init.log").read_text()
+
+
+# ---------------------------------------------------------------------------
+# metrics guards
+# ---------------------------------------------------------------------------
+
+def test_step_timer_no_samples_is_nan_not_raise():
+    t = StepTimer()
+    assert math.isnan(t.mean_step_seconds())
+    assert math.isnan(t.samples_per_sec(128))
+    assert math.isnan(t.samples_per_sec_per_chip(128, num_chips=4))
+
+
+def test_step_timer_zero_mean_is_nan_not_zero_division():
+    t = StepTimer(skip_first=0)
+    t.times = [0.0, 0.0]  # zero-duration clock (fake clocks in tests)
+    assert t.mean_step_seconds() == 0.0
+    assert math.isnan(t.samples_per_sec(128))
+
+
+def test_step_timer_normal_path_still_works():
+    t = StepTimer(skip_first=1)
+    t.times = [10.0, 0.5, 0.5]
+    assert t.mean_step_seconds() == 0.5
+    assert t.samples_per_sec(64) == 128.0
+
+
+def test_empty_latency_series_is_nan_everywhere():
+    s = LatencySeries()
+    assert math.isnan(s.mean())
+    assert math.isnan(s.percentile(50))
+    assert math.isnan(s.percentile(0))
+    summ = s.summary()
+    assert summ["count"] == 0
+    for k in ("mean", "p50", "p99", "max"):
+        assert math.isnan(summ[k])
+
+
+def test_percentile_empty_and_clamped():
+    assert math.isnan(percentile([], 99))
+    assert math.isnan(percentile([], 0))
+    assert percentile([3.0, 1.0, 2.0], 150) == 3.0  # p>100 clamps to max
+    assert percentile([3.0, 1.0, 2.0], -5) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Timer.seconds
+# ---------------------------------------------------------------------------
+
+def test_timer_seconds_is_none_before_context_exit():
+    t = Timer()
+    assert t.seconds is None  # used to AttributeError
+    assert t.elapsed() >= 0.0
+    assert t.seconds is None  # elapsed() is live, not freezing
+    with t:
+        time.sleep(0.001)
+    assert t.seconds is not None and t.seconds > 0.0
