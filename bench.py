@@ -520,9 +520,22 @@ def main():
                          "XLA cost tables, train/step dispatches, "
                          "opt/update traces) and write a Chrome "
                          "trace-event JSON there")
+    ap.add_argument("--health-out", default=None, metavar="PATH",
+                    help="also write observe.health_report() (MFU from "
+                         "the XLA cost tables, step-time summaries, "
+                         "watchdog state) as JSON")
     cli = ap.parse_args()
     if cli.trace_out:
         observe.enable()
+    # active monitoring rides the whole bench (flight recorder + hang
+    # watchdog + MFU meter); its overhead is two clock calls and an
+    # EWMA update per dispatch — the acceptance bar is < 2% tokens/s
+    # and the instrumented dispatches are ≥ milliseconds each.  The
+    # timeout is generous: a cold resnet/bert compile on the tunnel
+    # legitimately runs minutes with no dispatch heartbeat in between.
+    # crash_handler: a bench killed mid-run (uncaught exception,
+    # SIGTERM from a CI timeout) leaves a monitor-crash-*.json bundle.
+    observe.monitor.start(watchdog_timeout_s=900.0, crash_handler=True)
 
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "20"))
@@ -650,6 +663,14 @@ def main():
     # observe registry: graph cache hit/miss, train.steps, opt.updates —
     # the attribution surface for "where did this bench's time go"
     out["registry"] = observe.registry().snapshot()
+    # active-layer summary: MFU/model-flops gauges (XLA step flops ×
+    # train.steps rate ÷ chip peak — the per-workload resnet50_mfu
+    # above stays the per-workload number; this one is the whole-run
+    # rate), per-process step-time summaries, watchdog hang/anomaly
+    # state, flight-recorder status.  include_registry=False: the
+    # snapshot already rides the top-level `registry` key
+    out["health"] = observe.health_report(include_registry=False)
+    observe.monitor.stop()
     if cli.trace_out:
         observe.disable()
         out["trace"] = {
@@ -657,7 +678,13 @@ def main():
             "trace_events": observe.export.write_chrome_trace(
                 cli.trace_out, metadata={"bench": "train"}),
         }
-    print(json.dumps(out))
+    # strict JSON on stdout/disk: nan (MFU on unknown backends, empty
+    # histogram summaries) becomes null — jq-safe BENCH trajectory
+    out = observe.export.json_sanitize(out)
+    if cli.health_out:
+        with open(cli.health_out, "w") as f:
+            json.dump(out["health"], f, default=str, allow_nan=False)
+    print(json.dumps(out, default=str, allow_nan=False))
 
 
 if __name__ == "__main__":

@@ -60,10 +60,10 @@ def make_workload(n_requests=40, seed=0, n_positions=128):
     return reqs
 
 
-def run_engine(m, workload, max_slots, close_after=False):
+def run_engine(m, workload, max_slots, close_after=False, slo=None):
     from singa_tpu.serve import GenerationRequest
 
-    eng = m.serve(max_slots=max_slots)
+    eng = m.serve(max_slots=max_slots, slo=slo)
     handles = []
     pending = list(workload)
     t0 = time.perf_counter()
@@ -116,7 +116,21 @@ def main():
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="write a Chrome trace-event JSON of the timed "
                          "engine run (Perfetto/chrome://tracing)")
+    ap.add_argument("--health-out", default=None, metavar="PATH",
+                    help="also write observe.health_report() (goodput, "
+                         "MFU, SLO counters, watchdog state) as JSON")
     args = ap.parse_args()
+
+    # active monitoring rides the whole bench: flight recorder + hang
+    # watchdog (generous timeout — a CPU compile legitimately takes
+    # minutes) + crash handler, so a bench killed mid-run leaves a
+    # monitor-crash-*.json bundle for CI to upload.  The report's
+    # `health` key proves the run was clean.
+    observe.monitor.start(watchdog_timeout_s=900.0, crash_handler=True)
+    # generous CPU-scale SLO targets: a clean run reports the counters
+    # at zero; tighten these to your latency budget in production
+    slo = observe.SLO(ttft_p99_s=120.0, tpot_p50_s=30.0,
+                      queue_depth_max=64)
 
     max_slots = 8
     cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=192,
@@ -135,7 +149,7 @@ def main():
     if args.trace_out:
         observe.clear()  # drop warmup events; trace the timed run only
         observe.enable()
-    wall_e, outs_e, snap = run_engine(m, workload, max_slots)
+    wall_e, outs_e, snap = run_engine(m, workload, max_slots, slo=slo)
     observe.disable()
     wall_s, outs_s, ttfts_s = run_static(m, workload, max_slots)
 
@@ -189,6 +203,15 @@ def main():
         # process-wide observe registry (serve counters/gauges/latency
         # histograms across every run this process made)
         "registry": observe.registry().snapshot(),
+        # active-layer summary: serve goodput + SLO violation counts,
+        # watchdog hang/anomaly state (a clean run has hangs == 0),
+        # flight-recorder status, MFU accounting (nan here: no train
+        # step and no TPU peak on CPU).  include_registry=False: the
+        # snapshot already rides the top-level `registry` key above —
+        # embedding it twice would double the report and let the two
+        # copies silently diverge
+        "health": observe.health_report(engine_snapshots=[snap],
+                                        include_registry=False),
     }
     if args.trace_out:
         n_events = observe.export.write_chrome_trace(
@@ -196,7 +219,16 @@ def main():
             metadata={"bench": "serve_continuous_batching"})
         report["trace"] = {"path": args.trace_out,
                            "trace_events": n_events}
-    line = json.dumps(report)
+    # strict JSON on disk/stdout: nan (e.g. MFU on CPU) becomes null,
+    # so jq and non-Python consumers of the BENCH trajectory keep
+    # working
+    report = observe.export.json_sanitize(report)
+    if args.health_out:
+        with open(args.health_out, "w") as f:
+            json.dump(report["health"], f, default=str,
+                      allow_nan=False)
+    observe.monitor.stop()
+    line = json.dumps(report, default=str, allow_nan=False)
     print(line)
     with open("BENCH_SERVE.json", "w") as f:
         f.write(line + "\n")

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time as _time
 import zipfile
 import io as _io
 
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from . import autograd, layer, tensor
+from .observe import monitor as _monitor
 from .observe import trace as _trace
 from .observe.registry import registry as _obs_registry
 from .tensor import Tensor
@@ -686,7 +688,9 @@ class _GraphRunner:
             import contextlib
             trace_ctx = contextlib.nullcontext()
         with trace_ctx:
-            if key not in self._compiled or self._compiled[key][1] != names:
+            fresh_compile = (key not in self._compiled
+                             or self._compiled[key][1] != names)
+            if fresh_compile:
                 self._m_miss.inc()
                 _trace.event("graph/cache_miss", cat="train",
                              key=_key_digest(key))
@@ -709,6 +713,13 @@ class _GraphRunner:
                 self._m_hit.inc()
             self._m_steps.inc(n_steps or 1)
             fn = self._compiled[key][0]
+            # watchdog heartbeat around the dispatch (two clock calls,
+            # only while monitoring is on): liveness always; step time
+            # only for replays — a compile dispatch is minutes against
+            # milliseconds and would poison the EWMA anomaly estimator
+            # and the per-process straggler histogram
+            _mon = _monitor.active()
+            _hb_t0 = _time.perf_counter() if _mon else 0.0
             with _trace.span("train/step", cat="train",
                              steps=n_steps or 1):
                 # host-side dispatch time: device execution is async, so
@@ -716,6 +727,10 @@ class _GraphRunner:
                 # step finishes — the caller's readback sync (loss fetch)
                 # carries the device tail
                 new_state, out_tree = fn(state_arrays, in_arrays)
+            if _mon:
+                _monitor.heartbeat(
+                    "train", step_time=_time.perf_counter() - _hb_t0,
+                    steps=n_steps or 1, fresh_compile=fresh_compile)
         for t, a in zip(tensors, new_state[:-1]):
             t.data = a
             t.creator = None

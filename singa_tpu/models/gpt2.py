@@ -366,8 +366,9 @@ class GPT2LMHead(model.Model):
         asynchronous request admission, a fixed-shape slot pool (no
         recompiles), per-step retirement and backfill.  Keyword args
         pass through to the engine (``max_slots``, ``max_len``,
-        ``dtype``, ``top_k``, ``top_p``, ``scheduler``, ``clock``).
-        See docs/SERVING.md."""
+        ``dtype``, ``top_k``, ``top_p``, ``scheduler``, ``clock``,
+        ``slo`` — declarative latency targets, see
+        ``singa_tpu.observe.SLO``).  See docs/SERVING.md."""
         from ..serve import InferenceEngine
 
         return InferenceEngine(self, **kw)

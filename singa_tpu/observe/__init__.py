@@ -16,16 +16,30 @@ Tracing is OFF by default and costs one flag check per site when off;
 the registry is always on (counter bumps, vLLM-style).  See
 docs/OBSERVABILITY.md.
 
+Since PR 3 there is also an ACTIVE layer over the passive one
+(``monitor.py`` + ``health.py``): an always-on flight recorder with
+crash bundles (``monitor.install_crash_handler``), MFU/goodput
+accounting against a per-backend peak-FLOPs table, a hang/anomaly
+watchdog fed by heartbeats from the graph runner and the serve decode
+loop, declarative serve SLOs (``SLO``), and the one-call
+:func:`health_report` summary.  See docs/OBSERVABILITY.md.
+
     from singa_tpu import observe
     observe.enable()
+    observe.monitor.start()          # recorder + watchdog + MFU
     ...train / serve...
     observe.export.write_chrome_trace("/tmp/trace.json")
     print(observe.export.prometheus_text())
+    print(observe.health_report()["train"]["mfu"])
 """
 
 from . import export  # noqa: F401
 from . import trace  # noqa: F401
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricsRegistry, registry)
-from .trace import (clear, disable, drain, enable, event,  # noqa: F401
-                    events, is_enabled, set_max_events, span, traced)
+from .trace import (clear, disable, drain, dropped,  # noqa: F401
+                    enable, event, events, is_enabled, set_max_events,
+                    span, traced)
+from . import monitor  # noqa: F401  (imports trace/registry only)
+from . import health  # noqa: F401
+from .health import SLO, health_report  # noqa: F401

@@ -34,7 +34,24 @@ from .registry import Counter, Histogram, registry as _registry
 
 __all__ = ["jsonl_lines", "write_jsonl", "chrome_trace",
            "write_chrome_trace", "prometheus_text",
-           "write_prometheus"]
+           "write_prometheus", "json_sanitize"]
+
+
+def json_sanitize(obj):
+    """Deep copy with non-finite floats (nan/inf) replaced by None, so
+    the result serializes as STRICT JSON (``json.dumps(...,
+    allow_nan=False)`` passes).  Python's encoder would emit the
+    non-standard ``NaN`` token, which jq / JSON.parse / serde all
+    reject — an honest in-memory ``mfu=nan`` must become ``null`` on
+    the wire, not a file only Python can read.  Used by the benches'
+    report/health writers and the monitor's crash bundles."""
+    if isinstance(obj, dict):
+        return {k: json_sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_sanitize(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
 
 
 # ---------------------------------------------------------------------------
@@ -180,8 +197,12 @@ def prometheus_text(reg=None) -> str:
                         + _prom_labels(m.labels,
                                        [("quantile", q)])
                         + " " + _prom_num(s.percentile(q * 100)))
+                # running total, NOT sum(s.values): once the retained
+                # window is bounded, a windowed sum next to the
+                # all-time _count would make rate(_sum)/rate(_count)
+                # lie about the mean
                 lines.append(pname + "_sum" + _prom_labels(m.labels)
-                             + " " + _prom_num(sum(s.values)))
+                             + " " + _prom_num(s.total_sum))
                 lines.append(pname + "_count" + _prom_labels(m.labels)
                              + " " + _prom_num(s.count))
             else:

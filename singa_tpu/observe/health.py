@@ -1,0 +1,159 @@
+"""Health-report schema: declarative serve SLOs and the one-call
+:func:`health_report` summary over the whole ``observe`` layer.
+
+Two exports:
+
+* :class:`SLO` — declarative serving targets (``ttft_p99_s``,
+  ``tpot_p50_s``, ``queue_depth_max``).  Hand one to
+  ``model.serve(slo=...)`` (or ``EngineStats`` directly) and every
+  retire is checked against it: a request beyond a target increments
+  ``serve.slo_violations{engine=,kind=}`` and emits a trace instant;
+  a scheduling pass beyond ``queue_depth_max`` emits a
+  ``serve/queue_pressure`` event and a ``kind=queue`` violation.
+  Checking per retire (not per scrape) means the counters are exact —
+  no violation hides between two polls.
+* :func:`health_report` — one JSON-able dict answering "is this
+  process healthy and how close to hardware peak does it run":
+  host/process info, train steps + MFU accounting
+  (``monitor.MfuMeter``), per-process step-time summaries with the
+  named straggler, serve goodput + SLO violation counts, watchdog
+  hang/anomaly state, flight-recorder status, and the full registry
+  snapshot.  ``bench.py`` / ``bench_serve.py`` embed it under their
+  reports' ``health`` key and write it standalone via ``--health-out``.
+
+Schema stability: like ``EngineStats.snapshot()``, the report is
+extended by ADDING keys, never renaming — ``tests/test_monitor.py``
+asserts the section set and CI parses the bench-emitted file.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+from . import monitor as _monitor
+from . import trace as _trace
+from .registry import registry as _registry
+
+__all__ = ["SLO", "health_report"]
+
+
+@dataclass(frozen=True)
+class SLO:
+    """Serving service-level objectives; ``None`` disables a check.
+
+    ``ttft_p99_s``/``tpot_p50_s`` are named for the dashboard line
+    they guard, but they are enforced per REQUEST at retire time (a
+    per-request bound is strictly stronger than the percentile it
+    protects, and it is exact under any traffic shape).
+    """
+
+    ttft_p99_s: float | None = None
+    tpot_p50_s: float | None = None
+    queue_depth_max: int | None = None
+
+    def asdict(self) -> dict:
+        return asdict(self)
+
+
+def _slo_violations(snap_counters: dict) -> dict:
+    """Aggregate ``serve.slo_violations{engine=..,kind=..}`` counters
+    across engines into ``{kind: total}``."""
+    out = {"ttft": 0, "tpot": 0, "queue": 0}
+    for key, v in snap_counters.items():
+        if not key.startswith("serve.slo_violations"):
+            continue
+        for kind in out:
+            if f"kind={kind}" in key:
+                out[kind] += v
+    return out
+
+
+def _step_time_sections(snap_hists: dict) -> dict:
+    """Per-source step-time summaries keyed
+    ``{source: {process: summary}}``, plus the named straggler (the
+    process with the largest mean) per source — the multi-host "who is
+    slow" answer."""
+    out = {}
+    for key, summ in snap_hists.items():
+        if ".step_time{" not in key:
+            continue
+        source = key.split(".step_time{", 1)[0]
+        proc = "0"
+        for part in key[key.index("{") + 1:-1].split(","):
+            k, _, v = part.partition("=")
+            if k == "process":
+                proc = v
+        out.setdefault(source, {"per_process": {}})[
+            "per_process"][proc] = summ
+    for source, sec in out.items():
+        procs = {p: s for p, s in sec["per_process"].items()
+                 if s.get("count")}
+        if procs:
+            worst = max(procs, key=lambda p: procs[p]["mean"])
+            sec["straggler"] = {"process": worst,
+                                "mean_s": procs[worst]["mean"]}
+        else:
+            sec["straggler"] = None
+    return out
+
+
+def health_report(reg=None, engine_snapshots=(),
+                  include_registry=True) -> dict:
+    """Build the unified health dict.  ``engine_snapshots``: optional
+    ``EngineStats.snapshot()`` dicts to embed under ``serve.engines``
+    (goodput/uptime per engine); the registry-derived sections
+    (violation counters, step-time summaries) need no arguments.
+    ``include_registry=False`` omits the full registry snapshot — for
+    callers (the benches) that already embed the snapshot elsewhere in
+    the same document and should not duplicate it."""
+    reg = reg if reg is not None else _registry()
+    snap = reg.snapshot()
+    wd = _monitor.watchdog()
+    mfu = _monitor.mfu_meter()
+    rec = _monitor.flight_recorder()
+    engine_snapshots = list(engine_snapshots)
+
+    train_steps = snap["counters"].get("train.steps", 0)
+    # read(), not sample(): the report must not reset the meter's
+    # rate window under the watchdog poll thread's feet
+    mfu_sample = mfu.read() if mfu is not None else None
+    report = {
+        "schema": "singa_tpu.health/1",
+        "host": _monitor._process_info(),
+        "train": {
+            "steps": train_steps,
+            "mfu": mfu_sample["mfu"] if mfu_sample else float("nan"),
+            "model_flops_per_s": (mfu_sample["model_flops_per_s"]
+                                  if mfu_sample else float("nan")),
+            "step_flops": (mfu_sample["step_flops"] if mfu_sample
+                           else _monitor.step_flops()),
+            "peak_flops_per_s": (mfu_sample["peak_flops_per_s"]
+                                 if mfu_sample
+                                 else _monitor.peak_flops()),
+            "mfu_denominator": "bf16_peak",
+        },
+        "step_time": _step_time_sections(snap["histograms"]),
+        "serve": {
+            "engines": engine_snapshots,
+            # summed across engines (they serve concurrently, so the
+            # process-level rate is the sum) — same scope as the
+            # cross-engine slo_violations totals next to it
+            "goodput_tokens_per_s": (
+                sum(s["throughput"]["goodput_tokens_per_s"]
+                    for s in engine_snapshots)
+                if engine_snapshots else None),
+            "slo_violations": _slo_violations(snap["counters"]),
+        },
+        "watchdog": (
+            {"active": True, **wd.summary()} if wd is not None
+            else {"active": False, "hangs": 0, "sources": {}}),
+        "flight_recorder": {
+            "active": rec.active,
+            "events": len(rec),
+            "capacity": rec.capacity,
+            "trace_dropped": _trace.dropped(),
+        },
+    }
+    if include_registry:
+        report["registry"] = snap
+    return report

@@ -62,15 +62,35 @@ __all__ = ["enable", "disable", "is_enabled", "clear", "drain",
            "events", "span", "event", "traced", "set_max_events",
            "dropped"]
 
-# Module-global fast path: `if not _enabled: return _NULL_SPAN` is the
+# Module-global fast path: `if not _active: return _NULL_SPAN` is the
 # ENTIRE disabled cost of a span.  The buffer is a flat list of dicts;
 # list.append is atomic under the GIL, so writer threads need no lock.
+# ``_active`` is ``_enabled or (flight-recorder ring attached)``: the
+# monitor's always-on crash ring (observe/monitor.py) receives every
+# record regardless of enable(), so instrumentation keeps feeding the
+# forensic buffer even when full tracing is off.
 _enabled = False
+_active = False
+_ring = None  # deque(maxlen=N) owned by monitor.FlightRecorder
 _clock = time.perf_counter
 _events: list = []
 _dropped = 0
 _max_events = 1_000_000  # hard cap: a forgotten enable() cannot OOM
 _tls = threading.local()
+
+
+def _update_active():
+    global _active
+    _active = _enabled or _ring is not None
+
+
+def _attach_ring(ring):
+    """Internal (monitor.FlightRecorder): route every emitted record
+    into ``ring`` (an append-only bounded buffer, e.g. a deque with
+    maxlen) in ADDITION to the main buffer; ``None`` detaches."""
+    global _ring
+    _ring = ring
+    _update_active()
 
 
 def enable(clock=None):
@@ -80,6 +100,7 @@ def enable(clock=None):
     if clock is not None:
         _clock = clock
     _enabled = True
+    _update_active()
 
 
 def disable():
@@ -88,6 +109,7 @@ def disable():
     global _enabled, _clock
     _enabled = False
     _clock = time.perf_counter
+    _update_active()
 
 
 def is_enabled() -> bool:
@@ -137,6 +159,10 @@ def _stack() -> list:
 
 def _emit(rec: dict):
     global _dropped
+    if _ring is not None:
+        _ring.append(rec)  # bounded by construction (deque maxlen)
+    if not _enabled:
+        return
     if len(_events) >= _max_events:
         _dropped += 1
         return
@@ -164,7 +190,8 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "cat", "args", "_t0", "_parent", "_depth")
+    __slots__ = ("name", "cat", "args", "_t0", "_parent", "_depth",
+                 "_clk")
 
     def __init__(self, name, cat, args):
         self.name = name
@@ -185,6 +212,7 @@ class _Span:
         self._parent = st[-1] if st else None
         self._depth = len(st)
         st.append(self.name)
+        self._clk = _clock
         self._t0 = _clock()
         return self
 
@@ -193,10 +221,12 @@ class _Span:
         st = _stack()
         if st and st[-1] == self.name:
             st.pop()
-        if not _enabled:
-            # disable() mid-span: the clock may have been swapped back
-            # to perf_counter, so the duration would be garbage — and
-            # "disabled records nothing" is the contract anyway
+        if not _active or _clock is not self._clk:
+            # tracing AND recorder off, or enable()/disable() swapped
+            # the clock mid-span: in the latter case the duration
+            # would mix two time bases (garbage — possibly negative
+            # billions of seconds), and no buffer, ring included, may
+            # ever receive such a record
             return False
         _emit({"name": self.name, "cat": self.cat, "ph": "X",
                "ts": self._t0, "dur": t1 - self._t0,
@@ -210,7 +240,7 @@ def span(name: str, cat: str = "app", **args):
     """Context manager timing one scope.  ``cat`` groups spans into
     one exporter track per subsystem (train/serve/comms/snapshot/...);
     keyword args become Chrome-trace span args."""
-    if not _enabled:
+    if not _active:
         return _NULL_SPAN
     return _Span(name, cat, args)
 
@@ -218,7 +248,7 @@ def span(name: str, cat: str = "app", **args):
 def event(name: str, cat: str = "app", **args):
     """Zero-duration instant (Chrome "i" phase) — cache misses,
     collective issues, admissions."""
-    if not _enabled:
+    if not _active:
         return
     st = _stack()
     _emit({"name": name, "cat": cat, "ph": "i", "ts": _clock(),
@@ -237,7 +267,7 @@ def traced(fn=None, *, name=None, cat="app"):
 
     @functools.wraps(fn)
     def wrapper(*a, **kw):
-        if not _enabled:
+        if not _active:
             return fn(*a, **kw)
         with _Span(label, cat, None):
             return fn(*a, **kw)

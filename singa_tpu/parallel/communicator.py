@@ -32,9 +32,24 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..observe import trace as _otrace
 from ..observe.registry import registry as _obs_registry
 
-__all__ = ["Communicator", "get_mesh", "initialize_distributed", "is_tracing"]
+__all__ = ["Communicator", "get_mesh", "initialize_distributed",
+           "is_tracing", "process_info"]
 
 _DEFAULT_AXIS = "data"
+
+
+def process_info() -> dict:
+    """This host's place in the (possibly multi-process) run — the
+    identity every ``{process=<index>}``-labeled metric and health
+    report uses.  In single-controller single-host runs this is
+    ``{0, 1}``; after :func:`initialize_distributed` it reflects the
+    coordinated world, so a crash bundle or straggler summary from any
+    host names itself unambiguously."""
+    return {
+        "process_index": int(jax.process_index()),
+        "process_count": int(jax.process_count()),
+        "local_device_count": int(jax.local_device_count()),
+    }
 
 
 def _record_collective(op, arrs):

@@ -48,6 +48,7 @@ import numpy as np
 
 from ..models.gpt2_decode import (_logits, _norm_window, _sample,
                                   decode_step, extract_params, prefill)
+from ..observe import monitor as _monitor
 from ..observe import trace as _trace
 from ..utils.logging import get_channel
 from .request import (DeadlineExceededError, GenerationRequest,
@@ -164,11 +165,14 @@ class InferenceEngine:
     (and therefore tokens) identical to the offline path.  ``top_k``/
     ``top_p`` are ENGINE-level statics (one executable for the pool);
     per-request knobs are temperature/seed/max_new_tokens/deadline.
-    ``clock`` is injectable for deterministic scheduling tests."""
+    ``clock`` is injectable for deterministic scheduling tests.
+    ``slo``: optional :class:`~singa_tpu.observe.health.SLO` — retires
+    and scheduling passes are checked against it (see
+    ``EngineStats``/docs/SERVING.md)."""
 
     def __init__(self, model, max_slots=8, max_len=None, dtype=None,
                  scheduler=None, top_k=0, top_p=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, slo=None):
         cfg = model.cfg
         if _norm_window(cfg) is not None:
             raise NotImplementedError(
@@ -194,7 +198,11 @@ class InferenceEngine:
         self._use_top_p = top_p is not None
         self._clock = clock
         self.scheduler = scheduler or FIFOScheduler()
-        self.stats = EngineStats(self.max_slots, clock)
+        self.stats = EngineStats(self.max_slots, clock, slo=slo)
+        # per-ENGINE watchdog source: with a shared "serve" source a
+        # wedged engine would be masked as long as any sibling engine
+        # kept beating (per-tenant engines are a supported pattern)
+        self._hb_source = "serve.e" + self.stats.engine_label
         self._log = get_channel("serve")
 
         model.eval()
@@ -280,6 +288,7 @@ class InferenceEngine:
                 f"{self.scheduler.queue_depth}, live={self.live_slots});"
                 f" drain with run_until_complete() first")
         self.stats.unregister()
+        _monitor.forget(self._hb_source)
         self._kc = self._vc = None
         self._params = None
         self._closed = True
@@ -295,6 +304,7 @@ class InferenceEngine:
             # exception; still release the registry entries AND the
             # arena/params (the pinning close() exists to prevent)
             self.stats.unregister()
+            _monitor.forget(self._hb_source)
             self._kc = self._vc = None
             self._params = None
             self._closed = True
@@ -313,12 +323,24 @@ class InferenceEngine:
         if self._closed:
             raise RuntimeError(
                 "engine is closed; build a new one with model.serve()")
+        if _monitor.active():
+            # arm BEFORE the dispatches below: if the first prefill or
+            # decode after an idle period wedges, this beat is what
+            # lets the watchdog see an armed, then-silent source — a
+            # re-arm only after the dispatch returns would never come
+            _monitor.heartbeat(self._hb_source)
         if any(s is not None for s in self._slots):
             self._decode_once()
         self._schedule(self._clock())
         self.stats.on_schedule(self.scheduler.queue_depth)
         self.step_count += 1
-        return self.pending
+        pending = self.pending
+        if not pending and _monitor.active():
+            # drained: refresh liveness but DISARM hang detection —
+            # an idle engine between traffic bursts is not a wedged
+            # one; the next step's top-of-loop beat re-arms
+            _monitor.heartbeat(self._hb_source, busy=False)
+        return pending
 
     def run_until_complete(self, max_steps=None):
         """Drive ``step()`` until every submitted request resolves.
@@ -337,6 +359,11 @@ class InferenceEngine:
     def _decode_once(self):
         live = np.asarray([s is not None for s in self._slots])
         n_live = int(live.sum())
+        # watchdog heartbeat around the pool step (two clock calls,
+        # only while monitoring is on); includes the np.asarray sync,
+        # so the fed step time is real device time
+        _mon = _monitor.active()
+        _hb_t0 = time.perf_counter() if _mon else 0.0
         with _trace.span("serve/decode_step", cat="serve",
                          step=self.step_count, live=n_live):
             next_toks, self._kc, self._vc, self._keys = _pool_decode_step(
@@ -345,6 +372,11 @@ class InferenceEngine:
                 jnp.asarray(live), self._keys,
                 jnp.asarray(self._temps), self._top_p, **self._statics)
             next_toks = np.asarray(next_toks)
+        if _mon:
+            _monitor.heartbeat(
+                self._hb_source,
+                step_time=time.perf_counter() - _hb_t0,
+                fresh_compile=self.stats.decode_steps == 0)
         self.stats.on_decode_step(n_live)
         t_emit = self._clock()
         for i, slot in enumerate(self._slots):

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import itertools
 
+from ..observe import trace as _trace
 from ..observe.registry import registry
 from ..utils.logging import get_channel
 
@@ -43,9 +44,18 @@ _engine_ids = itertools.count()
 class EngineStats:
     """Accumulated over an engine's lifetime; ``snapshot()`` at any
     point.  All wall-clock numbers come from the engine's clock so a
-    fake clock makes the whole schema deterministic in tests."""
+    fake clock makes the whole schema deterministic in tests.
 
-    def __init__(self, max_slots: int, clock, reg=None):
+    ``slo``: an optional :class:`~singa_tpu.observe.health.SLO`.  When
+    set, every retire is checked against its targets (per REQUEST —
+    exact under any traffic shape, and strictly stronger than the
+    percentile line each target guards) and every scheduling pass
+    against ``queue_depth_max``; breaches increment
+    ``serve.slo_violations{engine=,kind=ttft|tpot|queue}`` and emit
+    trace instants (which the monitor's flight recorder captures even
+    with tracing off)."""
+
+    def __init__(self, max_slots: int, clock, reg=None, slo=None):
         self.max_slots = int(max_slots)
         self._clock = clock
         self._t0 = clock()
@@ -93,6 +103,16 @@ class EngineStats:
             self._tokens_out, self._queue_depth, self._occupancy,
             self._h_ttft, self._h_tpot,
         ]
+        self.slo = slo
+        self._slo_viol = {}
+        if slo is not None:
+            for kind in ("ttft", "tpot", "queue"):
+                c = reg.counter(
+                    "serve.slo_violations",
+                    help="requests/steps beyond the declared SLO "
+                         "target", kind=kind, **lbl)
+                self._slo_viol[kind] = c
+                self._registered.append(c)
 
     def unregister(self):
         """Remove this engine's metrics from the registry.  Call when
@@ -162,16 +182,51 @@ class EngineStats:
         self._queue_depth_sum += queue_depth
         self._queue_depth_max = max(self._queue_depth_max, queue_depth)
         self._queue_depth.set(queue_depth)
+        slo = self.slo
+        if (slo is not None and slo.queue_depth_max is not None
+                and queue_depth > slo.queue_depth_max):
+            self._slo_viol["queue"].inc()
+            _trace.event("serve/queue_pressure", cat="serve",
+                         depth=queue_depth,
+                         limit=slo.queue_depth_max)
 
     def on_complete(self, result):
         self._completed.inc()
         self.ttft.record(result.ttft)
         if result.tpot is not None:
             self.tpot.record(result.tpot)
+        slo = self.slo
+        if slo is None:
+            return
+        if slo.ttft_p99_s is not None and result.ttft > slo.ttft_p99_s:
+            self._slo_viol["ttft"].inc()
+            _trace.event("serve/slo_violation", cat="serve",
+                         kind="ttft", request=result.request_id,
+                         value=result.ttft, target=slo.ttft_p99_s)
+        if (slo.tpot_p50_s is not None and result.tpot is not None
+                and result.tpot > slo.tpot_p50_s):
+            self._slo_viol["tpot"].inc()
+            _trace.event("serve/slo_violation", cat="serve",
+                         kind="tpot", request=result.request_id,
+                         value=result.tpot, target=slo.tpot_p50_s)
+
+    @property
+    def uptime_s(self) -> float:
+        """Engine-clock seconds since construction (the submit clock —
+        serve health reports never recompute wall from trace events)."""
+        return max(self._clock() - self._t0, 1e-9)
+
+    @property
+    def goodput_tokens_per_s(self) -> float:
+        """Useful emitted tokens per wall second over the engine's
+        lifetime.  ``tokens_out`` counts only tokens requests asked
+        for (the engine never generates straggler padding), so this IS
+        goodput, not raw device throughput."""
+        return self.tokens_out / self.uptime_s
 
     # -- reporting ------------------------------------------------------
     def snapshot(self) -> dict:
-        wall = max(self._clock() - self._t0, 1e-9)
+        wall = self.uptime_s
         return {
             "requests": {
                 "submitted": self.submitted,
@@ -182,7 +237,12 @@ class EngineStats:
             "throughput": {
                 "tokens_out": self.tokens_out,
                 "wall_s": wall,
+                "uptime_s": wall,
                 "tokens_per_s": self.tokens_out / wall,
+                # same wall read as tokens_per_s — re-reading the
+                # clock via the property would make the identical-by-
+                # definition pair disagree by clock jitter
+                "goodput_tokens_per_s": self.tokens_out / wall,
                 "prefills": self.prefills,
                 "decode_steps": self.decode_steps,
             },
@@ -202,4 +262,9 @@ class EngineStats:
                                    / self.decode_steps
                                    if self.decode_steps else 0.0),
             },
+            "slo": (None if self.slo is None else {
+                "targets": self.slo.asdict(),
+                "violations": {k: c.value
+                               for k, c in self._slo_viol.items()},
+            }),
         }

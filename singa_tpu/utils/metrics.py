@@ -82,13 +82,23 @@ class LatencySeries:
 
     def __init__(self):
         self.values = []
+        # running totals over EVERY recorded value, maintained
+        # separately from ``values`` so that if the retained window is
+        # ever bounded/evicted, the Prometheus ``_sum``/``_count`` pair
+        # (export.prometheus_text) stays mutually consistent instead of
+        # pairing an all-time count with a windowed sum
+        self.total_sum = 0.0
+        self._total_count = 0
 
     def record(self, seconds: float):
-        self.values.append(float(seconds))
+        v = float(seconds)
+        self.values.append(v)
+        self.total_sum += v
+        self._total_count += 1
 
     @property
     def count(self) -> int:
-        return len(self.values)
+        return self._total_count
 
     def mean(self) -> float:
         return (sum(self.values) / len(self.values)

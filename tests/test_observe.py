@@ -261,6 +261,35 @@ def test_prometheus_text_format():
             assert "." not in ln.split("{")[0].split(" ")[0]
 
 
+def test_prometheus_sum_count_stay_consistent_under_windowing():
+    """_sum comes from the series' RUNNING total, not the retained
+    values window — evicting values must not desync the pair."""
+    reg = MetricsRegistry()
+    h = reg.histogram("serve.ttft", engine="0")
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v)
+    h.series.values.pop(0)  # simulate a bounded window evicting
+    lines = export.prometheus_text(reg).splitlines()
+    assert 'singa_tpu_serve_ttft_sum{engine="0"} 0.6000000000000001' \
+        in lines
+    assert 'singa_tpu_serve_ttft_count{engine="0"} 3' in lines
+
+
+def test_dropped_is_public_and_rides_chrome_metadata():
+    """Satellite: observe.dropped() is part of the public API and a
+    truncated trace is self-describing in its Chrome metadata."""
+    observe.enable(clock=FakeClock())
+    observe.set_max_events(5)
+    try:
+        for i in range(8):
+            observe.event(f"e{i}")
+        assert observe.dropped() == 3  # re-exported at package level
+        doc = export.chrome_trace(observe.events())
+        assert doc["otherData"]["dropped_events"] == 3
+    finally:
+        observe.set_max_events(1_000_000)
+
+
 # ---------------------------------------------------------------------------
 # EngineStats adoption
 # ---------------------------------------------------------------------------

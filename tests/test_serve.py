@@ -199,13 +199,13 @@ def test_stats_schema_stable():
     eng.run_until_complete(max_steps=50)
     snap = eng.stats.snapshot()
     assert set(snap) == {"requests", "throughput", "latency", "queue",
-                         "slots"}
+                         "slots", "slo"}
     assert set(snap["requests"]) == {
         "submitted", "completed", "rejected_deadline",
         "rejected_queue_full"}
     assert set(snap["throughput"]) == {
-        "tokens_out", "wall_s", "tokens_per_s", "prefills",
-        "decode_steps"}
+        "tokens_out", "wall_s", "uptime_s", "tokens_per_s",
+        "goodput_tokens_per_s", "prefills", "decode_steps"}
     assert set(snap["latency"]) == {"ttft", "tpot"}
     for series in snap["latency"].values():
         assert set(series) == {"count", "mean", "p50", "p99", "max"}

@@ -107,6 +107,22 @@ def test_percentile_empty_and_clamped():
     assert percentile([3.0, 1.0, 2.0], -5) == 1.0
 
 
+def test_latency_series_running_totals_survive_window_eviction():
+    """total_sum/count are maintained independently of the retained
+    ``values`` window, so the Prometheus _sum/_count pair stays
+    consistent if/when the window is ever bounded."""
+    s = LatencySeries()
+    for v in (1.0, 2.0, 3.0):
+        s.record(v)
+    assert s.total_sum == 6.0 and s.count == 3
+    # simulate a window eviction (a future bounded series would do
+    # this internally): the running totals must NOT move
+    s.values.pop(0)
+    assert s.total_sum == 6.0 and s.count == 3
+    s.record(4.0)
+    assert s.total_sum == 10.0 and s.count == 4
+
+
 # ---------------------------------------------------------------------------
 # Timer.seconds
 # ---------------------------------------------------------------------------
