@@ -1,0 +1,220 @@
+"""Chaos bench — drive the resilience layer end to end and PROVE the
+recovery invariants the unit tests assert piecewise:
+
+* **checkpoint corruption** — write two manager checkpoints, truncate
+  AND bit-flip the newest, and require ``restore_latest`` to fall back
+  to the previous good step (``resilience.checkpoint_fallbacks``);
+  a transient injected write fault must be absorbed by the retry
+  layer (``resilience.retries{site=checkpoint.write}``).
+* **collective retry** — a transient fault at the host-side
+  ``comm.collective`` dispatch site retries under backoff and the run
+  proceeds.
+* **decode fault + supervised restart** — a seeded fault injected into
+  ``serve.decode_step`` mid-run fails the engine TYPED; the supervisor
+  rebuilds it and requeues never-started requests.  The bench asserts
+  ZERO wedged/lost requests (every submitted request retires or fails
+  typed), token-stream parity against an uninterrupted run for every
+  completed request, and ``resilience.engine_restarts`` equal to the
+  number of injected decode faults.
+
+The whole run happens under active monitoring; the report embeds
+``observe.health_report()`` and the bench FAILS unless
+``watchdog.hangs == 0`` — recovery that trips the hang detector is
+not recovery.  Writes CHAOS.json (strict JSON) and prints it; CI runs
+this on CPU and re-parses the file as its gate (tier1.yml chaos job).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+
+def chaos_checkpoint(report):
+    """Corrupt-newest fallback + retried transient write fault."""
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models.mlp import MLP
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import (CheckpointManager, FailOnce,
+                                      RetryPolicy, faults)
+    from singa_tpu.resilience.checkpoint import STATES_NAME
+
+    dev = device.get_default_device()
+    m = MLP(data_size=10, perceptron_size=16, num_classes=4)
+    m.set_optimizer(opt.SGD(lr=0.05))
+    x = tensor.from_numpy(np.zeros((8, 10), np.float32), dev)
+    m.compile([x], is_train=True, use_graph=False, sequential=False)
+    rng = np.random.RandomState(0)
+
+    def train(n):
+        for _ in range(n):
+            xb = tensor.from_numpy(
+                rng.randn(8, 10).astype(np.float32), dev)
+            yb = tensor.from_numpy(
+                rng.randint(0, 4, (8,)).astype(np.int32), dev)
+            m(xb, yb)
+
+    root = tempfile.mkdtemp(prefix="chaos-ckpt-")
+    try:
+        mgr = CheckpointManager(
+            root, keep=3,
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                     max_delay_s=0.05))
+        train(2)
+        # transient write fault: FailOnce fires on the first attempt,
+        # the retry layer's second attempt commits the checkpoint
+        faults.inject("checkpoint.write", FailOnce())
+        mgr.save(m, 100, aux_states={"tag": np.int64(100)})
+        faults.clear()
+        good = {k: tensor.to_numpy(v) for k, v in m.get_params().items()}
+        train(2)
+        mgr.save(m, 200, aux_states={"tag": np.int64(200)})
+
+        # crash-mid-write: truncate the newest states file mid-record
+        sp = os.path.join(mgr.step_dir(200), STATES_NAME)
+        data = open(sp, "rb").read()
+        open(sp, "wb").write(data[:len(data) // 2])
+
+        m2 = MLP(data_size=10, perceptron_size=16, num_classes=4)
+        m2.compile([x], is_train=True, use_graph=False, sequential=False)
+        step, aux = mgr.restore_latest(m2)
+        assert step == 100 and int(aux["tag"]) == 100, \
+            f"fallback restored step {step}, wanted 100"
+        for k, v in m2.get_params().items():
+            np.testing.assert_array_equal(tensor.to_numpy(v), good[k])
+
+        snap = registry().snapshot()["counters"]
+        report["checkpoint"] = {
+            "fallbacks": snap.get("resilience.checkpoint_fallbacks", 0),
+            "write_retries": snap.get(
+                "resilience.retries{site=checkpoint.write}", 0),
+            "restored_step_after_corruption": step,
+        }
+        assert report["checkpoint"]["fallbacks"] >= 1
+        assert report["checkpoint"]["write_retries"] >= 1
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def chaos_collective(report):
+    """Transient fault at the host-side collective dispatch hook —
+    retried under the communicator's fast backoff policy."""
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.parallel.communicator import _record_collective
+    from singa_tpu.resilience import FailOnce, faults
+
+    faults.inject("comm.collective", FailOnce())
+    # the trace-time dispatch hook every collective method calls
+    _record_collective("all_reduce", [np.zeros((1024,), np.float32)])
+    faults.clear()
+    snap = registry().snapshot()["counters"]
+    report["collective"] = {
+        "retries": snap.get(
+            "resilience.retries{site=comm.collective}", 0),
+    }
+    assert report["collective"]["retries"] >= 1
+
+
+def chaos_serve(report):
+    """Injected decode faults mid-run: zero wedged/lost requests,
+    parity for everything that completed, restarts == injected."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(0)
+    workload = [(rng.randint(0, 256, rng.randint(3, 14)).astype(np.int32),
+                 int(rng.randint(2, 9))) for _ in range(10)]
+    # uninterrupted oracle, one prompt at a time
+    base = [np.asarray(m.generate(p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = 0
+    # two chaos rounds, each killing the engine once at a different
+    # depth into the run
+    for round_i, fail_after in enumerate((2, 4)):
+        sup = EngineSupervisor(m, max_slots=2, restart_budget=2)
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.decode_step",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=2000)
+        faults.clear()
+        injected += pol.fired
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "token stream diverged after restart"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1  # in-flight at fault: typed, not lost
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve"] = {
+        "requests": 2 * len(workload),
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "decode_faults_injected": injected,
+        "engine_restarts": restarts,
+    }
+    assert wedged == 0, f"{wedged} requests wedged/lost"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0 and typed_failed > 0
+    assert restarts == injected, \
+        f"restarts ({restarts}) != injected decode faults ({injected})"
+
+
+def main():
+    from singa_tpu import observe
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="CHAOS.json", metavar="PATH",
+                    help="where to write the strict-JSON chaos report")
+    args = ap.parse_args()
+
+    # the whole chaos run is monitored: recovery that hangs is failure
+    observe.monitor.start(watchdog_timeout_s=900.0, crash_handler=True)
+    report = {"bench": "chaos_resilience", "schema": "singa_tpu.chaos/1"}
+    chaos_checkpoint(report)
+    chaos_collective(report)
+    chaos_serve(report)
+
+    health = observe.health_report(include_registry=False)
+    report["health"] = health
+    assert health["watchdog"]["hangs"] == 0, "chaos run tripped the " \
+        "hang watchdog — recovery wedged somewhere"
+    assert health["resilience"]["engine_restarts"] >= \
+        report["serve"]["engine_restarts"]
+    observe.monitor.stop()
+
+    line = json.dumps(observe.export.json_sanitize(report),
+                      default=str, allow_nan=False)
+    print(line)
+    with open(args.out, "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

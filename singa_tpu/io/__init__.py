@@ -1,2 +1,9 @@
 """IO — the rebuild of src/io (snapshot key-value store, binfile
-readers/writers, data loaders); native C++ fast path in native/."""
+readers/writers, data loaders); native C++ fast path in native/.
+
+``binfile.CorruptRecordError`` (re-exported here) is the typed
+corruption surface: a truncated tail record or CRC mismatch names the
+key/offset/expected-vs-actual so the resilience layer's checkpoint
+fallback can log something actionable."""
+
+from .binfile import CorruptRecordError  # noqa: F401

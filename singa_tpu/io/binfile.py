@@ -101,6 +101,45 @@ def native_available() -> bool:
 _MAGIC = b"NSTGAIO1"
 
 
+class CorruptRecordError(OSError):
+    """A BinFile record failed integrity checks: a truncated tail
+    (crash mid-write) or a CRC mismatch.  Carries enough to log
+    something actionable — ``key`` (None when the truncation ate the
+    key itself), byte ``offset`` of the bad record, and for CRC
+    failures the ``expected`` vs ``actual`` checksum.  Classified
+    FATAL by the retry layer (corruption never heals on retry); the
+    CheckpointManager fallback walk absorbs it instead."""
+
+    def __init__(self, path, reason, key=None, offset=None,
+                 expected=None, actual=None):
+        detail = f"{path}: {reason}"
+        if key is not None:
+            detail += f" (key={key!r}"
+            if expected is not None:
+                detail += (f", crc expected=0x{expected:08x} "
+                           f"actual=0x{actual:08x}")
+            if offset is not None:
+                detail += f", offset={offset}"
+            detail += ")"
+        elif offset is not None:
+            detail += f" (offset={offset})"
+        super().__init__(detail)
+        self.path = path
+        self.reason = reason
+        self.key = key
+        self.offset = offset
+        self.expected = expected
+        self.actual = actual
+
+
+def _fault_check():
+    """io.binfile injection site — one module-flag read when disarmed."""
+    from ..resilience import faults
+
+    if faults._armed:
+        faults.check("io.binfile")
+
+
 class BinFileWriter:
     """Append key->bytes records (reference: io::BinFileWriter)."""
 
@@ -118,6 +157,7 @@ class BinFileWriter:
             self._h = None
 
     def put(self, key: str, value: bytes):
+        _fault_check()
         if self._h is not None:
             rc = self._lib.binfile_writer_put(self._h, key.encode(), value,
                                               len(value))
@@ -151,6 +191,7 @@ class BinFileReader:
 
     def __init__(self, path):
         self.path = path
+        _fault_check()
         self._lib = _load_native()
         if self._lib is not None:
             self._h = self._lib.binfile_reader_open(path.encode())
@@ -160,20 +201,62 @@ class BinFileReader:
         else:
             self._h = None
             self._records = []
+            fsize = os.path.getsize(path)
             with open(path, "rb") as f:
                 if f.read(8) != _MAGIC:
                     raise OSError(f"bad magic in {path}")
                 while True:
+                    rec_off = f.tell()
                     hdr = f.read(4)
+                    if len(hdr) == 0:
+                        break  # clean EOF on a record boundary
                     if len(hdr) < 4:
-                        break
+                        raise CorruptRecordError(
+                            path, "truncated tail: partial key-length "
+                            "header (crash mid-write?)", offset=rec_off)
                     (klen,) = struct.unpack("<I", hdr)
-                    key = f.read(klen).decode()
-                    (vlen,) = struct.unpack("<Q", f.read(8))
+                    # bound lengths against the file BEFORE reading: a
+                    # bit-flipped length field must surface as typed
+                    # corruption, not a multi-GB read/MemoryError
+                    if klen > fsize - f.tell():
+                        raise CorruptRecordError(
+                            path, f"key length {klen} exceeds "
+                            f"remaining file (corrupt header?)",
+                            offset=rec_off)
+                    kraw = f.read(klen)
+                    if len(kraw) < klen:
+                        raise CorruptRecordError(
+                            path, "truncated tail: key cut short",
+                            offset=rec_off)
+                    key = kraw.decode()
+                    vhdr = f.read(8)
+                    if len(vhdr) < 8:
+                        raise CorruptRecordError(
+                            path, "truncated tail: partial value-length "
+                            "header", key=key, offset=rec_off)
+                    (vlen,) = struct.unpack("<Q", vhdr)
+                    if vlen > fsize - f.tell():
+                        raise CorruptRecordError(
+                            path, f"value length {vlen} exceeds "
+                            f"remaining file (corrupt header or "
+                            f"truncated tail)", key=key, offset=rec_off)
                     val = f.read(vlen)
-                    (crc,) = struct.unpack("<I", f.read(4))
-                    if zlib.crc32(val) & 0xFFFFFFFF != crc:
-                        raise OSError(f"CRC mismatch for key {key}")
+                    if len(val) < vlen:
+                        raise CorruptRecordError(
+                            path, f"truncated tail: value cut at "
+                            f"{len(val)}/{vlen} bytes", key=key,
+                            offset=rec_off)
+                    craw = f.read(4)
+                    if len(craw) < 4:
+                        raise CorruptRecordError(
+                            path, "truncated tail: CRC footer missing",
+                            key=key, offset=rec_off)
+                    (crc,) = struct.unpack("<I", craw)
+                    actual = zlib.crc32(val) & 0xFFFFFFFF
+                    if actual != crc:
+                        raise CorruptRecordError(
+                            path, "CRC mismatch", key=key,
+                            offset=rec_off, expected=crc, actual=actual)
                     self._records.append((key, val))
 
     def count(self) -> int:
@@ -198,7 +281,8 @@ class BinFileReader:
             buf = ctypes.create_string_buffer(int(n) if n else 1)
             rc = self._lib.binfile_reader_val(self._h, i, buf, n)
             if rc == -2:
-                raise OSError(f"CRC mismatch at record {i} in {self.path}")
+                raise CorruptRecordError(self.path, "CRC mismatch",
+                                         key=self.key(i))
             if rc < 0:
                 raise OSError(f"read failed at record {i}")
             return buf.raw[:n]

@@ -40,6 +40,7 @@ from . import autograd, layer, tensor
 from .observe import monitor as _monitor
 from .observe import trace as _trace
 from .observe.registry import registry as _obs_registry
+from .resilience import faults as _faults
 from .tensor import Tensor
 
 # Default checkpoint file mode (0o666 & ~umask), probed WITHOUT calling
@@ -333,7 +334,8 @@ class Model(layer.Layer):
 
     # -- checkpointing (reference: save_states/load_states zip format,
     #    SURVEY.md §3.5/§5.4) ---------------------------------------------
-    def save_states(self, fpath, aux_states=None, async_save=False):
+    def save_states(self, fpath, aux_states=None, async_save=False,
+                    retry=None):
         """Zip of one .npy per state tensor + optimizer state + aux.
 
         ``async_save=True`` (beyond reference parity — the TPU-native
@@ -345,7 +347,14 @@ class Model(layer.Layer):
         compiles the step with donated state buffers, so the *original*
         arrays are deleted by the very next training step.  Returns an
         ``AsyncSaveHandle``; call ``.wait()`` before relying on the
-        file (exceptions re-raise there)."""
+        file (exceptions re-raise there; a fire-and-forget failure is
+        logged at thread exit and counted in
+        ``checkpoint.async_failures``).
+
+        ``retry``: an optional
+        :class:`~singa_tpu.resilience.retry.RetryPolicy` — transient
+        write I/O retries with backoff (sync and async paths alike),
+        counted under ``resilience.retries{site=checkpoint.write}``."""
         def snap(a):
             if not async_save:
                 return a
@@ -373,9 +382,16 @@ class Model(layer.Layer):
             with _trace.span("snapshot/write", cat="snapshot",
                              path=str(fpath), tensors=len(captured),
                              async_save=bool(async_save)):
-                _write_inner()
+                if retry is None:
+                    _write_inner()
+                else:
+                    from .resilience.retry import retry_call
+
+                    retry_call(_write_inner, "checkpoint.write",
+                               policy=retry)
 
         def _write_inner():
+            _faults.check("checkpoint.write")
             states = {k: _host_array(v) for k, v in captured.items()}
             # unique temp per call: two overlapping async saves to the
             # same fpath must not interleave writes into one temp file
@@ -409,6 +425,7 @@ class Model(layer.Layer):
         return AsyncSaveHandle(_write)
 
     def load_states(self, fpath):
+        _faults.check("checkpoint.read")
         aux = {}
         opt_states = {}
         states = {}
@@ -427,6 +444,34 @@ class Model(layer.Layer):
             self._optimizer.set_states(opt_states)
         return aux
 
+    # -- manager-aware checkpointing (single-file save_states/load_states
+    #    parity above stays untouched) ------------------------------------
+    def checkpoint_manager(self, root, keep=3, retry_policy=None):
+        """A :class:`~singa_tpu.resilience.checkpoint.CheckpointManager`
+        rooted at ``root``: step-numbered directories, strict-JSON
+        manifests with whole-file digests, last-``keep`` retention, and
+        corruption fallback on restore (docs/RESILIENCE.md)."""
+        from .resilience.checkpoint import CheckpointManager
+
+        return CheckpointManager(root, keep=keep,
+                                 retry_policy=retry_policy)
+
+    def save_checkpoint(self, root, step, aux_states=None, keep=3,
+                        manager=None):
+        """Manager-aware save: one validated, manifested checkpoint
+        directory for ``step`` under ``root`` (retention applied).
+        Returns the committed directory path."""
+        mgr = manager or self.checkpoint_manager(root, keep=keep)
+        return mgr.save(self, step, aux_states=aux_states)
+
+    def restore_latest_checkpoint(self, root, manager=None):
+        """Manager-aware restore: loads the newest VALID checkpoint
+        under ``root``, falling back past corrupt/truncated steps
+        (``resilience.checkpoint_fallbacks``).  Returns
+        ``(step, aux_states)``."""
+        mgr = manager or self.checkpoint_manager(root)
+        return mgr.restore_latest(self)
+
 
 def _host_array(a) -> np.ndarray:
     """Device->host fetch mirroring tensor.to_numpy's multi-host path
@@ -440,7 +485,12 @@ def _host_array(a) -> np.ndarray:
 
 class AsyncSaveHandle:
     """Background checkpoint write started by
-    ``Model.save_states(async_save=True)``."""
+    ``Model.save_states(async_save=True)``.
+
+    A fire-and-forget save that fails must not be SILENT: the thread
+    logs the exception at exit and bumps ``checkpoint.async_failures``
+    whether or not anyone ever calls ``wait()`` — ``wait()`` still
+    re-raises (test-pinned), the telemetry is additive."""
 
     def __init__(self, fn):
         import threading
@@ -452,6 +502,15 @@ class AsyncSaveHandle:
                 fn()
             except BaseException as e:  # re-raised on wait()
                 self._exc = e
+                _obs_registry().counter(
+                    "checkpoint.async_failures",
+                    help="async checkpoint writes that failed in the "
+                         "background thread").inc()
+                from .utils.logging import get_channel
+
+                get_channel("checkpoint").error(
+                    "async checkpoint save failed (call wait() to "
+                    "re-raise): %r", e)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
@@ -712,6 +771,10 @@ class _GraphRunner:
             else:
                 self._m_hit.inc()
             self._m_steps.inc(n_steps or 1)
+            if _faults._armed:
+                # chaos hook for the train dispatch path; disarmed the
+                # replay loop pays this one module-flag read
+                _faults.check("train.step")
             fn = self._compiled[key][0]
             # watchdog heartbeat around the dispatch (two clock calls,
             # only while monitoring is on): liveness always; step time

@@ -68,6 +68,52 @@ def _slo_violations(snap_counters: dict) -> dict:
     return out
 
 
+def _by_label(snap_counters: dict, name: str, label: str) -> dict:
+    """Aggregate ``name{...,label=v,...}`` counters into ``{v: total}``
+    (pure string work over the registry snapshot — no import of the
+    resilience layer, which sits above observe)."""
+    out = {}
+    prefix = name + "{"
+    for key, v in snap_counters.items():
+        if not (key == name or key.startswith(prefix)):
+            continue
+        val = "_"
+        if "{" in key:
+            for part in key[key.index("{") + 1:-1].split(","):
+                k, _, lv = part.partition("=")
+                if k == label:
+                    val = lv
+        out[val] = out.get(val, 0) + v
+    return out
+
+
+def _resilience_section(snap_counters: dict) -> dict:
+    """The ``resilience`` health section: retry/fallback/restart
+    counts published by singa_tpu.resilience (zeros when the layer
+    never armed — the section is always present so dashboards can
+    alert on it unconditionally)."""
+    return {
+        "retries": _by_label(snap_counters, "resilience.retries",
+                             "site"),
+        "gave_up": _by_label(snap_counters, "resilience.gave_up",
+                             "site"),
+        "faults_injected": _by_label(
+            snap_counters, "resilience.faults_injected", "site"),
+        "checkpoint_saves": snap_counters.get(
+            "resilience.checkpoint_saves", 0),
+        "checkpoint_fallbacks": snap_counters.get(
+            "resilience.checkpoint_fallbacks", 0),
+        "checkpoint_async_failures": snap_counters.get(
+            "checkpoint.async_failures", 0),
+        "engine_failures": snap_counters.get(
+            "resilience.engine_failures", 0),
+        "engine_restarts": snap_counters.get(
+            "resilience.engine_restarts", 0),
+        "shed_requests": _by_label(snap_counters,
+                                   "serve.shed_requests", "reason"),
+    }
+
+
 def _step_time_sections(snap_hists: dict) -> dict:
     """Per-source step-time summaries keyed
     ``{source: {process: summary}}``, plus the named straggler (the
@@ -144,6 +190,7 @@ def health_report(reg=None, engine_snapshots=(),
                 if engine_snapshots else None),
             "slo_violations": _slo_violations(snap["counters"]),
         },
+        "resilience": _resilience_section(snap["counters"]),
         "watchdog": (
             {"active": True, **wd.summary()} if wd is not None
             else {"active": False, "hangs": 0, "sources": {}}),

@@ -31,6 +31,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..observe import trace as _otrace
 from ..observe.registry import registry as _obs_registry
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy, retry_call
 
 __all__ = ["Communicator", "get_mesh", "initialize_distributed",
            "is_tracing", "process_info"]
@@ -52,12 +54,32 @@ def process_info() -> dict:
     }
 
 
+# host-side dispatch-site retry policy for INJECTED comm.collective
+# faults (fast backoff — a collective stall is milliseconds, not the
+# checkpoint layer's I/O seconds).  Scope is the injection site only:
+# real XLA collective execution happens inside compiled steps where
+# host-side retry cannot reach; what this buys is chaos-testing the
+# retry/backoff/counter plumbing on the comm path end to end.
+_COMM_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                          max_delay_s=0.1)
+
+
 def _record_collective(op, arrs):
     """Observe hook for one collective issue: per-op count + payload
     bytes (registry ``comms.collectives``/``comms.bytes``) and a trace
     instant.  Collectives execute inside compiled steps, so this fires
     at TRACE time — counts are per-compile, not per-replayed-step
-    (a replay issues the same collectives XLA baked in)."""
+    (a replay issues the same collectives XLA baked in).
+
+    Also the ``comm.collective`` fault-injection site: armed INJECTED
+    faults fire here (host side, trace time) and transient ones retry
+    under ``_COMM_RETRY`` — ``resilience.retries{site=comm.collective}``
+    counts them; disarmed, the hook is one module-flag read and no
+    retry machinery runs (real in-step collective errors are XLA's to
+    surface, not host-retryable)."""
+    if _faults._armed:
+        retry_call(lambda: _faults.check("comm.collective"),
+                   "comm.collective", policy=_COMM_RETRY)
     n = 0
     for a in arrs:
         try:

@@ -1,0 +1,38 @@
+"""singa_tpu.resilience — surviving the failures the observe layer can
+only watch (PR 4).
+
+Four cooperating pieces:
+
+* ``faults.py`` — a process-wide registry of named fault-injection
+  sites threaded through the checkpoint, io, collective, and serving
+  hot paths.  Disarmed (the default) every site is a single module-flag
+  check; armed, seeded-deterministic policies (fail-once, fail-rate,
+  fail-after-N, latency) raise :class:`FaultInjected` exactly where a
+  real fault would surface.  Chaos tests and the CI chaos job drive
+  the whole recovery stack through these sites.
+* ``retry.py`` — exponential backoff + jitter with retry budgets and
+  transient/fatal error classification.  Every retry and every
+  give-up is counted in the observe registry
+  (``resilience.retries{site=}`` / ``resilience.gave_up{site=}``).
+* ``checkpoint.py`` — :class:`CheckpointManager`: step-numbered
+  checkpoint directories with a strict-JSON manifest (whole-file
+  digest + step/param metadata), last-K retention with atomic
+  rotation, and :meth:`CheckpointManager.restore_latest` that
+  validates the newest checkpoint and falls back to the previous good
+  one on corruption (``resilience.checkpoint_fallbacks``).
+* ``serve.supervisor`` (in the serve package) — rebuilds a failed
+  engine, requeues not-yet-started requests, enforces a restart
+  budget, and sheds lowest-priority queued work under SLO pressure.
+
+Everything reports into ``observe.health_report()`` under the
+``resilience`` section.  See docs/RESILIENCE.md.
+"""
+
+from . import faults  # noqa: F401
+from . import retry  # noqa: F401
+from .checkpoint import (CheckpointCorruptError,  # noqa: F401
+                         CheckpointManager, NoValidCheckpointError)
+from .faults import (FaultInjected, FailAfterN, FailOnce,  # noqa: F401
+                     FailRate, Latency, clear, inject, injected)
+from .retry import (RetryBudgetExceededError, RetryPolicy,  # noqa: F401
+                    is_transient, retry_call, retryable)

@@ -6,6 +6,12 @@ one token per engine iteration, with finished rows retired and their
 slots backfilled the same step.  See docs/SERVING.md for the
 architecture and engine.py for the design rationale.
 
+Since the resilience round the engine fails TYPED instead of wedging
+(``EngineFailedError`` for every in-flight/queued request), and
+``EngineSupervisor`` rebuilds a failed engine, requeues never-started
+requests, enforces a restart budget, and sheds lowest-priority work
+under SLO pressure (``LoadShedError``).  See docs/RESILIENCE.md.
+
 Entry points::
 
     from singa_tpu.serve import InferenceEngine, GenerationRequest
@@ -13,10 +19,16 @@ Entry points::
     h = eng.submit(GenerationRequest(prompt, max_new_tokens=32))
     eng.run_until_complete()
     h.result().tokens
+
+    from singa_tpu.serve import EngineSupervisor
+    sup = EngineSupervisor(model, max_slots=8, restart_budget=2)
 """
 
 from .engine import InferenceEngine  # noqa: F401
-from .request import (DeadlineExceededError, GenerationRequest,  # noqa: F401
-                      GenerationResult, QueueFullError, RequestHandle)
+from .request import (DeadlineExceededError, EngineFailedError,  # noqa: F401
+                      GenerationRequest, GenerationResult, LoadShedError,
+                      QueueFullError, RequestHandle,
+                      RestartBudgetExceededError)
 from .scheduler import FIFOScheduler  # noqa: F401
 from .stats import EngineStats  # noqa: F401
+from .supervisor import EngineSupervisor  # noqa: F401

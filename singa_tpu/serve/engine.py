@@ -50,9 +50,11 @@ from ..models.gpt2_decode import (_logits, _norm_window, _sample,
                                   decode_step, extract_params, prefill)
 from ..observe import monitor as _monitor
 from ..observe import trace as _trace
+from ..resilience import faults as _faults
 from ..utils.logging import get_channel
-from .request import (DeadlineExceededError, GenerationRequest,
-                      GenerationResult, RequestHandle)
+from .request import (DeadlineExceededError, EngineFailedError,
+                      GenerationRequest, GenerationResult, LoadShedError,
+                      RequestHandle)
 from .scheduler import FIFOScheduler
 from .stats import EngineStats
 
@@ -227,6 +229,7 @@ class InferenceEngine:
         self._keys = jnp.zeros((S, 2), jnp.uint32)
         self._handles = {}
         self._closed = False
+        self._failed = False
         self.step_count = 0
         self._log.info(
             "engine up: slots=%d max_len=%d arena=%s x2 (%s)",
@@ -240,6 +243,10 @@ class InferenceEngine:
         if self._closed:
             raise RuntimeError(
                 "engine is closed; build a new one with model.serve()")
+        if self._failed:
+            raise EngineFailedError(
+                "engine has failed; rebuild it (EngineSupervisor does "
+                "this automatically)", engine_step=self.step_count)
         if not isinstance(request, GenerationRequest):
             request = GenerationRequest(np.asarray(request))
         need = len(request.prompt_ids) + request.max_new_tokens
@@ -319,19 +326,33 @@ class InferenceEngine:
         """One engine iteration: decode every live slot by one token,
         retire finished rows, then backfill freed slots from the queue
         (so backfill lands on the very step a row retires).  Returns
-        ``pending``."""
+        ``pending``.
+
+        A raising decode/prefill does NOT wedge the engine: every
+        in-flight and queued request is rejected with a typed
+        :class:`EngineFailedError` (``started`` says which were
+        occupying slots), the engine marks itself failed, and the
+        error re-raises for the caller/supervisor — no handle is ever
+        left dangling behind a dead pool."""
         if self._closed:
             raise RuntimeError(
                 "engine is closed; build a new one with model.serve()")
+        if self._failed:
+            raise EngineFailedError(
+                "engine has failed; rebuild it (EngineSupervisor does "
+                "this automatically)", engine_step=self.step_count)
         if _monitor.active():
             # arm BEFORE the dispatches below: if the first prefill or
             # decode after an idle period wedges, this beat is what
             # lets the watchdog see an armed, then-silent source — a
             # re-arm only after the dispatch returns would never come
             _monitor.heartbeat(self._hb_source)
-        if any(s is not None for s in self._slots):
-            self._decode_once()
-        self._schedule(self._clock())
+        try:
+            if any(s is not None for s in self._slots):
+                self._decode_once()
+            self._schedule(self._clock())
+        except Exception as e:
+            raise self._fail(e) from e
         self.stats.on_schedule(self.scheduler.queue_depth)
         self.step_count += 1
         pending = self.pending
@@ -341,6 +362,71 @@ class InferenceEngine:
             # one; the next step's top-of-loop beat re-arms
             _monitor.heartbeat(self._hb_source, busy=False)
         return pending
+
+    def _fail(self, cause) -> EngineFailedError:
+        """Fail the engine: reject every in-flight (started=True) and
+        queued (started=False) request typed, disarm the watchdog
+        source, and return the error for ``step()`` to raise.  The KV
+        arena and params stay allocated until ``close()`` — the
+        supervisor reads nothing from them, but a debugger might."""
+        self._failed = True
+        step = self.step_count
+        msg = f"engine failed at step {step}: {cause!r}"
+        self._log.error("%s — rejecting %d in-flight and %d queued "
+                        "requests typed", msg, self.live_slots,
+                        self.scheduler.queue_depth)
+        _trace.event("serve/engine_failed", cat="serve", step=step,
+                     error=repr(cause), live=self.live_slots,
+                     queued=self.scheduler.queue_depth)
+        self.stats.registry.counter(
+            "resilience.engine_failures",
+            help="serve engines failed by a raising decode/prefill").inc()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            rid = slot.handle.request.request_id
+            slot.handle._reject(EngineFailedError(
+                f"{msg} ({rid} was in flight, "
+                f"{len(slot.emitted)} tokens emitted)", request_id=rid,
+                started=True, engine_step=step))
+            self._slots[i] = None
+            self._handles.pop(rid, None)
+        for req in self.scheduler.drain():
+            h = self._handles.pop(req.request_id, None)
+            if h is not None:
+                h._reject(EngineFailedError(
+                    f"{msg} ({req.request_id} was queued, not started)",
+                    request_id=req.request_id, started=False,
+                    engine_step=step))
+        self._handles.clear()
+        if _monitor.active():
+            # dead, not hung: liveness beat with hang detection off so
+            # the watchdog doesn't page for an engine that failed FAST
+            _monitor.heartbeat(self._hb_source, busy=False)
+        return EngineFailedError(msg, engine_step=step)
+
+    def shed(self, reason="slo_pressure", below_priority=None):
+        """Shed the lowest-priority queued request (see
+        ``FIFOScheduler.shed_lowest``), rejecting its handle with a
+        typed :class:`LoadShedError`.  Returns the shed request or
+        None.  The supervisor's SLO-pressure admission mode calls this
+        before latency collapses; direct engine users can too."""
+        victim = self.scheduler.shed_lowest(reason,
+                                            below_priority=below_priority)
+        if victim is None:
+            return None
+        h = self._handles.pop(victim.request_id, None)
+        if h is not None:
+            h._reject(LoadShedError(
+                f"{victim.request_id} shed ({reason}): priority "
+                f"{victim.priority} was the lowest queued under SLO "
+                f"pressure"))
+        _trace.event("serve/shed", cat="serve", reason=reason,
+                     request=victim.request_id,
+                     priority=victim.priority)
+        self._log.warning("shed %s (%s, priority=%d)",
+                          victim.request_id, reason, victim.priority)
+        return victim
 
     def run_until_complete(self, max_steps=None):
         """Drive ``step()`` until every submitted request resolves.
@@ -357,6 +443,11 @@ class InferenceEngine:
 
     # -- internals -------------------------------------------------------
     def _decode_once(self):
+        if _faults._armed:
+            # chaos hook: a fault here is exactly a raising pool decode
+            # — step() fails the engine typed and the supervisor
+            # rebuilds; disarmed this is one module-flag read per step
+            _faults.check("serve.decode_step")
         live = np.asarray([s is not None for s in self._slots])
         n_live = int(live.sum())
         # watchdog heartbeat around the pool step (two clock calls,
@@ -394,7 +485,23 @@ class InferenceEngine:
         if slot.first_token_time is None:
             slot.first_token_time = now
         if req.on_token is not None:
-            req.on_token(req, token)
+            try:
+                req.on_token(req, token)
+            except Exception as e:
+                # a raising CLIENT callback is that request's failure,
+                # not an engine death: reject it typed-as-raised, free
+                # the slot, and keep serving the other tenants (a
+                # blanket engine _fail here would let one bad streaming
+                # client burn everyone — and the supervisor's restart
+                # budget with it)
+                self._log.warning(
+                    "on_token callback for %s raised (%r); rejecting "
+                    "that request, slot %d freed", req.request_id, e,
+                    idx)
+                self._slots[idx] = None
+                self._handles.pop(req.request_id, None)
+                slot.handle._reject(e)
+                return
         if slot.remaining <= 0:
             self._retire(idx, slot, now)
 

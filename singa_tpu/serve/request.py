@@ -36,6 +36,40 @@ class DeadlineExceededError(RuntimeError):
     re-raises this."""
 
 
+class EngineFailedError(RuntimeError):
+    """The engine's decode/prefill path raised and the engine failed
+    itself rather than wedging: every in-flight and queued request is
+    rejected with one of these (no handle is ever left dangling).
+    ``started`` distinguishes requests that were occupying a slot
+    (tokens may have streamed — NOT safely re-runnable through
+    ``on_token``) from queued ones that never started (safely
+    requeued by :class:`~singa_tpu.serve.supervisor.EngineSupervisor`).
+    """
+
+    def __init__(self, message, request_id=None, started=None,
+                 engine_step=None):
+        super().__init__(message)
+        self.request_id = request_id
+        self.started = started
+        self.engine_step = engine_step
+
+
+class RestartBudgetExceededError(EngineFailedError):
+    """The supervisor's restart budget is spent; remaining requests
+    are rejected with this instead of being requeued into an engine
+    that keeps dying."""
+
+
+class LoadShedError(RuntimeError):
+    """The request was shed by SLO-pressure admission control (queue
+    beyond ``SLO.queue_depth_max``): either a lower-priority queued
+    request evicted in favor of a newer higher-priority one, or an
+    incoming request refused while the queue is saturated.  Distinct
+    from :class:`QueueFullError` (hard back-pressure bound) — shedding
+    is a POLICY choice made before latency collapses, and clients
+    should drop, not retry immediately."""
+
+
 @dataclass
 class GenerationRequest:
     """One generation job.
@@ -47,7 +81,9 @@ class GenerationRequest:
     offline path (tests/test_serve.py).  ``deadline`` is an absolute
     time on the engine's clock (default ``time.monotonic``); a request
     still queued past it is rejected, never silently served late.
-    ``on_token(request, token)`` streams each emitted token."""
+    ``on_token(request, token)`` streams each emitted token.
+    ``priority`` only matters under SLO-pressure load shedding (higher
+    wins; default 0) — FIFO admission order is unchanged by it."""
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 20
@@ -55,6 +91,7 @@ class GenerationRequest:
     seed: int = 0
     deadline: Optional[float] = None
     on_token: Optional[Callable] = None
+    priority: int = 0
     request_id: str = field(
         default_factory=lambda: f"req-{next(_req_counter)}")
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 from collections import deque
 from typing import List, Tuple
 
+from ..observe.registry import registry as _registry
 from .request import GenerationRequest, QueueFullError
 
 
@@ -60,9 +61,42 @@ class FIFOScheduler:
     def enqueue(self, request: GenerationRequest):
         if len(self._queue) >= self.max_queue_depth:
             raise QueueFullError(
-                f"scheduler queue full ({self.max_queue_depth} "
-                f"requests); rejecting {request.request_id}")
+                f"scheduler queue full (depth {len(self._queue)} of "
+                f"max {self.max_queue_depth}); rejecting "
+                f"{request.request_id}")
         self._queue.append(request)
+
+    def drain(self) -> List[GenerationRequest]:
+        """Remove and return every queued request (queue order) — the
+        supervisor's requeue source after an engine failure."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
+    def shed_lowest(self, reason, below_priority=None):
+        """Load shedding: remove and return the lowest-priority queued
+        request (ties: the newest arrival — it has waited least), or
+        None when the queue is empty or nothing sits strictly below
+        ``below_priority``.  Every shed increments the process-wide
+        ``serve.shed_requests{reason=}`` counter."""
+        if not self._queue:
+            return None
+        victim_i = None
+        for i, r in enumerate(self._queue):
+            p = getattr(r, "priority", 0)
+            if victim_i is None \
+                    or p <= getattr(self._queue[victim_i], "priority", 0):
+                victim_i = i
+        victim = self._queue[victim_i]
+        if below_priority is not None \
+                and getattr(victim, "priority", 0) >= below_priority:
+            return None
+        del self._queue[victim_i]
+        _registry().counter(
+            "serve.shed_requests",
+            help="queued requests shed by load-shedding admission",
+            reason=reason).inc()
+        return victim
 
     def schedule(self, free_slots: int, now: float
                  ) -> Tuple[List[GenerationRequest],

@@ -16,6 +16,7 @@ import numpy as np
 from . import tensor
 from .io.binfile import BinFileReader, BinFileWriter
 from .observe import trace as _trace
+from .resilience import faults as _faults
 from .tensor import Tensor
 
 
@@ -53,6 +54,8 @@ class Snapshot:
 
     def write(self, key, t):
         assert self._writer is not None, "snapshot opened for reading"
+        if _faults._armed:
+            _faults.check("checkpoint.write")
         arr = tensor.to_numpy(t) if isinstance(t, Tensor) else np.asarray(t)
         with _trace.span("snapshot/write_record", cat="snapshot",
                          key=str(key), bytes=int(arr.nbytes)):
@@ -63,6 +66,8 @@ class Snapshot:
 
     def read(self) -> dict:
         assert self._reader is not None, "snapshot opened for writing"
+        if _faults._armed:
+            _faults.check("checkpoint.read")
         with _trace.span("snapshot/read", cat="snapshot",
                          path=self.path):
             return {k: tensor.from_numpy(_decode(v))

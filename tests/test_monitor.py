@@ -547,7 +547,11 @@ def test_health_report_schema_and_sections():
         report = health_report()
         assert set(report) == {
             "schema", "host", "train", "step_time", "serve",
-            "watchdog", "flight_recorder", "registry"}
+            "resilience", "watchdog", "flight_recorder", "registry"}
+        # the resilience section is always present, zeroed when the
+        # layer never armed
+        assert report["resilience"]["engine_restarts"] >= 0
+        assert isinstance(report["resilience"]["retries"], dict)
         assert report["watchdog"]["active"] is True
         assert report["watchdog"]["hangs"] == 0
         assert "train" in report["watchdog"]["sources"]

@@ -1,0 +1,504 @@
+"""singa_tpu.resilience: fault-injection policies, retry/backoff with
+transient/fatal classification, the CheckpointManager's corruption
+fallback, the async-save failure telemetry, and the typed BinFile
+corruption surface.
+
+Everything runs on CPU with seeded policies and injectable sleeps, so
+the chaos is deterministic."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, opt, tensor
+from singa_tpu.io import binfile
+from singa_tpu.io.binfile import BinFileReader, BinFileWriter, \
+    CorruptRecordError
+from singa_tpu.models.mlp import MLP
+from singa_tpu.observe.health import health_report
+from singa_tpu.observe.registry import MetricsRegistry, registry
+from singa_tpu.resilience import (CheckpointManager, FailAfterN,
+                                  FailOnce, FailRate, FaultInjected,
+                                  Latency, NoValidCheckpointError,
+                                  RetryBudgetExceededError, RetryPolicy,
+                                  faults, retry_call)
+from singa_tpu.resilience.checkpoint import (MANIFEST_NAME, STATES_NAME,
+                                             CheckpointCorruptError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name, **labels):
+    snap = registry().snapshot()["counters"]
+    key = name
+    if labels:
+        key += "{" + ",".join(f"{k}={v}"
+                              for k, v in sorted(labels.items())) + "}"
+    return snap.get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+def test_fault_registry_disarmed_is_noop():
+    assert not faults.armed()
+    faults.check("checkpoint.write")  # nothing armed: no-op, no raise
+
+
+def test_fail_once_fires_exactly_once():
+    pol = faults.inject("t.once", FailOnce())
+    with pytest.raises(FaultInjected) as ei:
+        faults.check("t.once")
+    assert ei.value.site == "t.once"
+    assert ei.value.transient
+    faults.check("t.once")  # second call passes
+    assert pol.fired == 1 and pol.calls == 2
+
+
+def test_fail_rate_is_seed_deterministic():
+    def run(seed):
+        faults.clear()
+        pol = faults.inject("t.rate", FailRate(0.5, seed=seed))
+        fired = []
+        for _ in range(20):
+            try:
+                faults.check("t.rate")
+                fired.append(0)
+            except FaultInjected:
+                fired.append(1)
+        return fired
+    a, b = run(7), run(7)
+    assert a == b                      # same seed, same fault sequence
+    assert 0 < sum(a) < 20             # actually probabilistic
+    assert run(8) != a                 # different seed, different draw
+
+
+def test_fail_after_n_passes_then_fires_times():
+    faults.inject("t.after", FailAfterN(3, times=2))
+    outcomes = []
+    for _ in range(7):
+        try:
+            faults.check("t.after")
+            outcomes.append("ok")
+        except FaultInjected:
+            outcomes.append("fault")
+    assert outcomes == ["ok"] * 3 + ["fault"] * 2 + ["ok"] * 2
+
+
+def test_latency_policy_sleeps_never_raises():
+    faults.inject("t.lat", Latency(0.0))
+    for _ in range(3):
+        faults.check("t.lat")  # no raise
+
+
+def test_injected_context_manager_disarms():
+    with faults.injected("t.ctx", FailOnce()):
+        assert faults.armed()
+        with pytest.raises(FaultInjected):
+            faults.check("t.ctx")
+    assert not faults.armed()
+
+
+def test_fired_faults_are_counted():
+    before = _counter("resilience.faults_injected", site="t.count")
+    faults.inject("t.count", FailOnce())
+    with pytest.raises(FaultInjected):
+        faults.check("t.count")
+    assert _counter("resilience.faults_injected",
+                    site="t.count") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff
+# ---------------------------------------------------------------------------
+
+def test_retry_transient_then_success_counts_retries():
+    reg = MetricsRegistry()
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient io")
+        return "ok"
+
+    out = retry_call(flaky, "t.retry",
+                     policy=RetryPolicy(max_attempts=4,
+                                        base_delay_s=0.01,
+                                        max_delay_s=0.05, jitter=0.5,
+                                        seed=3),
+                     sleep=sleeps.append, reg=reg)
+    assert out == "ok" and len(calls) == 3
+    snap = reg.snapshot()["counters"]
+    assert snap["resilience.retries{site=t.retry}"] == 2
+    assert "resilience.gave_up{site=t.retry}" not in snap
+    # exponential backoff with jitter in [1, 1.5): delay k in
+    # [base*2^k, 1.5*base*2^k)
+    assert 0.01 <= sleeps[0] < 0.015
+    assert 0.02 <= sleeps[1] < 0.03
+
+
+def test_retry_backoff_is_seed_deterministic():
+    def delays(seed):
+        sleeps = []
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise OSError("x")
+
+        with pytest.raises(RetryBudgetExceededError):
+            retry_call(flaky, "t.det",
+                       policy=RetryPolicy(max_attempts=3, seed=seed,
+                                          base_delay_s=0.01),
+                       sleep=sleeps.append, reg=MetricsRegistry())
+        return sleeps
+    assert delays(5) == delays(5)
+    assert delays(5) != delays(6)
+
+
+def test_retry_fatal_raises_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry_call(broken, "t.fatal", sleep=lambda s: None,
+                   reg=MetricsRegistry())
+    assert len(calls) == 1  # no retry for fatal classification
+
+
+def test_retry_budget_exhausted_raises_typed_and_counts():
+    reg = MetricsRegistry()
+
+    def always():
+        raise TimeoutError("never heals")
+
+    with pytest.raises(RetryBudgetExceededError) as ei:
+        retry_call(always, "t.budget",
+                   policy=RetryPolicy(max_attempts=3,
+                                      base_delay_s=0.001),
+                   sleep=lambda s: None, reg=reg)
+    assert ei.value.site == "t.budget"
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, TimeoutError)
+    snap = reg.snapshot()["counters"]
+    assert snap["resilience.retries{site=t.budget}"] == 2
+    assert snap["resilience.gave_up{site=t.budget}"] == 1
+
+
+def test_injected_fault_transient_flag_drives_classification():
+    # transient injected fault: retried and absorbed
+    faults.inject("t.class", FailOnce(transient=True))
+    out = retry_call(lambda: faults.check("t.class") or "ok", "t.class",
+                     policy=RetryPolicy(max_attempts=2,
+                                        base_delay_s=0.001),
+                     sleep=lambda s: None, reg=MetricsRegistry())
+    assert out == "ok"
+    # fatal injected fault: raised on first attempt
+    faults.clear()
+    faults.inject("t.class", FailOnce(transient=False))
+    with pytest.raises(FaultInjected):
+        retry_call(lambda: faults.check("t.class"), "t.class",
+                   sleep=lambda s: None, reg=MetricsRegistry())
+
+
+def test_corrupt_record_error_is_fatal_to_retry():
+    calls = []
+
+    def corrupted():
+        calls.append(1)
+        raise CorruptRecordError("/x.bin", "CRC mismatch", key="w0")
+
+    with pytest.raises(CorruptRecordError):
+        retry_call(corrupted, "t.corrupt", sleep=lambda s: None,
+                   reg=MetricsRegistry())
+    assert len(calls) == 1  # corruption never heals on retry
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager
+# ---------------------------------------------------------------------------
+
+def _mlp(dev, seed=0):
+    dev.SetRandSeed(seed)
+    m = MLP(data_size=10, perceptron_size=8, num_classes=4)
+    m.set_optimizer(opt.SGD(lr=0.05))
+    x = tensor.from_numpy(np.zeros((4, 10), np.float32), dev)
+    m.compile([x], is_train=True, use_graph=False, sequential=False)
+    return m
+
+
+def _train_steps(m, dev, n=2, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        x = tensor.from_numpy(rng.randn(4, 10).astype(np.float32), dev)
+        y = tensor.from_numpy(rng.randint(0, 4, (4,)).astype(np.int32),
+                              dev)
+        m(x, y)
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    _train_steps(m, dev)
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(m, 10, aux_states={"epoch": np.int64(1)})
+    params = {k: tensor.to_numpy(v) for k, v in m.get_params().items()}
+
+    m2 = _mlp(dev, seed=99)
+    step, aux = mgr.restore_latest(m2)
+    assert step == 10 and int(aux["epoch"]) == 1
+    for k, v in m2.get_params().items():
+        np.testing.assert_array_equal(tensor.to_numpy(v), params[k])
+
+
+def test_checkpoint_manifest_is_strict_json_with_digest(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    path = mgr.save(m, 5)
+    raiser = lambda c: (_ for _ in ()).throw(ValueError(c))  # noqa: E731
+    man = json.load(open(os.path.join(path, MANIFEST_NAME)),
+                    parse_constant=raiser)
+    assert man["schema"] == "singa_tpu.checkpoint/1"
+    assert man["step"] == 5
+    assert man["param_count"] > 0
+    meta = man["files"][STATES_NAME]
+    states = os.path.join(path, STATES_NAME)
+    assert meta["bytes"] == os.path.getsize(states)
+    assert len(meta["sha256"]) == 64
+    assert mgr.validate(5)["step"] == 5
+
+
+def test_checkpoint_retention_keeps_last_k(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    for step in (1, 2, 3, 4):
+        mgr.save(m, step)
+    assert mgr.steps() == [3, 4]
+    assert mgr.latest_step() == 4
+
+
+@pytest.mark.parametrize("cut", ["third", "half", "minus_one_byte"])
+def test_restore_falls_back_on_truncated_newest(tmp_path, cut):
+    """Crash-mid-checkpoint: a states file truncated at several byte
+    offsets must fall back to the previous good step, bumping the
+    fallback counter (satellite + acceptance criterion)."""
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    _train_steps(m, dev, seed=1)
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(m, 1, aux_states={"tag": np.int64(11)})
+    good = {k: tensor.to_numpy(v) for k, v in m.get_params().items()}
+    _train_steps(m, dev, seed=2)
+    mgr.save(m, 2, aux_states={"tag": np.int64(22)})
+
+    sp = os.path.join(mgr.step_dir(2), STATES_NAME)
+    data = open(sp, "rb").read()
+    n = {"third": len(data) // 3, "half": len(data) // 2,
+         "minus_one_byte": len(data) - 1}[cut]
+    open(sp, "wb").write(data[:n])
+
+    before = _counter("resilience.checkpoint_fallbacks")
+    m2 = _mlp(dev, seed=7)
+    step, aux = mgr.restore_latest(m2)
+    assert step == 1 and int(aux["tag"]) == 11
+    for k, v in m2.get_params().items():
+        np.testing.assert_array_equal(tensor.to_numpy(v), good[k])
+    assert _counter("resilience.checkpoint_fallbacks") == before + 1
+    # and the health report surfaces it
+    assert health_report()["resilience"]["checkpoint_fallbacks"] \
+        >= before + 1
+
+
+def test_restore_falls_back_on_bitflip_digest_mismatch(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(m, 1)
+    mgr.save(m, 2)
+    sp = os.path.join(mgr.step_dir(2), STATES_NAME)
+    b = bytearray(open(sp, "rb").read())
+    b[len(b) // 2] ^= 0xFF  # flipped bit, same length
+    open(sp, "wb").write(bytes(b))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        mgr.validate(2)
+    assert "digest mismatch" in str(ei.value)
+    step, _ = mgr.restore_latest(_mlp(dev, seed=3))
+    assert step == 1
+
+
+def test_restore_raises_when_nothing_valid(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    with pytest.raises(NoValidCheckpointError):
+        mgr.restore_latest(m)
+    mgr.save(m, 1)
+    os.unlink(os.path.join(mgr.step_dir(1), MANIFEST_NAME))
+    with pytest.raises(NoValidCheckpointError):
+        mgr.restore_latest(m)
+
+
+def test_checkpoint_write_fault_is_retried(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    mgr = CheckpointManager(
+        str(tmp_path), keep=3,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                 max_delay_s=0.002))
+    before = _counter("resilience.retries", site="checkpoint.write")
+    faults.inject("checkpoint.write", FailOnce())
+    mgr.save(m, 1)  # transient injected fault absorbed by retry
+    assert _counter("resilience.retries",
+                    site="checkpoint.write") == before + 1
+    assert mgr.validate(1)["step"] == 1
+
+
+def test_model_manager_entry_points(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    m.save_checkpoint(str(tmp_path), 3, aux_states={"e": np.int64(9)})
+    m2 = _mlp(dev, seed=5)
+    step, aux = m2.restore_latest_checkpoint(str(tmp_path))
+    assert step == 3 and int(aux["e"]) == 9
+
+
+# ---------------------------------------------------------------------------
+# async save failure telemetry (satellite)
+# ---------------------------------------------------------------------------
+
+def test_async_save_failure_logged_and_counted(tmp_path):
+    """A fire-and-forget async save that fails must bump
+    checkpoint.async_failures and log at thread exit; wait() still
+    re-raises (test-pinned)."""
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    before = _counter("checkpoint.async_failures")
+    faults.inject("checkpoint.write", FailOnce(transient=False))
+    handle = m.save_states(str(tmp_path / "a.zip"), async_save=True)
+    handle._thread.join(10.0)
+    assert _counter("checkpoint.async_failures") == before + 1
+    with pytest.raises(FaultInjected):  # wait() re-raises, unchanged
+        handle.wait(10.0)
+    assert health_report()["resilience"][
+        "checkpoint_async_failures"] >= before + 1
+
+
+def test_sync_save_retry_kwarg_absorbs_transient_fault(tmp_path):
+    dev = device.get_default_device()
+    m = _mlp(dev)
+    faults.inject("checkpoint.write", FailOnce())
+    m.save_states(str(tmp_path / "s.zip"),
+                  retry=RetryPolicy(max_attempts=2, base_delay_s=0.001))
+    m2 = _mlp(dev, seed=4)
+    m2.load_states(str(tmp_path / "s.zip"))  # file is whole
+
+
+# ---------------------------------------------------------------------------
+# BinFile typed corruption (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _py_binfile(monkeypatch):
+    """Force the pure-Python BinFile fallback: the typed truncation
+    surface lives in its parse loop (the native reader rejects a
+    truncated file at open)."""
+    monkeypatch.setattr(binfile, "_lib", None)
+    monkeypatch.setattr(binfile, "_lib_err", RuntimeError("forced"))
+    yield
+
+
+def _write_bin(path):
+    w = BinFileWriter(str(path))
+    w.put("alpha", b"A" * 100)
+    w.put("beta", b"B" * 50)
+    w.close()
+
+
+def test_truncated_tail_raises_typed(tmp_path, _py_binfile):
+    p = tmp_path / "t.bin"
+    _write_bin(p)
+    size = os.path.getsize(p)
+    # truncate at several offsets inside the SECOND record
+    for cut in (size - 2, size - 20, size - 54):
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:cut])
+        with pytest.raises(CorruptRecordError) as ei:
+            BinFileReader(str(p))
+        assert "truncated tail" in str(ei.value)
+        assert ei.value.offset is not None
+        open(p, "wb").write(data)  # restore for the next cut
+
+
+def test_corrupt_length_header_raises_typed_not_memoryerror(
+        tmp_path, _py_binfile):
+    """A bit-flipped value-length field must surface as typed
+    corruption, not a multi-GB allocation attempt."""
+    import struct as _struct
+
+    p = tmp_path / "l.bin"
+    _write_bin(p)
+    data = bytearray(open(p, "rb").read())
+    # the first record's 8-byte vlen header sits after magic+klen+key
+    off = 8 + 4 + 5
+    data[off:off + 8] = _struct.pack("<Q", 1 << 62)
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(CorruptRecordError) as ei:
+        BinFileReader(str(p))
+    assert "exceeds remaining file" in str(ei.value)
+
+
+def test_crc_mismatch_names_key_and_checksums(tmp_path, _py_binfile):
+    p = tmp_path / "c.bin"
+    _write_bin(p)
+    data = bytearray(open(p, "rb").read())
+    # corrupt one payload byte of the FIRST record (value starts after
+    # magic + klen + key + vlen headers = 8 + 4 + 5 + 8)
+    data[8 + 4 + 5 + 8 + 10] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(CorruptRecordError) as ei:
+        BinFileReader(str(p))
+    err = ei.value
+    assert err.key == "alpha"
+    assert err.expected is not None and err.actual is not None
+    assert err.expected != err.actual
+    assert "alpha" in str(err) and "crc expected" in str(err)
+
+
+def test_binfile_fault_site(tmp_path, _py_binfile):
+    p = tmp_path / "f.bin"
+    faults.inject("io.binfile", FailOnce())
+    with pytest.raises(FaultInjected):
+        BinFileWriter(str(p)).put("k", b"v")
+    faults.clear()
+    _write_bin(p)
+    assert BinFileReader(str(p)).read_all()["alpha"] == b"A" * 100
+
+
+# ---------------------------------------------------------------------------
+# collective dispatch site
+# ---------------------------------------------------------------------------
+
+def test_collective_fault_retried_at_trace_time():
+    from singa_tpu.parallel.communicator import _record_collective
+
+    before = _counter("resilience.retries", site="comm.collective")
+    faults.inject("comm.collective",
+                  FailOnce(latency_s=0.0, transient=True))
+    _record_collective("all_reduce", [np.zeros((4,), np.float32)])
+    assert _counter("resilience.retries",
+                    site="comm.collective") == before + 1

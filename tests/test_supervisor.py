@@ -1,0 +1,275 @@
+"""Serve-engine failure semantics + supervised recovery: a raising
+decode fails every request TYPED instead of wedging, the supervisor
+rebuilds the engine and requeues never-started requests with
+token-stream parity against an uninterrupted run, the restart budget
+bounds flapping, and SLO-pressure load shedding drops the
+lowest-priority queued work first.
+
+Deterministic on CPU: faults come from the seeded injection registry
+and scheduling tests run on a fake clock."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.observe.health import SLO, health_report
+from singa_tpu.observe.registry import registry
+from singa_tpu.resilience import FailAfterN, FailRate, faults
+from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                             FIFOScheduler, GenerationRequest,
+                             LoadShedError, QueueFullError,
+                             RestartBudgetExceededError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+_PROMPTS = [np.arange(9) % 256, (np.arange(4) + 3) % 256,
+            np.asarray([5, 1, 200]), (np.arange(7) + 40) % 256]
+_NEWS = [6, 3, 5, 4]
+
+
+def _counter(name, **labels):
+    snap = registry().snapshot()["counters"]
+    key = name
+    if labels:
+        key += "{" + ",".join(f"{k}={v}"
+                              for k, v in sorted(labels.items())) + "}"
+    return snap.get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# typed engine failure (no wedging, no dangling handles)
+# ---------------------------------------------------------------------------
+
+def test_decode_fault_fails_all_requests_typed(model):
+    """One raising decode step: in-flight requests reject with
+    started=True, queued ones with started=False, the engine marks
+    itself failed, and close() still releases its resources."""
+    eng = model.serve(max_slots=2)
+    hs = [eng.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in zip(_PROMPTS[:3], _NEWS[:3])]
+    eng.step()  # admit two rows (third stays queued)
+    faults.inject("serve.decode_step", FailAfterN(0, times=1))
+    with pytest.raises(EngineFailedError):
+        eng.step()
+    faults.clear()
+    assert not eng.pending  # nothing wedged, nothing dangling
+    started = []
+    for h in hs:
+        assert h.done()
+        with pytest.raises(EngineFailedError) as ei:
+            h.result()
+        assert ei.value.request_id == h.request.request_id
+        started.append(ei.value.started)
+    assert started == [True, True, False]
+    # failed engine: step/submit raise typed, close still works
+    with pytest.raises(EngineFailedError):
+        eng.step()
+    with pytest.raises(EngineFailedError):
+        eng.submit(GenerationRequest(_PROMPTS[0]))
+    eng.close()
+    assert _counter("resilience.engine_failures") >= 1
+
+
+# ---------------------------------------------------------------------------
+# supervised recovery
+# ---------------------------------------------------------------------------
+
+def test_supervisor_restart_requeue_parity(model):
+    """Mid-stream injected fault + restart: requeued (never-started)
+    requests complete with token streams identical to an uninterrupted
+    run; in-flight ones fail typed; restarts match injected faults."""
+    base = [np.asarray(model.generate(p, max_new_tokens=n,
+                                      temperature=0.0))
+            for p, n in zip(_PROMPTS, _NEWS)]
+    restarts0 = _counter("resilience.engine_restarts")
+
+    sup = EngineSupervisor(model, max_slots=2, restart_budget=2)
+    hs = [sup.submit(GenerationRequest(p, max_new_tokens=n,
+                                       temperature=0.0))
+          for p, n in zip(_PROMPTS, _NEWS)]
+    faults.inject("serve.decode_step", FailAfterN(2, times=1))
+    sup.run_until_complete(max_steps=500)
+    faults.clear()
+
+    completed, failed = [], []
+    for i, h in enumerate(hs):
+        assert h.done(), f"handle {i} left dangling"
+        try:
+            toks = h.result().tokens
+            np.testing.assert_array_equal(toks, base[i])
+            completed.append(i)
+        except EngineFailedError as e:
+            assert e.started is True  # only in-flight work fails
+            failed.append(i)
+    assert completed and failed  # the fault actually bit mid-stream
+    assert sup.restarts == 1
+    assert _counter("resilience.engine_restarts") == restarts0 + 1
+    report = health_report()
+    assert report["resilience"]["engine_restarts"] >= restarts0 + 1
+    sup.close()
+
+
+def test_supervisor_restart_budget_exhausts_typed(model):
+    """An engine that fails on EVERY decode burns the budget; every
+    outstanding handle resolves typed and the supervisor refuses new
+    work — zero wedged, zero lost."""
+    sup = EngineSupervisor(model, max_slots=2, restart_budget=1)
+    hs = [sup.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in zip(_PROMPTS, _NEWS)]
+    faults.inject("serve.decode_step", FailRate(1.0, seed=0))
+    with pytest.raises(RestartBudgetExceededError):
+        sup.run_until_complete(max_steps=500)
+    faults.clear()
+    assert sup.restarts == 2  # budget 1 allowed, the 2nd death killed it
+    for h in hs:
+        assert h.done()
+        with pytest.raises(EngineFailedError):
+            h.result()
+    with pytest.raises(RestartBudgetExceededError):
+        sup.submit(GenerationRequest(_PROMPTS[0]))
+    assert not sup.pending
+
+
+def test_supervisor_clean_run_has_no_restarts(model):
+    base = [np.asarray(model.generate(p, max_new_tokens=n,
+                                      temperature=0.0))
+            for p, n in zip(_PROMPTS[:2], _NEWS[:2])]
+    with EngineSupervisor(model, max_slots=2) as sup:
+        hs = [sup.submit(GenerationRequest(p, max_new_tokens=n,
+                                           temperature=0.0))
+              for p, n in zip(_PROMPTS[:2], _NEWS[:2])]
+        sup.run_until_complete(max_steps=200)
+        assert sup.restarts == 0
+        for h, b in zip(hs, base):
+            np.testing.assert_array_equal(h.result().tokens, b)
+
+
+def test_requeued_streaming_has_no_duplicate_tokens(model):
+    """A requeued request's on_token stream must match a clean run —
+    queued work never streamed, so the restart emits each token once."""
+    streams = {}
+
+    def on_token(req, tok):
+        streams.setdefault(req.request_id, []).append(tok)
+
+    sup = EngineSupervisor(model, max_slots=1, restart_budget=1)
+    reqs = [GenerationRequest(p, max_new_tokens=n, temperature=0.0,
+                              on_token=on_token)
+            for p, n in zip(_PROMPTS[:3], _NEWS[:3])]
+    hs = [sup.submit(r) for r in reqs]
+    faults.inject("serve.decode_step", FailAfterN(1, times=1))
+    sup.run_until_complete(max_steps=500)
+    faults.clear()
+    for r, h in zip(reqs, hs):
+        if h._error is not None:
+            continue  # in-flight at fault: typed failure, no requeue
+        toks = h.result().tokens
+        # streamed tokens == continuation exactly once each
+        np.testing.assert_array_equal(
+            np.asarray(streams[r.request_id]),
+            toks[len(r.prompt_ids):])
+    sup.close()
+
+
+def test_raising_on_token_callback_fails_only_that_request(model):
+    """One client's broken streaming callback must not kill the other
+    tenants' requests (or burn a supervisor restart)."""
+    def bad_cb(req, tok):
+        raise KeyError("client bug")
+
+    eng = model.serve(max_slots=2)
+    h_bad = eng.submit(GenerationRequest(_PROMPTS[0], max_new_tokens=4,
+                                         on_token=bad_cb))
+    h_ok = eng.submit(GenerationRequest(_PROMPTS[1], max_new_tokens=3,
+                                        temperature=0.0))
+    eng.run_until_complete(max_steps=100)
+    with pytest.raises(KeyError):
+        h_bad.result()
+    want = np.asarray(model.generate(_PROMPTS[1], max_new_tokens=3,
+                                     temperature=0.0))
+    np.testing.assert_array_equal(h_ok.result().tokens, want)
+    assert not eng._failed  # engine healthy, no restart consumed
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# load shedding (satellite + SLO-pressure admission mode)
+# ---------------------------------------------------------------------------
+
+def test_queue_full_error_names_depth_and_max():
+    sched = FIFOScheduler(max_queue_depth=2)
+    sched.enqueue(GenerationRequest(np.asarray([1])))
+    sched.enqueue(GenerationRequest(np.asarray([2])))
+    with pytest.raises(QueueFullError) as ei:
+        sched.enqueue(GenerationRequest(np.asarray([3])))
+    assert "depth 2" in str(ei.value)
+    assert "max 2" in str(ei.value)
+
+
+def test_scheduler_shed_lowest_priority_and_counter():
+    before = _counter("serve.shed_requests", reason="test")
+    sched = FIFOScheduler()
+    lo = GenerationRequest(np.asarray([1]), priority=0)
+    hi = GenerationRequest(np.asarray([2]), priority=5)
+    lo2 = GenerationRequest(np.asarray([3]), priority=0)
+    for r in (lo, hi, lo2):
+        sched.enqueue(r)
+    victim = sched.shed_lowest("test")
+    assert victim is lo2  # lowest priority, newest arrival sheds first
+    assert sched.queue_depth == 2
+    assert _counter("serve.shed_requests", reason="test") == before + 1
+    # below_priority guard: nothing ranks below 0
+    assert sched.shed_lowest("test", below_priority=0) is None
+    assert sched.shed_lowest("test", below_priority=99) is lo
+
+
+def test_slo_pressure_sheds_lowest_priority_queued(model):
+    """Admission under SLO queue pressure: a high-priority arrival
+    evicts the lowest-priority queued request (typed LoadShedError);
+    a low-priority arrival is refused itself."""
+    slo = SLO(queue_depth_max=2)
+    sup = EngineSupervisor(model, max_slots=1, shed_on_slo_pressure=True,
+                           slo=slo)
+    # fill: one in flight + two queued (at queue_depth_max)
+    hs = [sup.submit(GenerationRequest(_PROMPTS[0], max_new_tokens=2,
+                                       priority=0))]
+    sup.step()  # admit it into the single slot
+    hs += [sup.submit(GenerationRequest(p, max_new_tokens=2, priority=0))
+           for p in _PROMPTS[1:3]]  # queue depth now 2 == max
+    shed_before = _counter("serve.shed_requests", reason="slo_pressure")
+    h_hi = sup.submit(GenerationRequest(_PROMPTS[3], max_new_tokens=2,
+                                        priority=9))
+    assert _counter("serve.shed_requests",
+                    reason="slo_pressure") == shed_before + 1
+    # one of the queued low-priority handles was shed typed
+    shed = [h for h in hs if h.done()]
+    assert len(shed) == 1
+    with pytest.raises(LoadShedError):
+        shed[0].result()
+    # a second low-priority arrival is refused (it IS the lowest)
+    with pytest.raises(LoadShedError):
+        sup.submit(GenerationRequest(_PROMPTS[0], max_new_tokens=2,
+                                     priority=0))
+    assert _counter("serve.shed_requests", reason="slo_admission") >= 1
+    sup.run_until_complete(max_steps=300)
+    assert h_hi.result().finish_reason == "length"
+    # health report aggregates the shed reasons
+    shed_section = health_report()["resilience"]["shed_requests"]
+    assert shed_section.get("slo_pressure", 0) >= 1
+    sup.close()
