@@ -186,6 +186,87 @@ def chaos_serve(report):
         f"restarts ({restarts}) != injected decode faults ({injected})"
 
 
+def chaos_prefix(report):
+    """Injected prefix-cache copy faults (serve.prefix_copy fires in
+    the warm-admission block copy AND the retire-time donation): the
+    engine fails TYPED, the supervisor rebuilds it with an EMPTY radix
+    tree, and every request either completes with parity or fails
+    typed — zero wedged/lost, restarts == injected."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest, PrefixCacheConfig)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(2)
+    system = rng.randint(0, 256, 24).astype(np.int32)
+    workload = [(np.concatenate(
+        [system,
+         rng.randint(0, 256, rng.randint(3, 10)).astype(np.int32)]),
+        int(rng.randint(2, 7))) for _ in range(10)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = 0
+    for fail_after in (3, 8):
+        sup = EngineSupervisor(
+            m, max_slots=2, restart_budget=2,
+            prefix_cache=PrefixCacheConfig(block_size=8,
+                                           num_blocks=32))
+        cache0 = sup.engine.prefix_cache
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.prefix_copy",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=2000)
+        faults.clear()
+        injected += pol.fired
+        if pol.fired:
+            # the advertised restart contract: a FRESH cache object,
+            # rebuilt from empty (its contents now reflect only
+            # post-restart donations, never pre-fault state)
+            assert sup.engine.prefix_cache is not cache0, \
+                "rebuilt engine carried the old prefix cache"
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "warm/restarted token stream diverged"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve_prefix"] = {
+        "requests": 2 * len(workload),
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "copy_faults_injected": injected,
+        "engine_restarts": restarts,
+    }
+    assert wedged == 0, f"{wedged} requests wedged/lost"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0 and injected > 0
+    assert restarts == injected, \
+        f"restarts ({restarts}) != injected copy faults ({injected})"
+
+
 def main():
     from singa_tpu import observe
 
@@ -200,6 +281,7 @@ def main():
     chaos_checkpoint(report)
     chaos_collective(report)
     chaos_serve(report)
+    chaos_prefix(report)
 
     health = observe.health_report(include_registry=False)
     report["health"] = health

@@ -84,6 +84,175 @@ def run_engine(m, workload, max_slots, close_after=False, slo=None):
     return wall, outs, snap
 
 
+def make_prefix_workload(n_requests=16, seed=1, vocab=512,
+                         system_tokens=160):
+    """Shared-system-prompt + multi-turn traffic: every request opens
+    with the same ``system_tokens``-token system prompt and a ragged
+    user tail, and each completed turn is continued once through its
+    pinned session (the whole turn-1 conversation re-sent as turn 2's
+    prompt) — the workload shape prefix caching exists for.  Arrivals
+    are spread (1-2 steps apart) so TTFT reflects admission cost, not
+    queue wait."""
+    rng = np.random.RandomState(seed)
+    system = rng.randint(0, vocab, system_tokens).astype(np.int32)
+    reqs = []
+    arrival = 0
+    for _ in range(n_requests):
+        tail = rng.randint(0, vocab,
+                           int(rng.randint(8, 25))).astype(np.int32)
+        arrival += int(rng.randint(1, 3))
+        reqs.append(dict(
+            prompt=np.concatenate([system, tail]),
+            n_new=int(rng.choice([8, 16])),
+            arrival_step=arrival,
+            extra=rng.randint(0, vocab,
+                              int(rng.randint(4, 9))).astype(np.int32),
+            extra_new=int(rng.choice([8, 16]))))
+    return reqs
+
+
+def run_prefix_engine(m, workload, max_slots, prefix_cfg=None,
+                      close_after=False):
+    """Drive the two-turn session workload through one engine (warm
+    when ``prefix_cfg`` is set, cold baseline otherwise).  Returns
+    (wall, turn1 results, turn2 (request, result) pairs, stats snap)."""
+    from singa_tpu.serve import GenerationRequest
+
+    eng = m.serve(max_slots=max_slots, prefix_cache=prefix_cfg)
+    n = len(workload)
+    pending = list(workload)
+    turn1, turn2 = [], []
+    continued = set()
+    t0 = time.perf_counter()
+    while pending or len(continued) < n or eng.pending:
+        while pending and pending[0]["arrival_step"] <= eng.step_count:
+            w = pending.pop(0)
+            turn1.append((w, eng.submit(GenerationRequest(
+                w["prompt"], max_new_tokens=w["n_new"],
+                pin_session=True))))
+        for i, (w, h) in enumerate(turn1):
+            if i in continued or not h.done():
+                continue
+            req2 = h.result().session.request(
+                w["extra"], max_new_tokens=w["extra_new"])
+            turn2.append((req2, eng.submit(req2)))
+            continued.add(i)
+        eng.step()
+    wall = time.perf_counter() - t0
+    outs1 = [h.result() for _, h in turn1]
+    outs2 = [(req, h.result()) for req, h in turn2]
+    for r in outs1:
+        if r.session is not None:
+            r.session.release()
+    snap = eng.stats.snapshot()
+    if close_after:
+        eng.close()
+    return wall, outs1, outs2, snap
+
+
+def _serve_jit_cache_size():
+    """Total jit-cache entries across every executable the engine and
+    prefix cache dispatch — pinned across the timed runs to prove the
+    warm path introduces ZERO runtime recompiles."""
+    from singa_tpu.serve import engine as E
+    from singa_tpu.serve import prefix as P
+
+    total = 0
+    for f in (E._pool_decode_step, E._prefill_one, E._write_slot,
+              E._chunk_row, E._first_from_hidden, P._blocks_to_row,
+              P._row_to_blocks, P._read_slot):
+        try:
+            total += f._cache_size()
+        except Exception:
+            return None  # jax without _cache_size: report honestly
+    return total
+
+
+def run_prefix_mix(max_slots):
+    """The --prefix-mix measurement: the session workload warm
+    (radix cache on) vs cold (cache off), with byte parity against
+    the offline oracle for EVERY stream and the jit cache size pinned
+    across the timed runs.  Uses its own 256-position model: a
+    160-token shared system prompt against a 256-wide prefill is the
+    regime the cache targets (the standard bench model's 128 window
+    cannot hold two turns of real history)."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.serve import PrefixCacheConfig
+
+    cfg_m = GPT2Config(vocab_size=512, n_positions=256, n_embd=192,
+                       n_layer=4, n_head=4, n_inner=384, dropout=0.0,
+                       attn_impl="fused")
+    m = GPT2LMHead(cfg_m)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    cfg = PrefixCacheConfig(block_size=16, num_blocks=128)
+    workload = make_prefix_workload()
+
+    # warmup both paths (compiles; fresh engines per run)
+    run_prefix_engine(m, workload, max_slots, cfg, close_after=True)
+    run_prefix_engine(m, workload, max_slots, None, close_after=True)
+
+    jit_before = _serve_jit_cache_size()
+    wall_w, w1, w2, snap_w = run_prefix_engine(m, workload, max_slots,
+                                               cfg)
+    wall_c, c1, c2, snap_c = run_prefix_engine(m, workload, max_slots,
+                                               None, close_after=True)
+    jit_after = _serve_jit_cache_size()
+
+    parity = True
+    for (w, res) in zip(workload, w1):
+        want = m.generate(w["prompt"], max_new_tokens=w["n_new"],
+                          temperature=0)
+        parity &= bool(np.array_equal(res.tokens, want))
+    for req, res in w2:
+        want = m.generate(req.prompt_ids,
+                          max_new_tokens=req.max_new_tokens,
+                          temperature=0)
+        parity &= bool(np.array_equal(res.tokens, want))
+    # warm and cold engines must agree stream-for-stream too
+    parity &= all(np.array_equal(a.tokens, b.tokens)
+                  for a, b in zip(w1, c1))
+    parity &= all(np.array_equal(a[1].tokens, b[1].tokens)
+                  for a, b in zip(w2, c2))
+
+    useful = sum(w["n_new"] + w["extra_new"] for w in workload)
+    pre = snap_w["prefix"]
+    return {
+        "workload": {
+            "requests": len(workload), "turns": 2,
+            "system_prompt_tokens": 160, "useful_tokens": useful,
+            "n_positions": 256, "seed": 1,
+        },
+        "cache": {"block_size": cfg.block_size,
+                  "num_blocks": cfg.num_blocks},
+        "warm": {
+            "wall_s": wall_w,
+            "tokens_per_s": useful / wall_w,
+            "ttft_p50_s": snap_w["latency"]["ttft"]["p50"],
+            "ttft_p99_s": snap_w["latency"]["ttft"]["p99"],
+        },
+        "cold": {
+            "wall_s": wall_c,
+            "tokens_per_s": useful / wall_c,
+            "ttft_p50_s": snap_c["latency"]["ttft"]["p50"],
+            "ttft_p99_s": snap_c["latency"]["ttft"]["p99"],
+        },
+        "ttft_p50_improvement": (snap_c["latency"]["ttft"]["p50"]
+                                 / snap_w["latency"]["ttft"]["p50"]),
+        "speedup_tokens_per_s": wall_c / wall_w,
+        "prefix_hit_rate": pre["hit_rate_tokens"],
+        "hit_tokens": pre["hit_tokens"],
+        "lookup_tokens": pre["lookup_tokens"],
+        "cached_blocks": pre["cached_blocks"],
+        "evictions": pre["evictions"],
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": parity,
+    }
+
+
 def run_static(m, workload, max_slots):
     """Arrival-order batches of max_slots, each to its longest row."""
     from singa_tpu.models import gpt2_decode
@@ -119,6 +288,12 @@ def main():
     ap.add_argument("--health-out", default=None, metavar="PATH",
                     help="also write observe.health_report() (goodput, "
                          "MFU, SLO counters, watchdog state) as JSON")
+    ap.add_argument("--prefix-mix", action="store_true",
+                    help="also run the shared-system-prompt + "
+                         "multi-turn session workload warm (radix "
+                         "prefix cache) vs cold and embed the "
+                         "prefix_mix section (hit rate, TTFT "
+                         "cold-vs-warm, parity, recompile pin)")
     args = ap.parse_args()
 
     # active monitoring rides the whole bench: flight recorder + hang
@@ -213,6 +388,13 @@ def main():
         "health": observe.health_report(engine_snapshots=[snap],
                                         include_registry=False),
     }
+    if args.prefix_mix:
+        report["prefix_mix"] = run_prefix_mix(max_slots)
+        # the prefix engines ran after the health snapshot above;
+        # refresh it so serve.prefix counters appear in the report
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
     if args.trace_out:
         n_events = observe.export.write_chrome_trace(
             args.trace_out,

@@ -368,7 +368,10 @@ class GPT2LMHead(model.Model):
         pass through to the engine (``max_slots``, ``max_len``,
         ``dtype``, ``top_k``, ``top_p``, ``scheduler``, ``clock``,
         ``slo`` — declarative latency targets, see
-        ``singa_tpu.observe.SLO``).  See docs/SERVING.md."""
+        ``singa_tpu.observe.SLO`` — and ``prefix_cache`` — a
+        ``serve.PrefixCacheConfig`` enabling block-granular radix
+        prefix caching + pinned multi-turn sessions).  See
+        docs/SERVING.md."""
         from ..serve import InferenceEngine
 
         return InferenceEngine(self, **kw)

@@ -570,10 +570,24 @@ def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
     return x, k_cache, v_cache
 
 
-def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2):
-    """Advance every block by a K-token chunk (x: (B, K, E) embedded
-    inputs at positions pos..pos+K-1).  Returns ((B, K, V) logits,
-    new kc, new vc)."""
+def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2):
+    """PUBLIC offset-prefill entry (the prefix cache's contract;
+    serve.prefix round).  Advance every layer by a K-token chunk —
+    ``x``: (B, K, E) embedded inputs at positions ``pos..pos+K-1``
+    (``pos`` traced) against caches (L, B, H_kv, ctx, D) that already
+    hold K/V for positions < ``pos``.  Writes the chunk's K/V rows at
+    ``pos`` and returns ``((B, K, E) final-LN hidden, new kc, new vc)``
+    — hidden, NOT logits, so a caller prefilling from a cached-prefix
+    divergence boundary projects only the row it samples from instead
+    of paying a (K, V) vocab matmul per chunk.
+
+    Exactness: on this backend a chunked advance over [pos, pos+K) on
+    top of full-prefill K/V produces K/V and hidden rows BITWISE equal
+    to the full ``prefill`` of the same row (every op is row-independent
+    over the position axis with identical per-row reduction structure;
+    pinned by tests/test_prefix.py) — which is what lets the serve
+    engine's warm-prefix admissions emit byte-identical token streams
+    to cold prefill."""
     new_kc, new_vc = [], []
     for li, p in enumerate(params["blocks"]):
         x, kl, vl = _block_chunk(x, p, _cache_layer(kc, li),
@@ -582,7 +596,17 @@ def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2):
         new_kc.append(kl)
         new_vc.append(vl)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
-    return _logits(x, params), _cache_stack(new_kc), _cache_stack(new_vc)
+    return x, _cache_stack(new_kc), _cache_stack(new_vc)
+
+
+def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2):
+    """Advance every block by a K-token chunk (x: (B, K, E) embedded
+    inputs at positions pos..pos+K-1).  Returns ((B, K, V) logits,
+    new kc, new vc).  The speculative verify step — routed through
+    :func:`prefill_chunk` so the chunked cache math exists once."""
+    x, kc, vc = prefill_chunk(params, x, kc, vc, pos, n_head, eps,
+                              moe_top_k=moe_top_k)
+    return _logits(x, params), kc, vc
 
 
 def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p,

@@ -87,6 +87,34 @@ def _by_label(snap_counters: dict, name: str, label: str) -> dict:
     return out
 
 
+def _sum_metric(snap: dict, name: str):
+    """Sum a metric across its label sets (``name`` + ``name{...}``)."""
+    prefix = name + "{"
+    return sum(v for k, v in snap.items()
+               if k == name or k.startswith(prefix))
+
+
+def _prefix_section(snap: dict) -> dict:
+    """The ``serve.prefix`` health section: radix prefix-cache
+    counters summed across engines (zeros when no engine ever ran a
+    cache — always present so dashboards can alert unconditionally).
+    ``hit_rate_tokens`` is hit_tokens / lookup_tokens, the fraction
+    of admitted prompt tokens served from cached blocks."""
+    counters, gauges = snap["counters"], snap["gauges"]
+    hit = _sum_metric(counters, "serve.prefix.hit_tokens")
+    lookup = _sum_metric(counters, "serve.prefix.lookup_tokens")
+    return {
+        "hits": _sum_metric(counters, "serve.prefix.hits"),
+        "misses": _sum_metric(counters, "serve.prefix.misses"),
+        "evictions": _sum_metric(counters, "serve.prefix.evictions"),
+        "hit_tokens": hit,
+        "lookup_tokens": lookup,
+        "hit_rate_tokens": (hit / lookup) if lookup else 0.0,
+        "cached_blocks": _sum_metric(gauges,
+                                     "serve.prefix.cached_blocks"),
+    }
+
+
 def _resilience_section(snap_counters: dict) -> dict:
     """The ``resilience`` health section: retry/fallback/restart
     counts published by singa_tpu.resilience (zeros when the layer
@@ -189,6 +217,7 @@ def health_report(reg=None, engine_snapshots=(),
                     for s in engine_snapshots)
                 if engine_snapshots else None),
             "slo_violations": _slo_violations(snap["counters"]),
+            "prefix": _prefix_section(snap),
         },
         "resilience": _resilience_section(snap["counters"]),
         "watchdog": (

@@ -94,10 +94,13 @@ class Optimizer:
         # grads are scaled by min(1, clip_norm/||g||_global) BEFORE the
         # update rule.  Requires materializing the whole gradient set
         # per step (the norm is global), so backward_and_update
-        # two-passes when it is set and streams otherwise.  NOTE:
-        # DistOpt's sync modes drive the wrapped optimizer through
-        # apply() directly and do NOT clip — clipping synced gradients
-        # would need the clip between sync and apply.
+        # two-passes when it is set and streams otherwise.  DistOpt's
+        # dense/fp16 sync modes clip too — the mirrored pass sits
+        # between sync and apply (DistOpt._apply_all), so the clipped
+        # quantity is the synced (= full-batch) gradient and the
+        # distributed run matches the single-device clipped oracle;
+        # the partial/sparse modes refuse clip_norm (no per-step
+        # global gradient exists to clip).
         if clip_norm is not None and clip_norm <= 0:
             raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
         self.clip_norm = None if clip_norm is None else float(clip_norm)

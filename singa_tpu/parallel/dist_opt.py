@@ -47,16 +47,6 @@ class DistOpt:
 
     def __init__(self, opt, mesh=None, axis_name="data", num_devices=None,
                  communicator=None, **unused_reference_args):
-        if getattr(opt, "clip_norm", None) is not None:
-            # every sync mode drives the wrapped optimizer through
-            # apply() AFTER the gradient sync, bypassing
-            # Optimizer.backward_and_update where global-norm clipping
-            # lives — silently un-clipped distributed training would
-            # diverge from the single-device run the user tuned
-            raise ValueError(
-                "clip_norm is not supported under DistOpt (the sync "
-                "modes bypass the clipping pass); construct the "
-                "wrapped optimizer without clip_norm")
         self.opt = opt
         self.communicator = communicator if communicator is not None else \
             Communicator(mesh=mesh, axis_name=axis_name,
@@ -82,9 +72,36 @@ class DistOpt:
 
     def update(self, param, grad):
         """Single-param update with dense all-reduce (reference
-        DistOpt.update)."""
+        DistOpt.update).  Like the single-device ``Optimizer.update``
+        alias, this per-param path does NOT apply ``clip_norm`` — a
+        global norm does not exist one parameter at a time; clipping
+        lives in the ``backward_and_update``/``_half`` flows (see
+        ``_apply_all``), exactly as it lives in
+        ``Optimizer.backward_and_update`` on a single device."""
         g = self.communicator.all_reduce(grad.data, average=True)
         self.opt.update(param, tensor._wrap(g, param.device))
+
+    # -- global-norm clipping over SYNCED grads ----------------------------
+    def _apply_all(self, triples):
+        """Drive the wrapped optimizer over ``(name, param, synced
+        grad)`` triples, clipping by GLOBAL norm first when the
+        wrapped optimizer carries ``clip_norm`` — the synced-grad
+        mirror of ``Optimizer._clip_pairs`` (same eps guard, same
+        min(1, c/‖g‖) scale in f32).  Clipping after the mean
+        all-reduce is exactly the single-device semantics: the synced
+        grad IS the full-batch grad, and params stay replicated, so
+        every rank computes the identical scale."""
+        clip = getattr(self.opt, "clip_norm", None)
+        if clip is not None:
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for _, _, g in triples)
+            scale = jnp.minimum(
+                1.0, clip / jnp.maximum(jnp.sqrt(sq), 1e-12))
+            triples = [(n, p, (g.astype(jnp.float32)
+                               * scale).astype(g.dtype))
+                       for n, p, g in triples]
+        for n, p, g in triples:
+            self.opt.apply(n, p, tensor._wrap(g, p.device))
 
     def state_tensors(self):
         d = dict(self.opt.state_tensors())
@@ -119,39 +136,48 @@ class DistOpt:
 
     def backward_and_update(self, loss, threshold=2 ** 21):
         """Dense sync; grads smaller than ``threshold`` elements ride the
-        fusion buffer (reference default threshold is elements-based)."""
-        comm = self.communicator
-        bucket, pending = [], []
-        for p, g in autograd.backward(loss):
-            name = self._param_name(p)
-            if g.data.size < threshold:
-                bucket.append(g.data)
-                pending.append((name, p))
-                continue
-            synced = comm.all_reduce(g.data, average=True)
-            self.opt.apply(name, p, tensor._wrap(synced, p.device))
-        if bucket:
-            for (name, p), synced in zip(
-                    pending, comm.fused_synch(bucket, average=True)):
-                self.opt.apply(name, p, tensor._wrap(synced, p.device))
-        self.opt.step()
+        fusion buffer (reference default threshold is elements-based).
+        With ``clip_norm`` on the wrapped optimizer, applies are
+        deferred until every synced grad exists and the whole set is
+        scaled by the global norm (``_apply_all``) — unclipped, grads
+        stream straight into apply as before."""
+        self._dense_sync(loss, threshold,
+                         self.communicator.all_reduce,
+                         self.communicator.fused_synch)
 
     # -- mode 2: compressed ------------------------------------------------
     def backward_and_update_half(self, loss, threshold=2 ** 21):
-        comm = self.communicator
-        bucket, pending = [], []
+        """Compressed sync (bf16 wire format); global-norm clipping —
+        computed in f32 over the POST-sync grads, so what is clipped
+        is exactly what is applied — works here too."""
+        self._dense_sync(loss, threshold,
+                         self.communicator.synch_half,
+                         self.communicator.fused_synch_half)
+
+    def _dense_sync(self, loss, threshold, synch_one, synch_fused):
+        clip = getattr(self.opt, "clip_norm", None) is not None
+        bucket, pending, deferred = [], [], []
         for p, g in autograd.backward(loss):
             name = self._param_name(p)
             if g.data.size < threshold:
                 bucket.append(g.data)
                 pending.append((name, p))
                 continue
-            synced = comm.synch_half(g.data, average=True)
-            self.opt.apply(name, p, tensor._wrap(synced, p.device))
+            synced = synch_one(g.data, average=True)
+            if clip:
+                deferred.append((name, p, synced))
+            else:
+                self.opt.apply(name, p, tensor._wrap(synced, p.device))
         if bucket:
             for (name, p), synced in zip(
-                    pending, comm.fused_synch_half(bucket, average=True)):
-                self.opt.apply(name, p, tensor._wrap(synced, p.device))
+                    pending, synch_fused(bucket, average=True)):
+                if clip:
+                    deferred.append((name, p, synced))
+                else:
+                    self.opt.apply(name, p,
+                                   tensor._wrap(synced, p.device))
+        if clip:
+            self._apply_all(deferred)
         self.opt.step()
 
     # -- mode 3: round-robin partial sync ----------------------------------
@@ -160,6 +186,7 @@ class DistOpt:
         off-turn grads accumulate in the per-rank accumulator and are
         folded in at the next sync, so wire cost is 1/world of dense sync
         (the psum executes inside the taken lax.cond branch only)."""
+        self._refuse_clip("backward_and_partial_update")
         import jax
         from jax import lax
 
@@ -191,6 +218,7 @@ class DistOpt:
 
     # -- modes 4/5: sparse with residual accumulation ----------------------
     def backward_and_sparse_update(self, loss, spars=0.05, topK=True):
+        self._refuse_clip("backward_and_sparse_update")
         comm = self.communicator
         for p, g in autograd.backward(loss):
             name = self._param_name(p)
@@ -201,6 +229,22 @@ class DistOpt:
             self._write_rank_slice(r, new_res, in_step)
             self.opt.apply(name, p, tensor._wrap(synced, p.device))
         self.opt.step()
+
+    def _refuse_clip(self, mode):
+        """Partial/sparse sync modes apply PARTIAL gradient information
+        per step (a rank-round-robin slice, or top-K/thresholded
+        values with residual carry-over) — there is no per-step global
+        gradient whose norm would mean what the single-device
+        ``clip_norm`` means, so refusing beats silently clipping the
+        wrong thing.  Dense and bf16 sync support clipping (see
+        ``_apply_all``)."""
+        if getattr(self.opt, "clip_norm", None) is not None:
+            raise ValueError(
+                f"clip_norm is not supported under DistOpt.{mode} "
+                f"(the synced update is a partial gradient; a global "
+                f"norm over it is not the single-device clip). Use "
+                f"the dense or fp16 sync modes, which clip the synced "
+                f"global-norm exactly.")
 
     def _residual_for(self, name, p) -> Tensor:
         """Per-rank accumulator: global shape (world, *param_shape).  The

@@ -45,6 +45,7 @@ SITES = (
     "checkpoint.read",     # model load path + Snapshot.read
     "comm.collective",     # host-side collective dispatch
     "serve.decode_step",   # the engine's pool decode (and prefill)
+    "serve.prefix_copy",   # prefix-cache pool<->slot block copies
     "io.binfile",          # BinFile record read/write
     "train.step",          # _GraphRunner step dispatch
 )

@@ -25,6 +25,8 @@ Entry points::
 """
 
 from .engine import InferenceEngine  # noqa: F401
+from .prefix import (PrefixCache, PrefixCacheConfig,  # noqa: F401
+                     SessionHandle)
 from .request import (DeadlineExceededError, EngineFailedError,  # noqa: F401
                       GenerationRequest, GenerationResult, LoadShedError,
                       QueueFullError, RequestHandle,

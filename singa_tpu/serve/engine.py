@@ -39,6 +39,7 @@ NotImplementedError; repetition_penalty/min_p are offline-only knobs.
 
 from __future__ import annotations
 
+import inspect
 import time
 from functools import partial
 
@@ -47,11 +48,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gpt2_decode import (_logits, _norm_window, _sample,
-                                  decode_step, extract_params, prefill)
+                                  decode_step, extract_params, prefill,
+                                  prefill_chunk)
 from ..observe import monitor as _monitor
 from ..observe import trace as _trace
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
+from .prefix import (PrefixCache, PrefixCacheConfig, SessionHandle,
+                     _read_slot)
 from .request import (DeadlineExceededError, EngineFailedError,
                       GenerationRequest, GenerationResult, LoadShedError,
                       RequestHandle)
@@ -126,6 +130,43 @@ def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
     return tok0, ks[1], kc, vc
 
 
+@partial(jax.jit,
+         static_argnames=("n_head", "eps", "moe_top_k", "chunk"),
+         donate_argnums=(2, 3))
+def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
+               moe_top_k, chunk):
+    """Offset prefill of ONE block-width window: embed tokens at
+    positions [off, off+chunk) of the padded ``ids`` row and advance
+    them through ``gpt2_decode.prefill_chunk`` against a cache row
+    that already holds canonical K/V below ``off``.  ``off`` is
+    traced, so every warm admission's every window rides one
+    executable.  Returns ((1, chunk, E) final-LN hidden, kc_row,
+    vc_row) — rows donated, the warm-admission loop rebinds."""
+    toks = jax.lax.dynamic_slice(ids, (0, off), (1, chunk))
+    pos = off + jnp.arange(chunk)
+    x = jnp.take(params["wte"], toks[0], axis=0)[None] + \
+        jnp.take(params["wpe"], pos, axis=0)[None]
+    return prefill_chunk(params, x, kc_row, vc_row, off, n_head, eps,
+                         moe_top_k=moe_top_k)
+
+
+@partial(jax.jit, static_argnames=("top_k", "use_top_p"))
+def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
+                       use_top_p):
+    """Sample the admission token from a chunk's hidden block: row
+    ``row`` of ``hidden`` (1, chunk, E) is position prompt_len-1.
+    Mirrors the tail of ``_prefill_one`` exactly — same (1, 1, E)
+    logits projection, same key split, same ``_select_sample`` — so a
+    warm admission's first token matches the cold path's bit for bit
+    given a bitwise-equal hidden row."""
+    last_h = jax.lax.dynamic_index_in_dim(hidden, row, axis=1,
+                                          keepdims=False)     # (1, E)
+    logit0 = _logits(last_h[:, None, :], params)[0, 0]        # (V,)
+    ks = jax.random.split(key)
+    tok0 = _select_sample(logit0, ks[0], temp, top_k, top_p, use_top_p)
+    return tok0, ks[1]
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _write_slot(kc_arena, vc_arena, kc_row, vc_row, slot):
     """Install an admitted request's prefilled cache rows at ``slot``
@@ -143,7 +184,8 @@ class _Slot:
     inputs — not here)."""
 
     __slots__ = ("handle", "emitted", "remaining",
-                 "first_token_time", "admit_time", "admitted_step")
+                 "first_token_time", "admit_time", "admitted_step",
+                 "prefix_nodes")
 
     def __init__(self, handle, max_new, now, step):
         self.handle = handle
@@ -152,6 +194,7 @@ class _Slot:
         self.first_token_time = None
         self.admit_time = now
         self.admitted_step = step
+        self.prefix_nodes = []   # cached-prefix refs held while live
 
 
 class InferenceEngine:
@@ -174,7 +217,7 @@ class InferenceEngine:
 
     def __init__(self, model, max_slots=8, max_len=None, dtype=None,
                  scheduler=None, top_k=0, top_p=None,
-                 clock=time.monotonic, slo=None):
+                 clock=time.monotonic, slo=None, prefix_cache=None):
         cfg = model.cfg
         if _norm_window(cfg) is not None:
             raise NotImplementedError(
@@ -231,9 +274,58 @@ class InferenceEngine:
         self._closed = False
         self._failed = False
         self.step_count = 0
+        # radix prefix cache (serve/prefix.py): block-granular KV
+        # reuse for shared prompts and pinned sessions.  The cache is
+        # engine-owned and starts empty — a supervisor rebuild gets a
+        # fresh one (cold but correct) from the forwarded config.
+        self.prefix_cache = None
+        self._sched_cost = None
+        # identity check, not truthiness: prefix_cache={} means
+        # "enable with defaults", and silently disabling on a falsy
+        # dict would only surface as stats["prefix"] == None much later
+        if prefix_cache is not None and prefix_cache is not False:
+            if prefix_cache is True:
+                prefix_cache = PrefixCacheConfig()
+            elif isinstance(prefix_cache, dict):
+                prefix_cache = PrefixCacheConfig(**prefix_cache)
+            if not isinstance(prefix_cache, PrefixCacheConfig):
+                raise ValueError(
+                    f"prefix_cache must be a PrefixCacheConfig, a "
+                    f"kwargs dict, or True, got {type(prefix_cache)}")
+            if self.max_len % prefix_cache.block_size != 0:
+                raise ValueError(
+                    f"max_len ({self.max_len}) must be a multiple of "
+                    f"prefix_cache.block_size "
+                    f"({prefix_cache.block_size}) so chunked prefill "
+                    f"windows never cross the arena edge")
+            self.prefix_cache = PrefixCache(
+                prefix_cache, L, H_kv, D, cdt,
+                engine_label=self.stats.engine_label,
+                reg=self.stats.registry)
+            self.prefix_cache.attach_row_geometry(W)
+            self._chunk_statics = dict(
+                n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
+                moe_top_k=self._statics["moe_top_k"],
+                chunk=prefix_cache.block_size)
+            self.stats.prefix_source = self.prefix_cache.snapshot
+            # prefill-interleave pricing: warm admissions that
+            # recompute at most one chunk don't consume the cold
+            # budget (scheduler.schedule's ``cost``; custom schedulers
+            # without the parameter keep the flat 1-per-admit price)
+            try:
+                params_ = inspect.signature(
+                    self.scheduler.schedule).parameters
+                if "cost" in params_:
+                    self._sched_cost = self._prefill_cost
+            except (TypeError, ValueError):
+                pass
         self._log.info(
-            "engine up: slots=%d max_len=%d arena=%s x2 (%s)",
-            S, W, self._kc.shape, cdt)
+            "engine up: slots=%d max_len=%d arena=%s x2 (%s) "
+            "prefix_cache=%s",
+            S, W, self._kc.shape, cdt,
+            "off" if self.prefix_cache is None else
+            f"{self.prefix_cache.num_blocks}x"
+            f"{self.prefix_cache.block_size}")
 
     # -- submission ------------------------------------------------------
     def submit(self, request) -> RequestHandle:
@@ -296,6 +388,8 @@ class InferenceEngine:
                 f" drain with run_until_complete() first")
         self.stats.unregister()
         _monitor.forget(self._hb_source)
+        if self.prefix_cache is not None:
+            self.prefix_cache.unregister()
         self._kc = self._vc = None
         self._params = None
         self._closed = True
@@ -312,6 +406,8 @@ class InferenceEngine:
             # arena/params (the pinning close() exists to prevent)
             self.stats.unregister()
             _monitor.forget(self._hb_source)
+            if self.prefix_cache is not None:
+                self.prefix_cache.unregister()
             self._kc = self._vc = None
             self._params = None
             self._closed = True
@@ -384,6 +480,7 @@ class InferenceEngine:
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
+            self._release_prefix(slot)
             rid = slot.handle.request.request_id
             slot.handle._reject(EngineFailedError(
                 f"{msg} ({rid} was in flight, "
@@ -398,6 +495,16 @@ class InferenceEngine:
                     f"{msg} ({req.request_id} was queued, not started)",
                     request_id=req.request_id, started=False,
                     engine_step=step))
+        # a request can also fail MID-ADMISSION: popped from the queue
+        # by schedule() but not yet occupying a slot (e.g. a raising
+        # prefill or prefix-cache copy).  It has streamed nothing, so
+        # it is requeue-safe (started=False) — without this pass its
+        # handle would be cleared unresolved and the caller wedged
+        for rid, h in list(self._handles.items()):
+            if not h.done():
+                h._reject(EngineFailedError(
+                    f"{msg} ({rid} was admitting, not started)",
+                    request_id=rid, started=False, engine_step=step))
         self._handles.clear()
         if _monitor.active():
             # dead, not hung: liveness beat with hang detection off so
@@ -498,6 +605,7 @@ class InferenceEngine:
                     "on_token callback for %s raised (%r); rejecting "
                     "that request, slot %d freed", req.request_id, e,
                     idx)
+                self._release_prefix(slot)
                 self._slots[idx] = None
                 self._handles.pop(req.request_id, None)
                 slot.handle._reject(e)
@@ -525,6 +633,12 @@ class InferenceEngine:
             queue_time=slot.admit_time - submit_t,
             admitted_step=slot.admitted_step,
             finished_step=self.step_count)
+        if self.prefix_cache is not None:
+            self._prefix_retire(idx, slot, req, result)
+        elif req.pin_session:
+            # no cache: the session handle still works, continuation
+            # just runs through cold prefill
+            result.session = SessionHandle(result.tokens)
         slot.handle._finish(result)
         self.stats.on_complete(result)
         self._slots[idx] = None
@@ -533,11 +647,69 @@ class InferenceEngine:
         # traffic
         self._handles.pop(req.request_id, None)
 
+    def _release_prefix(self, slot):
+        if self.prefix_cache is not None and slot.prefix_nodes:
+            self.prefix_cache.release(slot.prefix_nodes)
+            slot.prefix_nodes = []
+
+    def _prefix_retire(self, idx, slot, req, result):
+        """Donate the retired request's prefix back to the radix tree
+        (its prompt's full blocks are canonical prefill K/V sitting in
+        the slot row — decode never touched positions < prompt_len),
+        and pin the FULL sequence for ``pin_session`` requests.
+
+        Session pinning re-canonicalizes the generated region first:
+        decode-step K/V is not bitwise prefill K/V (~1e-6 drift), so
+        the windows containing generated tokens are recomputed through
+        the same ``_chunk_row`` executable warm admission uses — one
+        chunk pass at retire (off the TTFT path) keeps every future
+        warm turn byte-identical to cold prefill."""
+        cache = self.prefix_cache
+        B = cache.block_size
+        try:
+            plen = len(req.prompt_ids)
+            total = len(result.tokens)
+            want_session = bool(req.pin_session)
+            n_goal = (total // B) if want_session else (plen // B)
+            path = []
+            if n_goal > 0:
+                existing = cache.lookup(result.tokens)[:n_goal]
+                if len(existing) == n_goal:
+                    # everything already cached (steady-state hit
+                    # regime): no row gather, no chunks, no scatter —
+                    # just refresh recency
+                    cache.touch(existing)
+                    path = existing
+                else:
+                    kc_row, vc_row = _read_slot(self._kc, self._vc,
+                                                jnp.int32(idx))
+                    if want_session and total // B > plen // B:
+                        ids = np.zeros((1, self.max_len), np.int32)
+                        ids[0, :total] = result.tokens
+                        ids_j = jnp.asarray(ids)
+                        for j in range(plen // B, total // B):
+                            _, kc_row, vc_row = _chunk_row(
+                                self._params, ids_j, kc_row, vc_row,
+                                jnp.int32(j * B),
+                                **self._chunk_statics)
+                    path = cache.donate_from_row(result.tokens, kc_row,
+                                                 vc_row, n_goal)
+            if want_session:
+                cache.acquire(path)
+                result.session = SessionHandle(result.tokens, cache,
+                                               path)
+        finally:
+            self._release_prefix(slot)
+
     def _schedule(self, now):
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free and self.scheduler.queue_depth == 0:
             return
-        admit, expired = self.scheduler.schedule(len(free), now)
+        if self._sched_cost is not None:
+            admit, expired = self.scheduler.schedule(
+                len(free), now, cost=self._sched_cost)
+        else:
+            admit, expired = self.scheduler.schedule(len(free), now)
         for req in expired:
             self.stats.on_deadline_expired(req.request_id)
             self._handles.pop(req.request_id)._reject(
@@ -547,29 +719,68 @@ class InferenceEngine:
         for req in admit:
             self._admit(free.pop(0), req, now)
 
+    def _prefill_cost(self, req):
+        """Scheduler interleave price of admitting ``req`` now: 0 for
+        a warm prefix hit that recomputes at most one block-width
+        chunk, 1 for anything colder (the O(ctx²) work the interleave
+        cap exists to bound)."""
+        cache = self.prefix_cache
+        plen = len(req.prompt_ids)
+        usable = min(len(cache.lookup(req.prompt_ids)),
+                     (plen - 1) // cache.block_size)
+        if usable > 0 and plen - usable * cache.block_size \
+                <= cache.block_size:
+            return 0
+        return 1
+
     def _admit(self, idx, req, now):
         """Prefill one request into slot ``idx`` and emit its first
         token.  Mirrors the offline key chain exactly: generate() makes
         per-row keys with split(PRNGKey(seed), B)[row]; a single-prompt
-        call is B=1, row 0."""
+        call is B=1, row 0.
+
+        With a prefix cache, the longest cached block-prefix is copied
+        into the slot and only the suffix past the divergence boundary
+        is prefilled (block-width chunks through ``_chunk_row``).
+        Cached K/V is canonical prefill output and the first-token
+        sampling mirrors ``_prefill_one``'s tail, so warm token
+        streams are byte-identical to the cold path's.  The match is
+        capped at ``(plen - 1) // block_size`` blocks: the hidden
+        state at prompt_len-1 must be recomputed to sample from — a
+        fully-cached prompt still recomputes its last block."""
         handle = self._handles[req.request_id]
         plen = len(req.prompt_ids)
+        cache = self.prefix_cache
+        nodes = []
+        if cache is not None:
+            nodes = cache.lookup(req.prompt_ids)[
+                :(plen - 1) // cache.block_size]
         with _trace.span("serve/prefill", cat="serve",
                          request=req.request_id, slot=idx,
-                         prompt_len=plen, step=self.step_count):
+                         prompt_len=plen, step=self.step_count,
+                         cached_tokens=(len(nodes) * cache.block_size
+                                        if cache is not None else 0)):
             ids = np.zeros((1, self.max_len), np.int32)
             ids[0, :plen] = req.prompt_ids
             key0 = jax.random.split(
                 jax.random.PRNGKey(int(req.seed)), 1)[0]
             temp = np.float32(req.temperature)
-            tok0, carry_key, kc_row, vc_row = _prefill_one(
-                self._params, jnp.asarray(ids), plen, key0, temp,
-                self._top_p, **self._statics)
+            if nodes:
+                tok0, carry_key, kc_row, vc_row = self._admit_warm(
+                    ids, plen, nodes, key0, temp)
+            else:
+                tok0, carry_key, kc_row, vc_row = _prefill_one(
+                    self._params, jnp.asarray(ids), plen, key0, temp,
+                    self._top_p, **self._statics)
             self._kc, self._vc = _write_slot(self._kc, self._vc,
                                              kc_row, vc_row,
                                              jnp.int32(idx))
+        if cache is not None:
+            cache.acquire(nodes)
+            cache.on_admit(len(nodes), plen)
         self.stats.on_prefill()
         slot = _Slot(handle, req.max_new_tokens, now, self.step_count)
+        slot.prefix_nodes = nodes
         self._slots[idx] = slot
         tok0 = int(np.asarray(tok0))
         self._toks[idx] = tok0
@@ -577,3 +788,26 @@ class InferenceEngine:
         self._temps[idx] = temp
         self._keys = self._keys.at[idx].set(carry_key)
         self._emit(idx, slot, tok0, self._clock())
+
+    def _admit_warm(self, ids, plen, nodes, key0, temp):
+        """Warm admission: one gather copies the matched blocks into a
+        fresh cache row, then block-width ``_chunk_row`` calls prefill
+        [divergence, last-block-end) — fixed shapes throughout, so the
+        jit cache stays warm whatever the hit length."""
+        cache = self.prefix_cache
+        B = cache.block_size
+        kc_row, vc_row = cache.copy_into_row(nodes)
+        ids_j = jnp.asarray(ids)
+        last_off = ((plen - 1) // B) * B
+        off = len(nodes) * B
+        hidden = None
+        while off <= last_off:
+            hidden, kc_row, vc_row = _chunk_row(
+                self._params, ids_j, kc_row, vc_row, jnp.int32(off),
+                **self._chunk_statics)
+            off += B
+        tok0, carry_key = _first_from_hidden(
+            self._params, hidden, jnp.int32(plen - 1 - last_off),
+            key0, temp, self._top_p, top_k=self._statics["top_k"],
+            use_top_p=self._statics["use_top_p"])
+        return tok0, carry_key, kc_row, vc_row

@@ -1,0 +1,443 @@
+"""Radix prefix cache: block-granular KV reuse for the serving engine.
+
+Production traffic is dominated by shared prefixes — system prompts,
+few-shot templates, and multi-turn sessions that re-send the whole
+conversation.  The engine (engine.py) recomputed the full prefill for
+every admission anyway.  This module is the RadixAttention/SGLang idea
+(Zheng et al. 2023) rebuilt for the fixed-shape TPU engine:
+
+* **block pool** — one preallocated arena of ``num_blocks`` KV blocks
+  per K/V, shape ``(L, num_blocks + 1, H_kv, block_size, D)`` (the +1
+  is a trash block scatter padding writes into).  A cached prefix is a
+  chain of blocks; all device copies between the pool and a slot's
+  cache row are ONE fixed-shape gather/scatter executable each,
+  whatever the chain length, so the engine's no-runtime-recompiles
+  contract survives intact;
+* **radix tree** — host-side trie at block granularity: each node is
+  one ``block_size``-token block keyed by its token tuple, children
+  hashed under the parent.  Longest-prefix match is a dict walk per
+  block.  Nodes are REF-COUNTED (in-flight requests and pinned
+  sessions hold references); eviction is LRU over unreferenced
+  leaves only, so a referenced block can never be freed and interior
+  nodes never orphan their children;
+* **canonical KV only** — the cache stores exclusively K/V produced by
+  the prefill/chunked-prefill executables.  On this backend those are
+  BITWISE identical to each other and invariant to the tokens beyond
+  the prefix (masked causal attention contributes exact zeros), so a
+  warm admission's token stream is byte-identical to cold prefill.
+  Decode-step K/V is NOT canonical (measured ~1e-6 drift vs prefill
+  on CPU f32), so a pinned session's generated region is
+  re-canonicalized through ``gpt2_decode.prefill_chunk`` at retire
+  time — one chunk pass off the TTFT path buys every later turn a
+  near-full prefix hit without sacrificing parity;
+* **graceful pressure** — a full pool with nothing evictable degrades
+  to cold prefill (misses, skipped donations), never an error; a
+  rebuilt engine (EngineSupervisor restart) starts from an empty tree
+  and stays correct, just cold.
+
+Metrics flow into the process-wide observe registry (and therefore
+the health report and Prometheus export) as
+``serve.prefix.{hits,misses,evictions,cached_blocks,hit_tokens,
+lookup_tokens}`` with the owning engine's label.  The
+``serve.prefix_copy`` fault site (singa_tpu.resilience) covers the
+pool<->row copy paths: an injected copy failure fails the engine
+TYPED and the supervisor rebuild path recovers with an empty cache
+(bench_chaos.py asserts zero wedged/lost requests under it).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..observe.registry import registry as _default_registry
+from ..resilience import faults as _faults
+from ..utils.logging import get_channel
+
+__all__ = ["PrefixCacheConfig", "PrefixCache", "SessionHandle"]
+
+
+@dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Knobs for the engine's prefix cache (hand to
+    ``model.serve(prefix_cache=...)``; the supervisor forwards it
+    verbatim to every rebuilt engine, which is what makes restart
+    recovery rebuild-from-empty by construction).
+
+    ``block_size``: tokens per cached block — the reuse granularity.
+    Smaller blocks match more of a ragged prefix but cost more tree
+    nodes per token; the engine requires ``max_len % block_size == 0``
+    so chunked prefill windows never cross the arena edge.
+    ``num_blocks``: pool capacity in blocks (device memory:
+    ``2 * L * num_blocks * H_kv * block_size * D`` elements)."""
+
+    block_size: int = 64
+    num_blocks: int = 256
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}")
+        if self.num_blocks < 1:
+            raise ValueError(
+                f"num_blocks must be >= 1, got {self.num_blocks}")
+
+
+# -- fixed-shape device copies ----------------------------------------------
+# Shapes are keyed on (pool, row) geometry only: every call below is
+# compiled once per engine and reused for any chain length, because the
+# block-index vector is always the full row's worth of block slots
+# (W // block_size entries) with unused lanes masked / pointed at the
+# trash block.
+
+@jax.jit
+def _blocks_to_row(pool_k, pool_v, idx, n_used):
+    """Gather ``idx`` (nb,) pool blocks into a fresh (L, 1, H, W, D)
+    cache row: block j covers positions [j*B, (j+1)*B).  Lanes
+    ``>= n_used`` (traced) are zeroed — junk that the chunked prefill
+    and the decode mask never read live."""
+    L, _, H, B, D = pool_k.shape
+    nb = idx.shape[0]
+
+    def gather(pool):
+        blocks = jnp.take(pool, idx, axis=1)         # (L, nb, H, B, D)
+        row = blocks.transpose(0, 2, 1, 3, 4).reshape(L, H, nb * B, D)
+        live = (jnp.arange(nb * B) < n_used * B)[None, None, :, None]
+        return jnp.where(live, row, 0)[:, None]      # (L, 1, H, W, D)
+
+    return gather(pool_k), gather(pool_v)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _row_to_blocks(pool_k, pool_v, kc_row, vc_row, idx):
+    """Scatter a cache row's blocks into the pool at ``idx`` (nb,)
+    block slots.  Lanes that should not store anything point at the
+    trash block (index ``num_blocks``, reserved by the pool for
+    exactly this) so one executable serves every donation size.
+    Duplicate trash-lane writes collide only with each other.  The
+    pool buffers are DONATED (the caller rebinds) — without that,
+    every retirement's donation would copy the whole pool (hundreds
+    of MB at production block counts) instead of scattering in
+    place."""
+    L, _, H, B, D = pool_k.shape
+    nb = idx.shape[0]
+
+    def scatter(pool, row):
+        blocks = row[:, 0].reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
+        return pool.at[:, idx].set(blocks)
+
+    return scatter(pool_k, kc_row), scatter(pool_v, vc_row)
+
+
+@jax.jit
+def _read_slot(kc_arena, vc_arena, slot):
+    """One slot's cache rows (L, 1, H, W, D) out of the engine arena."""
+    L, _, H, W, D = kc_arena.shape
+    sizes = (L, 1, H, W, D)
+    start = (0, slot, 0, 0, 0)
+    return (jax.lax.dynamic_slice(kc_arena, start, sizes),
+            jax.lax.dynamic_slice(vc_arena, start, sizes))
+
+
+class _Node:
+    """One cached block: ``key`` is the tuple of its block_size tokens,
+    ``block`` its pool slot.  ``refs`` counts in-flight admissions and
+    pinned sessions holding it; ``last_used`` is a logical LRU clock
+    tick (deterministic — no wall time)."""
+
+    __slots__ = ("key", "parent", "children", "block", "refs",
+                 "last_used")
+
+    def __init__(self, key, parent, block, tick):
+        self.key = key
+        self.parent = parent
+        self.children = {}
+        self.block = block
+        self.refs = 0
+        self.last_used = tick
+
+
+class SessionHandle:
+    """A finished request's sequence, pinned for multi-turn
+    continuation.  ``tokens`` is the full prompt + generation;
+    :meth:`request` builds the next turn's ``GenerationRequest`` with
+    the conversation re-sent as its prompt — against a warm cache the
+    whole pinned history is a block-prefix hit, so the next turn
+    prefills only the new user tokens.  Works (cold) against a
+    restarted engine's empty cache too: the handle owns host tokens,
+    not device state.  :meth:`release` unpins the cached path; a
+    released or restart-orphaned handle keeps building valid requests.
+    """
+
+    def __init__(self, tokens, cache=None, nodes=()):
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self._cache = cache
+        self._nodes = list(nodes)
+
+    @property
+    def pinned_blocks(self) -> int:
+        return len(self._nodes)
+
+    def request(self, extra_tokens, **kw):
+        """The next turn: a GenerationRequest whose prompt is this
+        session's full sequence + ``extra_tokens`` (the new user
+        input).  Keyword args pass through to GenerationRequest
+        (``max_new_tokens``, ``temperature``, ``pin_session`` for the
+        turn after this one, ...)."""
+        from .request import GenerationRequest
+        extra = np.asarray(extra_tokens, np.int32).reshape(-1)
+        return GenerationRequest(
+            np.concatenate([self.tokens, extra]), **kw)
+
+    def release(self):
+        """Unpin the session's cached path (idempotent).  The blocks
+        stay cached until LRU pressure evicts them."""
+        if self._cache is not None and self._nodes:
+            self._cache.release(self._nodes)
+        self._nodes = []
+
+
+class PrefixCache:
+    """Block-granular radix tree over a pooled KV arena (module
+    docstring).  Owned by one engine; the engine drives every device
+    copy through the fixed-shape helpers above and this class keeps
+    the host-side tree, refcounts, LRU state, and metrics."""
+
+    def __init__(self, config, n_layer, n_kv_head, head_dim, dtype,
+                 engine_label="0", reg=None):
+        self.config = config
+        B, N = config.block_size, config.num_blocks
+        self.block_size = B
+        self.num_blocks = N
+        # +1: the trash block scatter padding lands in (never read)
+        self._pool_k = jnp.zeros((n_layer, N + 1, n_kv_head, B,
+                                  head_dim), dtype)
+        self._pool_v = jnp.zeros_like(self._pool_k)
+        self._root = _Node((), None, -1, 0)
+        self._free = list(range(N))
+        self._nodes_by_block = {}       # pool slot -> node
+        self._tick = itertools.count(1)
+        self._log = get_channel("serve")
+        reg = reg if reg is not None else _default_registry()
+        lbl = dict(engine=engine_label)
+        self._c_hits = reg.counter(
+            "serve.prefix.hits",
+            help="admissions that reused >=1 cached block", **lbl)
+        self._c_misses = reg.counter(
+            "serve.prefix.misses",
+            help="admissions with no usable cached prefix", **lbl)
+        self._c_evictions = reg.counter(
+            "serve.prefix.evictions",
+            help="LRU evictions of unreferenced leaf blocks", **lbl)
+        self._c_hit_tokens = reg.counter(
+            "serve.prefix.hit_tokens",
+            help="prompt tokens served from cached blocks", **lbl)
+        self._c_lookup_tokens = reg.counter(
+            "serve.prefix.lookup_tokens",
+            help="prompt tokens seen by admission lookups", **lbl)
+        self._c_donate_skipped = reg.counter(
+            "serve.prefix.donate_skipped",
+            help="blocks not cached because the pool was full of "
+                 "referenced blocks", **lbl)
+        self._g_cached = reg.gauge(
+            "serve.prefix.cached_blocks",
+            help="blocks currently held by the radix tree", **lbl)
+        self._registry = reg
+        self._registered = [
+            self._c_hits, self._c_misses, self._c_evictions,
+            self._c_hit_tokens, self._c_lookup_tokens,
+            self._c_donate_skipped, self._g_cached]
+
+    # -- tree ------------------------------------------------------------
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._nodes_by_block)
+
+    def _block_keys(self, tokens):
+        B = self.block_size
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(toks) // B
+        return [tuple(int(t) for t in toks[j * B:(j + 1) * B])
+                for j in range(n)]
+
+    def lookup(self, tokens):
+        """Longest cached block-prefix of ``tokens``: the matched node
+        path, root-first.  Pure — no counters, no refcounts (the
+        scheduler's admission-cost probe uses it too).  Block keys are
+        built lazily so an early miss (block 0 of a long prompt) does
+        no O(prompt_len) tuple work."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        B = self.block_size
+        path = []
+        node = self._root
+        for j in range(len(toks) // B):
+            key = tuple(int(t) for t in toks[j * B:(j + 1) * B])
+            node = node.children.get(key)
+            if node is None:
+                break
+            path.append(node)
+        return path
+
+    def touch(self, nodes):
+        """Refresh LRU recency for an already-cached path (the
+        donation short-circuit: nothing to copy, but the path was
+        just used)."""
+        tick = next(self._tick)
+        for n in nodes:
+            n.last_used = tick
+
+    def acquire(self, nodes):
+        """Pin a matched path for the lifetime of an in-flight request
+        (or a session): referenced nodes are never evicted, so a hot
+        prefix cannot be churned out from under its users."""
+        tick = next(self._tick)
+        for n in nodes:
+            n.refs += 1
+            n.last_used = tick
+
+    def release(self, nodes):
+        for n in nodes:
+            n.refs -= 1
+            if n.refs < 0:
+                # a real exception, not an assert (-O strips asserts):
+                # underflow would let a still-pinned block read as
+                # unreferenced and be evicted under a live session
+                n.refs = 0
+                raise RuntimeError(
+                    "prefix-cache refcount underflow (double release "
+                    f"of block {n.block})")
+
+    def on_admit(self, hit_blocks, prompt_len):
+        """Metrics for one admission: ``hit_blocks`` usable cached
+        blocks against a ``prompt_len``-token prompt."""
+        self._c_lookup_tokens.inc(int(prompt_len))
+        if hit_blocks > 0:
+            self._c_hits.inc()
+            self._c_hit_tokens.inc(int(hit_blocks) * self.block_size)
+        else:
+            self._c_misses.inc()
+
+    # -- allocation / eviction -------------------------------------------
+    def _evict_one(self):
+        """Drop the least-recently-used UNREFERENCED LEAF.  Interior
+        nodes and referenced nodes are untouchable: evicting an
+        interior node would orphan its children's match path, and a
+        referenced one is in use.  Returns the freed pool slot or
+        None."""
+        victim = None
+        for node in self._nodes_by_block.values():
+            if node.refs > 0 or node.children:
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        if victim is None:
+            return None
+        del victim.parent.children[victim.key]
+        del self._nodes_by_block[victim.block]
+        self._c_evictions.inc()
+        self._g_cached.set(self.cached_blocks)
+        return victim.block
+
+    def _alloc(self):
+        if self._free:
+            return self._free.pop()
+        return self._evict_one()
+
+    # -- device copies (engine-driven) -----------------------------------
+    def _pad_idx(self, blocks, trash):
+        """Fixed-width block-index vector: real entries then ``trash``
+        padding, so one executable serves every chain length."""
+        nb = len(blocks)
+        idx = np.full(self._row_blocks, trash, np.int32)
+        idx[:nb] = blocks
+        return jnp.asarray(idx)
+
+    def attach_row_geometry(self, max_len):
+        """Called once by the owning engine: the number of blocks a
+        full cache row spans (the fixed width of every copy's index
+        vector)."""
+        assert max_len % self.block_size == 0
+        self._row_blocks = max_len // self.block_size
+
+    def copy_into_row(self, nodes):
+        """Build a cache row holding ``nodes``' blocks at positions
+        [0, len(nodes)*B); the rest zeros.  One gather dispatch."""
+        if _faults._armed:
+            _faults.check("serve.prefix_copy")
+        idx = self._pad_idx([n.block for n in nodes], trash=0)
+        return _blocks_to_row(self._pool_k, self._pool_v, idx,
+                              jnp.int32(len(nodes)))
+
+    def donate_from_row(self, tokens, kc_row, vc_row, n_blocks):
+        """Insert ``tokens``' first ``n_blocks`` full blocks into the
+        tree, copying the missing ones out of the (canonical) cache
+        row in ONE scatter dispatch.  Under pool pressure the
+        donation stops at the first unallocatable block (the stored
+        path must stay a contiguous prefix) — counted, never raised.
+        Returns the tree path covering what is now cached."""
+        if _faults._armed:
+            _faults.check("serve.prefix_copy")
+        keys = self._block_keys(tokens)[:n_blocks]
+        tick = next(self._tick)
+        path, new_nodes = [], []
+        node = self._root
+        try:
+            for j, key in enumerate(keys):
+                child = node.children.get(key)
+                if child is None:
+                    slot = self._alloc()
+                    if slot is None:
+                        self._c_donate_skipped.inc(len(keys) - j)
+                        break
+                    child = _Node(key, node, slot, tick)
+                    node.children[key] = child
+                    self._nodes_by_block[slot] = child
+                    new_nodes.append((j, child))
+                # transient ref: the in-progress path must not be LRU
+                # fodder for its OWN later allocations (an evicted
+                # ancestor would orphan the blocks donated under it)
+                child.refs += 1
+                child.last_used = tick
+                path.append(child)
+                node = child
+            if new_nodes:
+                idx = np.full(self._row_blocks, self.num_blocks,
+                              np.int32)
+                for j, child in new_nodes:
+                    idx[j] = child.block
+                self._pool_k, self._pool_v = _row_to_blocks(
+                    self._pool_k, self._pool_v, kc_row, vc_row,
+                    jnp.asarray(idx))
+                self._g_cached.set(self.cached_blocks)
+        finally:
+            for n in path:
+                n.refs -= 1
+        return path
+
+    # -- lifecycle / reporting -------------------------------------------
+    def unregister(self):
+        """Release registry entries and the device pool (engine
+        close())."""
+        self._registry.remove(*self._registered)
+        self._pool_k = self._pool_v = None
+
+    def snapshot(self) -> dict:
+        lookup = self._c_lookup_tokens.value
+        return {
+            "block_size": self.block_size,
+            "capacity_blocks": self.num_blocks,
+            "cached_blocks": self.cached_blocks,
+            "hits": self._c_hits.value,
+            "misses": self._c_misses.value,
+            "evictions": self._c_evictions.value,
+            "hit_tokens": self._c_hit_tokens.value,
+            "lookup_tokens": lookup,
+            "donate_skipped": self._c_donate_skipped.value,
+            "hit_rate_tokens": (self._c_hit_tokens.value / lookup
+                                if lookup else 0.0),
+        }

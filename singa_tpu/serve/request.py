@@ -83,7 +83,13 @@ class GenerationRequest:
     still queued past it is rejected, never silently served late.
     ``on_token(request, token)`` streams each emitted token.
     ``priority`` only matters under SLO-pressure load shedding (higher
-    wins; default 0) — FIFO admission order is unchanged by it."""
+    wins; default 0) — FIFO admission order is unchanged by it.
+    ``pin_session``: on an engine with a prefix cache, retire pins the
+    full sequence (prompt + generation) in the radix tree and attaches
+    a :class:`~singa_tpu.serve.prefix.SessionHandle` to the result, so
+    the next turn's re-sent conversation is a block-prefix hit; without
+    a cache the handle is still attached (continuation just runs
+    cold)."""
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 20
@@ -92,6 +98,7 @@ class GenerationRequest:
     deadline: Optional[float] = None
     on_token: Optional[Callable] = None
     priority: int = 0
+    pin_session: bool = False
     request_id: str = field(
         default_factory=lambda: f"req-{next(_req_counter)}")
 
@@ -122,6 +129,9 @@ class GenerationResult:
     queue_time: float
     admitted_step: int
     finished_step: int
+    # set when the request asked pin_session=True: the multi-turn
+    # continuation handle (serve/prefix.py SessionHandle)
+    session: Optional[object] = None
 
 
 class RequestHandle:

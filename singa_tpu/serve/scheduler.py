@@ -98,7 +98,7 @@ class FIFOScheduler:
             reason=reason).inc()
         return victim
 
-    def schedule(self, free_slots: int, now: float
+    def schedule(self, free_slots: int, now: float, cost=None
                  ) -> Tuple[List[GenerationRequest],
                             List[GenerationRequest]]:
         """One scheduling decision: returns ``(admit, expired)``.
@@ -107,17 +107,34 @@ class FIFOScheduler:
         removed from the queue (in queue order).  Expiry is checked for
         the WHOLE queue, not just the admissible prefix — a stale
         request deep in the queue should fail fast, not age further
-        behind back-pressure."""
+        behind back-pressure.
+
+        ``cost`` (optional): per-request prefill cost the interleave
+        budget counts instead of 1 per admission.  The cap exists to
+        bound the O(ctx²) prefill work a step can take; a warm
+        prefix-cache admission that recomputes at most one block-width
+        chunk is priced 0 by the engine, so cached traffic is not
+        throttled by the protection built for cold traffic.  FIFO
+        order is never violated — a too-expensive head-of-queue
+        request STOPS admission for this step rather than being
+        skipped."""
         expired = [r for r in self._queue
                    if r.deadline is not None and now > r.deadline]
         if expired:
             dead = {id(r) for r in expired}
             self._queue = deque(r for r in self._queue
                                 if id(r) not in dead)
-        budget = free_slots
-        if self.max_prefills_per_step is not None:
-            budget = min(budget, self.max_prefills_per_step)
+        budget = self.max_prefills_per_step
         admit = []
-        while self._queue and len(admit) < budget:
+        spent = 0
+        while self._queue and len(admit) < free_slots:
+            if budget is not None:
+                # cost is only consulted against a finite budget — an
+                # uncapped scheduler skips the per-request price probe
+                # (a radix lookup per admission) entirely
+                c = 1 if cost is None else int(cost(self._queue[0]))
+                if spent + c > budget:
+                    break
+                spent += c
             admit.append(self._queue.popleft())
         return admit, expired

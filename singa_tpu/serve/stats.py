@@ -103,6 +103,9 @@ class EngineStats:
             self._tokens_out, self._queue_depth, self._occupancy,
             self._h_ttft, self._h_tpot,
         ]
+        # set by the engine when a prefix cache is attached: a
+        # zero-arg callable returning the cache's snapshot dict
+        self.prefix_source = None
         self.slo = slo
         self._slo_viol = {}
         if slo is not None:
@@ -267,4 +270,6 @@ class EngineStats:
                 "violations": {k: c.value
                                for k, c in self._slo_viol.items()},
             }),
+            "prefix": (self.prefix_source()
+                       if self.prefix_source is not None else None),
         }

