@@ -16,6 +16,12 @@ recovery invariants the unit tests assert piecewise:
   typed), token-stream parity against an uninterrupted run for every
   completed request, and ``resilience.engine_restarts`` equal to the
   number of injected decode faults.
+* **replica kill + fleet failover** — the same decode fault against a
+  ``ServeFleet`` replica with a ZERO restart budget kills that replica
+  outright mid-decode; the fleet requeues its never-started work onto
+  the survivor (stream parity), fails started work typed, keeps
+  serving new requests, and the jit cache stays pinned at zero
+  recompiles across the failover.
 
 The whole run happens under active monitoring; the report embeds
 ``observe.health_report()`` and the bench FAILS unless
@@ -267,6 +273,115 @@ def chaos_prefix(report):
         f"restarts ({restarts}) != injected copy faults ({injected})"
 
 
+def chaos_fleet(report):
+    """Kill one replica mid-decode (``serve.decode_step`` fault against
+    a zero restart budget): the fleet marks it unhealthy, requeues its
+    never-started requests onto the survivor in arrival order (token-
+    stream parity vs an uninterrupted single-engine run), started
+    requests fail typed, the fleet KEEPS SERVING on the survivor — and
+    the jit cache stays pinned at zero runtime recompiles across the
+    failover (replicas share every executable)."""
+    from bench_serve import _serve_jit_cache_size
+    from singa_tpu import observe, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, GenerationRequest,
+                                 ServeFleet)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(3)
+    workload = [(rng.randint(0, 256, rng.randint(3, 12)).astype(np.int32),
+                 int(rng.randint(3, 8))) for _ in range(12)]
+    extra = [(rng.randint(0, 256, rng.randint(3, 10)).astype(np.int32),
+              int(rng.randint(2, 6))) for _ in range(4)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+    base_extra = [np.asarray(m.generate(p, max_new_tokens=n,
+                                        temperature=0.0))
+                  for p, n in extra]
+
+    def build():
+        return ServeFleet(m, replicas=2, max_slots=2, restart_budget=0)
+
+    # warmup: compile every executable the fleet dispatches, then pin
+    # the jit cache across the whole chaos run
+    fleet = build()
+    for p, n in workload:
+        fleet.submit(GenerationRequest(p, max_new_tokens=n))
+    fleet.run_until_complete(max_steps=4000)
+    fleet.close()
+    jit0 = _serve_jit_cache_size()
+
+    fleet = build()
+    handles = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0)) for p, n in workload]
+    pol = faults.inject("serve.decode_step", FailAfterN(4, times=1))
+    fleet.run_until_complete(max_steps=4000)
+    faults.clear()
+
+    completed = wedged = typed_failed = 0
+    for (p, n), h, want in zip(workload, handles, base):
+        if not h.done():
+            wedged += 1
+            continue
+        try:
+            got = h.result().tokens
+            assert np.array_equal(got, want), \
+                "token stream diverged across the failover"
+            completed += 1
+        except EngineFailedError:
+            typed_failed += 1
+    snap = fleet.snapshot()
+
+    # service-level availability: the survivor keeps admitting and
+    # completing new work after the failover
+    hs2 = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0)) for p, n in extra]
+    fleet.run_until_complete(max_steps=2000)
+    post_completed = sum(
+        bool(np.array_equal(h.result().tokens, want))
+        for h, want in zip(hs2, base_extra))
+    jit1 = _serve_jit_cache_size()
+
+    # the fleet health section reflects the failover BEFORE close
+    # unregisters this fleet's metrics
+    h_fleet = observe.health_report(
+        include_registry=False)["serve"]["fleet"]
+    assert h_fleet["failovers"] >= 1 and h_fleet["requeues"] >= 1
+    assert h_fleet["replicas_healthy"] == 1
+    fleet.close()
+
+    report["serve_fleet"] = {
+        "replicas": 2,
+        "requests": len(workload),
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "decode_faults_injected": pol.fired,
+        "failovers": snap["failovers"],
+        "requeues": snap["requeues"],
+        "replicas_healthy_after": snap["replicas_healthy"],
+        "post_failover_requests": len(extra),
+        "post_failover_completed": post_completed,
+        "recompiles": (None if jit0 is None else jit1 - jit0),
+    }
+    sf = report["serve_fleet"]
+    assert wedged == 0, f"{wedged} requests wedged/lost"
+    assert completed + typed_failed == len(workload)
+    assert completed > 0 and typed_failed > 0
+    assert sf["decode_faults_injected"] == 1 and sf["failovers"] == 1
+    assert sf["requeues"] >= 1, "no never-started work moved — the " \
+        "failover path was not exercised"
+    assert sf["replicas_healthy_after"] == 1
+    assert post_completed == len(extra), \
+        "survivor stopped serving after the failover"
+    assert sf["recompiles"] in (0, None), sf["recompiles"]
+
+
 def main():
     from singa_tpu import observe
 
@@ -282,6 +397,7 @@ def main():
     chaos_collective(report)
     chaos_serve(report)
     chaos_prefix(report)
+    chaos_fleet(report)
 
     health = observe.health_report(include_registry=False)
     report["health"] = health

@@ -23,7 +23,11 @@ the bench is invalid if the engine is fast but wrong.
 
 Writes BENCH_SERVE.json (schema: workload/config/engine/static_batch/
 speedup/parity) so future PRs have a serving perf trajectory, and
-prints the same JSON to stdout.  The ``registry`` key embeds the
+prints the same JSON to stdout.  ``--fleet`` additionally replays the
+workload through a 2-replica ServeFleet (same total slot count) and
+embeds a ``fleet`` section — routing balance, per-stream parity
+against the engine run, and the jit-cache pin proving replicas share
+every executable.  The ``registry`` key embeds the
 process-wide ``singa_tpu.observe`` metrics snapshot; ``--trace-out
 PATH`` additionally traces the timed engine run and writes a Chrome
 trace-event JSON there (open in https://ui.perfetto.dev — expect
@@ -253,6 +257,80 @@ def run_prefix_mix(max_slots):
     }
 
 
+def run_fleet(m, workload, replicas, max_slots):
+    """Drive the standard ragged workload through a ServeFleet (same
+    TOTAL slot count as the single-engine run: replicas x max_slots).
+    Returns (wall, results, fleet) — the caller closes the fleet
+    (``close()`` unregisters its ``serve.fleet.*`` metrics, so any
+    registry/health snapshot the caller wants must happen first)."""
+    from singa_tpu.serve import GenerationRequest, ServeFleet
+
+    fleet = ServeFleet(m, replicas=replicas, max_slots=max_slots)
+    handles = []
+    pending = list(workload)
+    t0 = time.perf_counter()
+    while pending or fleet.pending:
+        while pending and pending[0]["arrival_step"] <= fleet.step_count:
+            w = pending.pop(0)
+            handles.append(fleet.submit(GenerationRequest(
+                w["prompt"], max_new_tokens=w["n_new"])))
+        fleet.step()
+    wall = time.perf_counter() - t0
+    outs = [h.result() for h in handles]
+    return wall, outs, fleet
+
+
+def run_fleet_bench(m, workload, engine_outs, replicas=2, max_slots=4,
+                    engine_snap=None):
+    """The --fleet measurement: the workload through a 2-replica fleet
+    with per-stream parity against the (already oracle-verified)
+    single-engine results, router balance across replicas, and the jit
+    cache pinned across the timed run — replicas share every
+    executable, so a fleet costs ZERO extra compiles.  Returns
+    ``(fleet section, registry snapshot, health report)`` — the
+    latter two taken BEFORE the fleet closes, because ``close()``
+    unregisters the ``serve.fleet.*`` metrics and a post-close health
+    report would show an all-zero fleet section."""
+    from singa_tpu import observe
+    from singa_tpu.utils.metrics import percentile
+
+    _, _, warm = run_fleet(m, workload, replicas, max_slots)  # warmup
+    warm.close()
+    jit_before = _serve_jit_cache_size()
+    wall, outs, fleet = run_fleet(m, workload, replicas, max_slots)
+    jit_after = _serve_jit_cache_size()
+    snap = fleet.snapshot()
+    reg_snap = observe.registry().snapshot()
+    health = observe.health_report(
+        engine_snapshots=([engine_snap] if engine_snap is not None
+                          else ()),
+        include_registry=False)
+    fleet.close()
+
+    # engine_outs are parity-checked against single-prompt generate by
+    # the main bench; stream equality here is transitively oracle parity
+    parity = all(np.array_equal(a.tokens, b.tokens)
+                 for a, b in zip(outs, engine_outs))
+    useful = sum(w["n_new"] for w in workload)
+    ttfts = [r.ttft for r in outs]
+    return {
+        "replicas": replicas,
+        "max_slots_each": max_slots,
+        "wall_s": wall,
+        "tokens_per_s": useful / wall,
+        "ttft_p50_s": percentile(ttfts, 50),
+        "ttft_p99_s": percentile(ttfts, 99),
+        "routed": snap["routed"],
+        "replicas_healthy": snap["replicas_healthy"],
+        "failovers": snap["failovers"],
+        "requeues": snap["requeues"],
+        "hedges": snap["hedges"],
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": bool(parity),
+    }, reg_snap, health
+
+
 def run_static(m, workload, max_slots):
     """Arrival-order batches of max_slots, each to its longest row."""
     from singa_tpu.models import gpt2_decode
@@ -294,6 +372,11 @@ def main():
                          "prefix cache) vs cold and embed the "
                          "prefix_mix section (hit rate, TTFT "
                          "cold-vs-warm, parity, recompile pin)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the workload through a 2-replica "
+                         "ServeFleet (same total slots) and embed the "
+                         "fleet section (routing balance, parity, "
+                         "recompile pin)")
     args = ap.parse_args()
 
     # active monitoring rides the whole bench: flight recorder + hang
@@ -395,6 +478,14 @@ def main():
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(
             engine_snapshots=[snap], include_registry=False)
+    if args.fleet:
+        # the fleet's metrics unregister at close(), so the refreshed
+        # registry/health snapshots come back from INSIDE the bench
+        # (taken while the fleet's counters are live — a post-close
+        # health report would carry an all-zero fleet section)
+        report["fleet"], report["registry"], report["health"] = \
+            run_fleet_bench(m, workload, outs_e, replicas=2,
+                            max_slots=max_slots // 2, engine_snap=snap)
     if args.trace_out:
         n_events = observe.export.write_chrome_trace(
             args.trace_out,

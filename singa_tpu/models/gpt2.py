@@ -376,6 +376,21 @@ class GPT2LMHead(model.Model):
 
         return InferenceEngine(self, **kw)
 
+    def serve_fleet(self, replicas=2, **kw):
+        """N supervised engine replicas behind a health-checked router
+        (singa_tpu.serve.ServeFleet): least-loaded / SLO-headroom
+        scoring, sticky ``pin_session`` routing, cross-replica
+        failover with never-started requeue parity, optional hedged
+        re-dispatch.  Replicas share this model's weights and jitted
+        executables but own their KV arena and prefix cache.  Keyword
+        args: ``router``, ``restart_budget``, ``budget_reset_after_s``,
+        ``shed_on_slo_pressure``, ``hedge_after_steps``, plus
+        everything :meth:`serve` accepts (forwarded to every replica's
+        engine).  See docs/SERVING.md "Fleet serving"."""
+        from ..serve import ServeFleet
+
+        return ServeFleet(self, replicas=replicas, **kw)
+
 
 def create_model(size="small", plan=None, **kw):
     cfg = getattr(GPT2Config, size)(**kw)

@@ -115,6 +115,22 @@ def _prefix_section(snap: dict) -> dict:
     }
 
 
+def _fleet_section(snap: dict) -> dict:
+    """The ``serve.fleet`` health section: replicated-serve routing and
+    failover counters summed across fleets (zeros when no fleet ever
+    ran — always present so dashboards can alert unconditionally).
+    ``routed`` is per replica index, summed across fleets."""
+    counters, gauges = snap["counters"], snap["gauges"]
+    return {
+        "replicas_healthy": _sum_metric(
+            gauges, "serve.fleet.replicas_healthy"),
+        "failovers": _sum_metric(counters, "serve.fleet.failovers"),
+        "requeues": _sum_metric(counters, "serve.fleet.requeues"),
+        "hedges": _sum_metric(counters, "serve.fleet.hedges"),
+        "routed": _by_label(counters, "serve.fleet.routed", "replica"),
+    }
+
+
 def _resilience_section(snap_counters: dict) -> dict:
     """The ``resilience`` health section: retry/fallback/restart
     counts published by singa_tpu.resilience (zeros when the layer
@@ -137,6 +153,12 @@ def _resilience_section(snap_counters: dict) -> dict:
             "resilience.engine_failures", 0),
         "engine_restarts": snap_counters.get(
             "resilience.engine_restarts", 0),
+        # fleet restart accounting: service-level recovery actions on
+        # top of the per-engine restarts above
+        "fleet_failovers": _sum_metric(snap_counters,
+                                       "serve.fleet.failovers"),
+        "fleet_requeues": _sum_metric(snap_counters,
+                                      "serve.fleet.requeues"),
         "shed_requests": _by_label(snap_counters,
                                    "serve.shed_requests", "reason"),
     }
@@ -218,6 +240,7 @@ def health_report(reg=None, engine_snapshots=(),
                 if engine_snapshots else None),
             "slo_violations": _slo_violations(snap["counters"]),
             "prefix": _prefix_section(snap),
+            "fleet": _fleet_section(snap),
         },
         "resilience": _resilience_section(snap["counters"]),
         "watchdog": (

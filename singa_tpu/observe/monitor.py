@@ -567,6 +567,15 @@ class Watchdog:
     def hangs(self) -> int:
         return self._hang_total
 
+    def hang_latched(self, source) -> bool:
+        """True when ``source``'s heartbeat latched a hang.  The fleet
+        probes every replica per step — this is the one-field read
+        that keeps that probe off :meth:`summary`'s full dict build
+        (clock reads + EWMA/anomaly fields for EVERY source in the
+        process)."""
+        st = self._sources.get(source)
+        return st is not None and st.hang_fired
+
     def summary(self) -> dict:
         now = self._clock()
         return {

@@ -46,6 +46,7 @@ SITES = (
     "comm.collective",     # host-side collective dispatch
     "serve.decode_step",   # the engine's pool decode (and prefill)
     "serve.prefix_copy",   # prefix-cache pool<->slot block copies
+    "serve.route",         # fleet router admission (ServeFleet.submit)
     "io.binfile",          # BinFile record read/write
     "train.step",          # _GraphRunner step dispatch
 )

@@ -22,15 +22,24 @@ Entry points::
 
     from singa_tpu.serve import EngineSupervisor
     sup = EngineSupervisor(model, max_slots=8, restart_budget=2)
+
+Since the fleet round, ``ServeFleet`` puts N supervised replicas
+behind a health-checked ``Router`` (least-loaded / SLO-headroom
+scoring, sticky sessions, cross-replica failover with requeue parity,
+optional hedging)::
+
+    from singa_tpu.serve import ServeFleet
+    fleet = ServeFleet(model, replicas=2, max_slots=4)
 """
 
 from .engine import InferenceEngine  # noqa: F401
+from .fleet import Router, ServeFleet  # noqa: F401
 from .prefix import (PrefixCache, PrefixCacheConfig,  # noqa: F401
                      SessionHandle)
 from .request import (DeadlineExceededError, EngineFailedError,  # noqa: F401
-                      GenerationRequest, GenerationResult, LoadShedError,
-                      QueueFullError, RequestHandle,
-                      RestartBudgetExceededError)
+                      FleetDownError, GenerationRequest,
+                      GenerationResult, LoadShedError, QueueFullError,
+                      RequestHandle, RestartBudgetExceededError)
 from .scheduler import FIFOScheduler  # noqa: F401
 from .stats import EngineStats  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401

@@ -374,14 +374,17 @@ class InferenceEngine:
                 or any(s is not None for s in self._slots))
 
     # -- lifecycle -------------------------------------------------------
-    def close(self):
+    def close(self, force=False):
         """Retire the engine: unregister its ``serve.*{engine=n}``
         metrics from the process-wide observe registry (they would
         otherwise be pinned — TTFT/TPOT value lists included — for
         process lifetime) and drop the KV arena references.  Idempotent;
-        the engine must be drained (``not pending``) first.  Also the
+        the engine must be drained (``not pending``) first unless
+        ``force=True`` (the fleet's failover path: an abandoned
+        replica's handles are already rejected typed, its device state
+        is garbage to be released, not drained).  Also the
         context-manager exit: ``with model.serve(...) as eng: ...``."""
-        if self.pending:
+        if self.pending and not force:
             raise RuntimeError(
                 f"close() with work in flight (queue="
                 f"{self.scheduler.queue_depth}, live={self.live_slots});"
@@ -416,6 +419,15 @@ class InferenceEngine:
     @property
     def live_slots(self) -> int:
         return sum(s is not None for s in self._slots)
+
+    @property
+    def live_request_ids(self):
+        """Request ids currently occupying slots — i.e. STARTED: tokens
+        may already have streamed through ``on_token``, so these are
+        never safely re-runnable elsewhere (the fleet's failover path
+        uses exactly this distinction)."""
+        return {s.handle.request.request_id
+                for s in self._slots if s is not None}
 
     # -- the iteration-level step loop -----------------------------------
     def step(self) -> bool:

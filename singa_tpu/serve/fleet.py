@@ -1,0 +1,677 @@
+"""Replicated serve fleet: N engine replicas behind a health-checked
+router (the fleet round).
+
+One continuous-batching engine is one process-wide failure domain: a
+wedged decode, an exhausted restart budget, or a single slow replica
+takes every caller down with it.  Production LLM servers (vLLM's
+replicated deployments, Orca's iteration-level scheduling — the serve
+layer's design references) survive replica loss by routing around it.
+This module is that layer for the in-process engine:
+
+* **fleet** — :class:`ServeFleet` owns N
+  :class:`~singa_tpu.serve.supervisor.EngineSupervisor`-wrapped engine
+  replicas.  Replicas share the MODEL (one copy of the weights — each
+  engine's ``extract_params`` returns views of the same arrays, and
+  every jitted executable is shared because the replicas are built on
+  identical ``(max_slots, max_len)`` statics: an N-replica fleet
+  compiles exactly once) but own their KV arena and prefix cache, so a
+  replica's device state is disposable;
+* **router** — :class:`Router` scores every healthy replica on
+  queue depth, slot occupancy, and the TPOT EWMA from ``EngineStats``
+  (a degrading replica prices itself out of new admissions before its
+  latency collapses), with replicas past the SLO's ``queue_depth_max``
+  penalized behind those with headroom.  ``pin_session`` continuations
+  route STICKY: a :class:`~singa_tpu.serve.prefix.SessionHandle`'s next
+  turn lands on the replica whose radix tree holds the pinned blocks
+  (any other replica serves it cold but correct — sticky is a
+  performance preference, not a correctness requirement, so a dead
+  sticky target falls back to normal routing);
+* **failover** — per-replica health is derived from the watchdog
+  (``observe.monitor``: a replica whose heartbeat source latched a hang
+  is failed over even though its supervisor never raised) and from
+  typed failures (``RestartBudgetExceededError`` out of a supervisor
+  that crash-looped past its budget).  A failed replica is marked
+  unhealthy, its never-started requests (``started=False`` — no tokens
+  streamed, same seed → same chain) are REQUEUED onto healthy siblings
+  in arrival order with token-stream parity against an uninterrupted
+  run, and its started requests stay typed — exactly the single-engine
+  contract, now service-level.  ``revive()`` rebuilds a failed
+  replica's supervisor (jit cache hit — same statics) and the router
+  re-admits it;
+* **degradation** — fleet-wide pressure reuses the existing
+  ``shed_lowest()``/priority hooks: an arrival refused by one
+  replica's SLO-pressure admission tries the next, so a request is
+  only shed when NO healthy replica holds lower-priority work
+  (``LoadShedError``), and when every replica is gone, submission
+  fails typed (:class:`~singa_tpu.serve.request.FleetDownError`)
+  instead of queueing into the void;
+* **hedging** — optional (``hedge_after_steps``): a request stuck
+  un-started behind a slow replica's admission for that many fleet
+  steps is re-dispatched to the least-loaded sibling; first completion
+  wins (identical tokens either way — same seed), the loser's work is
+  the hedge's cost.  Never hedges streaming (``on_token``) or session
+  requests.
+
+Metrics ride the process-wide observe registry as
+``serve.fleet.{replicas_healthy,failovers,requeues,routed,hedges}``
+labeled ``{fleet=,replica=}`` and surface in
+``health_report()["serve"]["fleet"]``; the ``serve.route`` fault site
+(singa_tpu.resilience) covers admission routing.  bench_chaos.py's
+``chaos_fleet`` scenario kills a replica mid-decode and CI gates on
+zero wedged/lost requests, survivor parity, and a pinned jit cache.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import weakref
+
+import numpy as np
+
+from ..observe import monitor as _monitor
+from ..observe import trace as _trace
+from ..observe.registry import registry as _registry
+from ..resilience import faults as _faults
+from ..utils.logging import get_channel
+from .request import (EngineFailedError, FleetDownError,
+                      GenerationRequest, LoadShedError, QueueFullError,
+                      RequestHandle, RestartBudgetExceededError)
+from .supervisor import EngineSupervisor
+
+__all__ = ["Router", "ServeFleet"]
+
+_fleet_ids = itertools.count()
+
+#: score penalty for a replica past its SLO queue-depth headroom: large
+#: enough to rank every pressured replica behind every unpressured one
+#: (real scores are O(queue_depth)), small enough to still order the
+#: pressured ones among themselves.
+_PRESSURE_PENALTY = 1e6
+
+
+class Router:
+    """Least-loaded / SLO-headroom scoring over replica views.
+
+    A view is the host-side load sample the fleet takes per candidate:
+    ``{"replica": idx, "queue_depth": int, "occupancy": float,
+    "tpot_ewma": float | None, "queue_headroom": int | None}``.
+    ``score`` is a weighted sum — queue depth (requests ahead of this
+    one), occupancy (live slots / max_slots), and the TPOT EWMA
+    normalized by the fleet-wide best (a replica decoding 3x slower
+    than its healthiest sibling carries a 3x term; with no samples the
+    term is 0) — plus a large penalty when the replica sits at/past
+    ``SLO.queue_depth_max``.  ``rank`` returns candidate indices
+    best-first; ties break on replica index, which is deterministic
+    AND self-balancing because queue depth moves at submit time.
+    Subclass and override ``score`` for custom policies."""
+
+    def __init__(self, w_queue=1.0, w_occupancy=1.0, w_tpot=1.0):
+        self.w_queue = float(w_queue)
+        self.w_occupancy = float(w_occupancy)
+        self.w_tpot = float(w_tpot)
+
+    def score(self, view, tpot_base) -> float:
+        s = (self.w_queue * view["queue_depth"]
+             + self.w_occupancy * view["occupancy"])
+        ewma = view.get("tpot_ewma")
+        if ewma is not None and tpot_base:
+            s += self.w_tpot * (ewma / tpot_base)
+        headroom = view.get("queue_headroom")
+        if headroom is not None and headroom <= 0:
+            s += _PRESSURE_PENALTY
+        return s
+
+    def rank(self, views) -> list:
+        """Replica indices best-first."""
+        ewmas = [v["tpot_ewma"] for v in views
+                 if v.get("tpot_ewma")]
+        base = min(ewmas) if ewmas else None
+        scored = sorted(
+            ((self.score(v, base), v["replica"]) for v in views))
+        return [idx for _, idx in scored]
+
+
+class _Replica:
+    """Fleet-side bookkeeping for one supervised engine replica."""
+
+    __slots__ = ("idx", "sup", "healthy", "needs_failover",
+                 "down_error")
+
+    def __init__(self, idx, sup):
+        self.idx = idx
+        self.sup = sup
+        self.healthy = True
+        self.needs_failover = False
+        self.down_error = None
+
+
+class _Route:
+    """One fleet request's routing state: the caller-facing handle and
+    every dispatch attempt ``(replica_idx, supervisor_handle)`` made
+    for it (one normally; two when hedged or requeued)."""
+
+    __slots__ = ("handle", "attempts", "submit_step", "hedged")
+
+    def __init__(self, handle, step):
+        self.handle = handle
+        self.attempts = []
+        self.submit_step = step
+        self.hedged = False
+
+
+class ServeFleet:
+    """N data-parallel engine replicas behind a health-checked router.
+
+    >>> fleet = model.serve_fleet(replicas=2, max_slots=4)
+    >>> h = fleet.submit(GenerationRequest(prompt, max_new_tokens=32))
+    >>> fleet.run_until_complete()
+    >>> h.result().tokens     # survives a replica death in between
+
+    ``engine_kw`` is forwarded verbatim to every replica's engine
+    (``max_slots``, ``max_len``, ``slo``, ``prefix_cache``, ...);
+    ``restart_budget``/``budget_reset_after_s``/``shed_on_slo_pressure``
+    go to every supervisor.  Handles are fleet-owned: they resolve with
+    the final outcome across restarts AND failovers."""
+
+    def __init__(self, model, replicas=2, router=None, restart_budget=2,
+                 budget_reset_after_s=None, shed_on_slo_pressure=False,
+                 hedge_after_steps=None, clock=time.monotonic,
+                 **engine_kw):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if hedge_after_steps is not None and hedge_after_steps < 1:
+            raise ValueError(
+                f"hedge_after_steps must be >= 1 or None, got "
+                f"{hedge_after_steps}")
+        if budget_reset_after_s is not None and budget_reset_after_s <= 0:
+            # the supervisor would reject this too, but only after the
+            # fleet registered its metrics — validate before any side
+            # effect
+            raise ValueError(
+                f"budget_reset_after_s must be > 0 or None, got "
+                f"{budget_reset_after_s}")
+        self._model = model
+        self._clock = clock
+        self._engine_kw = dict(engine_kw)
+        self._sup_kw = dict(
+            restart_budget=restart_budget,
+            budget_reset_after_s=budget_reset_after_s,
+            shed_on_slo_pressure=shed_on_slo_pressure, clock=clock)
+        self.router = router if router is not None else Router()
+        self._slo = engine_kw.get("slo")
+        self.hedge_after_steps = hedge_after_steps
+        self.fleet_label = str(next(_fleet_ids))
+        self._log = get_channel("serve")
+        reg = _registry()
+        self._reg = reg
+        lbl = dict(fleet=self.fleet_label)
+        self._g_healthy = reg.gauge(
+            "serve.fleet.replicas_healthy",
+            help="replicas the router currently admits to", **lbl)
+        self._c_routed, self._c_failovers = [], []
+        self._c_requeues, self._c_hedges = [], []
+        for i in range(replicas):
+            rl = dict(lbl, replica=str(i))
+            self._c_routed.append(reg.counter(
+                "serve.fleet.routed",
+                help="requests admitted to this replica", **rl))
+            self._c_failovers.append(reg.counter(
+                "serve.fleet.failovers",
+                help="times this replica was failed out of the "
+                     "routing set", **rl))
+            self._c_requeues.append(reg.counter(
+                "serve.fleet.requeues",
+                help="never-started requests moved OFF this replica "
+                     "onto healthy siblings", **rl))
+            self._c_hedges.append(reg.counter(
+                "serve.fleet.hedges",
+                help="hedged re-dispatches admitted TO this replica",
+                **rl))
+        self._registered = ([self._g_healthy] + self._c_routed
+                            + self._c_failovers + self._c_requeues
+                            + self._c_hedges)
+        self._replicas = [
+            _Replica(i, EngineSupervisor(model, **self._sup_kw,
+                                         **self._engine_kw))
+            for i in range(replicas)]
+        self._g_healthy.set(replicas)
+        # fleet-owned completion routing (the supervisor pattern, one
+        # level up: routes resolve across restarts AND failovers)
+        self._routes = {}        # request_id -> _Route
+        self._order = []         # fleet arrival order (requeue order)
+        # SessionHandle -> replica idx (weak: a dropped session must
+        # not pin the mapping, and identity is the only safe key)
+        self._sessions = weakref.WeakKeyDictionary()
+        self.step_count = 0
+        self._closed = False
+        self._log.info(
+            "fleet up: %d replicas x (slots=%d) [fleet=%s]", replicas,
+            self._replicas[0].sup.engine.max_slots, self.fleet_label)
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def healthy_replicas(self) -> int:
+        return sum(r.healthy for r in self._replicas)
+
+    @property
+    def pending(self) -> bool:
+        """True while any fleet-submitted request is unresolved."""
+        return bool(self._routes)
+
+    def supervisor(self, idx) -> EngineSupervisor:
+        """The replica's current supervisor (tests, debuggers)."""
+        return self._replicas[idx].sup
+
+    def health(self) -> dict:
+        """Per-replica health view: the router's input plus status."""
+        out = {}
+        for rep in self._replicas:
+            eng = rep.sup.engine
+            out[rep.idx] = {
+                "healthy": rep.healthy,
+                "restarts": rep.sup.restarts,
+                "queue_depth": (eng.scheduler.queue_depth
+                                if not eng._closed else 0),
+                "live_slots": eng.live_slots if not eng._closed else 0,
+                "tpot_ewma_s": eng.stats.tpot_ewma,
+            }
+        return out
+
+    def snapshot(self) -> dict:
+        """Fleet-level stats (bench_serve's ``fleet`` section)."""
+        return {
+            "replicas": len(self._replicas),
+            "replicas_healthy": self.healthy_replicas,
+            "failovers": sum(c.value for c in self._c_failovers),
+            "requeues": sum(c.value for c in self._c_requeues),
+            "hedges": sum(c.value for c in self._c_hedges),
+            "routed": {str(i): c.value
+                       for i, c in enumerate(self._c_routed)},
+            "engines": [rep.sup.engine.stats.snapshot()
+                        for rep in self._replicas],
+        }
+
+    # -- admission -------------------------------------------------------
+    def submit(self, request) -> RequestHandle:
+        """Route a request to the best healthy replica.  Raises
+        :class:`FleetDownError` when none is healthy,
+        :class:`QueueFullError` when every healthy replica is at
+        back-pressure, and :class:`LoadShedError` when SLO-pressure
+        admission refuses it fleet-wide (no healthy replica holds
+        lower-priority work to shed)."""
+        if self._closed:
+            raise RuntimeError(
+                "fleet is closed; build a new one with "
+                "model.serve_fleet()")
+        if not isinstance(request, GenerationRequest):
+            request = GenerationRequest(np.asarray(request))
+        rid = request.request_id
+        if rid in self._routes:
+            raise ValueError(
+                f"request_id {rid!r} is already in flight fleet-wide")
+        if _faults._armed:
+            # chaos hook: a raising router admission is a SYNCHRONOUS
+            # typed failure for the caller — nothing was accepted
+            _faults.check("serve.route")
+        handle = RequestHandle(request)
+        route = _Route(handle, self.step_count)
+        idx, inner = self._route(request)
+        route.attempts.append((idx, inner))
+        self._routes[rid] = route
+        self._order.append(rid)
+        # a replica may have died during routing (budget exhausted
+        # surfacing in submit): move its work before returning
+        self._drain_failovers()
+        return handle
+
+    def _route(self, request, exclude=()):
+        """Admit ``request`` to the first candidate that takes it.
+        Tries sticky, then router-ranked healthy replicas; QueueFull /
+        LoadShed at one replica falls through to the next (which is
+        what makes shedding and back-pressure FLEET-wide decisions)."""
+        last_refusal = None   # QueueFull/LoadShed from a live replica
+        last_death = None     # budget exhaustion surfacing at admission
+        tried = 0
+        for idx in self._candidates(request, exclude):
+            rep = self._replicas[idx]
+            tried += 1
+            try:
+                inner = rep.sup.submit(request)
+            except (QueueFullError, LoadShedError) as e:
+                last_refusal = e
+                continue
+            except RestartBudgetExceededError as e:
+                # the replica died between steps (failure surfaced at
+                # admission): mark it down, keep routing — its
+                # outstanding work moves in _drain_failovers
+                self._mark_down(rep, e)
+                last_death = e
+                continue
+            self._c_routed[idx].inc()
+            return idx, inner
+        if tried == 0 or self.healthy_replicas == 0:
+            raise FleetDownError(
+                f"no healthy replica ({self.healthy_replicas} of "
+                f"{len(self._replicas)}); revive() one or build a new "
+                f"fleet", started=False)
+        if last_refusal is not None:
+            # a replica dying at admission must not mask a healthy
+            # sibling's back-pressure: the caller's typed error is the
+            # one that describes the replicas still serving
+            raise last_refusal
+        raise last_death
+
+    def _candidates(self, request, exclude=()):
+        """Candidate replica indices, best-first: the sticky session
+        target (healthy only) ahead of the router's ranking."""
+        out = []
+        sess = getattr(request, "session_of", None)
+        if sess is not None:
+            idx = self._sessions.get(sess)
+            if (idx is not None and idx not in exclude
+                    and self._replicas[idx].healthy):
+                out.append(idx)
+        views = [self._view(rep) for rep in self._replicas
+                 if rep.healthy and rep.idx not in exclude
+                 and rep.idx not in out]
+        out.extend(self.router.rank(views))
+        return out
+
+    def _view(self, rep) -> dict:
+        eng = rep.sup.engine
+        depth = eng.scheduler.queue_depth
+        headroom = None
+        if self._slo is not None \
+                and self._slo.queue_depth_max is not None:
+            headroom = self._slo.queue_depth_max - depth
+        return {
+            "replica": rep.idx,
+            "queue_depth": depth,
+            "occupancy": eng.live_slots / eng.max_slots,
+            "tpot_ewma": eng.stats.tpot_ewma,
+            "queue_headroom": headroom,
+        }
+
+    # -- drive -----------------------------------------------------------
+    def step(self) -> bool:
+        """One fleet iteration: drive every healthy replica one engine
+        step, fail over replicas that died (budget exhausted) or hung
+        (watchdog), requeue their never-started work onto healthy
+        siblings, and hedge stuck admissions.  Returns ``pending``."""
+        if self._closed:
+            raise RuntimeError(
+                "fleet is closed; build a new one with "
+                "model.serve_fleet()")
+        for rep in self._replicas:
+            if not rep.healthy or not rep.sup.pending:
+                continue
+            try:
+                rep.sup.step()
+            except RestartBudgetExceededError as e:
+                self._mark_down(rep, e)
+        self._check_watchdog()
+        self._drain_failovers()
+        if self.hedge_after_steps is not None:
+            self._maybe_hedge()
+        self._sync()
+        self.step_count += 1
+        return self.pending
+
+    def run_until_complete(self, max_steps=None):
+        """Drive :meth:`step` until every fleet-submitted request
+        resolves (normally, or typed — a fleet with dead replicas
+        still terminates: work that cannot be placed is rejected, never
+        parked)."""
+        steps = 0
+        while self.pending:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain within {max_steps} steps "
+                    f"(routes={len(self._routes)}, healthy="
+                    f"{self.healthy_replicas}/{len(self._replicas)})")
+
+    # -- health / failover -----------------------------------------------
+    def _check_watchdog(self):
+        """Fail over replicas whose heartbeat source latched a hang:
+        the watchdog (observe.monitor) sees a wedged engine that never
+        raises — typed failures alone would miss it."""
+        if not _monitor.active():
+            return
+        wd = _monitor.watchdog()
+        if wd is None:
+            return
+        for rep in self._replicas:
+            if not rep.healthy:
+                continue
+            if wd.hang_latched(rep.sup.engine._hb_source):
+                self._mark_down(rep, EngineFailedError(
+                    f"replica {rep.idx} hang-latched by the watchdog",
+                    started=None))
+
+    def _mark_down(self, rep, error):
+        """Take a replica out of the routing set (idempotent); the
+        requeue scan runs in ``_drain_failovers``."""
+        if not rep.healthy:
+            return
+        rep.healthy = False
+        rep.needs_failover = True
+        rep.down_error = error
+        self._c_failovers[rep.idx].inc()
+        self._g_healthy.set(self.healthy_replicas)
+        self._log.error(
+            "replica %d failed out of the fleet (%r); %d/%d healthy",
+            rep.idx, error, self.healthy_replicas, len(self._replicas))
+        _trace.event("serve/fleet_failover", cat="serve",
+                     replica=rep.idx, error=repr(error),
+                     healthy=self.healthy_replicas)
+
+    def _drain_failovers(self):
+        """Process every replica marked down since the last pass.  A
+        requeue can itself mark another replica down (its budget
+        surfaces at admission), so loop until quiescent — each replica
+        fails over at most once, so this terminates."""
+        progressed = True
+        while progressed:
+            progressed = False
+            for rep in self._replicas:
+                if rep.needs_failover:
+                    rep.needs_failover = False
+                    self._failover(rep)
+                    progressed = True
+
+    def _failover(self, rep):
+        """Reject the downed replica's outstanding work typed and move
+        the never-started part onto healthy siblings in arrival
+        order."""
+        rep.sup.abandon(repr(rep.down_error))  # no-op if already dead
+        for rid in list(self._order):
+            route = self._routes.get(rid)
+            if route is None or route.handle.done():
+                continue
+            atts = [h for i, h in route.attempts if i == rep.idx]
+            if not atts:
+                continue
+            inner = atts[-1]
+            live_elsewhere = any(
+                not h.done() and self._replicas[i].healthy
+                for i, h in route.attempts if i != rep.idx)
+            err = inner._error if inner.done() else None
+            if inner.done() and err is None:
+                continue  # resolved OK on this replica; _sync picks it up
+            if err is None:
+                # abandon() resolves every outstanding handle; an
+                # unresolved one here is a routing-table bug — reject
+                # typed rather than wedge the caller
+                err = EngineFailedError(
+                    f"{rid}: replica {rep.idx} failed over",
+                    request_id=rid, started=None)
+            requeue_safe = (isinstance(err, EngineFailedError)
+                            and err.started is False)
+            if live_elsewhere:
+                continue  # a hedge is still running on a healthy sibling
+            if not requeue_safe:
+                route.handle._reject(err)
+                continue
+            try:
+                idx2, inner2 = self._route(route.handle.request)
+            except (EngineFailedError, QueueFullError,
+                    LoadShedError) as e2:
+                # nowhere to put it: typed, never silently dropped.
+                # EngineFailedError covers FleetDownError AND a
+                # sibling's RestartBudgetExceededError surfacing at
+                # admission — an escape here would leave this route
+                # unresolved forever (needs_failover was already
+                # cleared)
+                route.handle._reject(e2)
+                continue
+            route.attempts.append((idx2, inner2))
+            self._c_requeues[rep.idx].inc()
+            _trace.event("serve/fleet_requeue", cat="serve",
+                         request=rid, src=rep.idx, dst=idx2)
+        self._log.warning(
+            "replica %d drained: never-started work requeued onto "
+            "healthy siblings", rep.idx)
+
+    def revive(self, idx):
+        """Bring a failed replica back: release the dead engine, build
+        a fresh supervisor (fresh restart budget, empty prefix cache —
+        cold but correct; same compiled shapes, so reviving costs an
+        arena allocation, not a recompile), and re-enter the routing
+        set."""
+        rep = self._replicas[idx]
+        if rep.healthy:
+            raise ValueError(f"replica {idx} is healthy")
+        if not rep.sup.engine._closed:
+            rep.sup.close(force=True)
+        rep.sup = EngineSupervisor(self._model, **self._sup_kw,
+                                   **self._engine_kw)
+        rep.healthy = True
+        rep.needs_failover = False
+        rep.down_error = None
+        self._g_healthy.set(self.healthy_replicas)
+        self._log.info("replica %d revived; %d/%d healthy", idx,
+                       self.healthy_replicas, len(self._replicas))
+        _trace.event("serve/fleet_revive", cat="serve", replica=idx,
+                     healthy=self.healthy_replicas)
+
+    # -- hedging ---------------------------------------------------------
+    def _maybe_hedge(self):
+        """Re-dispatch requests stuck un-started behind one replica's
+        admission for ``hedge_after_steps`` fleet steps.  Only
+        non-streaming, non-session requests hedge (a duplicate stream
+        would double tokens at the client; a session belongs to its
+        replica), and only once per request."""
+        for rid in self._order:
+            route = self._routes.get(rid)
+            if (route is None or route.handle.done() or route.hedged
+                    or len(route.attempts) != 1):
+                continue
+            req = route.handle.request
+            if (req.on_token is not None or req.pin_session
+                    or getattr(req, "session_of", None) is not None):
+                continue
+            if self.step_count - route.submit_step \
+                    < self.hedge_after_steps:
+                continue
+            idx0, inner0 = route.attempts[0]
+            rep0 = self._replicas[idx0]
+            if inner0.done():
+                continue
+            if rid in rep0.sup.engine.live_request_ids:
+                continue  # started: it is decoding, not stuck
+            try:
+                idx2, inner2 = self._route(req, exclude={idx0})
+            except (EngineFailedError, QueueFullError, LoadShedError):
+                continue  # nowhere better to run it; not an error
+            route.attempts.append((idx2, inner2))
+            route.hedged = True
+            self._c_hedges[idx2].inc()
+            _trace.event("serve/fleet_hedge", cat="serve", request=rid,
+                         src=idx0, dst=idx2,
+                         waited_steps=self.step_count
+                         - route.submit_step)
+            self._log.info("hedged %s: replica %d -> %d after %d "
+                           "steps un-started", rid, idx0, idx2,
+                           self.step_count - route.submit_step)
+
+    # -- completion routing ----------------------------------------------
+    def _sync(self):
+        """Propagate resolved attempts to the fleet handles.  First
+        success wins (hedged twins produce identical tokens — same
+        seed, same chain); a route rejects only once EVERY attempt has
+        failed and no requeue replaced it."""
+        done = []
+        for rid, route in self._routes.items():
+            h = route.handle
+            if h.done():
+                done.append(rid)
+                continue
+            finished = None
+            err = None
+            all_done = True
+            for idx, inner in route.attempts:
+                if not inner.done():
+                    all_done = False
+                    continue
+                if inner._error is None:
+                    finished = (idx, inner._result)
+                    break
+                err = inner._error
+            if finished is not None:
+                idx, result = finished
+                if result.session is not None:
+                    # sticky routing source: this session's blocks live
+                    # in replica idx's radix tree
+                    self._sessions[result.session] = idx
+                h._finish(result)
+                done.append(rid)
+            elif all_done and err is not None:
+                h._reject(err)
+                done.append(rid)
+        if done:
+            for rid in done:
+                self._routes.pop(rid, None)
+            live = set(self._routes)
+            self._order = [r for r in self._order if r in live]
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self):
+        """Retire the fleet: close every replica (force for abandoned
+        ones and hedge losers — their fleet handles are resolved; the
+        leftover supervisor-side work is nobody's) and unregister the
+        fleet metrics.  Requires every FLEET handle resolved
+        (``not pending``)."""
+        if self._closed:
+            return
+        if self.pending:
+            raise RuntimeError(
+                f"close() with {len(self._routes)} requests in flight;"
+                f" drain with run_until_complete() first")
+        for rep in self._replicas:
+            if not rep.sup.engine._closed:
+                rep.sup.close(force=True)
+        self._reg.remove(*self._registered)
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is None:
+            self.close()
+        elif not self._closed:
+            # release registries + arenas without masking the in-flight
+            # exception behind the drained-first check
+            for rep in self._replicas:
+                if not rep.sup.engine._closed:
+                    rep.sup.engine.__exit__(exc_type, *a)
+            self._reg.remove(*self._registered)
+            self._closed = True
+        return False

@@ -188,9 +188,12 @@ class SessionHandle:
         session's full sequence + ``extra_tokens`` (the new user
         input).  Keyword args pass through to GenerationRequest
         (``max_new_tokens``, ``temperature``, ``pin_session`` for the
-        turn after this one, ...)."""
+        turn after this one, ...).  The request carries
+        ``session_of=self`` so a fleet router can keep the continuation
+        on the replica whose cache holds the pinned blocks."""
         from .request import GenerationRequest
         extra = np.asarray(extra_tokens, np.int32).reshape(-1)
+        kw.setdefault("session_of", self)
         return GenerationRequest(
             np.concatenate([self.tokens, extra]), **kw)
 

@@ -60,6 +60,14 @@ class RestartBudgetExceededError(EngineFailedError):
     that keeps dying."""
 
 
+class FleetDownError(EngineFailedError):
+    """Every replica in a :class:`~singa_tpu.serve.fleet.ServeFleet`
+    is unhealthy: there is no sibling left to fail over to.  Raised by
+    ``ServeFleet.submit`` for new arrivals; outstanding never-started
+    requests of the last replica are rejected with it (``started=False``
+    — safe to resubmit once a replica is revived)."""
+
+
 class LoadShedError(RuntimeError):
     """The request was shed by SLO-pressure admission control (queue
     beyond ``SLO.queue_depth_max``): either a lower-priority queued
@@ -89,7 +97,13 @@ class GenerationRequest:
     a :class:`~singa_tpu.serve.prefix.SessionHandle` to the result, so
     the next turn's re-sent conversation is a block-prefix hit; without
     a cache the handle is still attached (continuation just runs
-    cold)."""
+    cold).
+    ``session_of``: the :class:`SessionHandle` this request continues
+    (set automatically by ``SessionHandle.request``).  A single engine
+    ignores it; the fleet router uses it for STICKY routing — the
+    continuation lands on the replica whose prefix cache holds the
+    pinned session, so session KV reuse stays replica-local (any other
+    replica would serve it cold but correct)."""
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 20
@@ -99,6 +113,7 @@ class GenerationRequest:
     on_token: Optional[Callable] = None
     priority: int = 0
     pin_session: bool = False
+    session_of: Optional[object] = None
     request_id: str = field(
         default_factory=lambda: f"req-{next(_req_counter)}")
 

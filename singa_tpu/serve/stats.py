@@ -106,6 +106,12 @@ class EngineStats:
         # set by the engine when a prefix cache is attached: a
         # zero-arg callable returning the cache's snapshot dict
         self.prefix_source = None
+        # recency-weighted TPOT (None until the first multi-token
+        # retire): the fleet router's SLO-headroom signal — a replica
+        # whose decode is degrading shows it here long before the
+        # lifetime-mean tpot histogram moves
+        self.tpot_ewma = None
+        self._tpot_alpha = 0.25
         self.slo = slo
         self._slo_viol = {}
         if slo is not None:
@@ -198,6 +204,10 @@ class EngineStats:
         self.ttft.record(result.ttft)
         if result.tpot is not None:
             self.tpot.record(result.tpot)
+            a = self._tpot_alpha
+            self.tpot_ewma = (result.tpot if self.tpot_ewma is None
+                              else (1 - a) * self.tpot_ewma
+                              + a * result.tpot)
         slo = self.slo
         if slo is None:
             return
@@ -252,6 +262,9 @@ class EngineStats:
             "latency": {
                 "ttft": self.ttft.summary(),
                 "tpot": self.tpot.summary(),
+                # schema extension (add-only): the router's headroom
+                # signal, exposed so fleet snapshots explain routing
+                "tpot_ewma_s": self.tpot_ewma,
             },
             "queue": {
                 "mean_depth": (self._queue_depth_sum

@@ -20,7 +20,12 @@ This module is the layer that turns that clean death into continuity:
 * **restart budget** — ``restart_budget`` consecutive-lifetime
   restarts; past it, remaining work is rejected with
   :class:`RestartBudgetExceededError` (an engine that keeps dying is a
-  bug, not bad luck) and the supervisor refuses further submissions;
+  bug, not bad luck) and the supervisor refuses further submissions.
+  ``budget_reset_after_s`` (default None = consecutive-lifetime, the
+  original behavior) forgives spent restarts after that much HEALTHY
+  uptime since the last one: a long-lived fleet replica is then only
+  condemned by crash-LOOPING (failures closer together than the
+  window), never by ancient restarts accumulated over weeks;
 * **SLO-pressure load shedding** — with ``shed_on_slo_pressure=True``
   and an :class:`~singa_tpu.observe.health.SLO` carrying
   ``queue_depth_max``, admission beyond that depth sheds the
@@ -64,15 +69,23 @@ class EngineSupervisor:
     the final outcome across restarts, not the first engine's."""
 
     def __init__(self, model, restart_budget=2,
+                 budget_reset_after_s=None,
                  shed_on_slo_pressure=False, clock=time.monotonic,
                  **engine_kw):
         if restart_budget < 0:
             raise ValueError(
                 f"restart_budget must be >= 0, got {restart_budget}")
+        if budget_reset_after_s is not None and budget_reset_after_s <= 0:
+            raise ValueError(
+                f"budget_reset_after_s must be > 0 or None, got "
+                f"{budget_reset_after_s}")
         self._model = model
+        self._clock = clock
         self._engine_kw = dict(engine_kw, clock=clock)
         self.restart_budget = int(restart_budget)
+        self.budget_reset_after_s = budget_reset_after_s
         self.restarts = 0
+        self._last_restart_t = None
         self._shed = bool(shed_on_slo_pressure)
         self._slo = engine_kw.get("slo")
         self._dead = False
@@ -215,7 +228,23 @@ class EngineSupervisor:
                    and self._inner[rid]._error.started is False]
         for rid in requeue:
             self._inner.pop(rid)
-        failed.close()  # release registry entries + arena
+        failed.close()  # release registry entries + arena (drained:
+        #                 _fail cleared every slot and the queue)
+        now = self._clock()
+        if (self.budget_reset_after_s is not None and self.restarts > 0
+                and self._last_restart_t is not None
+                and now - self._last_restart_t
+                >= self.budget_reset_after_s):
+            # healthy-uptime window elapsed since the last restart:
+            # this failure is bad luck, not a crash loop — forgive the
+            # spent budget (fleet replicas live for weeks; without
+            # this, ancient restarts eventually condemn them)
+            self._log.info(
+                "restart budget reset after %.1fs healthy uptime "
+                "(%d prior restarts forgiven)",
+                now - self._last_restart_t, self.restarts)
+            self.restarts = 0
+        self._last_restart_t = now
         self.restarts += 1
         self._c_restarts.inc()
         _trace.event("serve/engine_restart", cat="serve",
@@ -245,10 +274,57 @@ class EngineSupervisor:
             self._inner[rid] = self.engine.submit(
                 self._outer[rid].request)
 
+    def abandon(self, reason="fleet failover"):
+        """Fleet failover entry point: mark this supervisor dead WITHOUT
+        driving the (possibly wedged) engine, and reject every
+        outstanding handle typed — ``started=True`` for requests
+        occupying a slot (tokens may already have streamed),
+        ``started=False`` for queued/admitting ones (safe for the
+        fleet to requeue on a sibling — it re-derives the requeue set
+        from the rejected handles' ``started`` flags, so there is ONE
+        mechanism deciding re-runnability, not two).  Idempotent: a
+        supervisor that already died (budget exhausted) is a no-op —
+        its handles are already rejected typed with the same started
+        semantics, so the fleet's requeue scan works identically
+        either way."""
+        if self._dead:
+            return
+        self._dead = True
+        started_ids = self.engine.live_request_ids
+        step = self.engine.step_count
+        n_requeueable = 0
+        for rid in list(self._order):
+            inner = self._inner.pop(rid, None)
+            outer = self._outer.pop(rid, None)
+            if outer is None or outer.done():
+                continue
+            if inner is not None and inner.done():
+                # resolved in the engine but not yet synced: propagate
+                # the real outcome, don't overwrite it with an abandon
+                if inner._error is not None:
+                    outer._reject(inner._error)
+                else:
+                    outer._finish(inner._result)
+                continue
+            started = rid in started_ids
+            outer._reject(EngineFailedError(
+                f"{rid}: supervisor abandoned at step {step} ({reason})",
+                request_id=rid, started=started, engine_step=step))
+            if not started:
+                n_requeueable += 1
+        self._order = []
+        self._inner.clear()
+        self._outer.clear()
+        self._log.warning(
+            "supervisor abandoned (%s): %d never-started requests "
+            "rejected requeue-safe", reason, n_requeueable)
+        _trace.event("serve/supervisor_abandon", cat="serve",
+                     reason=str(reason), requeue=n_requeueable)
+
     # -- lifecycle -------------------------------------------------------
-    def close(self):
+    def close(self, force=False):
         if not self.engine._closed:
-            self.engine.close()
+            self.engine.close(force=force)
 
     def __enter__(self):
         return self

@@ -155,6 +155,74 @@ def test_dist_partial_update_runs_and_learns(dev):
     assert losses[-1] < losses[0], losses
 
 
+def test_dist_partial_update_preserves_gradients_over_cycle(dev):
+    """Gradient preservation over one FULL W-step round-robin cycle:
+    every accumulated gradient is applied exactly once, none scaled,
+    none dropped.  For momentum-free SGD(lr) the conservation law is
+    exact up to float accumulation:
+
+        P_W - P_0 = sum_t dense_delta(P_t) + lr * (Rbar_W - Rbar_0)
+
+    where ``dense_delta(P_t)`` is the single-device full-batch SGD
+    step evaluated at the partial run's OWN parameter trajectory (the
+    synced mean-of-shard-means grad IS the full-batch grad) and
+    ``Rbar`` is the rank-mean accumulator — the delayed-but-never-
+    dropped gradient mass still in flight at the cycle boundary.
+    Strictly stronger than "loss went down" (VERDICT weak #5): a mode
+    that silently rescaled or dropped off-turn gradients would pass
+    the loss test and fail this identity."""
+    x, y = _data(dev, n=32)
+    lr = 0.1
+    W = N_DEV
+
+    m = _make(dev, DistOpt(opt.SGD(lr=lr)),
+              dist_option="partialUpdate", seed=5)
+    # oracle for the per-step dense full-batch delta
+    m_ref = _make(dev, opt.SGD(lr=lr), use_graph=True, seed=5)
+    m_ref.dist = False
+    m_ref._graph_runner.model = m_ref
+
+    m(x, y)   # warm step: eager world-1 semantics, residuals zeroed
+
+    def params_np():
+        return {k: tensor.to_numpy(v).copy()
+                for k, v in m.get_params().items()}
+
+    def residual_mean_np():
+        out = {}
+        for k, t in m.optimizer.state_tensors().items():
+            if k.startswith("__residual__"):
+                out[k[len("__residual__"):]] = \
+                    tensor.to_numpy(t).mean(axis=0)
+        return out
+
+    p0 = params_np()
+    r0 = residual_mean_np()
+    assert set(r0) == set(p0), "residual accumulators missing params"
+    dense_sum = {k: np.zeros_like(v, np.float64) for k, v in p0.items()}
+    for _ in range(W):
+        before = params_np()
+        m_ref.set_params({k: tensor.from_numpy(v, dev)
+                          for k, v in before.items()})
+        m_ref(x, y)
+        for k, v in m_ref.get_params().items():
+            dense_sum[k] += (tensor.to_numpy(v).astype(np.float64)
+                             - before[k])
+        m(x, y)
+    p1 = params_np()
+    r1 = residual_mean_np()
+    for k in sorted(p0):
+        applied = p1[k].astype(np.float64) - p0[k]
+        want = dense_sum[k] + lr * (r1[k].astype(np.float64) - r0[k])
+        np.testing.assert_allclose(applied, want, rtol=5e-3, atol=5e-5,
+                                   err_msg=k)
+    # the identity must be tested with real in-flight mass: at the
+    # cycle boundary at least one accumulator is non-trivial (every
+    # param synced once, but off-turn grads since then accumulated)
+    assert any(np.abs(r1[k]).max() > 1e-8 for k in r1), \
+        "accumulators empty — the residual term tested nothing"
+
+
 def test_dist_sparse_topk_full_density_equals_plain(dev):
     """spars=1.0 topK sparse sync must equal dense all-reduce."""
     x, y = _data(dev, n=32)

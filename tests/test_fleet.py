@@ -1,0 +1,423 @@
+"""Replicated serve fleet: health-checked routing, sticky sessions,
+cross-replica failover with requeue parity, fleet-wide load shedding,
+hedged re-dispatch, and the fleet observability surface.
+
+Deterministic on CPU: faults come from the seeded injection registry,
+routing ties break on replica index, and every parity check compares
+against the single-prompt ``generate`` oracle (requeued/hedged requests
+re-derive the SAME private sampling chain from their seed)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.observe.health import SLO, health_report
+from singa_tpu.observe.registry import registry
+from singa_tpu.resilience import FailAfterN, FailOnce, faults
+from singa_tpu.serve import (EngineFailedError, FleetDownError,
+                             GenerationRequest, LoadShedError,
+                             PrefixCacheConfig, Router, ServeFleet)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+def _workload(n, seed=0, lo=3, hi=10, new_lo=2, new_hi=7):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, 256, rng.randint(lo, hi)).astype(np.int32),
+             int(rng.randint(new_lo, new_hi))) for _ in range(n)]
+
+
+def _oracle(m, work):
+    return [np.asarray(m.generate(p, max_new_tokens=n, temperature=0.0))
+            for p, n in work]
+
+
+def _counter(name, **labels):
+    snap = registry().snapshot()["counters"]
+    key = name
+    if labels:
+        key += "{" + ",".join(f"{k}={v}"
+                              for k, v in sorted(labels.items())) + "}"
+    return snap.get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+def test_fleet_balances_and_streams_match_oracle(model):
+    """Least-loaded routing spreads a burst over both replicas and
+    every stream is token-identical to single-prompt generate."""
+    work = _workload(8, seed=0)
+    base = _oracle(model, work)
+    with model.serve_fleet(replicas=2, max_slots=2) as fleet:
+        hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+              for p, n in work]
+        fleet.run_until_complete(max_steps=500)
+        for h, want in zip(hs, base):
+            assert np.array_equal(h.result().tokens, want)
+        snap = fleet.snapshot()
+        assert snap["replicas"] == 2
+        assert snap["replicas_healthy"] == 2
+        assert snap["failovers"] == 0
+        # queue depth moves at submit time, so a burst alternates
+        assert snap["routed"]["0"] > 0 and snap["routed"]["1"] > 0
+        assert snap["routed"]["0"] + snap["routed"]["1"] == len(work)
+
+
+def test_router_scores_pressure_and_tpot():
+    """Unit-level router policy: queue/occupancy dominate, a slower
+    TPOT EWMA prices a replica out, and a replica past its SLO
+    queue-depth headroom ranks behind every unpressured one."""
+    r = Router()
+    views = [
+        {"replica": 0, "queue_depth": 0, "occupancy": 0.0,
+         "tpot_ewma": 0.3, "queue_headroom": 4},
+        {"replica": 1, "queue_depth": 0, "occupancy": 0.0,
+         "tpot_ewma": 0.1, "queue_headroom": 4},
+    ]
+    assert r.rank(views)[0] == 1  # 3x slower decode loses the tie
+    views[1]["queue_depth"] = 5
+    assert r.rank(views)[0] == 0  # queue depth dominates
+    views[0]["queue_headroom"] = 0  # at SLO pressure: heavy penalty
+    assert r.rank(views)[0] == 1
+    assert r.rank([]) == []
+
+
+def test_sticky_session_stays_replica_local(model):
+    """A pinned session's continuation routes to the replica whose
+    radix tree holds the blocks — the warm hit shows up in that
+    engine's prefix counters."""
+    p = (np.arange(40) % 256).astype(np.int32)
+    cachecfg = PrefixCacheConfig(block_size=8, num_blocks=32)
+    with model.serve_fleet(replicas=2, max_slots=2,
+                           prefix_cache=cachecfg) as fleet:
+        h = fleet.submit(GenerationRequest(p, max_new_tokens=4,
+                                           pin_session=True))
+        fleet.run_until_complete(max_steps=300)
+        sess = h.result().session
+        assert sess is not None
+        idx = fleet._sessions[sess]
+        eng = fleet.supervisor(idx).engine
+        hits0 = eng.prefix_cache._c_hits.value
+        # spread some background load so the sticky target is NOT the
+        # least-loaded choice — stickiness must win anyway
+        extra = [fleet.submit(GenerationRequest(q, max_new_tokens=n))
+                 for q, n in _workload(2, seed=3)]
+        req2 = sess.request(np.asarray([7, 8, 9], np.int32),
+                            max_new_tokens=3)
+        assert req2.session_of is sess
+        h2 = fleet.submit(req2)
+        fleet.run_until_complete(max_steps=300)
+        want = np.asarray(model.generate(req2.prompt_ids,
+                                         max_new_tokens=3,
+                                         temperature=0.0))
+        assert np.array_equal(h2.result().tokens, want)
+        # the continuation ran on the session's replica, warm
+        assert eng.prefix_cache._c_hits.value > hits0
+        for e in extra:
+            e.result()
+        sess.release()
+
+
+# ---------------------------------------------------------------------------
+# failover
+# ---------------------------------------------------------------------------
+
+def test_failover_requeues_never_started_with_parity(model):
+    """A replica dying past its restart budget mid-decode: started
+    requests fail typed, never-started ones requeue onto the survivor
+    and complete token-identical to an uninterrupted run, and the
+    fleet keeps serving."""
+    work = _workload(8, seed=1, new_lo=3)
+    base = _oracle(model, work)
+    fleet = model.serve_fleet(replicas=2, max_slots=1,
+                              restart_budget=0)
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in work]
+    pol = faults.inject("serve.decode_step", FailAfterN(2, times=1))
+    fleet.run_until_complete(max_steps=1000)
+    faults.clear()
+    assert pol.fired == 1
+    completed = typed = 0
+    for h, want in zip(hs, base):
+        assert h.done(), "wedged handle after failover"
+        try:
+            assert np.array_equal(h.result().tokens, want)
+            completed += 1
+        except EngineFailedError:
+            typed += 1
+    snap = fleet.snapshot()
+    assert completed + typed == len(work)
+    assert typed >= 1           # the in-flight request at the fault
+    assert snap["failovers"] == 1
+    assert snap["requeues"] >= 1
+    assert snap["replicas_healthy"] == 1
+    assert fleet.healthy_replicas == 1
+    # service-level availability: the survivor keeps admitting
+    h2 = fleet.submit(GenerationRequest(work[0][0], max_new_tokens=4))
+    fleet.run_until_complete(max_steps=300)
+    want = np.asarray(model.generate(work[0][0], max_new_tokens=4,
+                                     temperature=0.0))
+    assert np.array_equal(h2.result().tokens, want)
+    fleet.close()
+
+
+def test_all_replicas_down_is_typed_not_wedged(model):
+    """Both replicas crash-loop past their budget: every handle
+    resolves typed (zero wedged), pending drains, and new submissions
+    raise FleetDownError."""
+    work = _workload(6, seed=2, new_lo=3)
+    fleet = model.serve_fleet(replicas=2, max_slots=1,
+                              restart_budget=0)
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in work]
+    faults.inject("serve.decode_step", FailAfterN(1, times=2))
+    fleet.run_until_complete(max_steps=1000)
+    faults.clear()
+    assert fleet.healthy_replicas == 0
+    assert not fleet.pending
+    for h in hs:
+        assert h.done()
+        with pytest.raises(EngineFailedError):
+            h.result()
+    with pytest.raises(FleetDownError):
+        fleet.submit(GenerationRequest(work[0][0], max_new_tokens=2))
+    fleet.close()
+
+
+def test_revive_reenters_routing_set(model):
+    """revive() rebuilds a failed replica (fresh budget, empty cache)
+    and the router admits to it again."""
+    fleet = model.serve_fleet(replicas=2, max_slots=1,
+                              restart_budget=0)
+    h0 = fleet.submit(GenerationRequest(
+        np.asarray([1, 2, 3], np.int32), max_new_tokens=6))
+    faults.inject("serve.decode_step", FailAfterN(0, times=1))
+    fleet.run_until_complete(max_steps=500)
+    faults.clear()
+    dead = [r.idx for r in fleet._replicas if not r.healthy]
+    assert len(dead) == 1
+    with pytest.raises(ValueError):
+        fleet.revive(1 - dead[0])   # healthy replica: refuse
+    fleet.revive(dead[0])
+    assert fleet.healthy_replicas == 2
+    del h0
+    routed0 = fleet.snapshot()["routed"][str(dead[0])]
+    # saturate the sibling so the router must pick the revived replica
+    work = _workload(4, seed=4)
+    base = _oracle(model, work)
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in work]
+    fleet.run_until_complete(max_steps=500)
+    for h, want in zip(hs, base):
+        assert np.array_equal(h.result().tokens, want)
+    assert fleet.snapshot()["routed"][str(dead[0])] > routed0
+    fleet.close()
+
+
+def test_watchdog_hang_failover(model, monkeypatch):
+    """A replica whose heartbeat source latched a hang is failed over
+    even though its supervisor never raised: queued work moves to the
+    sibling and completes with parity."""
+    from singa_tpu.serve import fleet as fleet_mod
+
+    work = _workload(4, seed=5)
+    base = _oracle(model, work)
+    fleet = model.serve_fleet(replicas=2, max_slots=1)
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in work]
+    hung_src = fleet.supervisor(0).engine._hb_source
+
+    class _FakeWd:
+        def beat(self, *a, **kw):
+            pass
+
+        def hang_latched(self, source):
+            return source == hung_src
+
+    monkeypatch.setattr(fleet_mod._monitor, "active", lambda: True)
+    monkeypatch.setattr(fleet_mod._monitor, "watchdog",
+                        lambda: _FakeWd())
+    monkeypatch.setattr(fleet_mod._monitor, "heartbeat",
+                        lambda *a, **kw: None)
+    fleet.run_until_complete(max_steps=500)
+    monkeypatch.undo()
+    assert fleet.healthy_replicas == 1
+    assert not fleet._replicas[0].healthy
+    completed = typed = 0
+    for h, want in zip(hs, base):
+        assert h.done()
+        try:
+            assert np.array_equal(h.result().tokens, want)
+            completed += 1
+        except EngineFailedError as e:
+            # only requests that had started may fail typed here
+            assert e.started is True
+            typed += 1
+    assert completed >= 1
+    assert fleet.snapshot()["failovers"] == 1
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# degradation + hedging
+# ---------------------------------------------------------------------------
+
+def test_fleet_wide_shed_lowest_priority(model):
+    """SLO-pressure shedding applied fleet-wide: an arrival is only
+    refused when NO healthy replica holds lower-priority work; a
+    higher-priority arrival evicts the globally cheapest victim."""
+    slo = SLO(queue_depth_max=1)
+    fleet = model.serve_fleet(replicas=2, max_slots=1, slo=slo,
+                              shed_on_slo_pressure=True)
+    p = np.asarray([1, 2, 3], np.int32)
+    # fill both queues to the SLO bound with priority-0 work
+    h_a = fleet.submit(GenerationRequest(p, max_new_tokens=2,
+                                         priority=0))
+    h_b = fleet.submit(GenerationRequest(p, max_new_tokens=2,
+                                         priority=0))
+    # equal priority, every replica at pressure: refused fleet-wide
+    with pytest.raises(LoadShedError):
+        fleet.submit(GenerationRequest(p, max_new_tokens=2, priority=0))
+    # higher priority: sheds a queued priority-0 victim somewhere
+    h_hi = fleet.submit(GenerationRequest(p, max_new_tokens=2,
+                                          priority=5))
+    fleet.run_until_complete(max_steps=300)
+    want = np.asarray(model.generate(p, max_new_tokens=2,
+                                     temperature=0.0))
+    assert np.array_equal(h_hi.result().tokens, want)
+    outcomes = []
+    for h in (h_a, h_b):
+        try:
+            assert np.array_equal(h.result().tokens, want)
+            outcomes.append("ok")
+        except LoadShedError:
+            outcomes.append("shed")
+    assert sorted(outcomes) == ["ok", "shed"]
+    fleet.close()
+
+
+def test_hedge_redispatches_stuck_admission(model):
+    """A request stuck un-started behind one replica's queue for
+    hedge_after_steps re-dispatches to the idle sibling; first
+    completion wins with oracle parity."""
+    work = _workload(3, seed=6, new_lo=4, new_hi=8)
+    base = _oracle(model, work)
+    fleet = model.serve_fleet(replicas=2, max_slots=1,
+                              hedge_after_steps=2)
+    # pin routing to replica 0 so its queue backs up
+    fleet.router.rank = lambda views: sorted(
+        v["replica"] for v in views)
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in work]
+    # admission happens at step time: all three sit in replica 0's queue
+    assert fleet.supervisor(0).engine.scheduler.queue_depth == 3
+    fleet.run_until_complete(max_steps=500)
+    for h, want in zip(hs, base):
+        assert np.array_equal(h.result().tokens, want)
+    snap = fleet.snapshot()
+    assert snap["hedges"] >= 1
+    # hedges land on the sibling, not the loaded replica
+    assert _counter("serve.fleet.hedges", fleet=fleet.fleet_label,
+                    replica="1") >= 1
+    fleet.close()
+
+
+def test_hedge_skips_streaming_and_sessions(model):
+    """on_token / pin_session requests never hedge (a duplicate stream
+    would double tokens at the client; sessions are replica-local)."""
+    fleet = model.serve_fleet(replicas=2, max_slots=1,
+                              hedge_after_steps=1)
+    fleet.router.rank = lambda views: sorted(
+        v["replica"] for v in views)
+    p = np.asarray([4, 5, 6], np.int32)
+    tokens = []
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=3)),
+          fleet.submit(GenerationRequest(
+              p, max_new_tokens=3,
+              on_token=lambda r, t: tokens.append(t))),
+          fleet.submit(GenerationRequest(p, max_new_tokens=3,
+                                         pin_session=True))]
+    fleet.run_until_complete(max_steps=500)
+    for h in hs:
+        h.result()
+    # the streaming request emitted each token exactly once
+    assert len(tokens) == 3
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# fault site + observability surface
+# ---------------------------------------------------------------------------
+
+def test_serve_route_fault_site_is_synchronous_and_typed(model):
+    from singa_tpu.resilience.faults import SITES
+
+    assert "serve.route" in SITES
+    with model.serve_fleet(replicas=2, max_slots=1) as fleet:
+        faults.inject("serve.route", FailOnce())
+        p = np.asarray([1, 2], np.int32)
+        with pytest.raises(Exception) as ei:
+            fleet.submit(GenerationRequest(p, max_new_tokens=2))
+        assert getattr(ei.value, "site", None) == "serve.route"
+        faults.clear()
+        # nothing was accepted: the next submit is clean
+        h = fleet.submit(GenerationRequest(p, max_new_tokens=2))
+        fleet.run_until_complete(max_steps=200)
+        h.result()
+
+
+def test_fleet_metrics_health_report_and_unregister(model):
+    work = _workload(4, seed=7)
+    fleet = model.serve_fleet(replicas=2, max_slots=2)
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in work]
+    fleet.run_until_complete(max_steps=500)
+    for h in hs:
+        h.result()
+    lbl = fleet.fleet_label
+    assert _counter("serve.fleet.routed", fleet=lbl, replica="0") \
+        + _counter("serve.fleet.routed", fleet=lbl, replica="1") \
+        == len(work)
+    rep = health_report(include_registry=False)
+    sec = rep["serve"]["fleet"]
+    assert sec["replicas_healthy"] >= 2
+    assert sec["failovers"] == 0
+    assert sum(sec["routed"].values()) >= len(work)
+    # fleet restart accounting rides the resilience section
+    assert "fleet_failovers" in rep["resilience"]
+    assert "fleet_requeues" in rep["resilience"]
+    snap = fleet.snapshot()
+    assert set(snap) == {"replicas", "replicas_healthy", "failovers",
+                         "requeues", "hedges", "routed", "engines"}
+    assert len(snap["engines"]) == 2
+    fleet.close()
+    gkey = "serve.fleet.replicas_healthy{fleet=%s}" % lbl
+    assert gkey not in registry().snapshot()["gauges"]
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.submit(GenerationRequest(work[0][0], max_new_tokens=2))
+
+
+def test_fleet_validates_config(model):
+    with pytest.raises(ValueError, match="replicas"):
+        ServeFleet(model, replicas=0)
+    with pytest.raises(ValueError, match="hedge_after_steps"):
+        ServeFleet(model, replicas=1, hedge_after_steps=0)
+    with pytest.raises(ValueError, match="budget_reset_after_s"):
+        ServeFleet(model, replicas=1, budget_reset_after_s=-1.0)

@@ -208,9 +208,12 @@ def test_stats_schema_stable():
     assert set(snap["throughput"]) == {
         "tokens_out", "wall_s", "uptime_s", "tokens_per_s",
         "goodput_tokens_per_s", "prefills", "decode_steps"}
-    assert set(snap["latency"]) == {"ttft", "tpot"}
-    for series in snap["latency"].values():
+    assert set(snap["latency"]) == {"ttft", "tpot", "tpot_ewma_s"}
+    for series in (snap["latency"]["ttft"], snap["latency"]["tpot"]):
         assert set(series) == {"count", "mean", "p50", "p99", "max"}
+    # the router's headroom signal: set once a multi-token retire exists
+    assert snap["latency"]["tpot_ewma_s"] == pytest.approx(
+        snap["latency"]["tpot"]["mean"])
     assert set(snap["queue"]) == {"mean_depth", "max_depth"}
     assert set(snap["slots"]) == {"max_slots", "occupancy_mean"}
     assert snap["requests"]["completed"] == 2

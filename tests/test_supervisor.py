@@ -146,6 +146,70 @@ def test_supervisor_restart_budget_exhausts_typed(model):
     assert not sup.pending
 
 
+def test_restart_budget_resets_after_healthy_uptime(model):
+    """``budget_reset_after_s``: a long-lived replica is only
+    condemned by crash-LOOPING.  Failures separated by more healthy
+    uptime than the window forgive the spent budget; failures inside
+    the window still exhaust it (and the default — None — keeps the
+    original consecutive-lifetime accounting)."""
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+        def advance(self, dt):
+            self.t += dt
+
+    clk = FakeClock()
+    sup = EngineSupervisor(model, max_slots=2, restart_budget=1,
+                           budget_reset_after_s=10.0, clock=clk)
+
+    def crash_once_and_drain():
+        h = sup.submit(GenerationRequest(_PROMPTS[0], max_new_tokens=3))
+        faults.inject("serve.decode_step", FailAfterN(0, times=1))
+        sup.run_until_complete(max_steps=200)
+        faults.clear()
+        assert h.done()  # typed (started) or requeued-complete
+
+    # three separate incidents, each past the healthy-uptime window:
+    # budget 1 would die on the second without the reset
+    for _ in range(3):
+        crash_once_and_drain()
+        assert sup.restarts == 1  # reset keeps it at one per incident
+        clk.advance(11.0)
+    # now two failures INSIDE the window: that IS a crash loop
+    crash_once_and_drain()
+    h = sup.submit(GenerationRequest(_PROMPTS[1], max_new_tokens=3))
+    faults.inject("serve.decode_step", FailAfterN(0, times=1))
+    with pytest.raises(RestartBudgetExceededError):
+        sup.run_until_complete(max_steps=200)
+    faults.clear()
+    assert h.done()
+    with pytest.raises(EngineFailedError):
+        h.result()
+
+    # default (None): ancient restarts still count — original contract
+    clk2 = FakeClock()
+    sup2 = EngineSupervisor(model, max_slots=2, restart_budget=1,
+                            clock=clk2)
+    for i in range(2):
+        hi = sup2.submit(GenerationRequest(_PROMPTS[0],
+                                           max_new_tokens=3))
+        faults.inject("serve.decode_step", FailAfterN(0, times=1))
+        if i == 0:
+            sup2.run_until_complete(max_steps=200)
+        else:
+            with pytest.raises(RestartBudgetExceededError):
+                sup2.run_until_complete(max_steps=200)
+        faults.clear()
+        clk2.advance(100.0)  # uptime is irrelevant without the window
+        assert hi.done()
+    with pytest.raises(ValueError, match="budget_reset_after_s"):
+        EngineSupervisor(model, budget_reset_after_s=0)
+
+
 def test_supervisor_clean_run_has_no_restarts(model):
     base = [np.asarray(model.generate(p, max_new_tokens=n,
                                       temperature=0.0))
