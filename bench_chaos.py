@@ -16,6 +16,11 @@ recovery invariants the unit tests assert piecewise:
   typed), token-stream parity against an uninterrupted run for every
   completed request, and ``resilience.engine_restarts`` equal to the
   number of injected decode faults.
+* **fault mid-verify (speculative engine)** — the same decode-site
+  fault against a trained-pair SPECULATIVE engine: the spec step
+  (draft scan + chunk verify + rejection sample) fails typed, not
+  wedged; the rebuilt engine gets fresh target AND draft arenas at
+  zero recompiles and requeued streams keep byte parity.
 * **replica kill + fleet failover** — the same decode fault against a
   ``ServeFleet`` replica with a ZERO restart budget kills that replica
   outright mid-decode; the fleet requeues its never-started work onto
@@ -273,6 +278,106 @@ def chaos_prefix(report):
         f"restarts ({restarts}) != injected copy faults ({injected})"
 
 
+def chaos_spec(report):
+    """A fault mid-verify against a SPECULATIVE engine
+    (``serve.decode_step`` gates the whole spec step: draft scan +
+    chunk verify + rejection sample): the engine fails TYPED, never
+    wedges, the supervisor rebuilds it — fresh target AND draft
+    arenas, every executable a jit cache hit — and requeued
+    never-started requests stream byte-identically to an
+    uninterrupted speculative run (which itself equals the
+    non-speculative oracle)."""
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest)
+
+    def train(cfg, seed, steps=12):
+        device.get_default_device().SetRandSeed(seed)
+        m = GPT2LMHead(cfg)
+        rng = np.random.RandomState(0)
+        motif = rng.randint(0, cfg.vocab_size, 8)
+        ids = np.tile(motif, (4, 4)).astype(np.int32)[:, :32]
+        noise = rng.randint(0, cfg.vocab_size, ids.shape)
+        mask = rng.rand(*ids.shape) < 0.05
+        ids[mask] = noise[mask]
+        labels = np.roll(ids, -1, axis=1).astype(np.int32)
+        m.set_optimizer(opt.Adam(lr=1e-3))
+        m.compile([tensor.from_numpy(ids)], is_train=True,
+                  use_graph=True)
+        for _ in range(steps):
+            m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        m.eval()
+        return m, ids
+
+    target, ids = train(GPT2Config.tiny(dropout=0.0), seed=0)
+    draft, _ = train(GPT2Config.tiny(dropout=0.0, n_layer=1), seed=1,
+                     steps=8)
+
+    rng = np.random.RandomState(5)
+    workload = []
+    for _ in range(10):
+        plen = int(rng.randint(4, 13))
+        row, off = int(rng.randint(0, 4)), int(rng.randint(0, 32 - 13))
+        workload.append((np.asarray(ids[row, off:off + plen], np.int32),
+                         int(rng.randint(3, 9))))
+    base = [np.asarray(target.generate(p, max_new_tokens=n,
+                                       temperature=0.0))
+            for p, n in workload]
+
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = 0
+    accepted = drafted = 0
+    for fail_after in (2, 4):
+        sup = EngineSupervisor(target, max_slots=2, restart_budget=2,
+                               draft_model=draft, spec_k=3)
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.decode_step",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=2000)
+        faults.clear()
+        injected += pol.fired
+        spec = sup.engine.stats.snapshot()["spec"]
+        accepted += spec["accepted"]
+        drafted += spec["drafted"]
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "speculative stream diverged after restart"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve_spec"] = {
+        "requests": 2 * len(workload),
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "decode_faults_injected": injected,
+        "engine_restarts": restarts,
+        "acceptance_rate": accepted / drafted if drafted else None,
+    }
+    assert wedged == 0, f"{wedged} speculative requests wedged/lost"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0 and typed_failed > 0
+    assert restarts == injected > 0, \
+        f"restarts ({restarts}) != injected spec-step faults ({injected})"
+    assert report["serve_spec"]["acceptance_rate"] > 0
+
+
 def chaos_fleet(report):
     """Kill one replica mid-decode (``serve.decode_step`` fault against
     a zero restart budget): the fleet marks it unhealthy, requeues its
@@ -397,6 +502,7 @@ def main():
     chaos_collective(report)
     chaos_serve(report)
     chaos_prefix(report)
+    chaos_spec(report)
     chaos_fleet(report)
 
     health = observe.health_report(include_registry=False)

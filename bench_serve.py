@@ -23,7 +23,14 @@ the bench is invalid if the engine is fast but wrong.
 
 Writes BENCH_SERVE.json (schema: workload/config/engine/static_batch/
 speedup/parity) so future PRs have a serving perf trajectory, and
-prints the same JSON to stdout.  ``--fleet`` additionally replays the
+prints the same JSON to stdout.  ``--spec`` trains a bench-scale
+target/draft pair and measures speculative serve (spec_k=4) against
+the plain engine on the same target — tokens/s, acceptance,
+accepted-tokens/chunk, byte parity, recompile pin (the ``spec``
+section).  ``--cache-int8`` replays the standard workload through an
+int8-KV-arena engine with byte parity against the offline int8 oracle
+(the ``cache_int8`` section; CPU-measured, chip-pending — see
+PERF.md).  ``--fleet`` additionally replays the
 workload through a 2-replica ServeFleet (same total slot count) and
 embeds a ``fleet`` section — routing balance, per-stream parity
 against the engine run, and the jit-cache pin proving replicas share
@@ -64,10 +71,11 @@ def make_workload(n_requests=40, seed=0, n_positions=128):
     return reqs
 
 
-def run_engine(m, workload, max_slots, close_after=False, slo=None):
+def run_engine(m, workload, max_slots, close_after=False, slo=None,
+               **engine_kw):
     from singa_tpu.serve import GenerationRequest
 
-    eng = m.serve(max_slots=max_slots, slo=slo)
+    eng = m.serve(max_slots=max_slots, slo=slo, **engine_kw)
     handles = []
     pending = list(workload)
     t0 = time.perf_counter()
@@ -162,8 +170,9 @@ def _serve_jit_cache_size():
     from singa_tpu.serve import prefix as P
 
     total = 0
-    for f in (E._pool_decode_step, E._prefill_one, E._write_slot,
-              E._chunk_row, E._first_from_hidden, P._blocks_to_row,
+    for f in (E._pool_decode_step, E._pool_spec_step, E._prefill_one,
+              E._prefill_rows, E._write_slot, E._chunk_row,
+              E._first_from_hidden, P._blocks_to_row,
               P._row_to_blocks, P._read_slot):
         try:
             total += f._cache_size()
@@ -254,6 +263,169 @@ def run_prefix_mix(max_slots):
         "recompiles": (None if jit_before is None
                        else jit_after - jit_before),
         "parity": parity,
+    }
+
+
+def _lat(snap):
+    """TTFT/TPOT percentile block out of an EngineStats snapshot."""
+    return {
+        "ttft_p50_s": snap["latency"]["ttft"]["p50"],
+        "ttft_p99_s": snap["latency"]["ttft"]["p99"],
+        "tpot_p50_s": snap["latency"]["tpot"]["p50"],
+        "tpot_p99_s": snap["latency"]["tpot"]["p99"],
+    }
+
+
+def _train_spec_pair(seed=0, steps=60):
+    """A trained bench-scale target (4 layers) + draft (1 layer) on
+    highly-learnable motif data — the examples/gpt2/speculative.py
+    recipe at the serve bench's model dims.  Acceptance is a property
+    of the PAIR, so the spec measurement needs models that actually
+    agree; untrained weights would measure the mechanism at its floor.
+    """
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    rng = np.random.RandomState(seed)
+    common = dict(vocab_size=512, n_positions=128, n_embd=192,
+                  n_head=4, n_inner=384, dropout=0.0, attn_impl="fused")
+    cfg_t = GPT2Config(n_layer=4, **common)
+    cfg_d = GPT2Config(n_layer=1, **common)
+    motif = rng.randint(0, cfg_t.vocab_size, 8)
+    ids = np.tile(motif, (4, 4)).astype(np.int32)[:, :32]
+    noise = rng.randint(0, cfg_t.vocab_size, ids.shape)
+    mask = rng.rand(*ids.shape) < 0.05
+    ids[mask] = noise[mask]
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    models = []
+    for i, cfg in enumerate((cfg_t, cfg_d)):
+        device.get_default_device().SetRandSeed(seed + i)
+        m = GPT2LMHead(cfg)
+        m.set_optimizer(opt.AdamW(lr=1e-3, weight_decay=0.01))
+        m.compile([tensor.from_numpy(ids)], is_train=True,
+                  use_graph=True)
+        for _ in range(steps):
+            m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+        m.eval()
+        models.append(m)
+    return models[0], models[1], ids
+
+
+def make_spec_workload(ids, n_requests=32, seed=4):
+    """The ragged serve workload shape (make_workload), with prompts
+    drawn as windows of the pair's training data so the draft has the
+    agreement speculation monetizes — the serving analogue of shipping
+    a draft distilled on production traffic.  The budget palette skews
+    DECODE-heavy: speculation amortizes target cache reads across
+    accepted tokens, which buys nothing on a 2-token
+    admission-dominated request (the crossover documented in
+    gpt2_decode.generate_speculative) — this workload is the shape the
+    knob exists for, and the baseline runs the identical workload."""
+    rng = np.random.RandomState(seed)
+    R, C = ids.shape
+    reqs = []
+    arrival = 0
+    for _ in range(n_requests):
+        plen = int(rng.randint(4, 21))
+        row = int(rng.randint(0, R))
+        off = int(rng.randint(0, C - plen))
+        prompt = np.asarray(ids[row, off:off + plen], np.int32)
+        n_new = int(rng.choice([8, 16, 32, 48, 64],
+                               p=[0.15, 0.2, 0.25, 0.2, 0.2]))
+        arrival += int(rng.randint(0, 2))
+        reqs.append(dict(prompt=prompt, n_new=n_new,
+                         arrival_step=arrival))
+    return reqs
+
+
+def run_spec(max_slots, spec_k=4):
+    """The --spec measurement: the trained-pair workload through the
+    PLAIN engine (the PR-6 serve path on the same target — the
+    baseline speculation must strictly beat) and through the
+    SPECULATIVE engine at ``spec_k``, with byte parity for every
+    stream (spec == plain == single-prompt oracle) and the jit cache
+    pinned across both timed runs."""
+    target, draft, ids = _train_spec_pair()
+    workload = make_spec_workload(ids)
+    useful = sum(w["n_new"] for w in workload)
+
+    # warmup both engines (compiles)
+    run_engine(target, workload, max_slots, close_after=True)
+    run_engine(target, workload, max_slots, close_after=True,
+               draft_model=draft, spec_k=spec_k)
+
+    jit_before = _serve_jit_cache_size()
+    wall_p, outs_p, snap_p = run_engine(target, workload, max_slots,
+                                        close_after=True)
+    wall_s, outs_s, snap_s = run_engine(target, workload, max_slots,
+                                        close_after=True,
+                                        draft_model=draft,
+                                        spec_k=spec_k)
+    jit_after = _serve_jit_cache_size()
+
+    parity = True
+    for w, a, b in zip(workload, outs_p, outs_s):
+        want = target.generate(w["prompt"], max_new_tokens=w["n_new"],
+                               temperature=0)
+        parity &= bool(np.array_equal(a.tokens, want))
+        parity &= bool(np.array_equal(b.tokens, a.tokens))
+
+    spec = snap_s["spec"]
+    return {
+        "workload": {"requests": len(workload),
+                     "useful_tokens": useful, "seed": 4},
+        "pair": {"target_layers": 4, "draft_layers": 1,
+                 "train_steps": 60},
+        "spec_k": spec_k,
+        "baseline": {"wall_s": wall_p,
+                     "tokens_per_s": useful / wall_p, **_lat(snap_p)},
+        "spec": {"wall_s": wall_s, "tokens_per_s": useful / wall_s,
+                 **_lat(snap_s)},
+        "speedup_tokens_per_s": wall_p / wall_s,
+        "acceptance_rate": spec["acceptance_rate"],
+        "accepted_tokens_per_chunk": spec["tokens_per_chunk"],
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": parity,
+    }
+
+
+def run_int8(m, workload, max_slots, engine_section):
+    """The --cache-int8 measurement: the standard workload through an
+    int8-arena engine, byte parity against the offline int8 oracle for
+    every stream, jit cache pinned.  ``vs_bf16_tokens_per_s`` compares
+    against the report's dense ``engine`` section (same model, same
+    workload) — int8 halves cache BYTES, so the win appears where
+    cache reads bound the loop (chip HBM); on CPU the dequantize
+    arithmetic usually prices it at/below 1.0, which is exactly why
+    the PERF.md row is marked chip-pending."""
+    from singa_tpu.models import gpt2_decode
+
+    run_engine(m, workload, max_slots, close_after=True,
+               cache_dtype="int8")  # warmup
+    jit_before = _serve_jit_cache_size()
+    wall, outs, snap = run_engine(m, workload, max_slots,
+                                  close_after=True, cache_dtype="int8")
+    jit_after = _serve_jit_cache_size()
+
+    parity = True
+    for w, res in zip(workload, outs):
+        want = gpt2_decode.generate(m, w["prompt"],
+                                    max_new_tokens=w["n_new"],
+                                    temperature=0, cache_dtype="int8")
+        parity &= bool(np.array_equal(res.tokens, want))
+
+    useful = sum(w["n_new"] for w in workload)
+    return {
+        "wall_s": wall,
+        "tokens_per_s": useful / wall,
+        **_lat(snap),
+        "vs_bf16_tokens_per_s": ((useful / wall)
+                                 / engine_section["tokens_per_s"]),
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": parity,
+        "chip_pending": True,  # CPU numbers; see PERF.md §9
     }
 
 
@@ -377,6 +549,17 @@ def main():
                          "ServeFleet (same total slots) and embed the "
                          "fleet section (routing balance, parity, "
                          "recompile pin)")
+    ap.add_argument("--spec", action="store_true",
+                    help="also train a target/draft pair and measure "
+                         "speculative serve (spec_k=4) against the "
+                         "plain engine on the same trained target "
+                         "(tokens/s, acceptance, accepted-tokens/"
+                         "chunk, parity, recompile pin)")
+    ap.add_argument("--cache-int8", action="store_true",
+                    help="also run the standard workload through an "
+                         "int8-KV-arena engine (tokens/s, TTFT/TPOT "
+                         "percentiles, parity vs the offline int8 "
+                         "oracle, recompile pin; chip-pending row)")
     args = ap.parse_args()
 
     # active monitoring rides the whole bench: flight recorder + hang
@@ -475,6 +658,17 @@ def main():
         report["prefix_mix"] = run_prefix_mix(max_slots)
         # the prefix engines ran after the health snapshot above;
         # refresh it so serve.prefix counters appear in the report
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.cache_int8:
+        report["cache_int8"] = run_int8(m, workload, max_slots,
+                                        report["engine"])
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.spec:
+        report["spec"] = run_spec(max_slots)
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(
             engine_snapshots=[snap], include_registry=False)

@@ -368,10 +368,14 @@ class GPT2LMHead(model.Model):
         pass through to the engine (``max_slots``, ``max_len``,
         ``dtype``, ``top_k``, ``top_p``, ``scheduler``, ``clock``,
         ``slo`` — declarative latency targets, see
-        ``singa_tpu.observe.SLO`` — and ``prefix_cache`` — a
+        ``singa_tpu.observe.SLO`` — ``prefix_cache`` — a
         ``serve.PrefixCacheConfig`` enabling block-granular radix
-        prefix caching + pinned multi-turn sessions).  See
-        docs/SERVING.md."""
+        prefix caching + pinned multi-turn sessions — and the
+        fast-decode knobs: ``draft_model=`` + ``spec_k=`` for
+        speculative decoding (up to spec_k tokens per step; greedy
+        streams byte-identical to the plain engine, sampled traffic
+        served via rejection sampling) and ``cache_dtype="int8"`` for
+        a quantized KV arena).  See docs/SERVING.md "Fast decode"."""
         from ..serve import InferenceEngine
 
         return InferenceEngine(self, **kw)

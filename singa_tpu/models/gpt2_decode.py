@@ -609,6 +609,110 @@ def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2):
     return _logits(x, params), kc, vc
 
 
+def spec_verify(t_logits, d_probs, props, key, temp, top_p, top_k,
+                use_top_p):
+    """Rejection-sampling chunk verify — the sampled half of
+    speculative decoding (VERDICT missing #4), batched over the chunk's
+    positions (vmap over slots batches it over rows; the serve engine's
+    ``_pool_spec_step`` does exactly that).
+
+    ``t_logits``: (spec_k, V) target logits at positions
+    pos..pos+spec_k-1; ``d_probs``: (spec_k-1, V) post-filter draft
+    distributions the proposals were drawn from; ``props``:
+    (spec_k-1,) proposed tokens; ``temp`` is TRACED (a serve pool mixes
+    greedy and sampled requests in one executable).  Returns
+    ``(out (spec_k,) int32, a_draft int32)``: ``out[:a_draft]`` echo
+    the accepted proposals, ``out[a_draft]`` is the correction token
+    (residual resample, or the bonus draw on a full accept), entries
+    past that are garbage the caller must not emit.  Tokens emitted =
+    ``a_draft + 1``.
+
+    Greedy (``temp <= 0``): accept while ``props[i] ==
+    argmax(t_logits[i])``, emit the target's argmax at the stop
+    position — the deterministic limit of the scheme and byte-identical
+    to sequential target-greedy decode (up to chunk-vs-sequential
+    einsum-order near-ties, same caveat as ``generate_speculative``).
+
+    Sampled: position i's proposal is accepted with probability
+    ``min(1, p_i(x) / q_i(x))`` where p/q are the POST-FILTER
+    (temperature → top-k → top-p, via the shared ``_filter_logits``)
+    target/draft distributions; the first rejection resamples from the
+    normalized residual ``max(0, p_i − q_i)`` and stops; all spec_k−1
+    accepted samples the bonus token from the last position's target
+    distribution (expressed below as the residual against a virtual
+    all-zero q row).  Marginally each emitted token is distributed
+    EXACTLY as direct target sampling — the standard speculative
+    sampling guarantee (Leviathan et al. / Chen et al. 2023) —
+    pinned distributionally by tests/test_spec_serve.py's χ² gate.
+    ``p == q`` makes the residual mass exactly 0; that degenerate case
+    falls back to sampling from p (acceptance was certain anyway, any
+    correction distribution is unreachable in exact arithmetic and p
+    is the safe float-noise answer)."""
+    spec_k, V = t_logits.shape
+    t_logits = t_logits.astype(jnp.float32)
+    # greedy branch: match-against-argmax, emit the target candidates
+    cands = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    match_g = props == cands[:-1]
+    a_greedy = jnp.argmin(jnp.concatenate(
+        [match_g, jnp.zeros((1,), bool)]))
+    # sampled branch: post-filter target distributions per position
+    ts = jnp.maximum(temp, 1e-6)
+    p = jax.nn.softmax(jax.vmap(
+        lambda lg: _filter_logits(lg, ts, top_p, top_k, use_top_p))(
+            t_logits), axis=-1)                          # (spec_k, V)
+    # virtual zero-q last row: its residual max(p-0, 0) IS the last
+    # position's target distribution, so one gather serves both the
+    # mid-chunk rejection resample and the full-accept bonus draw
+    q = jnp.concatenate(
+        [d_probs.astype(jnp.float32), jnp.zeros((1, V), jnp.float32)])
+    k_acc, k_fix = jax.random.split(key)
+    u = jax.random.uniform(k_acc, (spec_k - 1,))
+    p_prop = jnp.take_along_axis(p[:-1], props[:, None], axis=-1)[:, 0]
+    q_prop = jnp.take_along_axis(d_probs.astype(jnp.float32),
+                                 props[:, None], axis=-1)[:, 0]
+    # u < p/q without the division: q == 0 accepts iff p > 0 (the
+    # ratio's limit), and p >= q accepts always (u < 1 <= p/q)
+    accept = u * q_prop < p_prop
+    a_sampled = jnp.argmin(jnp.concatenate(
+        [accept, jnp.zeros((1,), bool)]))
+    res = jnp.maximum(p[a_sampled] - q[a_sampled], 0.0)
+    mass = jnp.sum(res)
+    res = jnp.where(mass > 0.0, res / jnp.maximum(mass, 1e-38),
+                    p[a_sampled])
+    fix = jax.random.categorical(
+        k_fix, jnp.log(jnp.maximum(res, 1e-38))).astype(jnp.int32)
+    out_s = jnp.concatenate([props, jnp.zeros((1,), jnp.int32)])
+    out_s = out_s.at[a_sampled].set(fix)
+    greedy = temp <= 0.0
+    out = jnp.where(greedy, cands, out_s)
+    a_draft = jnp.where(greedy, a_greedy, a_sampled)
+    return out, a_draft.astype(jnp.int32)
+
+
+def _filter_logits(logit, temperature, top_p, top_k, use_top_p):
+    """Temperature + top-k + top-p (nucleus) filtered f32 logits —
+    exactly the tensor ``_sample(greedy=False)`` hands to
+    ``jax.random.categorical``, factored out so the speculative
+    rejection-sampling verify (:func:`spec_verify`) scores the SAME
+    post-filter distribution the direct sampler draws from (any drift
+    here is a silent distribution bug, so the code exists once)."""
+    logit = logit.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jax.lax.top_k(logit, top_k)[0][-1]
+        logit = jnp.where(logit < kth, NEG_INF, logit)
+    if use_top_p:
+        order = jnp.argsort(-logit)
+        sp = jax.nn.softmax(logit[order])
+        cum = jnp.cumsum(sp)
+        # smallest prefix with mass >= top_p: drop tokens whose
+        # *preceding* cumulative mass already reached it (the top-1
+        # token is always kept)
+        keep_sorted = (cum - sp) < top_p
+        keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+        logit = jnp.where(keep, logit, NEG_INF)
+    return logit
+
+
 def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p,
             min_p=1.0, use_min_p=False, rep_mask=None, rep_penalty=1.0):
     """One token from a (V,) logit row.  ``greedy``/``top_k``/
@@ -629,20 +733,7 @@ def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p,
         logit = jnp.where(rep_mask, pen, logit)
     if greedy:
         return jnp.argmax(logit).astype(jnp.int32)
-    logit = logit / temperature
-    if top_k:
-        kth = jax.lax.top_k(logit, top_k)[0][-1]
-        logit = jnp.where(logit < kth, NEG_INF, logit)
-    if use_top_p:
-        order = jnp.argsort(-logit)
-        sp = jax.nn.softmax(logit[order])
-        cum = jnp.cumsum(sp)
-        # smallest prefix with mass >= top_p: drop tokens whose
-        # *preceding* cumulative mass already reached it (the top-1
-        # token is always kept)
-        keep_sorted = (cum - sp) < top_p
-        keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
-        logit = jnp.where(keep, logit, NEG_INF)
+    logit = _filter_logits(logit, temperature, top_p, top_k, use_top_p)
     if use_min_p:
         # keep p >= min_p·p_max  ⇔  logit >= max + ln(min_p)
         logit = jnp.where(logit < jnp.max(logit) + jnp.log(min_p),
@@ -1261,7 +1352,32 @@ def generate_speculative(target, draft, prompt_ids, max_new_tokens=20,
     verify read amortized over ``a`` accepted positions beats ``a``
     sequential target steps whenever the draft is cheap and agrees
     often (acceptance is a property of the MODEL PAIR and data, not
-    of this mechanism).  Takes one 1-D prompt (returns one array) or
+    of this mechanism).
+
+    Speculation-vs-unroll crossover (when each pays): the sequential
+    path already amortizes loop overhead with ``unroll=4`` (+76%
+    measured, PERF.md §8), so speculation must beat the UNROLLED
+    baseline, not the naive one.  Per emitted token the speculative
+    loop costs ``spec_k · c_draft + c_verify(spec_k)`` per ``a``
+    emitted tokens (``a = 1 + acceptance·(spec_k−1)`` expected), vs
+    one unrolled target step; with a draft ``r×`` cheaper than the
+    target and the chunk verify ≈ one target step on a
+    cache-read-bound loop, speculation wins when
+    ``(spec_k/r + 1) / a < 1`` — e.g. at ``spec_k=4``, ``r≈8``
+    (the 1-vs-2-layer demo pair is ~2×; production drafts are
+    8–20×), break-even sits near acceptance ≈ 0.17 and the measured
+    3.92 tokens/chunk at acceptance ≈ 0.97 is a ~2.6× bound.  Low
+    acceptance (< ~0.3 at spec_k=4) or an expensive draft (r < 2)
+    means the unrolled sequential loop is the faster choice; raising
+    spec_k helps only while acceptance stays high (expected emitted
+    tokens saturate at ``1/(1−acceptance)``).  The serve engine
+    exposes the same trade via ``model.serve(draft_model=,
+    spec_k=)``, where per-engine ``serve.spec.{accepted,drafted}``
+    metrics measure the realized acceptance on live traffic; sampled
+    (temperature/top-p) speculation lives there too, via
+    :func:`spec_verify` — this offline entry is greedy-only.
+
+    Takes one 1-D prompt (returns one array) or
     a list/2-D batch, possibly ragged (returns a list): rows accept
     at different rates, so each runs its own vmapped chunk loop
     until every row finishes — per-row cache scatters like the

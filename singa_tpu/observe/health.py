@@ -115,6 +115,24 @@ def _prefix_section(snap: dict) -> dict:
     }
 
 
+def _spec_section(snap: dict) -> dict:
+    """The ``serve.spec`` health section: speculative-decoding
+    acceptance counters summed across engines (zeros when no engine
+    ever ran a draft — always present so dashboards can alert
+    unconditionally).  ``acceptance_rate`` is accepted / drafted, the
+    realized fraction of draft proposals the target verify kept — the
+    number that decides whether speculation is still paying on live
+    traffic."""
+    counters = snap["counters"]
+    acc = _sum_metric(counters, "serve.spec.accepted")
+    drafted = _sum_metric(counters, "serve.spec.drafted")
+    return {
+        "accepted": acc,
+        "drafted": drafted,
+        "acceptance_rate": (acc / drafted) if drafted else 0.0,
+    }
+
+
 def _fleet_section(snap: dict) -> dict:
     """The ``serve.fleet`` health section: replicated-serve routing and
     failover counters summed across fleets (zeros when no fleet ever
@@ -240,6 +258,7 @@ def health_report(reg=None, engine_snapshots=(),
                 if engine_snapshots else None),
             "slo_violations": _slo_violations(snap["counters"]),
             "prefix": _prefix_section(snap),
+            "spec": _spec_section(snap),
             "fleet": _fleet_section(snap),
         },
         "resilience": _resilience_section(snap["counters"]),

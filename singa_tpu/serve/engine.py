@@ -31,10 +31,34 @@ pool-wide steps while the engine pays ~``Σ new_tokens / max_slots`` —
 the gap is the per-batch straggler tail plus the slots that sat idle
 behind it (bench_serve.py measures it on a ragged workload).
 
-v1 scope: dense/GQA/MoE models (everything _advance_one supports with
-a position-indexed dense cache).  Sliding-window models (rolling cache
-slot arithmetic) and int8 cache arenas are rejected with
-NotImplementedError; repetition_penalty/min_p are offline-only knobs.
+Fast decode (perf round): the offline path's measured decode wins now
+run inside the engine too —
+
+* **int8 KV arenas** (``cache_dtype="int8"``): the pool arena stores
+  (int8 values, f32 per-(token, head) scales) tuples, halving cache
+  bytes on a cache-read-bound loop; every executable is shape-agnostic
+  between dense and quantized arenas (pytree-mapped), and engine
+  streams are byte-identical to offline ``generate(...,
+  cache_dtype="int8")``;
+* **speculative decoding** (``draft_model=``, ``spec_k=``): each
+  ``step()`` runs spec_k sequential DRAFT decode steps and ONE target
+  chunk verify (``_advance_chunk`` — a single cache read serves spec_k
+  positions), emitting up to spec_k tokens per step.  Greedy requests
+  accept by argmax match (byte-identical streams to non-speculative
+  serve, same near-tie caveat as ``generate_speculative``); sampled
+  requests go through rejection sampling (``gpt2_decode.spec_verify``:
+  accept with min(1, p/q), resample the residual) so every emitted
+  token is distributed exactly as direct target sampling.  Multi-token
+  steps change the downstream accounting: retire fires per TOKEN
+  (budget/stop mid-chunk), ``on_token`` streams per accepted token,
+  and TPOT becomes tokens-per-step aware (stats.py).
+
+Scope: dense/GQA/MoE models (everything _advance_one supports with a
+position-indexed dense cache).  Sliding-window models (rolling cache
+slot arithmetic) are rejected with NotImplementedError, as is the
+int8-arena + prefix-cache combination (the block pool would need a
+second pool for the scale tensors); repetition_penalty/min_p are
+offline-only knobs.
 """
 
 from __future__ import annotations
@@ -47,9 +71,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.gpt2_decode import (_logits, _norm_window, _sample,
-                                  decode_step, extract_params, prefill,
-                                  prefill_chunk)
+from ..models.gpt2_decode import (_advance_chunk, _advance_one,
+                                  _filter_logits, _logits, _norm_window,
+                                  _quant_flag, _sample, decode_step,
+                                  extract_params, prefill, prefill_chunk,
+                                  spec_verify)
 from ..observe import monitor as _monitor
 from ..observe import trace as _trace
 from ..resilience import faults as _faults
@@ -93,17 +119,21 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
     new_keys)."""
 
     def row(kc_r, vc_r, tok, pos_r, live_r, key, temp):
-        # kc_r/vc_r: (L, H_kv, max_len, D) — one slot's cache rows
+        # kc_r/vc_r: (L, H_kv, max_len, D) — one slot's cache rows.
+        # int8 arenas are (values, scales) pytrees, so the batch-axis
+        # insert/strip is tree-mapped rather than indexed
         p_c = jnp.where(live_r, pos_r, 0)
         t_c = jnp.where(live_r, tok, 0)
         x = (params["wte"][t_c] + params["wpe"][p_c])[None, None, :]
         logits, kc2, vc2 = decode_step(
-            params, x, kc_r[:, None], vc_r[:, None], p_c, n_head, eps,
+            params, x, jax.tree.map(lambda a: a[:, None], kc_r),
+            jax.tree.map(lambda a: a[:, None], vc_r), p_c, n_head, eps,
             moe_top_k=moe_top_k)
         ks = jax.random.split(key)
         nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
                              use_top_p)
-        return nxt, kc2[:, 0], vc2[:, 0], ks[1]
+        return (nxt, jax.tree.map(lambda a: a[:, 0], kc2),
+                jax.tree.map(lambda a: a[:, 0], vc2), ks[1])
 
     return jax.vmap(row, in_axes=(1, 1, 0, 0, 0, 0, 0),
                     out_axes=(0, 1, 1, 0))(kc, vc, toks, pos, live,
@@ -112,22 +142,36 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
 
 @partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "top_k",
-                          "use_top_p"))
+                          "use_top_p", "quant"))
 def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
-                 eps, moe_top_k, top_k, use_top_p):
+                 eps, moe_top_k, top_k, use_top_p, quant=False):
     """Admission prefill for ONE request: ids (1, max_len)
     right-padded.  Returns (first token, carried key, kc_row, vc_row)
     with cache rows (L, 1, H_kv, max_len, D) ready to write into the
-    arena.  ``prompt_len`` is traced, so every admission reuses one
+    arena ((values, scales) tuples when ``quant`` — the int8 arena
+    mode).  ``prompt_len`` is traced, so every admission reuses one
     executable regardless of prompt length."""
     hidden, kc, vc = prefill(params, ids, n_head, eps,
-                             moe_top_k=moe_top_k)
+                             moe_top_k=moe_top_k, quant_cache=quant)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)      # (1, E)
     logit0 = _logits(last_h[:, None, :], params)[0, 0]       # (V,)
     ks = jax.random.split(key)
     tok0 = _select_sample(logit0, ks[0], temp, top_k, top_p, use_top_p)
     return tok0, ks[1], kc, vc
+
+
+@partial(jax.jit,
+         static_argnames=("n_head", "eps", "moe_top_k", "quant"))
+def _prefill_rows(params, ids, n_head, eps, moe_top_k, quant=False):
+    """DRAFT-side admission prefill: cache rows only, no sampling (the
+    draft first proposes from the next spec step's state; the
+    admission token is always the TARGET's, sampled by ``_prefill_one``
+    / the warm path — which is what keeps spec admission tokens
+    byte-identical to non-speculative admission)."""
+    _, kc, vc = prefill(params, ids, n_head, eps, moe_top_k=moe_top_k,
+                        quant_cache=quant)
+    return kc, vc
 
 
 @partial(jax.jit,
@@ -167,15 +211,100 @@ def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
     return tok0, ks[1]
 
 
+@partial(jax.jit,
+         static_argnames=("spec_k", "tn", "te", "tm", "dn", "de", "dm",
+                          "top_k", "use_top_p"),
+         donate_argnums=(2, 3, 4, 5))
+def _pool_spec_step(t_params, d_params, kc, vc, dkc, dvc, toks, pos,
+                    live, keys, temps, top_p, spec_k, tn, te, tm,
+                    dn, de, dm, top_k, use_top_p):
+    """Advance EVERY slot one speculative chunk.  Per slot: ``spec_k``
+    sequential DRAFT decode steps propose ``spec_k - 1`` tokens (the
+    extra step processes the last proposal as an input so a
+    full-accept chunk leaves the draft cache a valid row ahead — the
+    same trick as the offline ``_spec_row``), then ONE target chunk
+    advance (``_advance_chunk`` — a single cache read serves all
+    ``spec_k`` positions), then :func:`~singa_tpu.models.gpt2_decode.
+    spec_verify` decides the accept count: greedy match for
+    ``temp <= 0`` rows, rejection sampling with residual resample for
+    sampled rows — both in the SAME executable (temp is traced, like
+    ``_select_sample``).
+
+    Arenas (target AND draft) are donated and update in place; dead
+    slots run the same math on clamped inputs, their rows are garbage
+    the next admission's full-row write overwrites, and rows a
+    REJECTED proposal wrote past the accept point are overwritten by
+    the next chunk's contiguous write before the position mask can
+    ever read them live (the free-rollback argument from
+    gpt2_decode._spec_row).  Returns ``(out (S, spec_k) candidate
+    tokens, a_draft (S,) accepted-proposal counts, kc, vc, dkc, dvc,
+    new_keys)`` — the host emits ``a_draft + 1`` tokens per live slot
+    (capped by the request's remaining budget)."""
+
+    def row(kc_r, vc_r, dkc_r, dvc_r, tok, pos_r, live_r, key, temp):
+        p_c = jnp.where(live_r, pos_r, 0)
+        t_c = jnp.where(live_r, tok, 0)
+
+        def batch(c):
+            return jax.tree.map(lambda a: a[:, None], c)
+
+        def unbatch(c):
+            return jax.tree.map(lambda a: a[:, 0], c)
+
+        k_draft, k_verify, k_next = jax.random.split(key, 3)
+        ts = jnp.maximum(temp, 1e-6)
+
+        def dstep(c, k):
+            dkc_b, dvc_b, tok_, dpos = c
+            x = (d_params["wte"][tok_] + d_params["wpe"][dpos])[None, None]
+            lg, dkc_b, dvc_b = _advance_one(d_params, x, dkc_b, dvc_b,
+                                            dpos, dn, de, moe_top_k=dm)
+            # post-filter draft distribution (the q of the accept
+            # ratio) AND the proposal drawn from it — the identical
+            # filter chain _sample uses, via the shared helper
+            fl = _filter_logits(lg[0], ts, top_p, top_k, use_top_p)
+            nxt_s = jax.random.categorical(k, fl).astype(jnp.int32)
+            nxt_g = jnp.argmax(lg[0]).astype(jnp.int32)
+            nxt = jnp.where(temp <= 0.0, nxt_g, nxt_s)
+            return ((dkc_b, dvc_b, nxt, dpos + 1),
+                    (nxt, jax.nn.softmax(fl)))
+
+        dkeys = jax.random.split(k_draft, spec_k)
+        (dkc_b, dvc_b, _, _), (props_all, q_all) = jax.lax.scan(
+            dstep, (batch(dkc_r), batch(dvc_r), t_c, p_c), dkeys)
+        props = props_all[:-1]                      # (spec_k - 1,)
+        d_probs = q_all[:-1]                        # (spec_k - 1, V)
+
+        chunk_toks = jnp.concatenate([t_c[None], props])
+        xs = (jnp.take(t_params["wte"], chunk_toks, axis=0)
+              + jnp.take(t_params["wpe"],
+                         p_c + jnp.arange(spec_k), axis=0))[None]
+        lg, kc2, vc2 = _advance_chunk(t_params, xs, batch(kc_r),
+                                      batch(vc_r), p_c, tn, te,
+                                      moe_top_k=tm)
+        out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
+                                   temp, top_p, top_k, use_top_p)
+        return (out, a_draft, unbatch(kc2), unbatch(vc2),
+                unbatch(dkc_b), unbatch(dvc_b), k_next)
+
+    return jax.vmap(row, in_axes=(1, 1, 1, 1, 0, 0, 0, 0, 0),
+                    out_axes=(0, 0, 1, 1, 1, 1, 0))(
+        kc, vc, dkc, dvc, toks, pos, live, keys, temps)
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _write_slot(kc_arena, vc_arena, kc_row, vc_row, slot):
     """Install an admitted request's prefilled cache rows at ``slot``
-    (traced index — one executable for every slot)."""
-    kc_arena = jax.lax.dynamic_update_slice(
-        kc_arena, kc_row, (0, slot, 0, 0, 0))
-    vc_arena = jax.lax.dynamic_update_slice(
-        vc_arena, vc_row, (0, slot, 0, 0, 0))
-    return kc_arena, vc_arena
+    (traced index — one executable for every slot).  Arenas/rows are
+    pytrees: dense arrays, or (values, scales) tuples for int8 arenas
+    — the scales leaf lacks the trailing D axis, so the start index is
+    sized per leaf."""
+    def wr(arena, row):
+        start = (0, slot) + (0,) * (arena.ndim - 2)
+        return jax.lax.dynamic_update_slice(arena, row, start)
+
+    return (jax.tree.map(wr, kc_arena, kc_row),
+            jax.tree.map(wr, vc_arena, vc_row))
 
 
 class _Slot:
@@ -213,11 +342,23 @@ class InferenceEngine:
     ``clock`` is injectable for deterministic scheduling tests.
     ``slo``: optional :class:`~singa_tpu.observe.health.SLO` — retires
     and scheduling passes are checked against it (see
-    ``EngineStats``/docs/SERVING.md)."""
+    ``EngineStats``/docs/SERVING.md).
+
+    Fast-decode knobs (docs/SERVING.md "Fast decode"):
+    ``cache_dtype="int8"`` quantizes the KV arena (~2× less cache
+    traffic, streams byte-identical to offline int8 generate);
+    ``draft_model=`` + ``spec_k=`` turn on speculative decoding — up
+    to ``spec_k`` tokens per step, greedy streams byte-identical to
+    the non-speculative engine, sampled traffic served through
+    rejection sampling.  Incompatible combinations (vocab/position
+    mismatch, sliding-window draft, int8 + prefix cache) are rejected
+    with typed errors at construction, never inside a jitted
+    dispatch."""
 
     def __init__(self, model, max_slots=8, max_len=None, dtype=None,
                  scheduler=None, top_k=0, top_p=None,
-                 clock=time.monotonic, slo=None, prefix_cache=None):
+                 clock=time.monotonic, slo=None, prefix_cache=None,
+                 draft_model=None, spec_k=None, cache_dtype=None):
         cfg = model.cfg
         if _norm_window(cfg) is not None:
             raise NotImplementedError(
@@ -241,9 +382,45 @@ class InferenceEngine:
         self._top_k = min(int(top_k or 0), cfg.vocab_size)
         self._top_p = jnp.float32(1.0 if top_p is None else top_p)
         self._use_top_p = top_p is not None
+        # -- fast-decode config (speculative + int8 KV, perf round) --
+        # every incompatible combination is rejected HERE with a typed
+        # error naming the conflict, never deep inside a jitted
+        # dispatch where the failure surfaces as a shape/dtype trace
+        self._quant = _quant_flag(cache_dtype)   # bool; rejects typos
+        self.cache_dtype = cache_dtype
+        if spec_k is not None and draft_model is None:
+            raise ValueError(
+                f"spec_k={spec_k} without draft_model: speculative "
+                "decoding needs a draft to propose; pass draft_model= "
+                "(or drop spec_k)")
+        self.draft = draft_model
+        self.spec_k = 4 if spec_k is None else int(spec_k)
+        if draft_model is not None:
+            dcfg = draft_model.cfg
+            if self.spec_k < 2:
+                raise ValueError(
+                    f"spec_k must be >= 2, got {self.spec_k} (one "
+                    "proposal + the bonus token is the smallest chunk)")
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft/target vocab mismatch: draft "
+                    f"{dcfg.vocab_size} vs target {cfg.vocab_size} — "
+                    "the draft must propose from the target's token "
+                    "space")
+            if dcfg.n_positions < self.max_len:
+                raise ValueError(
+                    f"draft n_positions ({dcfg.n_positions}) < engine "
+                    f"max_len ({self.max_len}): the draft cache must "
+                    "cover every arena position the target can reach")
+            if _norm_window(dcfg) is not None:
+                raise NotImplementedError(
+                    "speculative serve does not support sliding-window "
+                    f"drafts (attn_window={dcfg.attn_window}); same "
+                    "rolling-cache restriction as the target")
         self._clock = clock
         self.scheduler = scheduler or FIFOScheduler()
-        self.stats = EngineStats(self.max_slots, clock, slo=slo)
+        self.stats = EngineStats(self.max_slots, clock, slo=slo,
+                                 spec=draft_model is not None)
         # per-ENGINE watchdog source: with a shared "serve" source a
         # wedged engine would be masked as long as any sibling engine
         # kept beating (per-tenant engines are a supported pattern)
@@ -257,13 +434,38 @@ class InferenceEngine:
             moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
             top_k=self._top_k, use_top_p=self._use_top_p)
         # fixed-shape KV arena keyed on (max_slots, max_len): L layers,
-        # H_kv heads (GQA keeps the narrow cache), compute dtype
+        # H_kv heads (GQA keeps the narrow cache), compute dtype —
+        # or (int8 values, f32 scales) tuples for cache_dtype="int8"
+        # (half the bytes per element on a cache-read-bound loop; the
+        # same (values, scales) layout gpt2_decode._quantize_kv makes)
         L, S, W = cfg.n_layer, self.max_slots, self.max_len
         H_kv = cfg.n_kv_head
         D = cfg.n_embd // cfg.n_head
         cdt = self._params["wte"].dtype
-        self._kc = jnp.zeros((L, S, H_kv, W, D), cdt)
-        self._vc = jnp.zeros((L, S, H_kv, W, D), cdt)
+
+        def _arena(L_, H_, D_):
+            if self._quant:
+                return (jnp.zeros((L_, S, H_, W, D_), jnp.int8),
+                        jnp.zeros((L_, S, H_, W), jnp.float32))
+            return jnp.zeros((L_, S, H_, W, D_), cdt)
+
+        self._kc = _arena(L, H_kv, D)
+        self._vc = _arena(L, H_kv, D)
+        # draft-side state (speculative decoding): its own params and
+        # its own (cheap) KV arena, advanced in lockstep by the spec
+        # pool step
+        self._d_params = self._d_statics = None
+        self._dkc = self._dvc = None
+        if self.draft is not None:
+            self.draft.eval()
+            self._d_params = extract_params(self.draft, dtype=dtype)
+            dcfg = self.draft.cfg
+            self._d_statics = (dcfg.n_head, float(dcfg.layer_norm_eps),
+                               int(getattr(dcfg, "moe_top_k", 2) or 2))
+            self._dkc = _arena(dcfg.n_layer, dcfg.n_kv_head,
+                               dcfg.n_embd // dcfg.n_head)
+            self._dvc = _arena(dcfg.n_layer, dcfg.n_kv_head,
+                               dcfg.n_embd // dcfg.n_head)
         # per-slot host state + device sampling keys
         self._slots = [None] * S            # _Slot or None
         self._toks = np.zeros(S, np.int32)  # last emitted token
@@ -292,6 +494,13 @@ class InferenceEngine:
                 raise ValueError(
                     f"prefix_cache must be a PrefixCacheConfig, a "
                     f"kwargs dict, or True, got {type(prefix_cache)}")
+            if self._quant:
+                raise NotImplementedError(
+                    "cache_dtype='int8' + prefix_cache: the block pool "
+                    "stores dense K/V rows only; an int8 arena's "
+                    "per-(token, head) scale tensors would have to "
+                    "ride the block pool as a second gather/scatter "
+                    "pool — not implemented, disable one of the two")
             if self.max_len % prefix_cache.block_size != 0:
                 raise ValueError(
                     f"max_len ({self.max_len}) must be a multiple of "
@@ -320,12 +529,13 @@ class InferenceEngine:
             except (TypeError, ValueError):
                 pass
         self._log.info(
-            "engine up: slots=%d max_len=%d arena=%s x2 (%s) "
-            "prefix_cache=%s",
-            S, W, self._kc.shape, cdt,
+            "engine up: slots=%d max_len=%d cache_dtype=%s "
+            "prefix_cache=%s spec=%s",
+            S, W, cache_dtype or str(cdt),
             "off" if self.prefix_cache is None else
             f"{self.prefix_cache.num_blocks}x"
-            f"{self.prefix_cache.block_size}")
+            f"{self.prefix_cache.block_size}",
+            "off" if self.draft is None else f"k={self.spec_k}")
 
     # -- submission ------------------------------------------------------
     def submit(self, request) -> RequestHandle:
@@ -342,12 +552,20 @@ class InferenceEngine:
         if not isinstance(request, GenerationRequest):
             request = GenerationRequest(np.asarray(request))
         need = len(request.prompt_ids) + request.max_new_tokens
-        if need > self.max_len:
+        spec_pad = 0 if self.draft is None else self.spec_k - 1
+        if need + spec_pad > self.max_len:
+            # speculative engines reserve spec_k - 1 positions of
+            # verify-chunk headroom past the last emitted token (the
+            # same rule as generate_speculative) — checked HERE so the
+            # failure is a submit-time ValueError, not a clipped
+            # dynamic_update_slice corrupting a neighbor's rows
             raise ValueError(
                 f"prompt ({len(request.prompt_ids)}) + max_new_tokens "
-                f"({request.max_new_tokens}) exceeds the engine arena "
-                f"max_len ({self.max_len}); use the offline windowed "
-                f"GPT2LMHead.generate for over-length generations")
+                f"({request.max_new_tokens})"
+                + (f" + spec_k-1 ({spec_pad})" if spec_pad else "")
+                + f" exceeds the engine arena max_len ({self.max_len});"
+                f" use the offline windowed GPT2LMHead.generate for "
+                f"over-length generations")
         if request.request_id in self._handles:
             # an in-flight duplicate would orphan the earlier handle
             # (the id is the engine's completion-routing key); finished
@@ -394,7 +612,8 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             self.prefix_cache.unregister()
         self._kc = self._vc = None
-        self._params = None
+        self._dkc = self._dvc = None
+        self._params = self._d_params = None
         self._closed = True
 
     def __enter__(self):
@@ -412,7 +631,8 @@ class InferenceEngine:
             if self.prefix_cache is not None:
                 self.prefix_cache.unregister()
             self._kc = self._vc = None
-            self._params = None
+            self._dkc = self._dvc = None
+            self._params = self._d_params = None
             self._closed = True
         return False
 
@@ -564,8 +784,11 @@ class InferenceEngine:
     def _decode_once(self):
         if _faults._armed:
             # chaos hook: a fault here is exactly a raising pool decode
-            # — step() fails the engine typed and the supervisor
-            # rebuilds; disarmed this is one module-flag read per step
+            # (speculative mode included — the draft scan, the chunk
+            # verify, and the rejection sample all sit behind this one
+            # dispatch) — step() fails the engine typed and the
+            # supervisor rebuilds; disarmed this is one module-flag
+            # read per step
             _faults.check("serve.decode_step")
         live = np.asarray([s is not None for s in self._slots])
         n_live = int(live.sum())
@@ -574,14 +797,35 @@ class InferenceEngine:
         # so the fed step time is real device time
         _mon = _monitor.active()
         _hb_t0 = time.perf_counter() if _mon else 0.0
-        with _trace.span("serve/decode_step", cat="serve",
-                         step=self.step_count, live=n_live):
-            next_toks, self._kc, self._vc, self._keys = _pool_decode_step(
-                self._params, self._kc, self._vc,
-                jnp.asarray(self._toks), jnp.asarray(self._pos),
-                jnp.asarray(live), self._keys,
-                jnp.asarray(self._temps), self._top_p, **self._statics)
-            next_toks = np.asarray(next_toks)
+        a_draft = None
+        if self.draft is not None:
+            tn, te, tm = (self._statics["n_head"], self._statics["eps"],
+                          self._statics["moe_top_k"])
+            with _trace.span("serve/spec_step", cat="serve",
+                             step=self.step_count, live=n_live):
+                (out, a_draft, self._kc, self._vc, self._dkc,
+                 self._dvc, self._keys) = _pool_spec_step(
+                    self._params, self._d_params, self._kc, self._vc,
+                    self._dkc, self._dvc, jnp.asarray(self._toks),
+                    jnp.asarray(self._pos), jnp.asarray(live),
+                    self._keys, jnp.asarray(self._temps), self._top_p,
+                    spec_k=self.spec_k, tn=tn, te=te, tm=tm,
+                    dn=self._d_statics[0], de=self._d_statics[1],
+                    dm=self._d_statics[2], top_k=self._top_k,
+                    use_top_p=self._use_top_p)
+                out = np.asarray(out)
+                a_draft = np.asarray(a_draft)
+        else:
+            with _trace.span("serve/decode_step", cat="serve",
+                             step=self.step_count, live=n_live):
+                next_toks, self._kc, self._vc, self._keys = \
+                    _pool_decode_step(
+                        self._params, self._kc, self._vc,
+                        jnp.asarray(self._toks), jnp.asarray(self._pos),
+                        jnp.asarray(live), self._keys,
+                        jnp.asarray(self._temps), self._top_p,
+                        **self._statics)
+                next_toks = np.asarray(next_toks)
         if _mon:
             _monitor.heartbeat(
                 self._hb_source,
@@ -592,9 +836,28 @@ class InferenceEngine:
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
-            self._emit(i, slot, int(next_toks[i]), t_emit)
-            self._toks[i] = next_toks[i]
-            self._pos[i] += 1
+            if a_draft is None:
+                self._emit(i, slot, int(next_toks[i]), t_emit)
+                self._toks[i] = next_toks[i]
+                self._pos[i] += 1
+                continue
+            # speculative: up to a_draft[i] + 1 accepted tokens this
+            # step.  Emission stops mid-chunk the moment the request
+            # retires (budget hit, stop token) or rejects (raising
+            # on_token) — tokens past that point are discarded, and
+            # their cache rows are dead weight the next admission's
+            # full-row write replaces
+            a = int(a_draft[i]) + 1
+            self.stats.on_spec(int(a_draft[i]), self.spec_k - 1)
+            emitted = 0
+            for j in range(a):
+                self._emit(i, slot, int(out[i, j]), t_emit)
+                emitted += 1
+                if self._slots[i] is not slot:
+                    break
+            if self._slots[i] is slot:
+                self._toks[i] = int(out[i, emitted - 1])
+                self._pos[i] += emitted
 
     def _emit(self, idx, slot, token, now):
         slot.emitted.append(token)
@@ -622,10 +885,16 @@ class InferenceEngine:
                 self._handles.pop(req.request_id, None)
                 slot.handle._reject(e)
                 return
-        if slot.remaining <= 0:
-            self._retire(idx, slot, now)
+        stop = (req.stop_token is not None and token == req.stop_token)
+        if stop or slot.remaining <= 0:
+            # budget/EOS retire is per TOKEN, not per step: a
+            # multi-token speculative chunk retires mid-chunk the
+            # moment the budget or the stop token lands, and the
+            # chunk's remaining tokens are never emitted
+            self._retire(idx, slot, now,
+                         finish_reason="stop" if stop else "length")
 
-    def _retire(self, idx, slot, now):
+    def _retire(self, idx, slot, now, finish_reason="length"):
         req = slot.handle.request
         n = len(slot.emitted)
         _trace.event("serve/retire", cat="serve",
@@ -640,7 +909,7 @@ class InferenceEngine:
             tokens=np.concatenate(
                 [req.prompt_ids,
                  np.asarray(slot.emitted, np.int32)]),
-            finish_reason="length",
+            finish_reason=finish_reason,
             ttft=ttft, tpot=tpot,
             queue_time=slot.admit_time - submit_t,
             admitted_step=slot.admitted_step,
@@ -774,6 +1043,7 @@ class InferenceEngine:
                                         if cache is not None else 0)):
             ids = np.zeros((1, self.max_len), np.int32)
             ids[0, :plen] = req.prompt_ids
+            ids_j = jnp.asarray(ids)
             key0 = jax.random.split(
                 jax.random.PRNGKey(int(req.seed)), 1)[0]
             temp = np.float32(req.temperature)
@@ -782,11 +1052,22 @@ class InferenceEngine:
                     ids, plen, nodes, key0, temp)
             else:
                 tok0, carry_key, kc_row, vc_row = _prefill_one(
-                    self._params, jnp.asarray(ids), plen, key0, temp,
-                    self._top_p, **self._statics)
+                    self._params, ids_j, plen, key0, temp,
+                    self._top_p, **self._statics, quant=self._quant)
             self._kc, self._vc = _write_slot(self._kc, self._vc,
                                              kc_row, vc_row,
                                              jnp.int32(idx))
+            if self.draft is not None:
+                # the draft sees the SAME prompt cold (its prefill is
+                # cheap by construction; the prefix cache stores only
+                # target K/V) — rows land in the draft arena at the
+                # same slot so the spec step advances both in lockstep
+                dkc_row, dvc_row = _prefill_rows(
+                    self._d_params, ids_j, *self._d_statics,
+                    quant=self._quant)
+                self._dkc, self._dvc = _write_slot(
+                    self._dkc, self._dvc, dkc_row, dvc_row,
+                    jnp.int32(idx))
         if cache is not None:
             cache.acquire(nodes)
             cache.on_admit(len(nodes), plen)

@@ -98,6 +98,12 @@ class GenerationRequest:
     the next turn's re-sent conversation is a block-prefix hit; without
     a cache the handle is still attached (continuation just runs
     cold).
+    ``stop_token``: optional end-of-sequence token id — the request
+    retires the moment it emits it (``finish_reason="stop"``), the
+    engine's analog of EOS for callers whose tokenizer has one.  With
+    a speculative engine the check runs per accepted token, so a
+    multi-token chunk stops MID-chunk and the surplus accepted tokens
+    are never emitted.
     ``session_of``: the :class:`SessionHandle` this request continues
     (set automatically by ``SessionHandle.request``).  A single engine
     ignores it; the fleet router uses it for STICKY routing — the
@@ -114,6 +120,7 @@ class GenerationRequest:
     priority: int = 0
     pin_session: bool = False
     session_of: Optional[object] = None
+    stop_token: Optional[int] = None
     request_id: str = field(
         default_factory=lambda: f"req-{next(_req_counter)}")
 
@@ -126,13 +133,17 @@ class GenerationRequest:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
                 " (a serve request that generates nothing is a no-op)")
+        if self.stop_token is not None:
+            self.stop_token = int(self.stop_token)
 
 
 @dataclass
 class GenerationResult:
     """Terminal state of a request.  ``tokens`` is prompt +
     continuation (the exact array single-prompt ``generate`` would
-    return); ``finish_reason`` is ``"length"`` for normal completion.
+    return); ``finish_reason`` is ``"length"`` for a spent token
+    budget, ``"stop"`` when the request's ``stop_token`` ended it
+    early.
     Latency fields are on the engine clock: ``ttft`` measures submit →
     first token, ``tpot`` the mean inter-token time after it."""
 

@@ -25,6 +25,18 @@ Metric definitions (the serving-standard ones):
   wait included — the user-visible "how long until it starts".
 * **TPOT** (time per output token): mean inter-token gap AFTER the
   first token; requests emitting one token have no TPOT sample.
+  TOKENS-PER-STEP AWARE since the speculative round: the measure is
+  (last token − first token) / (n − 1), which counts every token a
+  step emitted, however many that was — for a speculative engine this
+  IS step time / accepted tokens, so a replica whose draft stops
+  agreeing (acceptance collapses toward 0, steps emit ~1 token) shows
+  a proportionally worse TPOT and ``tpot_ewma``, and the fleet Router
+  prices it out honestly without any speculation-specific wiring.
+* **serve.spec.{accepted,drafted}** (speculative engines only):
+  draft proposals the target verify kept / offered — the realized
+  acceptance rate on live traffic, the number the speculation-vs-
+  unroll crossover (gpt2_decode.generate_speculative docstring) turns
+  on.
 * **slot occupancy**: live slots / max_slots, sampled once per decode
   step — how full the fixed-shape batch actually runs.
 * **queue depth**: sampled after each step's scheduling pass.
@@ -55,7 +67,8 @@ class EngineStats:
     trace instants (which the monitor's flight recorder captures even
     with tracing off)."""
 
-    def __init__(self, max_slots: int, clock, reg=None, slo=None):
+    def __init__(self, max_slots: int, clock, reg=None, slo=None,
+                 spec=False):
         self.max_slots = int(max_slots)
         self._clock = clock
         self._t0 = clock()
@@ -106,6 +119,27 @@ class EngineStats:
         # set by the engine when a prefix cache is attached: a
         # zero-arg callable returning the cache's snapshot dict
         self.prefix_source = None
+        # speculative engines only: acceptance accounting (``spec`` is
+        # set by the engine when a draft model is attached; a plain
+        # engine registers nothing and snapshots spec: None)
+        self.spec = bool(spec)
+        self._spec_accepted = self._spec_drafted = None
+        self._spec_chunks = None
+        if spec:
+            self._spec_accepted = reg.counter(
+                "serve.spec.accepted",
+                help="draft proposals the target verify kept", **lbl)
+            self._spec_drafted = reg.counter(
+                "serve.spec.drafted",
+                help="draft proposals offered to the target verify",
+                **lbl)
+            self._spec_chunks = reg.counter(
+                "serve.spec.chunks",
+                help="per-slot verify chunks run (one per live slot "
+                     "per spec step)", **lbl)
+            self._registered += [self._spec_accepted,
+                                 self._spec_drafted,
+                                 self._spec_chunks]
         # recency-weighted TPOT (None until the first multi-token
         # retire): the fleet router's SLO-headroom signal — a replica
         # whose decode is degrading shows it here long before the
@@ -179,6 +213,14 @@ class EngineStats:
 
     def on_token(self):
         self._tokens_out.inc()
+
+    def on_spec(self, accepted: int, drafted: int):
+        """One live slot's verify outcome: ``accepted`` of ``drafted``
+        proposals kept (the +1 bonus/correction token is counted by
+        ``on_token``, not here — acceptance measures the DRAFT)."""
+        self._spec_accepted.inc(int(accepted))
+        self._spec_drafted.inc(int(drafted))
+        self._spec_chunks.inc()
 
     def on_decode_step(self, live_slots: int):
         self._decode_steps.inc()
@@ -285,4 +327,22 @@ class EngineStats:
             }),
             "prefix": (self.prefix_source()
                        if self.prefix_source is not None else None),
+            # add-only schema extension (speculative round): None for
+            # plain engines.  tokens_per_chunk = accepted proposals +
+            # the chunk's bonus/correction token, per verify chunk —
+            # the accepted-tokens/step number (slight overcount for
+            # chunks the budget truncated mid-emit; acceptance itself
+            # is exact)
+            "spec": (None if not self.spec else {
+                "drafted": self._spec_drafted.value,
+                "accepted": self._spec_accepted.value,
+                "chunks": self._spec_chunks.value,
+                "acceptance_rate": (
+                    self._spec_accepted.value / self._spec_drafted.value
+                    if self._spec_drafted.value else None),
+                "tokens_per_chunk": (
+                    (self._spec_accepted.value + self._spec_chunks.value)
+                    / self._spec_chunks.value
+                    if self._spec_chunks.value else None),
+            }),
         }

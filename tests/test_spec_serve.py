@@ -1,0 +1,417 @@
+"""Fast-path serving: speculative decoding + int8 KV inside the serve
+engine (perf round).
+
+The contract under test, in order of importance:
+
+* **greedy byte-parity** — a speculative engine's greedy token streams
+  are byte-identical to the plain engine's (and therefore to
+  single-prompt ``generate``), whatever the draft proposes and however
+  requests arrive.  Trained model pairs throughout: speculative parity
+  must not ride argmax near-ties between the chunked and sequential
+  einsum orders (~1e-7 on random weights — the same discipline as
+  tests/test_gpt2.py's offline speculative tests);
+* **sampled distributional correctness** — rejection sampling (accept
+  with min(1, p/q), resample the residual) makes every emitted token
+  marginally distributed EXACTLY as direct target sampling.  Gated by
+  a two-sample χ² over a tiny vocab at a fixed seed schedule
+  (deterministic: the statistic is a constant, the gate can never
+  flake);
+* **int8 arenas** — engine streams equal offline
+  ``generate(cache_dtype="int8")`` bit for bit (greedy, seeded
+  sampling, GQA), because both run the identical quantized math;
+* **composition** — speculation × prefix cache (multi-token retire
+  donation, sessions), speculation × int8, stop-token mid-chunk
+  retire, supervisor restart pass-through;
+* **typed config validation** — every incompatible knob combination
+  fails at construction with a message naming the conflict, never
+  inside a jitted dispatch.
+"""
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, opt, tensor
+from singa_tpu.models import gpt2_decode
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.serve import (FIFOScheduler, GenerationRequest,
+                             PrefixCacheConfig)
+
+
+def _train(cfg, seed, steps=12):
+    """Train a tiny model on highly-learnable motif data (the
+    examples/gpt2/speculative.py recipe): decisive logits and real
+    draft/target agreement without a checkpoint dependency."""
+    device.get_default_device().SetRandSeed(seed)
+    m = GPT2LMHead(cfg)
+    rng = np.random.RandomState(0)
+    motif = rng.randint(0, cfg.vocab_size, 8)
+    ids = np.tile(motif, (4, 4)).astype(np.int32)[:, :32]
+    noise = rng.randint(0, cfg.vocab_size, ids.shape)
+    mask = rng.rand(*ids.shape) < 0.05
+    ids[mask] = noise[mask]
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    m.compile([tensor.from_numpy(ids)], is_train=True, use_graph=True)
+    for _ in range(steps):
+        m(tensor.from_numpy(ids), tensor.from_numpy(labels))
+    m.eval()
+    return m, ids
+
+
+_pairs = {}
+
+
+def _trained_pair(**cfgkw):
+    """Cached (target, draft, train ids): a 2-layer target and a
+    1-layer draft trained on the same motif data."""
+    key = tuple(sorted(cfgkw.items()))
+    if key not in _pairs:
+        cfg_t = GPT2Config.tiny(dropout=0.0, **cfgkw)
+        cfg_d = GPT2Config.tiny(dropout=0.0, n_layer=1, **cfgkw)
+        target, ids = _train(cfg_t, seed=0)
+        draft, _ = _train(cfg_d, seed=1, steps=8)
+        _pairs[key] = (target, draft, ids)
+    return _pairs[key]
+
+
+def _drive(eng, reqs, max_steps=4000):
+    handles = [eng.submit(r) for r in reqs]
+    eng.run_until_complete(max_steps=max_steps)
+    return [h.result() for h in handles]
+
+
+# ---------------------------------------------------------------------------
+# greedy byte-parity
+
+def test_spec_greedy_streams_byte_identical():
+    """The acceptance bar: greedy speculative serve streams equal the
+    plain engine's (and the offline oracle's) byte for byte, with a
+    positive realized acceptance, and a multi-token step count — a
+    12-token request must finish in fewer engine steps than tokens."""
+    target, draft, ids = _trained_pair()
+    prompts = [ids[0, :9], ids[1, :5], ids[2, :13], ids[0, 3:7]]
+    news = [12, 6, 9, 4]
+
+    def reqs():
+        return [GenerationRequest(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+
+    eng_plain = target.serve(max_slots=2)
+    plain = _drive(eng_plain, reqs())
+    eng_plain.close()
+
+    eng = target.serve(max_slots=2, draft_model=draft, spec_k=4)
+    spec = _drive(eng, reqs())
+    snap = eng.stats.snapshot()
+    eng.close()
+
+    for p, n, a, b in zip(prompts, news, plain, spec):
+        want = target.generate(np.asarray(p), max_new_tokens=n,
+                               temperature=0)
+        np.testing.assert_array_equal(a.tokens, want)
+        np.testing.assert_array_equal(b.tokens, a.tokens)
+    assert snap["spec"]["acceptance_rate"] > 0
+    assert snap["spec"]["tokens_per_chunk"] > 1.0
+    # multi-token steps: the 12-token request retired in fewer engine
+    # steps than it emitted tokens (the whole point of the fast path)
+    big = spec[0]
+    assert big.finished_step - big.admitted_step < news[0] - 1
+    assert big.tpot is not None
+
+
+def test_spec_gqa_parity():
+    """GQA target+draft (narrow H_kv caches in BOTH arenas): greedy
+    spec streams still equal the oracle token for token."""
+    target, draft, ids = _trained_pair(n_kv_head=2)
+    prompts = [ids[0, :8], ids[1, :6]]
+    eng = target.serve(max_slots=2, draft_model=draft, spec_k=3)
+    res = _drive(eng, [GenerationRequest(p, max_new_tokens=7)
+                       for p in prompts])
+    eng.close()
+    for p, r in zip(prompts, res):
+        want = target.generate(np.asarray(p), max_new_tokens=7,
+                               temperature=0)
+        np.testing.assert_array_equal(r.tokens, want)
+
+
+def test_spec_mixed_greedy_and_sampled_pool():
+    """One executable serves greedy and sampled requests side by side
+    (temp is traced): the greedy stream stays byte-identical to the
+    oracle while a sampled neighbor rides rejection sampling."""
+    target, draft, ids = _trained_pair()
+    eng = target.serve(max_slots=2, draft_model=draft, spec_k=3)
+    hg = eng.submit(GenerationRequest(ids[0, :9], max_new_tokens=8))
+    hs = eng.submit(GenerationRequest(ids[1, :6], max_new_tokens=8,
+                                      temperature=1.0, seed=5))
+    eng.run_until_complete(max_steps=500)
+    eng.close()
+    want = target.generate(np.asarray(ids[0, :9]), max_new_tokens=8,
+                           temperature=0)
+    np.testing.assert_array_equal(hg.result().tokens, want)
+    samp = hs.result()
+    assert len(samp.tokens) == 6 + 8
+    assert samp.finish_reason == "length"
+
+
+# ---------------------------------------------------------------------------
+# sampled distributional correctness (the χ² gate, VERDICT missing #4)
+
+def test_spec_sampled_chi2_matches_direct_sampling():
+    """Rejection sampling's whole claim: speculative sampled tokens are
+    distributed exactly as direct target sampling.  Two-sample χ² over
+    a 16-token vocab at a fixed seed schedule, on the two
+    verify-produced positions of a 3-token generation, against the
+    α=0.001 critical value for df=15 (37.70).  Everything is seeded,
+    so the statistic is deterministic — this can never flake, only
+    regress.  The trained 2-vs-1-layer pair keeps acceptance interior
+    (≈0.8): both the accept and the residual-resample branches carry
+    real probability mass, so a bug in either moves the statistic."""
+    target, draft, ids = _trained_pair(vocab_size=16)
+    prompt = ids[0, :8]
+    N = 400
+
+    def collect(spec):
+        kw = dict(draft_model=draft, spec_k=3) if spec else {}
+        eng = target.serve(
+            max_slots=8,
+            scheduler=FIFOScheduler(max_queue_depth=N + 1), **kw)
+        res = _drive(eng, [GenerationRequest(
+            prompt, max_new_tokens=3, temperature=1.0, seed=1000 + i)
+            for i in range(N)], max_steps=20000)
+        snap = eng.stats.snapshot()
+        eng.close()
+        return (np.stack([r.tokens[len(prompt):] for r in res]), snap)
+
+    t_spec, snap = collect(True)
+    t_plain, _ = collect(False)
+    rate = snap["spec"]["acceptance_rate"]
+    assert 0.05 < rate < 0.999, \
+        f"acceptance {rate} degenerate — the χ² gate needs both " \
+        "branches exercised"
+    for pos in (1, 2):
+        o1 = np.bincount(t_spec[:, pos], minlength=16)
+        o2 = np.bincount(t_plain[:, pos], minlength=16)
+        live = (o1 + o2) > 0
+        chi2 = float((((o1 - o2) ** 2)
+                      / np.maximum(o1 + o2, 1))[live].sum())
+        # df <= 15; the df=15 critical value upper-bounds smaller dfs
+        assert chi2 < 37.70, \
+            (f"position {pos}: chi2={chi2:.1f} over df<={live.sum() - 1}"
+             f" — speculative sampling diverges from direct sampling")
+
+
+# ---------------------------------------------------------------------------
+# int8 KV arenas
+
+def test_int8_engine_parity():
+    """int8 arena streams equal offline generate(cache_dtype='int8')
+    bit for bit — greedy, seeded sampling, and GQA (the engine and the
+    offline path run the identical quantized decode math)."""
+    for cfgkw in ({}, {"n_kv_head": 2}):
+        cfg = GPT2Config.tiny(dropout=0.0, **cfgkw)
+        m = GPT2LMHead(cfg)
+        m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+                  is_train=False, use_graph=False)
+        prompts = [np.arange(9) % 256, (np.arange(4) + 3) % 256]
+        eng = m.serve(max_slots=2, cache_dtype="int8")
+        hg = eng.submit(GenerationRequest(prompts[0], max_new_tokens=6))
+        s = int(np.random.RandomState(3).randint(0, 2 ** 31 - 1))
+        hs = eng.submit(GenerationRequest(prompts[1], max_new_tokens=5,
+                                          temperature=0.9, seed=s))
+        eng.run_until_complete(max_steps=200)
+        eng.close()
+        want_g = gpt2_decode.generate(m, np.asarray(prompts[0]),
+                                      max_new_tokens=6, temperature=0,
+                                      cache_dtype="int8")
+        np.testing.assert_array_equal(hg.result().tokens, want_g)
+        want_s = gpt2_decode.generate(
+            m, np.asarray(prompts[1]), max_new_tokens=5,
+            temperature=0.9, rng=np.random.RandomState(3),
+            cache_dtype="int8")
+        np.testing.assert_array_equal(hs.result().tokens, want_s)
+
+
+def test_int8_spec_compose():
+    """int8 arenas × speculation: greedy spec streams equal offline
+    int8 sequential decode (the comparison point when the cache is
+    quantized, as generate_speculative documents)."""
+    target, draft, ids = _trained_pair()
+    p = ids[0, :9]
+    eng = target.serve(max_slots=2, draft_model=draft, spec_k=3,
+                       cache_dtype="int8")
+    res = _drive(eng, [GenerationRequest(p, max_new_tokens=8)])
+    eng.close()
+    want = gpt2_decode.generate(target, np.asarray(p),
+                                max_new_tokens=8, temperature=0,
+                                cache_dtype="int8")
+    np.testing.assert_array_equal(res[0].tokens, want)
+
+
+# ---------------------------------------------------------------------------
+# composition: prefix cache, stop tokens, supervisor pass-through
+
+def test_spec_prefix_compose():
+    """Speculation × radix prefix cache: warm (shared system prompt)
+    spec streams are byte-identical to cold spec streams and to the
+    oracle, multi-token retires donate canonical prompt blocks, and a
+    pinned session's next turn is a warm hit that still matches."""
+    target, draft, ids = _trained_pair()
+    system = np.asarray(ids[0, :16])
+    tails = [ids[1, :5], ids[2, 2:8], ids[0, 7:12]]
+    prompts = [np.concatenate([system, t]) for t in tails]
+    cfg = PrefixCacheConfig(block_size=8, num_blocks=32)
+
+    eng = target.serve(max_slots=2, draft_model=draft, spec_k=3,
+                       prefix_cache=cfg)
+    res = _drive(eng, [GenerationRequest(p, max_new_tokens=6,
+                                         pin_session=True)
+                       for p in prompts])
+    for p, r in zip(prompts, res):
+        want = target.generate(np.asarray(p), max_new_tokens=6,
+                               temperature=0)
+        np.testing.assert_array_equal(r.tokens, want)
+    # the shared system prompt hits once a retire has donated it (the
+    # first two requests admit in the same pass, before any donation)
+    snap = eng.stats.snapshot()
+    assert snap["prefix"]["hits"] >= 1, snap["prefix"]
+    # session continuation: near-full prefix hit, still oracle-exact
+    sess = res[0].session
+    req2 = sess.request(ids[1, :4], max_new_tokens=5)
+    r2 = _drive(eng, [req2])[0]
+    want2 = target.generate(np.asarray(req2.prompt_ids),
+                            max_new_tokens=5, temperature=0)
+    np.testing.assert_array_equal(r2.tokens, want2)
+    snap2 = eng.stats.snapshot()
+    assert snap2["prefix"]["hit_tokens"] > snap["prefix"]["hit_tokens"]
+    for r in res:
+        if r.session is not None:
+            r.session.release()
+    eng.close()
+
+
+def test_stop_token_retires_mid_chunk():
+    """A stop token lands mid-speculative-chunk: the request retires
+    with finish_reason='stop' truncated at the stop position, surplus
+    accepted tokens never emitted — and the plain engine agrees."""
+    target, draft, ids = _trained_pair()
+    p = ids[0, :9]
+    base = np.asarray(target.generate(np.asarray(p), max_new_tokens=10,
+                                      temperature=0))
+    # stop on the 3rd generated token: with spec_k=4 chunks, that is
+    # mid-chunk for any acceptance >= 2
+    stop = int(base[len(p) + 2])
+    outs = []
+    for kw in ({}, dict(draft_model=draft, spec_k=4)):
+        eng = target.serve(max_slots=1, **kw)
+        r = _drive(eng, [GenerationRequest(p, max_new_tokens=10,
+                                           stop_token=stop)])[0]
+        eng.close()
+        assert r.finish_reason == "stop"
+        outs.append(r.tokens)
+    np.testing.assert_array_equal(outs[0], base[:len(p) + 3])
+    np.testing.assert_array_equal(outs[1], outs[0])
+
+
+def test_supervisor_restart_rebuilds_spec_engine():
+    """EngineSupervisor forwards the fast-decode knobs verbatim: a
+    decode fault mid-spec-run rebuilds a SPECULATIVE engine (fresh
+    target AND draft arenas, jit cache hit) and requeued never-started
+    requests stream byte-identically to an uninterrupted run."""
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor)
+
+    target, draft, ids = _trained_pair()
+    prompts = [ids[i % 3, :7 + i % 4] for i in range(6)]
+    base = [np.asarray(target.generate(np.asarray(p), max_new_tokens=5,
+                                       temperature=0)) for p in prompts]
+    sup = EngineSupervisor(target, max_slots=2, restart_budget=2,
+                           draft_model=draft, spec_k=3)
+    assert sup.engine.draft is draft
+    handles = [sup.submit(GenerationRequest(p, max_new_tokens=5))
+               for p in prompts]
+    pol = faults.inject("serve.decode_step", FailAfterN(2, times=1))
+    try:
+        sup.run_until_complete(max_steps=2000)
+    finally:
+        faults.clear()
+    assert pol.fired == 1
+    assert sup.engine.draft is draft  # rebuilt engine kept the knobs
+    completed = typed = 0
+    for h, want in zip(handles, base):
+        assert h.done()
+        try:
+            np.testing.assert_array_equal(h.result().tokens, want)
+            completed += 1
+        except EngineFailedError:
+            typed += 1
+    assert completed + typed == len(prompts) and completed > 0
+    sup.close()
+
+
+# ---------------------------------------------------------------------------
+# stats / metrics / health
+
+def test_spec_metrics_and_health():
+    target, draft, ids = _trained_pair()
+    from singa_tpu import observe
+
+    eng = target.serve(max_slots=2, draft_model=draft, spec_k=3)
+    _drive(eng, [GenerationRequest(ids[0, :9], max_new_tokens=6)])
+    snap = eng.stats.snapshot()
+    assert set(snap["spec"]) == {"drafted", "accepted", "chunks",
+                                 "acceptance_rate", "tokens_per_chunk"}
+    assert snap["spec"]["drafted"] >= snap["spec"]["accepted"] >= 0
+    assert snap["spec"]["chunks"] >= 1
+    reg = observe.registry().snapshot()["counters"]
+    lbl = "{engine=" + eng.stats.engine_label + "}"
+    assert reg["serve.spec.drafted" + lbl] == snap["spec"]["drafted"]
+    health = observe.health_report(include_registry=False)
+    assert health["serve"]["spec"]["drafted"] > 0
+    assert 0.0 <= health["serve"]["spec"]["acceptance_rate"] <= 1.0
+    eng.close()
+    reg2 = observe.registry().snapshot()["counters"]
+    assert ("serve.spec.drafted" + lbl) not in reg2  # unregistered
+
+
+# ---------------------------------------------------------------------------
+# typed config validation (the guard-fix satellite)
+
+def test_config_validation_typed_errors():
+    target, draft, ids = _trained_pair()
+
+    with pytest.raises(ValueError, match="without draft_model"):
+        target.serve(spec_k=4)
+    with pytest.raises(ValueError, match="spec_k must be >= 2"):
+        target.serve(draft_model=draft, spec_k=1)
+
+    small_vocab = GPT2LMHead(GPT2Config.tiny(dropout=0.0,
+                                             vocab_size=128))
+    small_vocab.compile(
+        [tensor.from_numpy(np.zeros((1, 16), np.int32))],
+        is_train=False, use_graph=False)
+    with pytest.raises(ValueError, match="vocab mismatch"):
+        target.serve(draft_model=small_vocab)
+
+    short = GPT2LMHead(GPT2Config.tiny(dropout=0.0, n_positions=32))
+    short.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+                  is_train=False, use_graph=False)
+    with pytest.raises(ValueError, match="n_positions"):
+        target.serve(draft_model=short)
+
+    win = GPT2LMHead(GPT2Config.tiny(dropout=0.0, attn_window=8))
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        target.serve(draft_model=win)
+
+    with pytest.raises(NotImplementedError, match="int8.*prefix|prefix.*int8"):
+        target.serve(cache_dtype="int8",
+                     prefix_cache=PrefixCacheConfig(block_size=8,
+                                                    num_blocks=16))
+    with pytest.raises(ValueError, match="cache_dtype"):
+        target.serve(cache_dtype="int4")
+
+    # speculative headroom: spec_k - 1 positions reserved at submit
+    eng = target.serve(max_slots=1, draft_model=draft, spec_k=4)
+    with pytest.raises(ValueError, match="spec_k-1"):
+        eng.submit(GenerationRequest(np.zeros(120, np.int32),
+                                     max_new_tokens=6))
+    eng.close()
