@@ -40,7 +40,15 @@ PATH`` additionally traces the timed engine run and writes a Chrome
 trace-event JSON there (open in https://ui.perfetto.dev — expect
 serve/prefill, serve/decode_step and serve/retire rows).  Tracing is
 off unless the flag is given, so the default throughput numbers are
-untouched.
+untouched.  ``--request-log PATH`` enables the per-request lifecycle
+ledger (``observe.requests``) for every timed run, writes one
+strict-JSON line per request there, embeds a ``request_log``
+self-check section (complete monotonic timelines, exact TTFT phase
+attribution, recompile pin with the ledger ON) and turns on the
+health report's ``why_slow`` tail-latency attribution; with
+``--trace-out`` the Chrome trace additionally carries per-request
+tracks with hop flow arrows.  ``--prom-out PATH`` writes the
+Prometheus text exposition (bucketed histogram families) at exit.
 """
 
 import argparse
@@ -263,6 +271,73 @@ def run_prefix_mix(max_slots):
         "recompiles": (None if jit_before is None
                        else jit_after - jit_before),
         "parity": parity,
+    }
+
+
+def _request_log_section(led, path, recompiles=None):
+    """The --request-log deliverable: write the ledger's sealed ring
+    as strict JSONL at ``path`` and self-check the acceptance
+    invariants — every completed request's timeline is COMPLETE
+    (submit -> admission -> first token -> retire) and MONOTONIC, and
+    the phase attribution (hops + queue + prefill) reproduces each
+    request's measured TTFT — so the CI gate reads verdicts instead of
+    re-deriving them from raw timelines."""
+    from singa_tpu.observe import requests as reqtrace
+
+    n = reqtrace.write_request_log(path, ledger_=led)
+    entries = led.entries()
+    completed = [e for e in entries
+                 if e["outcome"] in ("length", "stop")]
+    complete = monotonic = True
+    max_rel_err = 0.0
+    for e in completed:
+        # the serving hop is the entry's seal-time verdict (on a
+        # hedged request the last hop BY POSITION may be the losing
+        # twin) — completeness is judged on it
+        final = e["hops"][e["final_hop"]]
+        complete &= (e["t_retire"] is not None
+                     and e["ttft_s"] is not None
+                     and final["t_admit"] is not None
+                     and final["t_first_token"] is not None
+                     and e["tokens_out"] > 0)
+        # hops run CONCURRENTLY under hedging, so monotonicity is a
+        # per-hop property (submit <= admit <= first token <= steps)
+        # anchored at the request's original submit; retire closes
+        # the serving hop
+        for h in e["hops"]:
+            t = e["t_submit"]
+            for tn in (h["t_submit"], h["t_admit"],
+                       h["t_first_token"]):
+                if tn is not None:
+                    monotonic &= tn >= t
+                    t = tn
+            for s in h["steps"]:
+                monotonic &= s[0] >= t
+                t = s[0]
+            if h is final:
+                monotonic &= e["t_retire"] >= t
+        ph = e["phases"]
+        if e["ttft_s"] > 0:
+            err = abs(ph["hops"] + ph["queue"] + ph["prefill"]
+                      - e["ttft_s"]) / e["ttft_s"]
+            max_rel_err = max(max_rel_err, err)
+    return {
+        "path": path,
+        "lines": n,
+        "requests": len(entries),
+        "completed": len(completed),
+        "rejected": sum(1 for e in entries
+                        if e["outcome"] == "rejected"),
+        "open_after_run": led.open_count,
+        "dropped": led.dropped,
+        "multi_hop_requests": sum(1 for e in entries
+                                  if len(e["hops"]) > 1),
+        "timelines_complete": bool(complete),
+        "timestamps_monotonic": bool(monotonic),
+        # attribution is arithmetic over recorded timestamps, so this
+        # is ~0 by construction; the gate allows 5%
+        "ttft_attribution_max_rel_err": max_rel_err,
+        "recompiles": recompiles,
     }
 
 
@@ -538,6 +613,17 @@ def main():
     ap.add_argument("--health-out", default=None, metavar="PATH",
                     help="also write observe.health_report() (goodput, "
                          "MFU, SLO counters, watchdog state) as JSON")
+    ap.add_argument("--request-log", default=None, metavar="PATH",
+                    help="enable the per-request lifecycle ledger "
+                         "(observe.requests) for the timed runs and "
+                         "write one strict-JSON line per request "
+                         "there; embeds the request_log self-check "
+                         "section and turns on the health report's "
+                         "why_slow attribution")
+    ap.add_argument("--prom-out", default=None, metavar="PATH",
+                    help="also write the Prometheus text exposition "
+                         "of the live metrics registry (bucketed "
+                         "histogram families) at exit")
     ap.add_argument("--prefix-mix", action="store_true",
                     help="also run the shared-system-prompt + "
                          "multi-turn session workload warm (radix "
@@ -590,7 +676,18 @@ def main():
     if args.trace_out:
         observe.clear()  # drop warmup events; trace the timed run only
         observe.enable()
+    led = jit_rl_before = None
+    if args.request_log:
+        # ledger ON for every timed run from here (engine + the
+        # optional prefix/spec/int8/fleet sections); warmup traffic
+        # above never reached it.  The jit pin brackets the timed
+        # engine run to prove the ledger's host-side hooks introduce
+        # zero runtime recompiles
+        led = observe.requests.enable(capacity=4096)
+        jit_rl_before = _serve_jit_cache_size()
     wall_e, outs_e, snap = run_engine(m, workload, max_slots, slo=slo)
+    jit_rl_after = (_serve_jit_cache_size() if args.request_log
+                    else None)
     observe.disable()
     wall_s, outs_s, ttfts_s = run_static(m, workload, max_slots)
 
@@ -680,10 +777,29 @@ def main():
         report["fleet"], report["registry"], report["health"] = \
             run_fleet_bench(m, workload, outs_e, replicas=2,
                             max_slots=max_slots // 2, engine_snap=snap)
+    if args.request_log:
+        report["request_log"] = _request_log_section(
+            led, args.request_log,
+            recompiles=(None if jit_rl_before is None
+                        or jit_rl_after is None
+                        else jit_rl_after - jit_rl_before))
+        # every optional section above refreshed health while the
+        # ledger was live, so the report's why_slow is the enabled
+        # attribution; refresh only when nothing ran after the timed
+        # engine run (a --fleet health snapshot must NOT be retaken —
+        # the fleet's metrics unregistered at close)
+        if not args.fleet:
+            report["health"] = observe.health_report(
+                engine_snapshots=[snap], include_registry=False)
+        observe.requests.disable()
+    if args.prom_out:
+        observe.export.write_prometheus(args.prom_out)
+        report["prometheus"] = {"path": args.prom_out}
     if args.trace_out:
         n_events = observe.export.write_chrome_trace(
             args.trace_out,
-            metadata={"bench": "serve_continuous_batching"})
+            metadata={"bench": "serve_continuous_batching"},
+            requests=(led.entries() if led is not None else None))
         report["trace"] = {"path": args.trace_out,
                            "trace_events": n_events}
     # strict JSON on disk/stdout: nan (e.g. MFU on CPU) becomes null,

@@ -16,6 +16,15 @@ Tracing is OFF by default and costs one flag check per site when off;
 the registry is always on (counter bumps, vLLM-style).  See
 docs/OBSERVABILITY.md.
 
+Since the request-tracing round, ``requests.py`` adds a per-REQUEST
+lifecycle ledger over the serve stack: one timeline per
+``GenerationRequest`` (queue wait, cold/warm admission, per-step
+emission, supervisor-restart and fleet-failover hops), a bounded JSONL
+request log, per-request Chrome-trace tracks with hop flow arrows, and
+the ``health_report()["serve"]["why_slow"]`` tail-latency attribution
+(``requests.enable()`` — off by default, one flag read per hook when
+off).
+
 Since PR 3 there is also an ACTIVE layer over the passive one
 (``monitor.py`` + ``health.py``): an always-on flight recorder with
 crash bundles (``monitor.install_crash_handler``), MFU/goodput
@@ -41,5 +50,7 @@ from .trace import (clear, disable, drain, dropped,  # noqa: F401
                     enable, event, events, is_enabled, set_max_events,
                     span, traced)
 from . import monitor  # noqa: F401  (imports trace/registry only)
+from . import requests  # noqa: F401  (per-request lifecycle ledger)
+from .requests import RequestLedger  # noqa: F401
 from . import health  # noqa: F401
 from .health import SLO, health_report  # noqa: F401

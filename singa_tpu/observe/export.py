@@ -14,9 +14,22 @@ Three formats, three audiences:
   each span's args.  Timestamps are microseconds per the spec.
 * :func:`prometheus_text` — text exposition of a
   :class:`~singa_tpu.observe.registry.MetricsRegistry` (counters,
-  gauges, histograms-as-summaries), scrapeable by any Prometheus
-  agent.  Metric names are sanitized to the exposition charset and
-  prefixed ``singa_tpu_``.
+  gauges, and histograms with cumulative ``_bucket{le=...}`` series),
+  scrapeable by any Prometheus agent.  Metric names are sanitized to
+  the exposition charset and prefixed ``singa_tpu_``.  Histograms
+  export the full bucket ladder (``registry.DEFAULT_BUCKETS`` or the
+  per-metric override) precisely so that cross-process
+  ``histogram_quantile(0.99, sum(rate(x_bucket[5m])) by (le))`` works
+  over a fleet of replicas — the precomputed nearest-rank quantiles
+  (kept as a sibling ``<name>_quantile`` gauge family, the
+  single-process view) cannot be aggregated.
+
+The request-tracing round adds :func:`request_trace_events`: the
+:class:`~singa_tpu.observe.requests.RequestLedger`'s per-request
+timelines as Chrome-trace tracks (one row per request: queue /
+prefill / decode phase spans per hop, flow arrows linking
+cross-replica hops).  ``chrome_trace(requests=...)`` merges them into
+the span trace under their own ``requests`` process group.
 
 All exporters take explicit ``events``/``reg`` arguments and default
 to the live trace buffer / default registry, so tests can run them on
@@ -33,8 +46,8 @@ from . import trace as _trace
 from .registry import Counter, Histogram, registry as _registry
 
 __all__ = ["jsonl_lines", "write_jsonl", "chrome_trace",
-           "write_chrome_trace", "prometheus_text",
-           "write_prometheus", "json_sanitize"]
+           "write_chrome_trace", "request_trace_events",
+           "prometheus_text", "write_prometheus", "json_sanitize"]
 
 
 def json_sanitize(obj):
@@ -81,11 +94,82 @@ def write_jsonl(path, events=None):
 # Chrome trace-event JSON (Perfetto / chrome://tracing)
 # ---------------------------------------------------------------------------
 
-def chrome_trace(events=None, metadata=None) -> dict:
+def request_trace_events(entries, pid=1) -> list:
+    """Per-request Chrome-trace tracks from sealed
+    :class:`~singa_tpu.observe.requests.RequestLedger` entries: one
+    tid per request, phase spans per hop (``queue`` submit→admission,
+    ``prefill`` admission→first token, ``decode`` first token→hop
+    end), rejection instants, and FLOW events (``ph: s``/``f``)
+    drawing an arrow across each requeue/failover/hedge hop boundary —
+    in Perfetto a failover-requeued request reads as one line with a
+    visible jump between replicas.  Rides its own ``requests``
+    process (``pid``) so the per-subsystem span tracks (pid 0) stay
+    untouched."""
+    out = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "requests"}}]
+    flow_id = 0
+    for tid, e in enumerate(entries):
+        rid = e["request_id"]
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"req {rid}"}})
+        hops = e.get("hops") or []
+        for j, h in enumerate(hops):
+            if j + 1 < len(hops):
+                hop_end = hops[j + 1]["t_submit"]
+            elif e.get("t_retire") is not None:
+                hop_end = e["t_retire"]
+            else:
+                hop_end = h["t_submit"]
+            base = {"request": rid, "hop": j,
+                    "engine": h.get("engine"),
+                    "replica": h.get("replica"), "via": h.get("via")}
+
+            def span(name, t0, t1, **extra):
+                if t0 is None or t1 is None or t1 < t0:
+                    return
+                out.append({"name": name, "cat": "request", "ph": "X",
+                            "pid": pid, "tid": tid, "ts": t0 * 1e6,
+                            "dur": (t1 - t0) * 1e6,
+                            "args": dict(base, **extra)})
+
+            t_admit, t_first = h.get("t_admit"), h.get("t_first_token")
+            span("queue", h["t_submit"],
+                 t_admit if t_admit is not None else hop_end,
+                 depth=h.get("queue_depth_at_enqueue"))
+            span("prefill", t_admit, t_first,
+                 kind=h.get("admit_kind"),
+                 hit_tokens=h.get("hit_tokens"),
+                 chunks=len(h.get("chunks") or ()))
+            span("decode", t_first, hop_end, tokens=h.get("tokens"))
+            rej = h.get("reject")
+            if rej is not None:
+                out.append({"name": "rejected", "cat": "request",
+                            "ph": "i", "s": "t", "pid": pid,
+                            "tid": tid, "ts": rej["t"] * 1e6,
+                            "args": dict(base,
+                                         reason=rej.get("reason"),
+                                         started=rej.get("started"))})
+            if j > 0:
+                # flow arrow: previous hop's end -> this hop's submit
+                flow_id += 1
+                out.append({"name": "hop", "cat": "request", "ph": "s",
+                            "pid": pid, "tid": tid, "id": flow_id,
+                            "ts": h["t_submit"] * 1e6 - 1,
+                            "args": base})
+                out.append({"name": "hop", "cat": "request", "ph": "f",
+                            "bp": "e", "pid": pid, "tid": tid,
+                            "id": flow_id, "ts": h["t_submit"] * 1e6,
+                            "args": base})
+    return out
+
+
+def chrome_trace(events=None, metadata=None, requests=None) -> dict:
     """Build the trace-event object: spans as complete ("X") events,
     instants as "i", one tid per subsystem category with a
     ``thread_name`` row label.  ``metadata`` is merged into the
-    top-level ``otherData``."""
+    top-level ``otherData``.  ``requests``: optional sealed
+    request-ledger entries rendered as per-request tracks
+    (:func:`request_trace_events`) in the same document."""
     if events is None:
         events = _trace.events()
     cats = []
@@ -110,18 +194,23 @@ def chrome_trace(events=None, metadata=None) -> dict:
         else:
             ev["s"] = "t"  # instant scoped to its track
         out.append(ev)
+    if requests:
+        out.extend(request_trace_events(requests, pid=1))
     doc = {"traceEvents": out, "displayTimeUnit": "ms",
            "otherData": {"source": "singa_tpu.observe",
                          "dropped_events": _trace.dropped()}}
+    if requests:
+        doc["otherData"]["request_tracks"] = len(requests)
     if metadata:
         doc["otherData"].update(metadata)
     return doc
 
 
-def write_chrome_trace(path, events=None, metadata=None) -> int:
+def write_chrome_trace(path, events=None, metadata=None,
+                       requests=None) -> int:
     """Write the Chrome trace JSON; returns the trace-event count
     (metadata rows included)."""
-    doc = chrome_trace(events, metadata)
+    doc = chrome_trace(events, metadata, requests=requests)
     with open(path, "w") as f:
         # default=str: span args routinely carry numpy/jax scalars; a
         # trace must never be lost at export time over a dtype
@@ -166,8 +255,16 @@ def _prom_num(v) -> str:
 
 def prometheus_text(reg=None) -> str:
     """Render a registry in the Prometheus text exposition format.
-    Histograms are exposed as summaries (quantile series + ``_sum`` /
-    ``_count``), matching their nearest-rank p50/p99 summary schema."""
+    Histograms are exposed as real TYPE-histogram families: cumulative
+    ``_bucket{le=...}`` series over the metric's bucket ladder
+    (``registry.DEFAULT_BUCKETS`` or the per-metric override) ending
+    in ``le="+Inf"`` == ``_count``, plus ``_sum``/``_count`` — the
+    form ``histogram_quantile()`` can aggregate ACROSS a fleet of
+    scraped replicas, which the previous summary-only exposition could
+    not.  The nearest-rank p50/p99 each process already computes ride
+    along as a sibling ``<name>_quantile`` gauge family (the exact
+    single-process view; conformant scrapers reject quantile samples
+    inside a histogram family, hence the separate name)."""
     if reg is None:
         reg = _registry()
     by_name = {}
@@ -178,7 +275,6 @@ def prometheus_text(reg=None) -> str:
         group = by_name[name]
         pname = _prom_name(name)
         kind = group[0].KIND
-        kind = {"histogram": "summary"}.get(kind, kind)
         # counter samples carry the _total suffix, and the classic
         # text format (prometheus_client convention) declares TYPE/
         # HELP under the SAMPLE name — a TYPE under the bare name
@@ -191,12 +287,11 @@ def prometheus_text(reg=None) -> str:
         for m in group:
             if isinstance(m, Histogram):
                 s = m.series
-                for q in (0.5, 0.99):
+                for le, c in m.bucket_counts():
                     lines.append(
-                        pname
-                        + _prom_labels(m.labels,
-                                       [("quantile", q)])
-                        + " " + _prom_num(s.percentile(q * 100)))
+                        pname + "_bucket"
+                        + _prom_labels(m.labels, [("le", _prom_num(le))])
+                        + " " + _prom_num(c))
                 # running total, NOT sum(s.values): once the retained
                 # window is bounded, a windowed sum next to the
                 # all-time _count would make rate(_sum)/rate(_count)
@@ -209,6 +304,15 @@ def prometheus_text(reg=None) -> str:
                 suffix = "_total" if isinstance(m, Counter) else ""
                 lines.append(pname + suffix + _prom_labels(m.labels)
                              + " " + _prom_num(m.value))
+        if kind == "histogram":
+            # sibling family for the exact in-process quantiles
+            lines.append(f"# TYPE {pname}_quantile gauge")
+            for m in group:
+                for q in (0.5, 0.99):
+                    lines.append(
+                        pname + "_quantile"
+                        + _prom_labels(m.labels, [("quantile", q)])
+                        + " " + _prom_num(m.series.percentile(q * 100)))
     return "\n".join(lines) + "\n"
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 from dataclasses import asdict, dataclass
 
 from . import monitor as _monitor
+from . import requests as _requests
 from . import trace as _trace
 from .registry import registry as _registry
 
@@ -260,6 +261,13 @@ def health_report(reg=None, engine_snapshots=(),
             "prefix": _prefix_section(snap),
             "spec": _spec_section(snap),
             "fleet": _fleet_section(snap),
+            # tail-latency attribution from the request ledger
+            # (observe.requests): always present; {"enabled": False}
+            # until requests.enable() is called.  When live it
+            # decomposes the TTFT/TPOT p99 population and the top-K
+            # slowest requests into queue/prefill/decode/stall/hop
+            # phase components — the "WHY did p99 regress" answer
+            "why_slow": _requests.why_slow_section(),
         },
         "resilience": _resilience_section(snap["counters"]),
         "watchdog": (

@@ -26,12 +26,22 @@ JSON-able dict), Prometheus text exposition in ``export.py``.
 
 from __future__ import annotations
 
+import bisect as _bisect
 import threading
 
 from ..utils.metrics import LatencySeries
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "registry"]
+           "registry", "DEFAULT_BUCKETS"]
+
+#: default cumulative-histogram bucket ladder (seconds): latency-
+#: shaped, 1ms..2min.  Buckets exist for the PROMETHEUS side — a
+#: summary's precomputed quantiles cannot be aggregated across a fleet
+#: of replicas, while ``sum(rate(x_bucket[5m])) by (le)`` +
+#: ``histogram_quantile()`` can.  Override per metric via
+#: ``registry.histogram(name, buckets=...)``.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 
 
 class _Metric:
@@ -101,15 +111,34 @@ class Gauge(_Metric):
 
 class Histogram(_Metric):
     """Value distribution over a :class:`LatencySeries` (count/mean/
-    p50/p99/max summary schema)."""
+    p50/p99/max summary schema).  ``buckets``: cumulative upper bounds
+    for the Prometheus ``_bucket{le=...}`` exposition (+Inf is
+    implicit); defaults to :data:`DEFAULT_BUCKETS`."""
 
-    __slots__ = ("series",)
+    __slots__ = ("series", "buckets", "_bins", "_bin_idx")
 
     KIND = "histogram"
 
-    def __init__(self, name, labels=(), help="", series=None):
+    def __init__(self, name, labels=(), help="", series=None,
+                 buckets=None):
         super().__init__(name, labels, help)
         self.series = series if series is not None else LatencySeries()
+        if buckets is None:
+            self.buckets = DEFAULT_BUCKETS
+        else:
+            b = tuple(float(x) for x in buckets)
+            if not b or list(b) != sorted(set(b)):
+                raise ValueError(
+                    f"buckets must be non-empty, strictly increasing, "
+                    f"got {buckets}")
+            self.buckets = b
+        # per-ladder-bin counts, filled INCREMENTALLY on the read side
+        # (bucket_counts): adopters record into the series directly
+        # (EngineStats), so observe() cannot be the binning point, and
+        # re-binning the whole history per scrape would make scrape
+        # cost grow with uptime
+        self._bins = [0] * len(self.buckets)
+        self._bin_idx = 0
 
     def observe(self, v):
         self.series.record(v)
@@ -118,6 +147,28 @@ class Histogram(_Metric):
     @property
     def count(self):
         return self.series.count
+
+    def bucket_counts(self) -> list:
+        """Cumulative ``(le, count)`` pairs, ending with ``(inf,
+        count)``.  Each call bins only the values APPENDED since the
+        last call (O(new * log buckets), so a scrape's cost does not
+        grow with process uptime), keeping the bins cumulative over
+        all time — the Prometheus histogram contract — even if the
+        retained value window is ever bounded.  The +Inf bucket uses
+        the series' RUNNING count (same source as ``_count``), so
+        ``x_bucket{le="+Inf"} == x_count`` always holds."""
+        vals = self.series.values
+        while self._bin_idx < len(vals):
+            i = _bisect.bisect_left(self.buckets, vals[self._bin_idx])
+            if i < len(self._bins):
+                self._bins[i] += 1
+            self._bin_idx += 1
+        out, c = [], 0
+        for le, n in zip(self.buckets, self._bins):
+            c += n
+            out.append((le, c))
+        out.append((float("inf"), self.series.count))
+        return out
 
     def summary(self) -> dict:
         return self.series.summary()
@@ -165,12 +216,15 @@ class MetricsRegistry:
     def gauge(self, name, help="", **labels) -> Gauge:
         return self._get_or_create(Gauge, name, labels, help)
 
-    def histogram(self, name, help="", series=None, **labels) -> Histogram:
+    def histogram(self, name, help="", series=None, buckets=None,
+                  **labels) -> Histogram:
         """``series``: adopt an existing LatencySeries as the backing
         store (EngineStats hands its TTFT/TPOT series over this way —
-        one copy of the data, two views)."""
+        one copy of the data, two views).  ``buckets``: per-metric
+        Prometheus bucket-ladder override (first registration wins —
+        get-or-create semantics)."""
         return self._get_or_create(Histogram, name, labels, help,
-                                   series=series)
+                                   series=series, buckets=buckets)
 
     def metrics(self) -> list:
         """All registered metrics, in stable (name, labels) order."""

@@ -77,6 +77,7 @@ from ..models.gpt2_decode import (_advance_chunk, _advance_one,
                                   extract_params, prefill, prefill_chunk,
                                   spec_verify)
 from ..observe import monitor as _monitor
+from ..observe import requests as _reqs
 from ..observe import trace as _trace
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
@@ -575,13 +576,30 @@ class InferenceEngine:
                 f"request_id {request.request_id!r} is already "
                 f"in flight")
         handle = RequestHandle(request)
+        t_sub = self._clock()
+        if _reqs._active:
+            # request-ledger hook: one flag read when tracing is off.
+            # Starts (or, on a supervisor/fleet requeue, CONTINUES)
+            # this request's timeline with a hop on this engine
+            _reqs._ledger.on_submit(
+                request.request_id, engine=self.stats.engine_label,
+                t=t_sub, prompt_len=len(request.prompt_ids),
+                max_new_tokens=request.max_new_tokens)
         self.stats.on_submit()
         try:
             self.scheduler.enqueue(request)
         except Exception:
             self.stats.on_queue_full(request.request_id)
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=request.request_id,
+                         reason="queue_full")
+            if _reqs._active:
+                _reqs._ledger.on_reject(
+                    request.request_id, t=self._clock(),
+                    reason="queue_full",
+                    engine=self.stats.engine_label, started=False)
             raise
-        handle._submit_time = self._clock()
+        handle._submit_time = t_sub
         self._handles[request.request_id] = handle
         return handle
 
@@ -709,11 +727,24 @@ class InferenceEngine:
         self.stats.registry.counter(
             "resilience.engine_failures",
             help="serve engines failed by a raising decode/prefill").inc()
+        t_fail = self._clock()
+        lbl = self.stats.engine_label
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
             self._release_prefix(slot)
             rid = slot.handle.request.request_id
+            # typed rejections must be VISIBLE, not just raised: the
+            # instant puts the rejected request in the trace/flight
+            # recorder and the ledger hook keeps its timeline from
+            # vanishing from the request log
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=rid, reason="engine_failed",
+                         started=True)
+            if _reqs._active:
+                _reqs._ledger.on_reject(rid, t=t_fail,
+                                        reason="engine_failed",
+                                        engine=lbl, started=True)
             slot.handle._reject(EngineFailedError(
                 f"{msg} ({rid} was in flight, "
                 f"{len(slot.emitted)} tokens emitted)", request_id=rid,
@@ -723,6 +754,13 @@ class InferenceEngine:
         for req in self.scheduler.drain():
             h = self._handles.pop(req.request_id, None)
             if h is not None:
+                _trace.event("serve/request_rejected", cat="serve",
+                             request=req.request_id,
+                             reason="engine_failed", started=False)
+                if _reqs._active:
+                    _reqs._ledger.on_reject(req.request_id, t=t_fail,
+                                            reason="engine_failed",
+                                            engine=lbl, started=False)
                 h._reject(EngineFailedError(
                     f"{msg} ({req.request_id} was queued, not started)",
                     request_id=req.request_id, started=False,
@@ -734,6 +772,13 @@ class InferenceEngine:
         # handle would be cleared unresolved and the caller wedged
         for rid, h in list(self._handles.items()):
             if not h.done():
+                _trace.event("serve/request_rejected", cat="serve",
+                             request=rid, reason="engine_failed",
+                             started=False)
+                if _reqs._active:
+                    _reqs._ledger.on_reject(rid, t=t_fail,
+                                            reason="engine_failed",
+                                            engine=lbl, started=False)
                 h._reject(EngineFailedError(
                     f"{msg} ({rid} was admitting, not started)",
                     request_id=rid, started=False, engine_step=step))
@@ -763,6 +808,14 @@ class InferenceEngine:
         _trace.event("serve/shed", cat="serve", reason=reason,
                      request=victim.request_id,
                      priority=victim.priority)
+        _trace.event("serve/request_rejected", cat="serve",
+                     request=victim.request_id,
+                     reason=f"shed:{reason}")
+        if _reqs._active:
+            _reqs._ledger.on_reject(victim.request_id, t=self._clock(),
+                                    reason=f"shed:{reason}",
+                                    engine=self.stats.engine_label,
+                                    started=False)
         self._log.warning("shed %s (%s, priority=%d)",
                           victim.request_id, reason, victim.priority)
         return victim
@@ -833,11 +886,16 @@ class InferenceEngine:
                 fresh_compile=self.stats.decode_steps == 0)
         self.stats.on_decode_step(n_live)
         t_emit = self._clock()
+        led = _reqs._ledger if _reqs._active else None
+        lbl = self.stats.engine_label
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
+            rid = slot.handle.request.request_id
             if a_draft is None:
                 self._emit(i, slot, int(next_toks[i]), t_emit)
+                if led is not None:
+                    led.on_step(rid, engine=lbl, t=t_emit, tokens=1)
                 self._toks[i] = next_toks[i]
                 self._pos[i] += 1
                 continue
@@ -855,6 +913,14 @@ class InferenceEngine:
                 emitted += 1
                 if self._slots[i] is not slot:
                     break
+            if led is not None:
+                # per-step ledger record with the chunk's acceptance:
+                # emitted tokens (may stop mid-chunk), accepted
+                # proposals, proposals offered (lands on the sealed
+                # entry when the last token retired the request)
+                led.on_step(rid, engine=lbl, t=t_emit, tokens=emitted,
+                            accepted=int(a_draft[i]),
+                            drafted=self.spec_k - 1)
             if self._slots[i] is slot:
                 self._toks[i] = int(out[i, emitted - 1])
                 self._pos[i] += emitted
@@ -883,6 +949,15 @@ class InferenceEngine:
                 self._release_prefix(slot)
                 self._slots[idx] = None
                 self._handles.pop(req.request_id, None)
+                _trace.event("serve/request_rejected", cat="serve",
+                             request=req.request_id,
+                             reason="on_token_callback")
+                if _reqs._active:
+                    # started=True: tokens streamed — never requeued
+                    _reqs._ledger.on_reject(
+                        req.request_id, t=now,
+                        reason="on_token_callback",
+                        engine=self.stats.engine_label, started=True)
                 slot.handle._reject(e)
                 return
         stop = (req.stop_token is not None and token == req.stop_token)
@@ -900,6 +975,11 @@ class InferenceEngine:
         _trace.event("serve/retire", cat="serve",
                      request=req.request_id, slot=idx, tokens=n,
                      step=self.step_count)
+        if _reqs._active:
+            _reqs._ledger.on_retire(req.request_id,
+                                    engine=self.stats.engine_label,
+                                    t=now, finish_reason=finish_reason,
+                                    tokens=n)
         submit_t = getattr(slot.handle, "_submit_time", slot.admit_time)
         ttft = slot.first_token_time - submit_t
         tpot = ((now - slot.first_token_time) / (n - 1)
@@ -993,6 +1073,13 @@ class InferenceEngine:
             admit, expired = self.scheduler.schedule(len(free), now)
         for req in expired:
             self.stats.on_deadline_expired(req.request_id)
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=req.request_id, reason="deadline")
+            if _reqs._active:
+                _reqs._ledger.on_reject(req.request_id, t=now,
+                                        reason="deadline",
+                                        engine=self.stats.engine_label,
+                                        started=False)
             self._handles.pop(req.request_id)._reject(
                 DeadlineExceededError(
                     f"{req.request_id}: deadline {req.deadline} passed "
@@ -1036,6 +1123,14 @@ class InferenceEngine:
         if cache is not None:
             nodes = cache.lookup(req.prompt_ids)[
                 :(plen - 1) // cache.block_size]
+        if _reqs._active:
+            # admission started: the queue-wait phase of this hop ends
+            # HERE (cold/warm classification is annotated by the
+            # prefix cache's own hook below)
+            _reqs._ledger.on_admit(req.request_id,
+                                   engine=self.stats.engine_label,
+                                   t=now, slot=idx,
+                                   step=self.step_count)
         with _trace.span("serve/prefill", cat="serve",
                          request=req.request_id, slot=idx,
                          prompt_len=plen, step=self.step_count,
@@ -1049,7 +1144,8 @@ class InferenceEngine:
             temp = np.float32(req.temperature)
             if nodes:
                 tok0, carry_key, kc_row, vc_row = self._admit_warm(
-                    ids, plen, nodes, key0, temp)
+                    ids, plen, nodes, key0, temp,
+                    rid=req.request_id)
             else:
                 tok0, carry_key, kc_row, vc_row = _prefill_one(
                     self._params, ids_j, plen, key0, temp,
@@ -1070,19 +1166,28 @@ class InferenceEngine:
                     jnp.int32(idx))
         if cache is not None:
             cache.acquire(nodes)
-            cache.on_admit(len(nodes), plen)
+            cache.on_admit(len(nodes), plen,
+                           request_id=req.request_id)
         self.stats.on_prefill()
         slot = _Slot(handle, req.max_new_tokens, now, self.step_count)
         slot.prefix_nodes = nodes
         self._slots[idx] = slot
-        tok0 = int(np.asarray(tok0))
+        tok0 = int(np.asarray(tok0))  # device sync: prefill is done
+        t_first = self._clock()
+        self.stats.on_admission(
+            now - getattr(handle, "_submit_time", now),
+            t_first - now, warm=bool(nodes))
+        if _reqs._active:
+            _reqs._ledger.on_first_token(req.request_id,
+                                         engine=self.stats.engine_label,
+                                         t=t_first)
         self._toks[idx] = tok0
         self._pos[idx] = plen
         self._temps[idx] = temp
         self._keys = self._keys.at[idx].set(carry_key)
-        self._emit(idx, slot, tok0, self._clock())
+        self._emit(idx, slot, tok0, t_first)
 
-    def _admit_warm(self, ids, plen, nodes, key0, temp):
+    def _admit_warm(self, ids, plen, nodes, key0, temp, rid=None):
         """Warm admission: one gather copies the matched blocks into a
         fresh cache row, then block-width ``_chunk_row`` calls prefill
         [divergence, last-block-end) — fixed shapes throughout, so the
@@ -1098,6 +1203,10 @@ class InferenceEngine:
             hidden, kc_row, vc_row = _chunk_row(
                 self._params, ids_j, kc_row, vc_row, jnp.int32(off),
                 **self._chunk_statics)
+            if _reqs._active and rid is not None:
+                _reqs._ledger.on_prefill_chunk(
+                    rid, engine=self.stats.engine_label,
+                    t=self._clock(), offset=off)
             off += B
         tok0, carry_key = _first_from_hidden(
             self._params, hidden, jnp.int32(plen - 1 - last_off),

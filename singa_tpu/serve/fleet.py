@@ -70,6 +70,7 @@ import weakref
 import numpy as np
 
 from ..observe import monitor as _monitor
+from ..observe import requests as _reqs
 from ..observe import trace as _trace
 from ..observe.registry import registry as _registry
 from ..resilience import faults as _faults
@@ -320,7 +321,25 @@ class ServeFleet:
             _faults.check("serve.route")
         handle = RequestHandle(request)
         route = _Route(handle, self.step_count)
-        idx, inner = self._route(request)
+        try:
+            idx, inner = self._route(request)
+        except FleetDownError:
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=rid, reason="fleet_down")
+            if _reqs._active:
+                # no replica ever accepted it: give the request log a
+                # terminal entry anyway (requests refused by a downed
+                # fleet must not vanish from observability)
+                _reqs._ledger.on_reject(
+                    rid, t=self._clock(), reason="fleet_down",
+                    started=False,
+                    prompt_len=len(request.prompt_ids),
+                    max_new_tokens=request.max_new_tokens)
+            raise
+        if _reqs._active:
+            # engine.submit (inside the supervisor) opened the hop;
+            # stamp WHICH replica the router chose on it
+            _reqs._ledger.annotate_hop(rid, replica=idx)
         route.attempts.append((idx, inner))
         self._routes[rid] = route
         self._order.append(rid)
@@ -517,6 +536,14 @@ class ServeFleet:
             if live_elsewhere:
                 continue  # a hedge is still running on a healthy sibling
             if not requeue_safe:
+                _trace.event("serve/request_rejected", cat="serve",
+                             request=rid, reason="failover_terminal",
+                             replica=rep.idx)
+                if _reqs._active:
+                    _reqs._ledger.on_reject(
+                        rid, t=self._clock(),
+                        reason="failover_terminal",
+                        started=getattr(err, "started", None))
                 route.handle._reject(err)
                 continue
             try:
@@ -529,8 +556,23 @@ class ServeFleet:
                 # admission — an escape here would leave this route
                 # unresolved forever (needs_failover was already
                 # cleared)
+                _trace.event("serve/request_rejected", cat="serve",
+                             request=rid,
+                             reason="failover_unplaceable")
+                if _reqs._active:
+                    _reqs._ledger.on_reject(
+                        rid, t=self._clock(),
+                        reason=f"failover_unplaceable:"
+                               f"{type(e2).__name__}",
+                        started=False)
                 route.handle._reject(e2)
                 continue
+            if _reqs._active:
+                # engine.submit reopened the timeline on the sibling;
+                # record the hop's cause and both replica indices
+                _reqs._ledger.annotate_hop(rid, replica=idx2,
+                                           via="failover",
+                                           src_replica=rep.idx)
             route.attempts.append((idx2, inner2))
             self._c_requeues[rep.idx].inc()
             _trace.event("serve/fleet_requeue", cat="serve",
@@ -590,6 +632,12 @@ class ServeFleet:
                 idx2, inner2 = self._route(req, exclude={idx0})
             except (EngineFailedError, QueueFullError, LoadShedError):
                 continue  # nowhere better to run it; not an error
+            if _reqs._active:
+                # the hedge twin is a CONCURRENT hop on the same
+                # timeline (engine labels disambiguate its events)
+                _reqs._ledger.annotate_hop(rid, replica=idx2,
+                                           via="hedge",
+                                           src_replica=idx0)
             route.attempts.append((idx2, inner2))
             route.hedged = True
             self._c_hedges[idx2].inc()

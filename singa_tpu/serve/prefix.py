@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observe import requests as _reqs
 from ..observe.registry import registry as _default_registry
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
@@ -315,15 +316,22 @@ class PrefixCache:
                     "prefix-cache refcount underflow (double release "
                     f"of block {n.block})")
 
-    def on_admit(self, hit_blocks, prompt_len):
+    def on_admit(self, hit_blocks, prompt_len, request_id=None):
         """Metrics for one admission: ``hit_blocks`` usable cached
-        blocks against a ``prompt_len``-token prompt."""
+        blocks against a ``prompt_len``-token prompt.  With the
+        request ledger on, also annotates the request's timeline with
+        the authoritative cold/warm verdict and hit-token count (the
+        cache owns hit accounting; the engine only owns timing)."""
         self._c_lookup_tokens.inc(int(prompt_len))
         if hit_blocks > 0:
             self._c_hits.inc()
             self._c_hit_tokens.inc(int(hit_blocks) * self.block_size)
         else:
             self._c_misses.inc()
+        if _reqs._active and request_id is not None:
+            _reqs._ledger.on_prefix(
+                request_id,
+                hit_tokens=int(hit_blocks) * self.block_size)
 
     # -- allocation / eviction -------------------------------------------
     def _evict_one(self):

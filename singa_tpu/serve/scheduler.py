@@ -30,6 +30,7 @@ from __future__ import annotations
 from collections import deque
 from typing import List, Tuple
 
+from ..observe import requests as _reqs
 from ..observe.registry import registry as _registry
 from .request import GenerationRequest, QueueFullError
 
@@ -65,6 +66,12 @@ class FIFOScheduler:
                 f"max {self.max_queue_depth}); rejecting "
                 f"{request.request_id}")
         self._queue.append(request)
+        if _reqs._active:
+            # request-ledger hook: how many requests sat ahead of this
+            # one at enqueue — the queue-wait phase's explanation
+            _reqs._ledger.annotate_hop(
+                request.request_id,
+                queue_depth_at_enqueue=len(self._queue) - 1)
 
     def drain(self) -> List[GenerationRequest]:
         """Remove and return every queued request (queue order) — the

@@ -32,6 +32,12 @@ Metric definitions (the serving-standard ones):
   agreeing (acceptance collapses toward 0, steps emit ~1 token) shows
   a proportionally worse TPOT and ``tpot_ewma``, and the fleet Router
   prices it out honestly without any speculation-specific wiring.
+* **serve.request.queue_wait_s / serve.request.admission_s{kind=}**:
+  the TTFT split — submit→admission (queue wait) and admission→first
+  token (prefill, ``kind=cold|warm``), the same per-request numbers
+  the request ledger (``observe.requests``) attributes, exported as
+  bucketed Prometheus histograms so the split aggregates across a
+  fleet.
 * **serve.spec.{accepted,drafted}** (speculative engines only):
   draft proposals the target verify kept / offered — the realized
   acceptance rate on live traffic, the number the speculation-vs-
@@ -99,6 +105,21 @@ class EngineStats:
             "serve.tpot", help="mean inter-token seconds", **lbl)
         self.ttft = self._h_ttft.series
         self.tpot = self._h_tpot.series
+        # request-lifecycle phase histograms (the ledger's queue/
+        # prefill decomposition, as aggregable Prometheus series): the
+        # fed values are the SAME numbers the request ledger records
+        # per timeline, so the histogram percentiles and the ledger's
+        # why_slow attribution can never disagree about the population
+        self._h_queue_wait = reg.histogram(
+            "serve.request.queue_wait_s",
+            help="submit->admission seconds (queue-wait phase of "
+                 "TTFT)", **lbl)
+        self._h_admission = {
+            kind: reg.histogram(
+                "serve.request.admission_s",
+                help="admission->first-token seconds (prefill phase "
+                     "of TTFT, cold vs prefix-warm)", kind=kind, **lbl)
+            for kind in ("cold", "warm")}
         self._queue_depth = reg.gauge(
             "serve.queue_depth", help="scheduler queue depth", **lbl)
         self._occupancy = reg.gauge(
@@ -114,7 +135,8 @@ class EngineStats:
             self._submitted, self._completed, self._rej_deadline,
             self._rej_queue, self._prefills, self._decode_steps,
             self._tokens_out, self._queue_depth, self._occupancy,
-            self._h_ttft, self._h_tpot,
+            self._h_ttft, self._h_tpot, self._h_queue_wait,
+            self._h_admission["cold"], self._h_admission["warm"],
         ]
         # set by the engine when a prefix cache is attached: a
         # zero-arg callable returning the cache's snapshot dict
@@ -210,6 +232,15 @@ class EngineStats:
 
     def on_prefill(self):
         self._prefills.inc()
+
+    def on_admission(self, queue_wait_s, admission_s, warm=False):
+        """One admission's latency split: ``queue_wait_s`` (submit ->
+        the scheduling pass that admitted it) and ``admission_s``
+        (admission -> first token, the prefill cost — labeled
+        ``kind=warm`` when a prefix-cache hit skipped most of it)."""
+        self._h_queue_wait.observe(queue_wait_s)
+        self._h_admission["warm" if warm else "cold"].observe(
+            admission_s)
 
     def on_token(self):
         self._tokens_out.inc()

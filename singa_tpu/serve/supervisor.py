@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import time
 
+from ..observe import requests as _reqs
 from ..observe import trace as _trace
 from ..observe.registry import registry as _registry
 from ..utils.logging import get_channel
@@ -156,6 +157,18 @@ class EngineSupervisor:
         _trace.event("serve/shed", cat="serve", reason="slo_admission",
                      request=incoming.request_id,
                      priority=incoming.priority)
+        _trace.event("serve/request_rejected", cat="serve",
+                     request=incoming.request_id,
+                     reason="shed:slo_admission")
+        if _reqs._active:
+            # refused BEFORE any engine accepted it: the ledger still
+            # gets a (minimal, terminal) entry so the request log
+            # shows the refusal instead of nothing
+            _reqs._ledger.on_reject(
+                incoming.request_id, t=self._clock(),
+                reason="shed:slo_admission", started=False,
+                prompt_len=len(incoming.prompt_ids),
+                max_new_tokens=incoming.max_new_tokens)
         raise LoadShedError(
             f"{incoming.request_id} refused: queue at SLO pressure "
             f"(depth {self.engine.scheduler.queue_depth} >= "
@@ -257,9 +270,21 @@ class EngineSupervisor:
                 f"restarts allowed); engine keeps failing")
             self._log.error("%s — rejecting %d remaining requests",
                             err, len(requeue))
+            t_rej = self._clock()
             for rid in requeue:
                 outer = self._outer.pop(rid, None)
                 if outer is not None and not outer.done():
+                    _trace.event("serve/request_rejected", cat="serve",
+                                 request=rid,
+                                 reason="restart_budget_exceeded")
+                    if _reqs._active:
+                        # the engine already sealed this timeline as a
+                        # requeue-safe failure; this marks the
+                        # supervisor's TERMINAL verdict on it
+                        _reqs._ledger.on_reject(
+                            rid, t=t_rej,
+                            reason="restart_budget_exceeded",
+                            started=False)
                     outer._reject(RestartBudgetExceededError(
                         f"{rid}: {err}", request_id=rid,
                         started=False))
@@ -273,6 +298,11 @@ class EngineSupervisor:
         for rid in requeue:
             self._inner[rid] = self.engine.submit(
                 self._outer[rid].request)
+            if _reqs._active:
+                # engine.submit reopened the timeline with a hop on
+                # the REBUILT engine; say why the hop exists
+                _reqs._ledger.annotate_hop(rid, via="supervisor_restart",
+                                           restart=self.restarts)
 
     def abandon(self, reason="fleet failover"):
         """Fleet failover entry point: mark this supervisor dead WITHOUT
@@ -292,6 +322,7 @@ class EngineSupervisor:
         self._dead = True
         started_ids = self.engine.live_request_ids
         step = self.engine.step_count
+        t_ab = self._clock()
         n_requeueable = 0
         for rid in list(self._order):
             inner = self._inner.pop(rid, None)
@@ -307,6 +338,17 @@ class EngineSupervisor:
                     outer._finish(inner._result)
                 continue
             started = rid in started_ids
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=rid, reason="abandoned",
+                         started=started)
+            if _reqs._active:
+                # the engine never drove this rejection (abandon does
+                # not touch a possibly-wedged engine), so the ledger
+                # seal happens here; started=False entries reopen when
+                # the fleet requeues them on a sibling
+                _reqs._ledger.on_reject(rid, t=t_ab,
+                                        reason=f"abandoned:{reason}",
+                                        started=started)
             outer._reject(EngineFailedError(
                 f"{rid}: supervisor abandoned at step {step} ({reason})",
                 request_id=rid, started=started, engine_step=step))

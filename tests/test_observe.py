@@ -251,14 +251,52 @@ def test_prometheus_text_format():
     assert "singa_tpu_graph_cache_miss_total 3" in lines
     assert "# TYPE singa_tpu_serve_queue_depth gauge" in lines
     assert 'singa_tpu_serve_queue_depth{engine="0"} 2' in lines
-    assert "# TYPE singa_tpu_serve_ttft summary" in lines
-    assert ('singa_tpu_serve_ttft{engine="0",quantile="0.5"} 0.2'
-            in lines)
+    # histograms export as REAL histogram families (cumulative
+    # _bucket series aggregable across a fleet of scraped replicas),
+    # with the in-process nearest-rank quantiles as a sibling gauge
+    # family — not as quantile samples inside the histogram family,
+    # which conformant scrapers reject
+    assert "# TYPE singa_tpu_serve_ttft histogram" in lines
+    assert 'singa_tpu_serve_ttft_bucket{engine="0",le="0.25"} 1' \
+        in lines
+    assert 'singa_tpu_serve_ttft_bucket{engine="0",le="0.5"} 2' \
+        in lines
+    assert 'singa_tpu_serve_ttft_bucket{engine="0",le="+Inf"} 2' \
+        in lines
     assert 'singa_tpu_serve_ttft_count{engine="0"} 2' in lines
+    assert "# TYPE singa_tpu_serve_ttft_quantile gauge" in lines
+    assert ('singa_tpu_serve_ttft_quantile{engine="0",quantile="0.5"}'
+            ' 0.2' in lines)
     # exposition charset: no dots/slashes survive in metric names
     for ln in lines:
         if not ln.startswith("#"):
             assert "." not in ln.split("{")[0].split(" ")[0]
+
+
+def test_prometheus_bucket_override_and_inf_invariant():
+    """Per-metric bucket ladders override the default, cumulative
+    counts are monotone, and le="+Inf" always equals _count."""
+    reg = MetricsRegistry()
+    h = reg.histogram("serve.request.queue_wait_s", engine="0",
+                      buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.bucket_counts() == [(0.1, 1), (1.0, 2),
+                                 (float("inf"), 3)]
+    lines = export.prometheus_text(reg).splitlines()
+    pfx = "singa_tpu_serve_request_queue_wait_s"
+    assert f'{pfx}_bucket{{engine="0",le="0.1"}} 1' in lines
+    assert f'{pfx}_bucket{{engine="0",le="1"}} 2' in lines
+    assert f'{pfx}_bucket{{engine="0",le="+Inf"}} 3' in lines
+    assert f'{pfx}_count{{engine="0"}} 3' in lines
+    # a default-ladder histogram ends in the same invariant
+    d = reg.histogram("serve.ttft", engine="0")
+    d.observe(0.2)
+    lines = export.prometheus_text(reg).splitlines()
+    assert 'singa_tpu_serve_ttft_bucket{engine="0",le="+Inf"} 1' \
+        in lines
+    with pytest.raises(ValueError):
+        reg.histogram("bad.buckets", buckets=(1.0, 0.5))
 
 
 def test_prometheus_sum_count_stay_consistent_under_windowing():
@@ -351,10 +389,11 @@ def test_engine_stats_unregister_releases_metrics():
     a = EngineStats(2, FakeClock(), reg=reg)
     b = EngineStats(2, FakeClock(), reg=reg)
     a.on_submit()
-    assert len(reg.metrics()) == 22  # 11 per engine
+    assert len(reg.metrics()) == 28  # 14 per engine (incl. the
+    #   queue-wait + cold/warm admission request-phase histograms)
     a.unregister()
     remaining = reg.metrics()
-    assert len(remaining) == 11
+    assert len(remaining) == 14
     assert all(("engine", b.engine_label) in m.labels
                for m in remaining)
     # a fully-removed NAME frees its kind reservation

@@ -1,0 +1,496 @@
+"""Per-request lifecycle ledger (observe.requests): timeline
+completeness on a live engine, hop continuity across supervisor
+restarts and fleet failovers, typed-rejection visibility, the
+disabled-mode zero-overhead pin, tail-latency attribution arithmetic,
+and the JSONL / Chrome-trace export surface.
+
+Engine-backed tests drive the REAL serve stack (tiny model, seeded
+fault injection — the test_supervisor/test_fleet idiom); attribution
+tests feed the ledger hooks directly on a fake timeline so the phase
+arithmetic is pinned exactly."""
+
+import json
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.observe import export, requests as reqtrace
+from singa_tpu.observe.health import health_report
+from singa_tpu.observe.requests import RequestLedger
+from singa_tpu.resilience import FailAfterN, faults
+from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                             GenerationRequest, PrefixCacheConfig,
+                             QueueFullError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    reqtrace.disable()
+    yield
+    faults.clear()
+    reqtrace.disable()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+def _workload(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, 256, rng.randint(3, 10)).astype(np.int32),
+             int(rng.randint(2, 7))) for _ in range(n)]
+
+
+def _assert_monotonic(entry):
+    """Every recorded timestamp in the entry is non-decreasing in
+    causal order: submit <= admit <= chunks <= first token <= steps <=
+    retire, hop over hop."""
+    t = entry["t_submit"]
+    for h in entry["hops"]:
+        assert h["t_submit"] >= t
+        t = h["t_submit"]
+        if h["t_admit"] is not None:
+            assert h["t_admit"] >= t
+            t = h["t_admit"]
+        for ct, _off in h["chunks"]:
+            assert ct >= t
+            t = ct
+        if h["t_first_token"] is not None:
+            assert h["t_first_token"] >= t
+            t = h["t_first_token"]
+        for s in h["steps"]:
+            assert s[0] >= t
+            t = s[0]
+    if entry["t_retire"] is not None:
+        assert entry["t_retire"] >= t
+
+
+# ---------------------------------------------------------------------------
+# live engine: timeline completeness
+# ---------------------------------------------------------------------------
+
+def test_engine_run_records_complete_timelines(model):
+    """Every completed request gets one sealed entry: submit ->
+    admission -> first token -> per-step emissions -> retire, with
+    monotonic timestamps, exact phase sums, and the queue depth it
+    saw at enqueue."""
+    work = _workload(5, seed=0)
+    led = reqtrace.enable(capacity=64)
+    with model.serve(max_slots=2) as eng:
+        hs = [eng.submit(GenerationRequest(p, max_new_tokens=n))
+              for p, n in work]
+        eng.run_until_complete(max_steps=500)
+        for h in hs:
+            h.result()
+    entries = led.entries()
+    assert len(entries) == len(work)
+    assert led.open_count == 0
+    by_rid = {e["request_id"]: e for e in entries}
+    for (p, n), h in zip(work, hs):
+        e = by_rid[h.request.request_id]
+        assert e["outcome"] == "length"
+        assert e["prompt_len"] == len(p)
+        assert e["tokens_out"] == n
+        assert len(e["hops"]) == 1
+        hop = e["hops"][0]
+        assert hop["via"] == "submit"
+        assert hop["queue_depth_at_enqueue"] is not None
+        assert hop["admit_kind"] == "cold"
+        assert hop["slot"] is not None
+        assert hop["tokens"] == n
+        # first token at admission + one step record per decode step
+        assert len(hop["steps"]) == n - 1
+        _assert_monotonic(e)
+        # attribution is exact arithmetic: the first three phases sum
+        # to TTFT and all five to total latency
+        ph = e["phases"]
+        ttft = ph["hops"] + ph["queue"] + ph["prefill"]
+        assert ttft == pytest.approx(e["ttft_s"], abs=1e-9)
+        total = sum(ph.values())
+        assert total == pytest.approx(e["t_retire"] - e["t_submit"],
+                                      abs=1e-9)
+    # health_report carries the attribution section while enabled
+    ws = health_report(include_registry=False)["serve"]["why_slow"]
+    assert ws["enabled"] is True
+    assert ws["completed"] == len(work)
+    assert ws["ttft_p99_s"] > 0
+    att = ws["ttft_p99_attribution"]
+    assert att and sum(v["frac"] for v in att.values()) \
+        == pytest.approx(1.0)
+
+
+def test_prefix_warm_admission_annotates_hit_tokens(model):
+    """The prefix cache's hook owns the cold/warm verdict: a repeated
+    prompt's second admission is marked warm with the cached-token
+    count, and its warm-prefill chunks are on the timeline."""
+    led = reqtrace.enable()
+    p = (np.arange(40) % 256).astype(np.int32)
+    cachecfg = PrefixCacheConfig(block_size=8, num_blocks=32)
+    with model.serve(max_slots=1, prefix_cache=cachecfg) as eng:
+        h1 = eng.submit(GenerationRequest(p, max_new_tokens=3))
+        eng.run_until_complete(max_steps=200)
+        h1.result()
+        h2 = eng.submit(GenerationRequest(p, max_new_tokens=3))
+        eng.run_until_complete(max_steps=200)
+        h2.result()
+    e1 = led.entry(h1.request.request_id)
+    e2 = led.entry(h2.request.request_id)
+    assert e1["hops"][0]["admit_kind"] == "cold"
+    assert e1["hops"][0]["hit_tokens"] == 0
+    assert e2["hops"][0]["admit_kind"] == "warm"
+    assert e2["hops"][0]["hit_tokens"] > 0
+    assert e2["hops"][0]["chunks"]  # warm path prefills by chunk
+    _assert_monotonic(e2)
+
+
+# ---------------------------------------------------------------------------
+# disabled-mode zero overhead
+# ---------------------------------------------------------------------------
+
+def test_disabled_mode_no_entries_no_ring_growth(model):
+    """With the ledger off (the default), serve traffic allocates
+    nothing: no live ledger, and a previously-enabled ledger's ring
+    does not grow after disable()."""
+    assert reqtrace.active() is False
+    assert reqtrace.ledger() is None
+    led = reqtrace.enable()
+    reqtrace.disable()
+    assert reqtrace.active() is False
+    with model.serve(max_slots=2) as eng:
+        hs = [eng.submit(GenerationRequest(p, max_new_tokens=n))
+              for p, n in _workload(3, seed=1)]
+        eng.run_until_complete(max_steps=300)
+        for h in hs:
+            h.result()
+    assert led.entries() == []
+    assert led.open_count == 0
+    assert led.dropped == 0
+    # the health section stays present but honest
+    ws = health_report(include_registry=False)["serve"]["why_slow"]
+    assert ws == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# hop continuity: supervisor restart + fleet failover
+# ---------------------------------------------------------------------------
+
+def test_supervisor_restart_hops_share_one_timeline(model):
+    """A mid-stream fault + supervised restart: each requeued request
+    keeps ONE ledger entry whose second hop says via=supervisor_restart
+    on the rebuilt engine; the in-flight request's entry ends in a
+    terminal started=True rejection."""
+    led = reqtrace.enable()
+    sup = EngineSupervisor(model, max_slots=1, restart_budget=2)
+    hs = [sup.submit(GenerationRequest(p, max_new_tokens=n,
+                                       temperature=0.0))
+          for p, n in _workload(4, seed=2)]
+    faults.inject("serve.decode_step", FailAfterN(2, times=1))
+    sup.run_until_complete(max_steps=500)
+    faults.clear()
+    requeued = typed = 0
+    for h in hs:
+        rid = h.request.request_id
+        e = led.entry(rid)
+        assert e is not None
+        try:
+            h.result()
+        except EngineFailedError:
+            typed += 1
+            assert e["outcome"] == "rejected"
+            assert e["started"] is True
+            # the terminal hop carries the typed-rejection record
+            assert e["hops"][-1]["reject"]["reason"] == "engine_failed"
+            _assert_monotonic(e)
+            continue
+        if len(e["hops"]) > 1:
+            requeued += 1
+            assert e["outcome"] == "length"
+            assert e["hops"][0]["reject"]["reason"] == "engine_failed"
+            assert e["hops"][0]["reject"]["started"] is False
+            assert e["hops"][1]["via"] == "supervisor_restart"
+            # one timeline, sealed once: a single JSONL record
+            assert sum(1 for ln in led.jsonl_lines()
+                       if json.loads(ln)["request_id"] == rid) == 1
+            _assert_monotonic(e)
+    assert requeued >= 1 and typed >= 1
+    sup.close()
+
+
+def test_fleet_failover_timeline_shows_both_replicas(model):
+    """A replica dying past its budget: the requeued request's single
+    timeline shows both replicas (hop 0 on the dead one, a
+    via=failover hop on the survivor) and the started request's shows
+    a terminal rejection hop on the dead replica."""
+    led = reqtrace.enable()
+    work = _workload(6, seed=3)
+    fleet = model.serve_fleet(replicas=2, max_slots=1,
+                              restart_budget=0)
+    hs = [fleet.submit(GenerationRequest(p, max_new_tokens=n))
+          for p, n in work]
+    faults.inject("serve.decode_step", FailAfterN(2, times=1))
+    fleet.run_until_complete(max_steps=1000)
+    faults.clear()
+    failed_over = typed = 0
+    for h in hs:
+        e = led.entry(h.request.request_id)
+        assert e is not None
+        try:
+            h.result()
+        except EngineFailedError:
+            typed += 1
+            assert e["outcome"] == "rejected"
+            assert e["hops"][-1]["reject"] is not None
+            _assert_monotonic(e)
+            continue
+        if len(e["hops"]) > 1:
+            failed_over += 1
+            assert e["outcome"] == "length"
+            h0, h1 = e["hops"][0], e["hops"][-1]
+            assert h1["via"] == "failover"
+            assert h0["replica"] is not None
+            assert h1["replica"] is not None
+            assert h0["replica"] != h1["replica"]
+            assert h1["src_replica"] == h0["replica"]
+            # different engines served the two hops
+            assert h0["engine"] != h1["engine"]
+            _assert_monotonic(e)
+    assert failed_over >= 1 and typed >= 1
+    # the failed-over requests burned real time on the dead replica:
+    # their attribution shows a non-zero hops phase, and why_slow's
+    # evidence list carries the full hop chain
+    ws = led.why_slow(top_k=len(work))
+    assert ws["completed"] + ws["rejected"] == len(work)
+    slow_hops = [s for s in ws["slowest"] if len(s["hops"]) > 1]
+    assert slow_hops and all(s["phases"]["hops"] > 0
+                             for s in slow_hops)
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# typed rejections stay visible
+# ---------------------------------------------------------------------------
+
+def test_queue_full_rejection_lands_in_ledger_and_trace(model):
+    """The small-fix satellite: a refused request must appear in the
+    ledger (terminal entry) AND as a serve/request_rejected trace
+    instant instead of vanishing from observability."""
+    from singa_tpu.observe import trace
+    from singa_tpu.serve import FIFOScheduler
+
+    led = reqtrace.enable()
+    trace.enable()
+    try:
+        with model.serve(max_slots=1,
+                         scheduler=FIFOScheduler(
+                             max_queue_depth=2)) as eng:
+            p = np.asarray([1, 2, 3], np.int32)
+            h1 = eng.submit(GenerationRequest(p, max_new_tokens=2))
+            h2 = eng.submit(GenerationRequest(p, max_new_tokens=2))
+            with pytest.raises(QueueFullError):
+                eng.submit(GenerationRequest(p, max_new_tokens=2))
+            eng.run_until_complete(max_steps=200)
+            h1.result(), h2.result()
+        rejected = [e for e in led.entries()
+                    if e["outcome"] == "rejected"]
+        assert len(rejected) == 1
+        e = rejected[0]
+        assert e["reason"] == "queue_full"
+        assert e["started"] is False
+        assert e["hops"][-1]["reject"]["reason"] == "queue_full"
+        evs = [ev for ev in trace.events()
+               if ev.get("name") == "serve/request_rejected"]
+        assert any(ev["args"]["request"] == e["request_id"]
+                   and ev["args"]["reason"] == "queue_full"
+                   for ev in evs)
+    finally:
+        trace.disable()
+        trace.clear()
+
+
+# ---------------------------------------------------------------------------
+# attribution arithmetic on a fake timeline
+# ---------------------------------------------------------------------------
+
+def _fake_completed(led, rid, engine="0", replica=0, t0=0.0,
+                    queue=1.0, prefill=0.5, steps=(0.1,) * 5):
+    led.on_submit(rid, engine=engine, t=t0, prompt_len=8,
+                  max_new_tokens=len(steps) + 1)
+    led.annotate_hop(rid, replica=replica, queue_depth_at_enqueue=2)
+    led.on_admit(rid, engine=engine, t=t0 + queue, slot=0)
+    t = t0 + queue + prefill
+    led.on_first_token(rid, engine=engine, t=t)
+    for dt in steps:
+        t += dt
+        led.on_step(rid, engine=engine, t=t, tokens=1)
+    led.on_retire(rid, engine=engine, t=t, finish_reason="length",
+                  tokens=len(steps) + 1)
+    return t - t0
+
+
+def test_why_slow_attribution_decomposes_exactly():
+    """Pinned arithmetic: a queue-dominated slow request on replica 1
+    shows ~80% queue in the p99 attribution, fractions sum to 1, and
+    the per-replica split names the right replica."""
+    led = RequestLedger()
+    for i in range(9):
+        _fake_completed(led, f"fast-{i}", replica=0, queue=0.01,
+                        prefill=0.05)
+    _fake_completed(led, "slow", replica=1, queue=8.0, prefill=1.5)
+    ws = led.why_slow(top_k=3)
+    assert ws["completed"] == 10
+    # nearest-rank p99 over 10 values = the slowest request
+    assert ws["ttft_p99_s"] == pytest.approx(9.5)
+    att = ws["ttft_p99_attribution"]
+    assert att["queue"]["frac"] == pytest.approx(8.0 / 9.5)
+    assert sum(v["frac"] for v in att.values()) == pytest.approx(1.0)
+    assert set(ws["per_replica"]) == {"1"}
+    assert ws["per_replica"]["1"]["requests"] == 1
+    top = ws["slowest"][0]
+    assert top["request_id"] == "slow"
+    assert top["dominant_phase"] == "queue"
+    assert top["phases"]["queue"] == pytest.approx(8.0)
+    assert top["phases"]["prefill"] == pytest.approx(1.5)
+
+
+def test_stall_carved_out_of_decode():
+    """An inter-token gap far beyond the request's own median is
+    attributed to stall, not decode — and the five phases still sum
+    to total latency exactly."""
+    led = RequestLedger()
+    total = _fake_completed(
+        led, "stalled", steps=(0.1, 0.1, 0.1, 5.0, 0.1, 0.1))
+    e = led.entry("stalled")
+    ph = e["phases"]
+    assert ph["stall"] == pytest.approx(4.9)   # excess over the median
+    assert ph["decode"] == pytest.approx(5.5 - 4.9)
+    assert sum(ph.values()) == pytest.approx(total)
+    ws = led.why_slow()
+    assert ws["tpot_p99_attribution"]["stall"]["frac"] > 0.8
+
+
+def test_tpot_uses_retire_token_count():
+    """The engine emits, retires, THEN writes the step record, so the
+    hop's token tally lags by the final step at seal time — tpot must
+    come from on_retire's authoritative count, not the tally."""
+    led = RequestLedger()
+    led.on_submit("r", engine="0", t=0.0)
+    led.on_admit("r", engine="0", t=0.0, slot=0)
+    led.on_first_token("r", engine="0", t=0.0)
+    led.on_step("r", engine="0", t=1.0, tokens=1)
+    led.on_retire("r", engine="0", t=2.0, finish_reason="length",
+                  tokens=3)
+    led.on_step("r", engine="0", t=2.0, tokens=1)  # trailing record
+    e = led.entry("r")
+    assert e["final_hop"] == 0
+    assert e["tokens_out"] == 3
+    assert e["tpot_s"] == pytest.approx(2.0 / (3 - 1))
+    assert e["hops"][0]["tokens"] == 3  # tally catches up post-seal
+
+
+def test_hedge_winner_defines_latency():
+    """A hedged request's ttft/tpot and replica attribution come from
+    the hop whose engine RETIRED it, not the last hop by position
+    (the losing twin)."""
+    led = RequestLedger()
+    led.on_submit("h", engine="0", t=0.0)
+    led.on_admit("h", engine="0", t=0.1, slot=0)
+    led.on_first_token("h", engine="0", t=0.5)
+    # concurrent hedge twin on a slower engine
+    led.on_submit("h", engine="1", t=1.0)
+    led.annotate_hop("h", engine="1", via="hedge", replica=1)
+    led.on_admit("h", engine="1", t=1.2, slot=0)
+    led.on_first_token("h", engine="1", t=2.0)
+    # the ORIGINAL hop wins the race
+    led.on_retire("h", engine="0", t=1.5, finish_reason="length",
+                  tokens=3)
+    e = led.entry("h")
+    assert e["final_hop"] == 0
+    assert e["ttft_s"] == pytest.approx(0.5)
+    assert e["tpot_s"] == pytest.approx(1.0 / 2)
+    assert led._replica_key(e) == "engine:0"
+    # the loser's late retire only annotates, never reopens
+    led.on_retire("h", engine="1", t=2.5, finish_reason="length",
+                  tokens=3)
+    assert e["t_retire"] == 1.5
+    assert e["hops"][1]["duplicate_retire_t"] == 2.5
+
+
+def test_ring_capacity_bounds_and_drop_count():
+    led = RequestLedger(capacity=2)
+    for i in range(5):
+        _fake_completed(led, f"r{i}")
+    assert len(led.entries()) == 2
+    assert led.dropped == 3
+    assert [e["request_id"] for e in led.entries()] == ["r3", "r4"]
+    assert led.snapshot() == {"capacity": 2, "sealed": 2, "open": 0,
+                              "dropped": 3}
+    with pytest.raises(ValueError):
+        RequestLedger(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# export surface: JSONL + Chrome trace tracks
+# ---------------------------------------------------------------------------
+
+def test_request_log_is_strict_jsonl(tmp_path):
+    led = reqtrace.enable()
+    _fake_completed(led, "a")
+    led.on_submit("b", engine="0", t=0.0)
+    led.on_reject("b", t=1.0, reason="shed:slo_pressure", engine="0",
+                  started=False)
+    path = tmp_path / "requests.jsonl"
+    n = reqtrace.write_request_log(str(path))
+    assert n == 2
+    raiser = (lambda c: (_ for _ in ()).throw(ValueError(c)))
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    recs = [json.loads(ln, parse_constant=raiser) for ln in lines]
+    assert {r["request_id"] for r in recs} == {"a", "b"}
+    rej = next(r for r in recs if r["request_id"] == "b")
+    assert rej["outcome"] == "rejected"
+    assert rej["reason"] == "shed:slo_pressure"
+    reqtrace.disable()
+    with pytest.raises(RuntimeError, match="enable"):
+        reqtrace.write_request_log(str(path))
+    # an explicit ledger still exports after disable()
+    assert reqtrace.write_request_log(str(path), ledger_=led) == 2
+
+
+def test_chrome_trace_request_tracks_and_hop_flow():
+    """Per-request tracks: phase spans per hop, a rejection instant,
+    and a flow-arrow pair across the requeue hop boundary; merged into
+    chrome_trace under its own pid."""
+    led = RequestLedger()
+    # two-hop requeued request: hop 0 rejected requeue-safe, hop 1
+    # completes on another engine
+    led.on_submit("x", engine="0", t=0.0)
+    led.on_reject("x", t=1.0, reason="engine_failed", engine="0",
+                  started=False)
+    led.on_submit("x", engine="1", t=1.5)
+    led.annotate_hop("x", via="failover", replica=1)
+    led.on_admit("x", engine="1", t=2.0, slot=0)
+    led.on_first_token("x", engine="1", t=2.5)
+    led.on_retire("x", engine="1", t=3.0, finish_reason="length",
+                  tokens=2)
+    evs = export.request_trace_events(led.entries())
+    names = [e["name"] for e in evs]
+    assert names.count("queue") == 2      # one per hop
+    assert "prefill" in names and "decode" in names
+    assert "rejected" in names
+    flows = [e for e in evs if e["name"] == "hop"]
+    assert [f["ph"] for f in flows] == ["s", "f"]
+    assert flows[0]["id"] == flows[1]["id"]
+    doc = export.chrome_trace(events=[], requests=led.entries())
+    assert doc["otherData"]["request_tracks"] == 1
+    pids = {e["pid"] for e in doc["traceEvents"]
+            if e["name"] in ("queue", "prefill", "decode")}
+    assert pids == {1}
+    json.dumps(doc, allow_nan=False)
