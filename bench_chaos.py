@@ -21,6 +21,13 @@ recovery invariants the unit tests assert piecewise:
   (draft scan + chunk verify + rejection sample) fails typed, not
   wedged; the rebuilt engine gets fresh target AND draft arenas at
   zero recompiles and requeued streams keep byte parity.
+* **fault mid-swap (paged engine)** — a ``serve.paged_copy`` fault
+  against a block-paged engine whose pool deliberately over-commits
+  (growth swaps fire every round): the copy raises mid-preemption, the
+  engine fails TYPED (swapped requests ``started=True`` — tokens
+  streamed, never requeued), the supervisor rebuild gets a FRESH pool,
+  and requeued never-started streams keep byte parity, preemption and
+  swap/resume included post-restart.
 * **replica kill + fleet failover** — the same decode fault against a
   ``ServeFleet`` replica with a ZERO restart budget kills that replica
   outright mid-decode; the fleet requeues its never-started work onto
@@ -378,6 +385,98 @@ def chaos_spec(report):
     assert report["serve_spec"]["acceptance_rate"] > 0
 
 
+def chaos_paged(report):
+    """A fault in the paged arena's copy path (``serve.paged_copy``
+    fires in the admission scatter, the swap-out gather, and the
+    swap-in restore): the engine fails TYPED mid-operation — the
+    first injection lands on the first SWAP-OUT gather by
+    construction (two admissions check the site once each, the next
+    check is the preemption gather on this workload) — never wedges;
+    the supervisor rebuild gets a FRESH pool (zero blocks used), and
+    every request either completes with byte parity (requeued
+    never-started work, swap/resume included post-restart) or fails
+    typed started=True (live + swapped).  Zero wedged/lost,
+    restarts == injected."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest, PagedConfig)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(7)
+    # fixed 10-token prompts + 20-token budgets against a 6-block pool
+    # of 8-token blocks: two live slots grow past the pool and the
+    # growth self-swap fires every round
+    workload = [(rng.randint(0, 256, 10).astype(np.int32), 20)
+                for _ in range(8)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = 0
+    preempted_total = 0
+    for fail_after in (2, 7):
+        sup = EngineSupervisor(
+            m, max_slots=2, restart_budget=2,
+            paged=PagedConfig(block_size=8, num_blocks=6))
+        arena0 = sup.engine.paged_arena
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.paged_copy",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=4000)
+        faults.clear()
+        injected += pol.fired
+        if pol.fired:
+            assert sup.engine.paged_arena is not arena0, \
+                "rebuilt engine carried the old paged arena"
+        pg = sup.engine.stats.snapshot()["paged"]
+        preempted_total += pg["preemptions"]
+        assert pg["blocks_used"] == 0, \
+            f"drained paged engine leaked {pg['blocks_used']} blocks"
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "paged token stream diverged after restart"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve_paged"] = {
+        "requests": 2 * len(workload),
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "copy_faults_injected": injected,
+        "engine_restarts": restarts,
+        "preemptions": preempted_total,
+        "blocks_leaked": 0,
+    }
+    assert wedged == 0, f"{wedged} paged requests wedged/lost"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0 and typed_failed > 0
+    assert preempted_total > 0, "no preemption — the swap path was " \
+        "not exercised"
+    assert restarts == injected > 0, \
+        f"restarts ({restarts}) != injected copy faults ({injected})"
+
+
 def chaos_fleet(report):
     """Kill one replica mid-decode (``serve.decode_step`` fault against
     a zero restart budget): the fleet marks it unhealthy, requeues its
@@ -503,6 +602,7 @@ def main():
     chaos_serve(report)
     chaos_prefix(report)
     chaos_spec(report)
+    chaos_paged(report)
     chaos_fleet(report)
 
     health = observe.health_report(include_registry=False)

@@ -23,7 +23,11 @@ the bench is invalid if the engine is fast but wrong.
 
 Writes BENCH_SERVE.json (schema: workload/config/engine/static_batch/
 speedup/parity) so future PRs have a serving perf trajectory, and
-prints the same JSON to stdout.  ``--spec`` trains a bench-scale
+prints the same JSON to stdout.  ``--paged`` replays the workload
+through the block-paged KV engine vs the slot arena at the SAME
+persistent KV byte budget (the ``paged`` section: concurrent requests
+at fixed memory, tokens/s, byte parity with priority preemption
+exercised mid-run, recompile pin).  ``--spec`` trains a bench-scale
 target/draft pair and measures speculative serve (spec_k=4) against
 the plain engine on the same target — tokens/s, acceptance,
 accepted-tokens/chunk, byte parity, recompile pin (the ``spec``
@@ -171,22 +175,26 @@ def run_prefix_engine(m, workload, max_slots, prefix_cfg=None,
 
 
 def _serve_jit_cache_size():
-    """Total jit-cache entries across every executable the engine and
-    prefix cache dispatch — pinned across the timed runs to prove the
-    warm path introduces ZERO runtime recompiles."""
+    """Total jit-cache entries across every executable the engine,
+    prefix cache, and paged arena dispatch — pinned across the timed
+    runs to prove the warm path introduces ZERO runtime recompiles.
+    The paged pool steps dispatch through their own AOT compile cache
+    (cost-table capture), so its entry count rides the same pin."""
     from singa_tpu.serve import engine as E
+    from singa_tpu.serve import paged as G
     from singa_tpu.serve import prefix as P
 
     total = 0
     for f in (E._pool_decode_step, E._pool_spec_step, E._prefill_one,
               E._prefill_rows, E._write_slot, E._chunk_row,
               E._first_from_hidden, P._blocks_to_row,
-              P._row_to_blocks, P._read_slot):
+              P._row_to_blocks, P._read_slot, G._paged_decode_step,
+              G._paged_spec_step, G._pool_to_row, G._row_to_pool):
         try:
             total += f._cache_size()
         except Exception:
             return None  # jax without _cache_size: report honestly
-    return total
+    return total + G._compile_cache_size()
 
 
 def run_prefix_mix(max_slots):
@@ -268,6 +276,108 @@ def run_prefix_mix(max_slots):
         "lookup_tokens": pre["lookup_tokens"],
         "cached_blocks": pre["cached_blocks"],
         "evictions": pre["evictions"],
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": parity,
+    }
+
+
+def run_paged(m, workload, engine_outs):
+    """The --paged measurement: the standard ragged workload through
+    the SLOT-ARENA engine and through the PAGED engine at the SAME
+    persistent KV byte budget — ``max_slots * max_len`` slot positions
+    vs ``num_blocks * block_size`` pool positions (512 each here; the
+    pool carries one extra trash block).  The slot arena admits at
+    most ``max_slots`` concurrent requests whatever their lengths; the
+    paged engine admits by BLOCKS FREE, so the mostly-short workload
+    packs several times more live requests into the same bytes
+    (``concurrency_gain`` = peak live slots, paged / slot).
+
+    The paged run uses the PriorityScheduler with the long-budget
+    requests at LOW priority, so the pool deliberately over-commits
+    and priority preemption fires DURING the timed run (the gated
+    ``preemptions > 0``): token streams must stay byte-identical to
+    the slot engine's (same seed, same chain — swap/resume is a byte
+    copy) and the jit+AOT cache must stay pinned across both timed
+    runs."""
+    from singa_tpu.serve import GenerationRequest, PagedConfig
+
+    slot_slots = 4
+    pcfg = PagedConfig(block_size=16, num_blocks=32)  # == 4x128 positions
+    # 20 decode lanes over a 32-block pool: slots are host bookkeeping
+    # + vmap width, the PERSISTENT KV bytes are the pool — and 20
+    # mostly-short requests deliberately OVER-commit 32 blocks, so the
+    # growth/priority preemption path runs during the timed window
+    paged_slots = 20
+    paged_kw = dict(paged=pcfg, scheduler="priority")
+
+    def drive(max_slots, **kw):
+        eng = m.serve(max_slots=max_slots, **kw)
+        handles = []
+        pending = list(workload)
+        peak = 0
+        t0 = time.perf_counter()
+        while pending or eng.pending:
+            while pending and pending[0]["arrival_step"] <= eng.step_count:
+                w = pending.pop(0)
+                handles.append(eng.submit(GenerationRequest(
+                    w["prompt"], max_new_tokens=w["n_new"],
+                    priority=0 if w["n_new"] >= 48 else 1)))
+            eng.step()
+            peak = max(peak, eng.live_slots)
+        wall = time.perf_counter() - t0
+        outs = [h.result() for h in handles]
+        snap = eng.stats.snapshot()
+        eng.close()
+        return wall, outs, snap, peak
+
+    # warmup both geometries (compiles; the paged steps also populate
+    # their AOT cost-table cache here)
+    drive(slot_slots)
+    drive(paged_slots, **paged_kw)
+
+    jit_before = _serve_jit_cache_size()
+    wall_s, outs_s, snap_s, peak_s = drive(slot_slots)
+    wall_p, outs_p, snap_p, peak_p = drive(paged_slots, **paged_kw)
+    jit_after = _serve_jit_cache_size()
+
+    # engine_outs are oracle-verified by the main bench; per-stream
+    # equality here is transitively oracle parity — preemption/swap
+    # included, because resume restores bytes
+    parity = all(np.array_equal(a.tokens, b.tokens)
+                 for a, b in zip(outs_s, engine_outs))
+    parity &= all(np.array_equal(a.tokens, b.tokens)
+                  for a, b in zip(outs_p, engine_outs))
+
+    useful = sum(w["n_new"] for w in workload)
+    pg = snap_p["paged"]
+    return {
+        "kv_budget": {
+            "slot_positions": slot_slots * m.cfg.n_positions,
+            "paged_positions": pcfg.num_blocks * pcfg.block_size,
+            "block_size": pcfg.block_size,
+            "num_blocks": pcfg.num_blocks,
+            "slot_max_slots": slot_slots,
+            "paged_max_slots": paged_slots,
+        },
+        "slot_arena": {
+            "wall_s": wall_s,
+            "tokens_per_s": useful / wall_s,
+            "peak_concurrent": peak_s,
+            **_lat(snap_s),
+        },
+        "paged": {
+            "wall_s": wall_p,
+            "tokens_per_s": useful / wall_p,
+            "peak_concurrent": peak_p,
+            **_lat(snap_p),
+        },
+        "concurrency_gain": peak_p / peak_s,
+        "speedup_tokens_per_s": wall_s / wall_p,
+        "preemptions": pg["preemptions"],
+        "swap_in": pg["swap_in"],
+        "swap_out": pg["swap_out"],
+        "blocks_leaked": pg["blocks_used"],
         "recompiles": (None if jit_before is None
                        else jit_after - jit_before),
         "parity": parity,
@@ -624,6 +734,13 @@ def main():
                     help="also write the Prometheus text exposition "
                          "of the live metrics registry (bucketed "
                          "histogram families) at exit")
+    ap.add_argument("--paged", action="store_true",
+                    help="also run the workload through the paged-KV "
+                         "engine vs the slot arena at the SAME KV "
+                         "byte budget and embed the paged section "
+                         "(concurrency at fixed memory, tokens/s, "
+                         "priority preemption exercised, parity, "
+                         "recompile pin)")
     ap.add_argument("--prefix-mix", action="store_true",
                     help="also run the shared-system-prompt + "
                          "multi-turn session workload warm (radix "
@@ -751,6 +868,11 @@ def main():
         "health": observe.health_report(engine_snapshots=[snap],
                                         include_registry=False),
     }
+    if args.paged:
+        report["paged"] = run_paged(m, workload, outs_e)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
     if args.prefix_mix:
         report["prefix_mix"] = run_prefix_mix(max_slots)
         # the prefix engines ran after the health snapshot above;

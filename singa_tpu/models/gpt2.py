@@ -375,7 +375,13 @@ class GPT2LMHead(model.Model):
         speculative decoding (up to spec_k tokens per step; greedy
         streams byte-identical to the plain engine, sampled traffic
         served via rejection sampling) and ``cache_dtype="int8"`` for
-        a quantized KV arena).  See docs/SERVING.md "Fast decode"."""
+        a quantized KV arena.  ``paged=`` — a ``serve.PagedConfig``
+        replacing the worst-case slot arena with ONE block-paged KV
+        pool shared with the prefix cache: admission by blocks-free,
+        block-by-block growth, priority preemption with byte-exact
+        swap/resume; pair with ``scheduler="priority"`` for strict-
+        priority admission).  See docs/SERVING.md "Fast decode" and
+        "Paged KV and preemption"."""
         from ..serve import InferenceEngine
 
         return InferenceEngine(self, **kw)

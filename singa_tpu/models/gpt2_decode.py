@@ -587,7 +587,16 @@ def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2):
     over the position axis with identical per-row reduction structure;
     pinned by tests/test_prefix.py) — which is what lets the serve
     engine's warm-prefix admissions emit byte-identical token streams
-    to cold prefill."""
+    to cold prefill.  The paged serve arena (serve/paged.py) leans on
+    the same guarantee for its zero-copy donation path: a retiring
+    slot's prompt blocks hold prefill/chunk output, so the radix tree
+    adopts them in place.  NOTE the guarantee is about DENSE rows:
+    with a quantized (int8) cache this function is self-consistent —
+    the same chunk over the same quantized cache reproduces itself
+    bitwise — but the hidden states attend DEQUANTIZED keys where the
+    full ``prefill``'s attend float ones, which is why int8 engines
+    with a prefix cache route every admission (cold included) through
+    the chunked path (engine._admit)."""
     new_kc, new_vc = [], []
     for li, p in enumerate(params["blocks"]):
         x, kl, vl = _block_chunk(x, p, _cache_layer(kc, li),

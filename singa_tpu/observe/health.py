@@ -116,6 +116,24 @@ def _prefix_section(snap: dict) -> dict:
     }
 
 
+def _paged_section(snap: dict) -> dict:
+    """The ``serve.paged`` health section: block-pool accounting and
+    preemption/swap counters summed across engines (zeros when no
+    paged engine ever ran — always present so dashboards can alert
+    unconditionally).  ``blocks_used``/``blocks_free`` are gauges (the
+    CURRENT pool state, last-written engine set included); the
+    counters are lifetime totals."""
+    counters, gauges = snap["counters"], snap["gauges"]
+    return {
+        "blocks_free": _sum_metric(gauges, "serve.paged.blocks_free"),
+        "blocks_used": _sum_metric(gauges, "serve.paged.blocks_used"),
+        "preemptions": _sum_metric(counters,
+                                   "serve.paged.preemptions"),
+        "swap_out": _sum_metric(counters, "serve.paged.swap_out"),
+        "swap_in": _sum_metric(counters, "serve.paged.swap_in"),
+    }
+
+
 def _spec_section(snap: dict) -> dict:
     """The ``serve.spec`` health section: speculative-decoding
     acceptance counters summed across engines (zeros when no engine
@@ -259,6 +277,7 @@ def health_report(reg=None, engine_snapshots=(),
                 if engine_snapshots else None),
             "slo_violations": _slo_violations(snap["counters"]),
             "prefix": _prefix_section(snap),
+            "paged": _paged_section(snap),
             "spec": _spec_section(snap),
             "fleet": _fleet_section(snap),
             # tail-latency attribution from the request ledger

@@ -148,16 +148,39 @@ def _process_info() -> dict:
     return info
 
 
+#: extra cost-table providers (serve-side AOT compiles — the paged
+#: pool steps register one): zero-arg callables returning
+#: [{"key": str, "cost": {scalars}}] entries for crash bundles
+_extra_cost_sources = []
+
+
+def register_cost_source(provider):
+    """Register a zero-arg callable contributing XLA cost-table
+    entries to :func:`dump_report` bundles alongside the graph
+    runners' tables.  Serve-side executables (``serve/paged.py``'s
+    AOT-compiled pool steps) use this so their compiles are just as
+    visible post-mortem as a train step's."""
+    if provider not in _extra_cost_sources:
+        _extra_cost_sources.append(provider)
+
+
 def _cost_tables() -> list:
     """Every graph runner's XLA cost tables (scalar entries only —
-    the full tables carry per-op rows that can run to megabytes)."""
+    the full tables carry per-op rows that can run to megabytes),
+    plus any registered extra sources' entries."""
+    out = []
     try:
         from ..model import _compiled_cost_tables, _cost_args
     except Exception:
-        return []
-    out = []
-    for key, cost in _compiled_cost_tables():
-        out.append({"key": key, "cost": _cost_args(cost)})
+        pass
+    else:
+        for key, cost in _compiled_cost_tables():
+            out.append({"key": key, "cost": _cost_args(cost)})
+    for provider in _extra_cost_sources:
+        try:
+            out.extend(provider())
+        except Exception:
+            pass  # a broken telemetry source must not break bundles
     return out
 
 

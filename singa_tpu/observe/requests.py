@@ -27,12 +27,15 @@ structured timeline per ``GenerationRequest.request_id``:
 * **phase attribution** — at retire the timeline is decomposed into
   ``hops`` (time burned on earlier hops before the final submission),
   ``queue`` (final-hop submit → admission), ``prefill`` (admission →
-  first token), ``decode`` (first token → retire, stall removed) and
-  ``stall`` (inter-token gaps far beyond the request's own median —
-  the spec-verify / scheduler-starvation signature).  The first three
-  sum to TTFT *exactly* and all five sum to the request's total
-  latency exactly — attribution is arithmetic over recorded
-  timestamps, never an estimate.
+  first token), ``decode`` (first token → retire, stall and
+  preemption removed), ``stall`` (inter-token gaps far beyond the
+  request's own median — the spec-verify / scheduler-starvation
+  signature) and ``preempted`` (time the paged engine held the
+  request swapped out to host; swap pauses are excluded from the
+  stall detector's gaps so the two phases never double-count one
+  pause).  The first three sum to TTFT *exactly* and all six sum to
+  the request's total latency exactly — attribution is arithmetic
+  over recorded timestamps, never an estimate.
 * **bounded retention** — sealed (retired or terminally rejected)
   entries live in a ring of ``capacity`` entries (the FlightRecorder
   idiom: a forgotten ledger cannot OOM), exported as strict JSONL via
@@ -162,6 +165,7 @@ def _new_hop(engine, t):
         "t_first_token": None,
         "steps": [],            # [t, tokens] or [t, tokens, acc, drafted]
         "tokens": 0,            # tokens emitted on THIS hop
+        "preemptions": [],      # [t_swap_out, t_swap_in|None] pairs
         "reject": None,         # {"t", "reason", "started"} terminal
     }
 
@@ -317,6 +321,28 @@ class RequestLedger:
                 rec += [int(accepted), int(drafted)]
             hop["steps"].append(rec)
 
+    def on_preempt(self, rid, engine, t):
+        """The paged engine swapped this request's blocks to host
+        mid-decode: open a preemption interval on the hop.  Time
+        inside it is attributed to the ``preempted`` phase at seal
+        (exact arithmetic — carved OUT of decode, and excluded from
+        the stall detector's inter-step gaps so the two phases never
+        double-count one pause)."""
+        _, hop = self._hop(rid, engine)
+        if hop is not None:
+            hop.setdefault("preemptions", []).append([t, None])
+
+    def on_resume(self, rid, engine, t):
+        """The request's blocks were restored and decode continues:
+        close the newest open preemption interval."""
+        _, hop = self._hop(rid, engine)
+        if hop is None:
+            return
+        for iv in reversed(hop.get("preemptions") or []):
+            if iv[1] is None:
+                iv[1] = t
+                break
+
     def on_retire(self, rid, engine, t, finish_reason, tokens=None):
         """Normal completion: seal the entry with its phase
         attribution.  Idempotent against hedge losers — a second
@@ -401,10 +427,30 @@ class RequestLedger:
                      else 0.0)
         decode_s = (max(end - t_first, 0.0)
                     if t_first is not None else 0.0)
+        # preempted: time the paged engine held this request swapped
+        # out (clipped to the decode span — preemption only exists
+        # after the first token, since admission always emits one)
+        ivs = []
+        for t_out, t_in in final.get("preemptions") or []:
+            t_in = end if t_in is None else t_in
+            if t_first is not None:
+                a, b = max(t_out, t_first), min(t_in, end)
+                if b > a:
+                    ivs.append((a, b))
+        preempted_s = min(sum(b - a for a, b in ivs), decode_s)
         stall_s = 0.0
         steps = final.get("steps") or []
         ts = [s[0] for s in steps]
-        gaps = [b - a for a, b in zip(ts, ts[1:])]
+
+        def swapped_inside(a, b):
+            return sum(max(0.0, min(b, ti) - max(a, to))
+                       for to, ti in ivs)
+
+        # inter-step gaps NET of preemption time inside them: a swap
+        # pause is the preempted phase's, never double-counted as
+        # stall
+        gaps = [b - a - swapped_inside(a, b)
+                for a, b in zip(ts, ts[1:])]
         if len(gaps) >= 3:
             med = sorted(gaps)[len(gaps) // 2]
             if med > 0:
@@ -413,13 +459,14 @@ class RequestLedger:
                 # straggler compile) — subtract the excess over the
                 # median so phase sums stay exact
                 stall_s = sum(g - med for g in gaps if g > 3 * med)
-        stall_s = min(stall_s, decode_s)
+        stall_s = min(stall_s, decode_s - preempted_s)
         return {
             "hops": hops_s,
             "queue": queue_s,
             "prefill": prefill_s,
-            "decode": decode_s - stall_s,
+            "decode": decode_s - stall_s - preempted_s,
             "stall": stall_s,
+            "preempted": preempted_s,
         }
 
     def _finalize(self, e, final=None):
@@ -545,12 +592,19 @@ class RequestLedger:
                       for e in dpop)
             stall = sum((e["phases"] or {}).get("stall", 0.0)
                         for e in dpop)
-            dt = dec + stall
+            pre = sum((e["phases"] or {}).get("preempted", 0.0)
+                      for e in dpop)
+            dt = dec + stall + pre
             out["tpot_p99_attribution"] = {
                 "decode": {"s": dec,
                            "frac": dec / dt if dt > 0 else 0.0},
                 "stall": {"s": stall,
                           "frac": stall / dt if dt > 0 else 0.0},
+                # the paged engine's swap time: a slow request that
+                # spent its tail preempted reads "preempted", not
+                # "decode got slow"
+                "preempted": {"s": pre,
+                              "frac": pre / dt if dt > 0 else 0.0},
             }
         for e in sorted(completed, key=lambda e: -e["ttft_s"])[:top_k]:
             ph = e["phases"] or self._phases(e)

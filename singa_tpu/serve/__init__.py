@@ -30,16 +30,30 @@ optional hedging)::
 
     from singa_tpu.serve import ServeFleet
     fleet = ServeFleet(model, replicas=2, max_slots=4)
+
+Since the paged round, ``paged=PagedConfig(...)`` replaces the
+worst-case slot arena with ONE block-paged KV pool shared with the
+prefix cache: admission by blocks free, block-by-block growth,
+priority preemption with byte-exact swap/resume
+(``scheduler="priority"``), zero-copy donation.  Token streams stay
+bitwise identical to the slot engine's.  See docs/SERVING.md
+"Paged KV and preemption"::
+
+    from singa_tpu.serve import PagedConfig
+    eng = model.serve(max_slots=16, scheduler="priority",
+                      paged=PagedConfig(block_size=16, num_blocks=256),
+                      prefix_cache=PrefixCacheConfig(block_size=16))
 """
 
 from .engine import InferenceEngine  # noqa: F401
 from .fleet import Router, ServeFleet  # noqa: F401
+from .paged import PagedConfig, PagedKVArena  # noqa: F401
 from .prefix import (PrefixCache, PrefixCacheConfig,  # noqa: F401
                      SessionHandle)
 from .request import (DeadlineExceededError, EngineFailedError,  # noqa: F401
                       FleetDownError, GenerationRequest,
                       GenerationResult, LoadShedError, QueueFullError,
                       RequestHandle, RestartBudgetExceededError)
-from .scheduler import FIFOScheduler  # noqa: F401
+from .scheduler import FIFOScheduler, PriorityScheduler  # noqa: F401
 from .stats import EngineStats  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401

@@ -53,17 +53,28 @@ run inside the engine too —
   (budget/stop mid-chunk), ``on_token`` streams per accepted token,
   and TPOT becomes tokens-per-step aware (stats.py).
 
+Paged KV (memory-model round): ``paged=PagedConfig(...)`` swaps the
+worst-case slot arena for ONE block-paged pool (serve/paged.py)
+shared with the prefix cache — a request's KV is a block list grown
+as decode advances, admission is bounded by blocks free rather than
+slots free, and pool pressure PREEMPTS (swap a request's blocks to
+host byte-exactly, resume later) instead of stalling.  The paged pool
+steps vmap the same ``_decode_row``/``_spec_row`` math the slot-arena
+steps do, so the two memory models produce bit-identical streams.
+
 Scope: dense/GQA/MoE models (everything _advance_one supports with a
 position-indexed dense cache).  Sliding-window models (rolling cache
-slot arithmetic) are rejected with NotImplementedError, as is the
-int8-arena + prefix-cache combination (the block pool would need a
-second pool for the scale tensors); repetition_penalty/min_p are
-offline-only knobs.
+slot arithmetic) are rejected with NotImplementedError;
+repetition_penalty/min_p are offline-only knobs.  int8 arenas compose
+with the prefix cache since the paged round (pytree-generic block
+pools; cache-enabled int8 engines route every admission through the
+chunked canonical form — see _admit).
 """
 
 from __future__ import annotations
 
 import inspect
+import itertools
 import time
 from functools import partial
 
@@ -81,12 +92,14 @@ from ..observe import requests as _reqs
 from ..observe import trace as _trace
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
+from .paged import (PagedConfig, PagedKVArena, _aot_call,
+                    _paged_decode_step, _paged_spec_step)
 from .prefix import (PrefixCache, PrefixCacheConfig, SessionHandle,
                      _read_slot)
 from .request import (DeadlineExceededError, EngineFailedError,
                       GenerationRequest, GenerationResult, LoadShedError,
                       RequestHandle)
-from .scheduler import FIFOScheduler
+from .scheduler import FIFOScheduler, PriorityScheduler
 from .stats import EngineStats
 
 
@@ -105,6 +118,28 @@ def _select_sample(logit, key, temp, top_k, top_p, use_top_p):
     return jnp.where(temp <= 0.0, g, s).astype(jnp.int32)
 
 
+def _decode_row(params, kc_r, vc_r, tok, pos_r, live_r, key, temp,
+                top_p, n_head, eps, moe_top_k, top_k, use_top_p):
+    """ONE slot's decode-step math — kc_r/vc_r: (L, H_kv, max_len, D)
+    cache rows (int8 arenas are (values, scales) pytrees, so the
+    batch-axis insert/strip is tree-mapped rather than indexed).
+    Shared by the slot-arena pool step below AND the paged pool step
+    (serve/paged.py), so the two memory models run literally the same
+    per-row ops and cannot drift."""
+    p_c = jnp.where(live_r, pos_r, 0)
+    t_c = jnp.where(live_r, tok, 0)
+    x = (params["wte"][t_c] + params["wpe"][p_c])[None, None, :]
+    logits, kc2, vc2 = decode_step(
+        params, x, jax.tree.map(lambda a: a[:, None], kc_r),
+        jax.tree.map(lambda a: a[:, None], vc_r), p_c, n_head, eps,
+        moe_top_k=moe_top_k)
+    ks = jax.random.split(key)
+    nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
+                         use_top_p)
+    return (nxt, jax.tree.map(lambda a: a[:, 0], kc2),
+            jax.tree.map(lambda a: a[:, 0], vc2), ks[1])
+
+
 @partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "top_k",
                           "use_top_p"),
@@ -120,21 +155,9 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
     new_keys)."""
 
     def row(kc_r, vc_r, tok, pos_r, live_r, key, temp):
-        # kc_r/vc_r: (L, H_kv, max_len, D) — one slot's cache rows.
-        # int8 arenas are (values, scales) pytrees, so the batch-axis
-        # insert/strip is tree-mapped rather than indexed
-        p_c = jnp.where(live_r, pos_r, 0)
-        t_c = jnp.where(live_r, tok, 0)
-        x = (params["wte"][t_c] + params["wpe"][p_c])[None, None, :]
-        logits, kc2, vc2 = decode_step(
-            params, x, jax.tree.map(lambda a: a[:, None], kc_r),
-            jax.tree.map(lambda a: a[:, None], vc_r), p_c, n_head, eps,
-            moe_top_k=moe_top_k)
-        ks = jax.random.split(key)
-        nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
-                             use_top_p)
-        return (nxt, jax.tree.map(lambda a: a[:, 0], kc2),
-                jax.tree.map(lambda a: a[:, 0], vc2), ks[1])
+        return _decode_row(params, kc_r, vc_r, tok, pos_r, live_r,
+                           key, temp, top_p, n_head, eps, moe_top_k,
+                           top_k, use_top_p)
 
     return jax.vmap(row, in_axes=(1, 1, 0, 0, 0, 0, 0),
                     out_axes=(0, 1, 1, 0))(kc, vc, toks, pos, live,
@@ -212,6 +235,67 @@ def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
     return tok0, ks[1]
 
 
+def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
+              live_r, key, temp, top_p, spec_k, tn, te, tm, dn, de, dm,
+              top_k, use_top_p):
+    """ONE slot's speculative-chunk math: ``spec_k`` sequential DRAFT
+    decode steps propose ``spec_k - 1`` tokens (the extra step
+    processes the last proposal as an input so a full-accept chunk
+    leaves the draft cache a valid row ahead — the same trick as the
+    offline ``_spec_row``), then ONE target chunk advance
+    (``_advance_chunk`` — a single cache read serves all ``spec_k``
+    positions), then :func:`~singa_tpu.models.gpt2_decode.spec_verify`
+    decides the accept count: greedy match for ``temp <= 0`` rows,
+    rejection sampling with residual resample for sampled rows — both
+    in the SAME executable (temp is traced, like ``_select_sample``).
+    Shared by the slot-arena spec step and the paged spec step
+    (serve/paged.py) — one definition, no drift."""
+    p_c = jnp.where(live_r, pos_r, 0)
+    t_c = jnp.where(live_r, tok, 0)
+
+    def batch(c):
+        return jax.tree.map(lambda a: a[:, None], c)
+
+    def unbatch(c):
+        return jax.tree.map(lambda a: a[:, 0], c)
+
+    k_draft, k_verify, k_next = jax.random.split(key, 3)
+    ts = jnp.maximum(temp, 1e-6)
+
+    def dstep(c, k):
+        dkc_b, dvc_b, tok_, dpos = c
+        x = (d_params["wte"][tok_] + d_params["wpe"][dpos])[None, None]
+        lg, dkc_b, dvc_b = _advance_one(d_params, x, dkc_b, dvc_b,
+                                        dpos, dn, de, moe_top_k=dm)
+        # post-filter draft distribution (the q of the accept
+        # ratio) AND the proposal drawn from it — the identical
+        # filter chain _sample uses, via the shared helper
+        fl = _filter_logits(lg[0], ts, top_p, top_k, use_top_p)
+        nxt_s = jax.random.categorical(k, fl).astype(jnp.int32)
+        nxt_g = jnp.argmax(lg[0]).astype(jnp.int32)
+        nxt = jnp.where(temp <= 0.0, nxt_g, nxt_s)
+        return ((dkc_b, dvc_b, nxt, dpos + 1),
+                (nxt, jax.nn.softmax(fl)))
+
+    dkeys = jax.random.split(k_draft, spec_k)
+    (dkc_b, dvc_b, _, _), (props_all, q_all) = jax.lax.scan(
+        dstep, (batch(dkc_r), batch(dvc_r), t_c, p_c), dkeys)
+    props = props_all[:-1]                      # (spec_k - 1,)
+    d_probs = q_all[:-1]                        # (spec_k - 1, V)
+
+    chunk_toks = jnp.concatenate([t_c[None], props])
+    xs = (jnp.take(t_params["wte"], chunk_toks, axis=0)
+          + jnp.take(t_params["wpe"],
+                     p_c + jnp.arange(spec_k), axis=0))[None]
+    lg, kc2, vc2 = _advance_chunk(t_params, xs, batch(kc_r),
+                                  batch(vc_r), p_c, tn, te,
+                                  moe_top_k=tm)
+    out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
+                               temp, top_p, top_k, use_top_p)
+    return (out, a_draft, unbatch(kc2), unbatch(vc2),
+            unbatch(dkc_b), unbatch(dvc_b), k_next)
+
+
 @partial(jax.jit,
          static_argnames=("spec_k", "tn", "te", "tm", "dn", "de", "dm",
                           "top_k", "use_top_p"),
@@ -219,74 +303,22 @@ def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
 def _pool_spec_step(t_params, d_params, kc, vc, dkc, dvc, toks, pos,
                     live, keys, temps, top_p, spec_k, tn, te, tm,
                     dn, de, dm, top_k, use_top_p):
-    """Advance EVERY slot one speculative chunk.  Per slot: ``spec_k``
-    sequential DRAFT decode steps propose ``spec_k - 1`` tokens (the
-    extra step processes the last proposal as an input so a
-    full-accept chunk leaves the draft cache a valid row ahead — the
-    same trick as the offline ``_spec_row``), then ONE target chunk
-    advance (``_advance_chunk`` — a single cache read serves all
-    ``spec_k`` positions), then :func:`~singa_tpu.models.gpt2_decode.
-    spec_verify` decides the accept count: greedy match for
-    ``temp <= 0`` rows, rejection sampling with residual resample for
-    sampled rows — both in the SAME executable (temp is traced, like
-    ``_select_sample``).
-
-    Arenas (target AND draft) are donated and update in place; dead
-    slots run the same math on clamped inputs, their rows are garbage
-    the next admission's full-row write overwrites, and rows a
-    REJECTED proposal wrote past the accept point are overwritten by
-    the next chunk's contiguous write before the position mask can
-    ever read them live (the free-rollback argument from
-    gpt2_decode._spec_row).  Returns ``(out (S, spec_k) candidate
-    tokens, a_draft (S,) accepted-proposal counts, kc, vc, dkc, dvc,
-    new_keys)`` — the host emits ``a_draft + 1`` tokens per live slot
-    (capped by the request's remaining budget)."""
+    """Advance EVERY slot one speculative chunk (the per-slot math is
+    :func:`_spec_row`).  Arenas (target AND draft) are donated and
+    update in place; dead slots run the same math on clamped inputs,
+    their rows are garbage the next admission's full-row write
+    overwrites, and rows a REJECTED proposal wrote past the accept
+    point are overwritten by the next chunk's contiguous write before
+    the position mask can ever read them live (the free-rollback
+    argument from gpt2_decode._spec_row).  Returns ``(out (S, spec_k)
+    candidate tokens, a_draft (S,) accepted-proposal counts, kc, vc,
+    dkc, dvc, new_keys)`` — the host emits ``a_draft + 1`` tokens per
+    live slot (capped by the request's remaining budget)."""
 
     def row(kc_r, vc_r, dkc_r, dvc_r, tok, pos_r, live_r, key, temp):
-        p_c = jnp.where(live_r, pos_r, 0)
-        t_c = jnp.where(live_r, tok, 0)
-
-        def batch(c):
-            return jax.tree.map(lambda a: a[:, None], c)
-
-        def unbatch(c):
-            return jax.tree.map(lambda a: a[:, 0], c)
-
-        k_draft, k_verify, k_next = jax.random.split(key, 3)
-        ts = jnp.maximum(temp, 1e-6)
-
-        def dstep(c, k):
-            dkc_b, dvc_b, tok_, dpos = c
-            x = (d_params["wte"][tok_] + d_params["wpe"][dpos])[None, None]
-            lg, dkc_b, dvc_b = _advance_one(d_params, x, dkc_b, dvc_b,
-                                            dpos, dn, de, moe_top_k=dm)
-            # post-filter draft distribution (the q of the accept
-            # ratio) AND the proposal drawn from it — the identical
-            # filter chain _sample uses, via the shared helper
-            fl = _filter_logits(lg[0], ts, top_p, top_k, use_top_p)
-            nxt_s = jax.random.categorical(k, fl).astype(jnp.int32)
-            nxt_g = jnp.argmax(lg[0]).astype(jnp.int32)
-            nxt = jnp.where(temp <= 0.0, nxt_g, nxt_s)
-            return ((dkc_b, dvc_b, nxt, dpos + 1),
-                    (nxt, jax.nn.softmax(fl)))
-
-        dkeys = jax.random.split(k_draft, spec_k)
-        (dkc_b, dvc_b, _, _), (props_all, q_all) = jax.lax.scan(
-            dstep, (batch(dkc_r), batch(dvc_r), t_c, p_c), dkeys)
-        props = props_all[:-1]                      # (spec_k - 1,)
-        d_probs = q_all[:-1]                        # (spec_k - 1, V)
-
-        chunk_toks = jnp.concatenate([t_c[None], props])
-        xs = (jnp.take(t_params["wte"], chunk_toks, axis=0)
-              + jnp.take(t_params["wpe"],
-                         p_c + jnp.arange(spec_k), axis=0))[None]
-        lg, kc2, vc2 = _advance_chunk(t_params, xs, batch(kc_r),
-                                      batch(vc_r), p_c, tn, te,
-                                      moe_top_k=tm)
-        out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
-                                   temp, top_p, top_k, use_top_p)
-        return (out, a_draft, unbatch(kc2), unbatch(vc2),
-                unbatch(dkc_b), unbatch(dvc_b), k_next)
+        return _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r,
+                         tok, pos_r, live_r, key, temp, top_p, spec_k,
+                         tn, te, tm, dn, de, dm, top_k, use_top_p)
 
     return jax.vmap(row, in_axes=(1, 1, 1, 1, 0, 0, 0, 0, 0),
                     out_axes=(0, 0, 1, 1, 1, 1, 0))(
@@ -311,11 +343,15 @@ def _write_slot(kc_arena, vc_arena, kc_row, vc_row, slot):
 class _Slot:
     """Host-side bookkeeping for one pool row (the decode position
     lives in the engine's per-slot arrays — the jitted step's
-    inputs — not here)."""
+    inputs — not here).  On a paged engine ``blocks`` is the slot's
+    block table (pool block ids, grown block-by-block as decode
+    advances) and ``n_shared`` the count of leading blocks REFERENCED
+    from the prefix cache (never written, never freed by this slot —
+    only released)."""
 
     __slots__ = ("handle", "emitted", "remaining",
                  "first_token_time", "admit_time", "admitted_step",
-                 "prefix_nodes")
+                 "prefix_nodes", "blocks", "n_shared")
 
     def __init__(self, handle, max_new, now, step):
         self.handle = handle
@@ -325,6 +361,27 @@ class _Slot:
         self.admit_time = now
         self.admitted_step = step
         self.prefix_nodes = []   # cached-prefix refs held while live
+        self.blocks = []         # paged mode: the slot's block table
+        self.n_shared = 0        # leading blocks shared with the cache
+
+
+class _Swapped:
+    """A preempted request's complete host-side state: byte copies of
+    its target cache lanes (and draft rows on a speculative engine),
+    the sampling-key chain, and every scrap of slot bookkeeping — so a
+    resume continues the EXACT token stream the uninterrupted run
+    would have produced.  Swapped requests are STARTED (the admission
+    token always streamed), so they are never requeue-safe: an engine
+    failure rejects them typed with ``started=True``."""
+
+    __slots__ = ("handle", "request", "emitted", "remaining",
+                 "first_token_time", "admit_time", "admitted_step",
+                 "pos", "tok", "temp", "key", "kc_h", "vc_h", "dkc_h",
+                 "dvc_h", "n_data", "seq", "t_preempt")
+
+    @property
+    def priority(self):
+        return getattr(self.request, "priority", 0)
 
 
 class InferenceEngine:
@@ -352,14 +409,27 @@ class InferenceEngine:
     to ``spec_k`` tokens per step, greedy streams byte-identical to
     the non-speculative engine, sampled traffic served through
     rejection sampling.  Incompatible combinations (vocab/position
-    mismatch, sliding-window draft, int8 + prefix cache) are rejected
-    with typed errors at construction, never inside a jitted
-    dispatch."""
+    mismatch, sliding-window draft, spec_k wider than a paged block)
+    are rejected with typed errors at construction, never inside a
+    jitted dispatch.
+
+    Paged KV (``paged=`` a :class:`~singa_tpu.serve.paged.PagedConfig`;
+    docs/SERVING.md "Paged KV and preemption"): the worst-case
+    ``(max_slots, max_len)`` slot arena is replaced by ONE block pool
+    shared with the prefix cache — admission is bounded by blocks
+    free rather than slots free, a request's KV grows block-by-block,
+    retire donation is zero-copy adoption, and when the pool runs out
+    the engine PREEMPTS (swap a lower-priority request's blocks to
+    host, resume byte-identically later) instead of stalling.  Pair
+    with ``scheduler="priority"`` so urgent arrivals overtake and
+    preempt background work.  Token streams stay bitwise identical to
+    the slot engine's — both vmap the same per-row math."""
 
     def __init__(self, model, max_slots=8, max_len=None, dtype=None,
                  scheduler=None, top_k=0, top_p=None,
                  clock=time.monotonic, slo=None, prefix_cache=None,
-                 draft_model=None, spec_k=None, cache_dtype=None):
+                 draft_model=None, spec_k=None, cache_dtype=None,
+                 paged=None):
         cfg = model.cfg
         if _norm_window(cfg) is not None:
             raise NotImplementedError(
@@ -419,6 +489,18 @@ class InferenceEngine:
                     f"drafts (attn_window={dcfg.attn_window}); same "
                     "rolling-cache restriction as the target")
         self._clock = clock
+        # string schedulers construct PER ENGINE — an object instance
+        # forwarded through supervisor/fleet engine_kw would be SHARED
+        # across replicas, which is never what "priority scheduling on
+        # a fleet" means
+        if scheduler == "priority":
+            scheduler = PriorityScheduler()
+        elif scheduler == "fifo":
+            scheduler = FIFOScheduler()
+        elif isinstance(scheduler, str):
+            raise ValueError(
+                f"unknown scheduler {scheduler!r}: pass 'fifo', "
+                f"'priority', or a scheduler instance")
         self.scheduler = scheduler or FIFOScheduler()
         self.stats = EngineStats(self.max_slots, clock, slo=slo,
                                  spec=draft_model is not None)
@@ -450,8 +532,45 @@ class InferenceEngine:
                         jnp.zeros((L_, S, H_, W), jnp.float32))
             return jnp.zeros((L_, S, H_, W, D_), cdt)
 
-        self._kc = _arena(L, H_kv, D)
-        self._vc = _arena(L, H_kv, D)
+        # -- paged KV mode (serve/paged.py): ONE block pool replaces
+        # the per-slot worst-case arena; capacity becomes "blocks
+        # free", requests grow block-by-block, and preemption/swap +
+        # the unified prefix cache ride the same pool.  max_slots
+        # still bounds the decode vmap width, but a slot costs only
+        # the blocks its request actually holds
+        self.paged_arena = None
+        self._spec_pad = 0 if draft_model is None else self.spec_k - 1
+        if paged is not None and paged is not False:
+            if paged is True:
+                paged = PagedConfig()
+            elif isinstance(paged, dict):
+                paged = PagedConfig(**paged)
+            if not isinstance(paged, PagedConfig):
+                raise ValueError(
+                    f"paged must be a PagedConfig, a kwargs dict, or "
+                    f"True, got {type(paged)}")
+            if self.max_len % paged.block_size != 0:
+                raise ValueError(
+                    f"max_len ({self.max_len}) must be a multiple of "
+                    f"the paged block_size ({paged.block_size}) so "
+                    f"block tables tile the row exactly")
+            if draft_model is not None \
+                    and self.spec_k > paged.block_size:
+                raise ValueError(
+                    f"spec_k ({self.spec_k}) > paged block_size "
+                    f"({paged.block_size}): a verify chunk would span "
+                    f"more than two pool blocks; raise block_size or "
+                    f"lower spec_k")
+            self.paged_arena = PagedKVArena(
+                paged, L, H_kv, D, cdt, row_width=W,
+                quant=self._quant,
+                engine_label=self.stats.engine_label,
+                reg=self.stats.registry)
+            self.stats.paged_source = self.paged_arena.snapshot
+            self._kc = self._vc = None
+        else:
+            self._kc = _arena(L, H_kv, D)
+            self._vc = _arena(L, H_kv, D)
         # draft-side state (speculative decoding): its own params and
         # its own (cheap) KV arena, advanced in lockstep by the spec
         # pool step
@@ -474,6 +593,8 @@ class InferenceEngine:
         self._temps = np.zeros(S, np.float32)
         self._keys = jnp.zeros((S, 2), jnp.uint32)
         self._handles = {}
+        self._swapped = []                  # paged mode: _Swapped list
+        self._swap_seq = itertools.count()
         self._closed = False
         self._failed = False
         self.step_count = 0
@@ -495,14 +616,27 @@ class InferenceEngine:
                 raise ValueError(
                     f"prefix_cache must be a PrefixCacheConfig, a "
                     f"kwargs dict, or True, got {type(prefix_cache)}")
-            if self._quant:
-                raise NotImplementedError(
-                    "cache_dtype='int8' + prefix_cache: the block pool "
-                    "stores dense K/V rows only; an int8 arena's "
-                    "per-(token, head) scale tensors would have to "
-                    "ride the block pool as a second gather/scatter "
-                    "pool — not implemented, disable one of the two")
-            if self.max_len % prefix_cache.block_size != 0:
+            # int8 + prefix cache is SUPPORTED since the paged round:
+            # the block pool is pytree-leaf-generic ((values, scales)
+            # blocks), and quantized engines with a cache route EVERY
+            # admission through the chunked prefill path so warm and
+            # cold streams stay byte-identical to each other (see
+            # _admit; docs/SERVING.md "int8 and the prefix cache")
+            if self.paged_arena is not None:
+                # one pool, one granularity: the radix tree shares the
+                # paged arena's blocks by reference, so its block size
+                # IS the arena's
+                if prefix_cache.block_size != \
+                        self.paged_arena.block_size:
+                    raise ValueError(
+                        f"prefix_cache.block_size "
+                        f"({prefix_cache.block_size}) != paged "
+                        f"block_size ({self.paged_arena.block_size}): "
+                        f"a paged engine keeps ONE block pool, so the "
+                        f"cache must share its granularity (its "
+                        f"num_blocks is ignored — capacity is the "
+                        f"arena's)")
+            elif self.max_len % prefix_cache.block_size != 0:
                 raise ValueError(
                     f"max_len ({self.max_len}) must be a multiple of "
                     f"prefix_cache.block_size "
@@ -511,8 +645,14 @@ class InferenceEngine:
             self.prefix_cache = PrefixCache(
                 prefix_cache, L, H_kv, D, cdt,
                 engine_label=self.stats.engine_label,
-                reg=self.stats.registry)
+                reg=self.stats.registry, quant=self._quant,
+                arena=self.paged_arena)
             self.prefix_cache.attach_row_geometry(W)
+            if self.paged_arena is not None:
+                # cached-but-unreferenced blocks are soft free space:
+                # allocation evicts LRU leaves before failing
+                self.paged_arena.evict_cb = \
+                    self.prefix_cache._evict_one
             self._chunk_statics = dict(
                 n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
                 moe_top_k=self._statics["moe_top_k"],
@@ -531,12 +671,15 @@ class InferenceEngine:
                 pass
         self._log.info(
             "engine up: slots=%d max_len=%d cache_dtype=%s "
-            "prefix_cache=%s spec=%s",
+            "prefix_cache=%s spec=%s paged=%s",
             S, W, cache_dtype or str(cdt),
             "off" if self.prefix_cache is None else
             f"{self.prefix_cache.num_blocks}x"
             f"{self.prefix_cache.block_size}",
-            "off" if self.draft is None else f"k={self.spec_k}")
+            "off" if self.draft is None else f"k={self.spec_k}",
+            "off" if self.paged_arena is None else
+            f"{self.paged_arena.num_blocks}x"
+            f"{self.paged_arena.block_size}")
 
     # -- submission ------------------------------------------------------
     def submit(self, request) -> RequestHandle:
@@ -567,6 +710,18 @@ class InferenceEngine:
                 + f" exceeds the engine arena max_len ({self.max_len});"
                 f" use the offline windowed GPT2LMHead.generate for "
                 f"over-length generations")
+        if self.paged_arena is not None:
+            B = self.paged_arena.block_size
+            worst = ((len(request.prompt_ids) + request.max_new_tokens
+                      - 1 + spec_pad) // B) + 1
+            if worst > self.paged_arena.num_blocks:
+                # a request that could never fit the pool ALONE would
+                # deadlock the growth loop; fail it at submit, typed
+                raise ValueError(
+                    f"request needs up to {worst} KV blocks but the "
+                    f"paged pool holds {self.paged_arena.num_blocks}; "
+                    f"raise PagedConfig.num_blocks or lower "
+                    f"max_new_tokens")
         if request.request_id in self._handles:
             # an in-flight duplicate would orphan the earlier handle
             # (the id is the engine's completion-routing key); finished
@@ -605,9 +760,11 @@ class InferenceEngine:
 
     @property
     def pending(self) -> bool:
-        """True while any request is queued or occupying a slot."""
+        """True while any request is queued, occupying a slot, or
+        swapped out awaiting resume."""
         return (self.scheduler.queue_depth > 0
-                or any(s is not None for s in self._slots))
+                or any(s is not None for s in self._slots)
+                or bool(self._swapped))
 
     # -- lifecycle -------------------------------------------------------
     def close(self, force=False):
@@ -625,13 +782,19 @@ class InferenceEngine:
                 f"close() with work in flight (queue="
                 f"{self.scheduler.queue_depth}, live={self.live_slots});"
                 f" drain with run_until_complete() first")
+        self._release_everything()
+
+    def _release_everything(self):
         self.stats.unregister()
         _monitor.forget(self._hb_source)
         if self.prefix_cache is not None:
             self.prefix_cache.unregister()
+        if self.paged_arena is not None:
+            self.paged_arena.unregister()
         self._kc = self._vc = None
         self._dkc = self._dvc = None
         self._params = self._d_params = None
+        self._swapped = []
         self._closed = True
 
     def __enter__(self):
@@ -644,14 +807,7 @@ class InferenceEngine:
             # don't let the drained-first check mask the in-flight
             # exception; still release the registry entries AND the
             # arena/params (the pinning close() exists to prevent)
-            self.stats.unregister()
-            _monitor.forget(self._hb_source)
-            if self.prefix_cache is not None:
-                self.prefix_cache.unregister()
-            self._kc = self._vc = None
-            self._dkc = self._dvc = None
-            self._params = self._d_params = None
-            self._closed = True
+            self._release_everything()
         return False
 
     @property
@@ -660,12 +816,15 @@ class InferenceEngine:
 
     @property
     def live_request_ids(self):
-        """Request ids currently occupying slots — i.e. STARTED: tokens
-        may already have streamed through ``on_token``, so these are
-        never safely re-runnable elsewhere (the fleet's failover path
-        uses exactly this distinction)."""
-        return {s.handle.request.request_id
-                for s in self._slots if s is not None}
+        """Request ids currently occupying slots OR swapped out —
+        i.e. STARTED: tokens already streamed through ``on_token`` (a
+        swapped request streamed at least its admission token), so
+        these are never safely re-runnable elsewhere (the fleet's
+        failover path uses exactly this distinction)."""
+        ids = {s.handle.request.request_id
+               for s in self._slots if s is not None}
+        ids.update(sw.request.request_id for sw in self._swapped)
+        return ids
 
     # -- the iteration-level step loop -----------------------------------
     def step(self) -> bool:
@@ -694,6 +853,12 @@ class InferenceEngine:
             # re-arm only after the dispatch returns would never come
             _monitor.heartbeat(self._hb_source)
         try:
+            if self.paged_arena is not None:
+                # paged growth: every live slot must own the block(s)
+                # the coming decode/spec chunk will write BEFORE the
+                # dispatch; a slot that cannot grow (pool exhausted,
+                # no strictly-lower-priority victim) swaps ITSELF out
+                self._grow_live_slots()
             if any(s is not None for s in self._slots):
                 self._decode_once()
             self._schedule(self._clock())
@@ -733,6 +898,7 @@ class InferenceEngine:
             if slot is None:
                 continue
             self._release_prefix(slot)
+            self._free_slot_blocks(slot)
             rid = slot.handle.request.request_id
             # typed rejections must be VISIBLE, not just raised: the
             # instant puts the rejected request in the trace/flight
@@ -751,6 +917,25 @@ class InferenceEngine:
                 started=True, engine_step=step))
             self._slots[i] = None
             self._handles.pop(rid, None)
+        # swapped-out requests are STARTED (tokens streamed before the
+        # preemption): typed started=True, never requeued — without
+        # this pass the generic not-done sweep below would misread
+        # them as requeue-safe and a restart would re-stream duplicates
+        for sw in self._swapped:
+            rid = sw.request.request_id
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=rid, reason="engine_failed",
+                         started=True)
+            if _reqs._active:
+                _reqs._ledger.on_reject(rid, t=t_fail,
+                                        reason="engine_failed",
+                                        engine=lbl, started=True)
+            sw.handle._reject(EngineFailedError(
+                f"{msg} ({rid} was swapped out mid-decode, "
+                f"{len(sw.emitted)} tokens emitted)", request_id=rid,
+                started=True, engine_step=step))
+            self._handles.pop(rid, None)
+        self._swapped = []
         for req in self.scheduler.drain():
             h = self._handles.pop(req.request_id, None)
             if h is not None:
@@ -851,33 +1036,64 @@ class InferenceEngine:
         _mon = _monitor.active()
         _hb_t0 = time.perf_counter() if _mon else 0.0
         a_draft = None
+        arena = self.paged_arena
         if self.draft is not None:
             tn, te, tm = (self._statics["n_head"], self._statics["eps"],
                           self._statics["moe_top_k"])
             with _trace.span("serve/spec_step", cat="serve",
-                             step=self.step_count, live=n_live):
-                (out, a_draft, self._kc, self._vc, self._dkc,
-                 self._dvc, self._keys) = _pool_spec_step(
-                    self._params, self._d_params, self._kc, self._vc,
-                    self._dkc, self._dvc, jnp.asarray(self._toks),
-                    jnp.asarray(self._pos), jnp.asarray(live),
-                    self._keys, jnp.asarray(self._temps), self._top_p,
-                    spec_k=self.spec_k, tn=tn, te=te, tm=tm,
-                    dn=self._d_statics[0], de=self._d_statics[1],
-                    dm=self._d_statics[2], top_k=self._top_k,
-                    use_top_p=self._use_top_p)
+                             step=self.step_count, live=n_live,
+                             paged=arena is not None):
+                if arena is not None:
+                    (out, a_draft, arena.pool_k, arena.pool_v,
+                     self._dkc, self._dvc, self._keys) = _aot_call(
+                        "paged_spec_step", _paged_spec_step,
+                        self._params, self._d_params, arena.pool_k,
+                        arena.pool_v, self._dkc, self._dvc,
+                        self._block_tables(), jnp.asarray(self._toks),
+                        jnp.asarray(self._pos), jnp.asarray(live),
+                        self._keys, jnp.asarray(self._temps),
+                        self._top_p, block=arena.block_size,
+                        spec_k=self.spec_k, tn=tn, te=te, tm=tm,
+                        dn=self._d_statics[0], de=self._d_statics[1],
+                        dm=self._d_statics[2], top_k=self._top_k,
+                        use_top_p=self._use_top_p)
+                else:
+                    (out, a_draft, self._kc, self._vc, self._dkc,
+                     self._dvc, self._keys) = _pool_spec_step(
+                        self._params, self._d_params, self._kc,
+                        self._vc, self._dkc, self._dvc,
+                        jnp.asarray(self._toks),
+                        jnp.asarray(self._pos), jnp.asarray(live),
+                        self._keys, jnp.asarray(self._temps),
+                        self._top_p, spec_k=self.spec_k, tn=tn, te=te,
+                        tm=tm, dn=self._d_statics[0],
+                        de=self._d_statics[1], dm=self._d_statics[2],
+                        top_k=self._top_k, use_top_p=self._use_top_p)
                 out = np.asarray(out)
                 a_draft = np.asarray(a_draft)
         else:
             with _trace.span("serve/decode_step", cat="serve",
-                             step=self.step_count, live=n_live):
-                next_toks, self._kc, self._vc, self._keys = \
-                    _pool_decode_step(
-                        self._params, self._kc, self._vc,
-                        jnp.asarray(self._toks), jnp.asarray(self._pos),
-                        jnp.asarray(live), self._keys,
-                        jnp.asarray(self._temps), self._top_p,
+                             step=self.step_count, live=n_live,
+                             paged=arena is not None):
+                if arena is not None:
+                    (next_toks, arena.pool_k, arena.pool_v,
+                     self._keys) = _aot_call(
+                        "paged_decode_step", _paged_decode_step,
+                        self._params, arena.pool_k, arena.pool_v,
+                        self._block_tables(), jnp.asarray(self._toks),
+                        jnp.asarray(self._pos), jnp.asarray(live),
+                        self._keys, jnp.asarray(self._temps),
+                        self._top_p, block=arena.block_size,
                         **self._statics)
+                else:
+                    next_toks, self._kc, self._vc, self._keys = \
+                        _pool_decode_step(
+                            self._params, self._kc, self._vc,
+                            jnp.asarray(self._toks),
+                            jnp.asarray(self._pos),
+                            jnp.asarray(live), self._keys,
+                            jnp.asarray(self._temps), self._top_p,
+                            **self._statics)
                 next_toks = np.asarray(next_toks)
         if _mon:
             _monitor.heartbeat(
@@ -947,6 +1163,7 @@ class InferenceEngine:
                     "that request, slot %d freed", req.request_id, e,
                     idx)
                 self._release_prefix(slot)
+                self._free_slot_blocks(slot)
                 self._slots[idx] = None
                 self._handles.pop(req.request_id, None)
                 _trace.event("serve/request_rejected", cat="serve",
@@ -994,7 +1211,9 @@ class InferenceEngine:
             queue_time=slot.admit_time - submit_t,
             admitted_step=slot.admitted_step,
             finished_step=self.step_count)
-        if self.prefix_cache is not None:
+        if self.paged_arena is not None:
+            self._paged_retire(idx, slot, req, result)
+        elif self.prefix_cache is not None:
             self._prefix_retire(idx, slot, req, result)
         elif req.pin_session:
             # no cache: the session handle still works, continuation
@@ -1012,6 +1231,271 @@ class InferenceEngine:
         if self.prefix_cache is not None and slot.prefix_nodes:
             self.prefix_cache.release(slot.prefix_nodes)
             slot.prefix_nodes = []
+
+    # -- paged-arena internals -------------------------------------------
+    def _free_slot_blocks(self, slot):
+        """Teardown for a paged slot that will not retire normally:
+        free its private blocks (shared prefix blocks are only
+        ref-released, by ``_release_prefix``)."""
+        if self.paged_arena is not None and slot.blocks:
+            self.paged_arena.free(slot.blocks[slot.n_shared:])
+            slot.blocks = []
+
+    def _block_tables(self):
+        """The (S, W//B) int32 block-table input of the paged pool
+        steps: each live slot's block list, trash-padded (dead slots
+        are all-trash, so their writes land in the trash block)."""
+        arena = self.paged_arena
+        tables = np.full((self.max_slots, arena.row_blocks),
+                         arena.trash, np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                tables[i, :len(slot.blocks)] = slot.blocks
+        return jnp.asarray(tables)
+
+    def _grow_live_slots(self):
+        """Block-by-block growth: before the pool step dispatches,
+        every live slot must own the block(s) covering the position(s)
+        this step writes (``pos`` .. ``pos + spec_k - 1`` on a
+        speculative engine).  A slot that cannot grow — pool exhausted
+        and no strictly-lower-priority victim to preempt — swaps
+        ITSELF out: its blocks free the pool for the others and it
+        resumes (byte-identical) once capacity returns, so the pool
+        never livelocks with every slot too big to advance."""
+        arena = self.paged_arena
+        B = arena.block_size
+        for i in range(self.max_slots):
+            slot = self._slots[i]
+            if slot is None:
+                continue
+            need = (int(self._pos[i]) + self._spec_pad) // B + 1
+            short = need - len(slot.blocks)
+            if short <= 0:
+                continue
+            prio = getattr(slot.handle.request, "priority", 0)
+            got = self._alloc_blocks(short, prio, exclude_idx=i)
+            if got is None:
+                self._preempt_slot(i, reason="pool_exhausted")
+                continue
+            slot.blocks.extend(got)
+
+    def _alloc_blocks(self, n, priority, exclude_idx=None):
+        """``n`` pool blocks for a request at ``priority``, evicting
+        unreferenced cached blocks first (arena.alloc) and then
+        PREEMPTING strictly-lower-priority live slots (lowest
+        priority, then latest admitted) until the allocation fits or
+        no victim remains.  Strictly-lower only: equal-priority slots
+        never preempt each other, which is what makes every preemption
+        chain terminate.
+
+        Feasibility is checked BEFORE any side effect: when free +
+        evictable + every eligible victim's private blocks still
+        cannot cover ``n`` (e.g. pinned sessions hold unevictable
+        references), the claimant simply waits — preempting victims
+        that cannot make the allocation fit would be pure swap churn,
+        and with a permanently infeasible head request it would
+        livelock the engine (preempt → fail → resume → preempt)."""
+        arena = self.paged_arena
+        avail = arena.blocks_free
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.evictable_blocks()
+        avail += sum(
+            len(s.blocks) - s.n_shared
+            for i, s in enumerate(self._slots)
+            if s is not None and i != exclude_idx
+            and getattr(s.handle.request, "priority", 0) < priority)
+        if n > avail:
+            return None
+        while True:
+            got = arena.alloc(n)
+            if got is not None:
+                return got
+            victim = self._pick_victim(priority, exclude=exclude_idx)
+            if victim is None:
+                return None
+            self._preempt_slot(victim, reason="preempted")
+
+    def _pick_victim(self, below_priority, exclude=None):
+        """The live slot to preempt for a ``below_priority`` claimant:
+        strictly lower priority only; lowest priority first, ties to
+        the latest-admitted (least sunk progress).  None when nothing
+        qualifies."""
+        best = None
+        for i, s in enumerate(self._slots):
+            if s is None or i == exclude:
+                continue
+            p = getattr(s.handle.request, "priority", 0)
+            if p >= below_priority:
+                continue
+            k = (p, -s.admitted_step)
+            if best is None or k < best[0]:
+                best = (k, i)
+        return None if best is None else best[1]
+
+    def _preempt_slot(self, idx, reason):
+        """Swap one live request's state to HOST memory and free its
+        blocks: one fixed-shape gather + device sync for the target
+        lanes (plus the draft row on a speculative engine), every
+        scrap of host bookkeeping saved, shared prefix refs released.
+        The byte copy is what keeps a resumed request's remaining
+        token stream identical to the uninterrupted run's — see
+        serve/paged.py's module docstring for why recompute-on-resume
+        could not promise that."""
+        arena = self.paged_arena
+        slot = self._slots[idx]
+        req = slot.handle.request
+        rid = req.request_id
+        pos = int(self._pos[idx])
+        sw = _Swapped()
+        sw.handle = slot.handle
+        sw.request = req
+        sw.emitted = slot.emitted
+        sw.remaining = slot.remaining
+        sw.first_token_time = slot.first_token_time
+        sw.admit_time = slot.admit_time
+        sw.admitted_step = slot.admitted_step
+        sw.pos = pos
+        sw.tok = int(self._toks[idx])
+        sw.temp = float(self._temps[idx])
+        sw.key = np.asarray(self._keys[idx])
+        sw.n_data = (pos - 1) // arena.block_size + 1
+        sw.seq = next(self._swap_seq)
+        sw.t_preempt = self._clock()
+        sw.kc_h, sw.vc_h = arena.swap_out(slot.blocks, sw.n_data)
+        sw.dkc_h = sw.dvc_h = None
+        if self.draft is not None:
+            dkc_row, dvc_row = _read_slot(self._dkc, self._dvc,
+                                          jnp.int32(idx))
+            sw.dkc_h = jax.tree.map(np.asarray, dkc_row)
+            sw.dvc_h = jax.tree.map(np.asarray, dvc_row)
+        n_freed = len(slot.blocks) - slot.n_shared
+        self._free_slot_blocks(slot)
+        self._release_prefix(slot)
+        self._slots[idx] = None
+        self._swapped.append(sw)
+        arena.on_preempt()
+        _trace.event("serve/preempt", cat="serve", request=rid,
+                     slot=idx, reason=reason, pos=pos,
+                     blocks_freed=n_freed, tokens=len(sw.emitted))
+        if _reqs._active:
+            _reqs._ledger.on_preempt(rid,
+                                     engine=self.stats.engine_label,
+                                     t=sw.t_preempt)
+        self._log.info("preempted %s (%s): %d blocks freed at pos %d",
+                       rid, reason, n_freed, pos)
+
+    def _try_resume(self, now):
+        """Resume swapped-out requests, highest priority first (FIFO
+        within a class): allocate the full block need (preempting
+        strictly-lower live slots if necessary), scatter the host copy
+        back, restore the slot state and sampling key.  Head-of-line
+        semantics: if the best swapped request does not fit, nothing
+        behind it jumps the line."""
+        if not self._swapped:
+            return
+        arena = self.paged_arena
+        B = arena.block_size
+        while self._swapped:
+            # re-sort every iteration: a resume's own preemption (of a
+            # strictly-lower live slot) APPENDS to the swap list, and
+            # the next head must still be the highest-priority oldest
+            self._swapped.sort(key=lambda s: (-s.priority, s.seq))
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                return
+            sw = self._swapped[0]
+            need = (sw.pos + self._spec_pad) // B + 1
+            blocks = self._alloc_blocks(need, sw.priority)
+            if blocks is None:
+                return
+            idx = free[0]
+            arena.swap_in(sw.kc_h, sw.vc_h, blocks[:sw.n_data])
+            if self.draft is not None and sw.dkc_h is not None:
+                self._dkc, self._dvc = _write_slot(
+                    self._dkc, self._dvc,
+                    jax.tree.map(jnp.asarray, sw.dkc_h),
+                    jax.tree.map(jnp.asarray, sw.dvc_h),
+                    jnp.int32(idx))
+            slot = _Slot(sw.handle, sw.remaining, sw.admit_time,
+                         sw.admitted_step)
+            slot.emitted = sw.emitted
+            slot.first_token_time = sw.first_token_time
+            slot.blocks = blocks
+            slot.n_shared = 0
+            self._slots[idx] = slot
+            self._toks[idx] = sw.tok
+            self._pos[idx] = sw.pos
+            self._temps[idx] = sw.temp
+            self._keys = self._keys.at[idx].set(jnp.asarray(sw.key))
+            self._swapped.pop(0)
+            rid = sw.request.request_id
+            _trace.event("serve/resume", cat="serve", request=rid,
+                         slot=idx, pos=sw.pos,
+                         swapped_s=now - sw.t_preempt)
+            if _reqs._active:
+                _reqs._ledger.on_resume(
+                    rid, engine=self.stats.engine_label, t=now)
+            self._log.info("resumed %s after %.3fs swapped", rid,
+                           now - sw.t_preempt)
+
+    def _paged_retire(self, idx, slot, req, result):
+        """Retire teardown for the paged arena.  Donation is
+        ZERO-COPY: the slot's prompt blocks already live in the shared
+        pool, so the radix tree ADOPTS them (``adopt_blocks``) instead
+        of scattering a copy — only a pinned session's generated
+        windows pay a re-canonicalization chunk pass (decode-step KV
+        is not canonical; same analysis as ``_prefix_retire``)."""
+        arena = self.paged_arena
+        cache = self.prefix_cache
+        B = arena.block_size
+        try:
+            if cache is None:
+                if req.pin_session:
+                    result.session = SessionHandle(result.tokens)
+                return
+            plen = len(req.prompt_ids)
+            total = len(result.tokens)
+            want_session = bool(req.pin_session)
+            n_goal = (total // B) if want_session else (plen // B)
+            # the FINAL emitted token's KV position is never written
+            # (nothing decodes after it), so at block_size=1 its block
+            # was never allocated — a session pins one block less (the
+            # next turn's admission recomputes the tail block anyway)
+            n_goal = min(n_goal, len(slot.blocks))
+            path = []
+            if n_goal > 0:
+                if want_session and n_goal > plen // B:
+                    kc_row, vc_row = arena.gather_row(slot.blocks)
+                    ids = np.zeros((1, self.max_len), np.int32)
+                    ids[0, :total] = result.tokens
+                    ids_j = jnp.asarray(ids)
+                    for j in range(plen // B, n_goal):
+                        _, kc_row, vc_row = _chunk_row(
+                            self._params, ids_j, kc_row, vc_row,
+                            jnp.int32(j * B), **self._chunk_statics)
+                    arena.scatter_row(
+                        kc_row, vc_row,
+                        {j: slot.blocks[j]
+                         for j in range(plen // B, n_goal)})
+                path = cache.adopt_blocks(result.tokens, slot.blocks,
+                                          n_goal)
+            if want_session:
+                cache.acquire(path)
+                result.session = SessionHandle(result.tokens, cache,
+                                               path)
+            # free the private blocks the tree did not adopt (the
+            # decode-region blocks, the growth block, and any lane a
+            # sibling's earlier donation made a duplicate of)
+            adopted = {n.block for n in path}
+            arena.free([b for b in slot.blocks[slot.n_shared:]
+                        if b not in adopted])
+            slot.blocks = []
+        finally:
+            self._release_prefix(slot)
+            # exception path: nothing was adopted, every private
+            # block is still slot-owned — free them so a raising
+            # donation cannot leak pool capacity
+            self._free_slot_blocks(slot)
 
     def _prefix_retire(self, idx, slot, req, result):
         """Donate the retired request's prefix back to the radix tree
@@ -1063,6 +1547,12 @@ class InferenceEngine:
             self._release_prefix(slot)
 
     def _schedule(self, now):
+        if self.paged_arena is not None:
+            # swapped requests re-enter BEFORE new admissions: they
+            # already made progress (and streamed tokens), so leaving
+            # them swapped behind fresh arrivals would invert both the
+            # priority order and the latency story
+            self._try_resume(now)
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free and self.scheduler.queue_depth == 0:
             return
@@ -1084,8 +1574,27 @@ class InferenceEngine:
                 DeadlineExceededError(
                     f"{req.request_id}: deadline {req.deadline} passed "
                     f"at {now} before a slot was available"))
-        for req in admit:
-            self._admit(free.pop(0), req, now)
+        # a swapped request still waiting after the resume pass is
+        # blocked on CAPACITY: fresh arrivals at or below its priority
+        # must not eat the blocks/slots it is waiting for (it already
+        # streamed tokens — letting new work overtake it would grow
+        # its latency without bound); strictly-higher arrivals may
+        # still overtake (they outrank it for preemption anyway)
+        blocked_p = (max(sw.priority for sw in self._swapped)
+                     if self._swapped else None)
+        for k, req in enumerate(admit):
+            if (blocked_p is not None
+                    and getattr(req, "priority", 0) <= blocked_p) \
+                    or not self._admit(free.pop(0), req, now):
+                # capacity block: the head request's blocks do not fit
+                # even after eviction + priority preemption (or a
+                # swapped request outranks it).  Push it AND
+                # everything scheduled behind it back to the queue
+                # front in original order — admission order blocks,
+                # it never skips
+                for r in reversed(admit[k:]):
+                    self.scheduler.requeue_front(r)
+                break
 
     def _prefill_cost(self, req):
         """Scheduler interleave price of admitting ``req`` now: 0 for
@@ -1123,6 +1632,31 @@ class InferenceEngine:
         if cache is not None:
             nodes = cache.lookup(req.prompt_ids)[
                 :(plen - 1) // cache.block_size]
+        arena = self.paged_arena
+        new_blocks = []
+        if arena is not None:
+            # admission by BLOCKS FREE: the request needs lanes
+            # [len(nodes), plen//B] now (matched prefix blocks are
+            # shared by reference — zero copy).  The matched path is
+            # ACQUIRED before allocating: _alloc_blocks' eviction only
+            # spares referenced nodes, so without the pin the
+            # allocation could evict the request's OWN match and hand
+            # the same pool block back as one of new_blocks — the
+            # block table would alias one block in two lanes and the
+            # admission scatter would corrupt the shared prefix KV.
+            # Eviction and strictly-lower priority preemption run
+            # inside _alloc_blocks; a miss blocks admission (caller
+            # requeues at the queue front) rather than dropping the
+            # request
+            if cache is not None and nodes:
+                cache.acquire(nodes)
+            n0 = plen // arena.block_size + 1
+            new_blocks = self._alloc_blocks(
+                n0 - len(nodes), getattr(req, "priority", 0))
+            if new_blocks is None:
+                if cache is not None and nodes:
+                    cache.release(nodes)
+                return False
         if _reqs._active:
             # admission started: the queue-wait phase of this hop ends
             # HERE (cold/warm classification is annotated by the
@@ -1142,7 +1676,15 @@ class InferenceEngine:
             key0 = jax.random.split(
                 jax.random.PRNGKey(int(req.seed)), 1)[0]
             temp = np.float32(req.temperature)
-            if nodes:
+            # int8 + prefix cache: EVERY admission (cold included)
+            # runs the chunked path, because a quantized engine's
+            # full-prefill hidden attends FLOAT keys while a chunked
+            # recompute over the quantized cache attends DEQUANTIZED
+            # ones — the streams can only be byte-identical if cold
+            # and warm admissions share one canonical form, and
+            # chunked-quantized is the one donation can store (docs/
+            # SERVING.md "int8 and the prefix cache")
+            if nodes or (cache is not None and self._quant):
                 tok0, carry_key, kc_row, vc_row = self._admit_warm(
                     ids, plen, nodes, key0, temp,
                     rid=req.request_id)
@@ -1150,9 +1692,18 @@ class InferenceEngine:
                 tok0, carry_key, kc_row, vc_row = _prefill_one(
                     self._params, ids_j, plen, key0, temp,
                     self._top_p, **self._statics, quant=self._quant)
-            self._kc, self._vc = _write_slot(self._kc, self._vc,
-                                             kc_row, vc_row,
-                                             jnp.int32(idx))
+            if arena is not None:
+                # the prefilled lanes past the shared prefix scatter
+                # into the request's freshly-allocated pool blocks;
+                # matched lanes never move (shared by reference)
+                m = len(nodes)
+                arena.scatter_row(
+                    kc_row, vc_row,
+                    {m + j: b for j, b in enumerate(new_blocks)})
+            else:
+                self._kc, self._vc = _write_slot(self._kc, self._vc,
+                                                 kc_row, vc_row,
+                                                 jnp.int32(idx))
             if self.draft is not None:
                 # the draft sees the SAME prompt cold (its prefill is
                 # cheap by construction; the prefix cache stores only
@@ -1165,12 +1716,18 @@ class InferenceEngine:
                     self._dkc, self._dvc, dkc_row, dvc_row,
                     jnp.int32(idx))
         if cache is not None:
-            cache.acquire(nodes)
+            if arena is None:
+                # paged admissions acquired the path BEFORE the block
+                # allocation above; acquiring again would double-pin
+                cache.acquire(nodes)
             cache.on_admit(len(nodes), plen,
                            request_id=req.request_id)
         self.stats.on_prefill()
         slot = _Slot(handle, req.max_new_tokens, now, self.step_count)
         slot.prefix_nodes = nodes
+        if arena is not None:
+            slot.blocks = [n.block for n in nodes] + new_blocks
+            slot.n_shared = len(nodes)
         self._slots[idx] = slot
         tok0 = int(np.asarray(tok0))  # device sync: prefill is done
         t_first = self._clock()
@@ -1186,6 +1743,7 @@ class InferenceEngine:
         self._temps[idx] = temp
         self._keys = self._keys.at[idx].set(carry_key)
         self._emit(idx, slot, tok0, t_first)
+        return True
 
     def _admit_warm(self, ids, plen, nodes, key0, temp, rid=None):
         """Warm admission: one gather copies the matched blocks into a

@@ -96,7 +96,9 @@ class Router:
 
     A view is the host-side load sample the fleet takes per candidate:
     ``{"replica": idx, "queue_depth": int, "occupancy": float,
-    "tpot_ewma": float | None, "queue_headroom": int | None}``.
+    "tpot_ewma": float | None, "queue_headroom": int | None,
+    "blocks_used_frac": float | None}`` (the last only on paged
+    replicas — KV-pool pressure).
     ``score`` is a weighted sum — queue depth (requests ahead of this
     one), occupancy (live slots / max_slots), and the TPOT EWMA
     normalized by the fleet-wide best (a replica decoding 3x slower
@@ -107,10 +109,12 @@ class Router:
     AND self-balancing because queue depth moves at submit time.
     Subclass and override ``score`` for custom policies."""
 
-    def __init__(self, w_queue=1.0, w_occupancy=1.0, w_tpot=1.0):
+    def __init__(self, w_queue=1.0, w_occupancy=1.0, w_tpot=1.0,
+                 w_blocks=1.0):
         self.w_queue = float(w_queue)
         self.w_occupancy = float(w_occupancy)
         self.w_tpot = float(w_tpot)
+        self.w_blocks = float(w_blocks)
 
     def score(self, view, tpot_base) -> float:
         s = (self.w_queue * view["queue_depth"]
@@ -118,6 +122,11 @@ class Router:
         ewma = view.get("tpot_ewma")
         if ewma is not None and tpot_base:
             s += self.w_tpot * (ewma / tpot_base)
+        # paged replicas: KV-pool pressure (new admissions on a nearly
+        # full pool preempt/swap — route around it before the thrash)
+        blocks = view.get("blocks_used_frac")
+        if blocks is not None:
+            s += self.w_blocks * blocks
         headroom = view.get("queue_headroom")
         if headroom is not None and headroom <= 0:
             s += _PRESSURE_PENALTY
@@ -408,12 +417,21 @@ class ServeFleet:
         if self._slo is not None \
                 and self._slo.queue_depth_max is not None:
             headroom = self._slo.queue_depth_max - depth
+        arena = eng.paged_arena
         return {
             "replica": rep.idx,
             "queue_depth": depth,
             "occupancy": eng.live_slots / eng.max_slots,
             "tpot_ewma": eng.stats.tpot_ewma,
             "queue_headroom": headroom,
+            # paged replicas: fraction of the KV pool in use (live
+            # slots + cached blocks; swapped requests hold none but
+            # will re-allocate on resume) — a replica whose pool is
+            # nearly full will preempt/swap new admissions, so it
+            # prices itself up before the thrash starts
+            "blocks_used_frac": (eng.paged_arena.blocks_used
+                                 / eng.paged_arena.num_blocks
+                                 if arena is not None else None),
         }
 
     # -- drive -----------------------------------------------------------

@@ -1,0 +1,534 @@
+"""Block-paged KV arena: ONE memory system for live decode and the
+radix prefix cache (the paged-KV round; vLLM/PagedAttention, cited in
+ISSUE.md/ROADMAP item 2).
+
+The engine's original memory model reserved worst-case bytes per
+request: a ``(L, max_slots, H_kv, max_len, D)`` slot arena where every
+slot owns ``max_len`` positions whether its request uses 20 of them or
+all of them, plus a SECOND pool for the prefix cache's blocks
+(serve/prefix.py).  Short requests therefore wasted most of the arena
+and ``max_slots`` capped concurrency far below what the bytes could
+carry.  This module collapses both into one pool:
+
+* **block pool** — one preallocated arena of ``num_blocks`` KV blocks
+  per K/V, shape ``(L, num_blocks + 1, H_kv, block_size, D)`` (the +1
+  is the trash block scatter padding lands in, prefix.py's idiom).
+  Leaves are PYTREE-GENERIC: a dense pool is one array per K/V, an
+  int8 pool is a ``(values, scales)`` tuple — every copy helper below
+  tree-maps with per-leaf rank awareness, which is what lifts the old
+  ``int8 + prefix-cache`` refusal;
+* **block tables** — a live request's KV is a per-slot block LIST
+  grown block-by-block as decode advances.  Capacity is "blocks free",
+  not "slots free": a 20-token request holds one block, not a
+  ``max_len`` row, so far more requests fit the same bytes;
+* **paged pool step** — each decode step gathers every live slot's
+  blocks into a fixed-shape row INSIDE one jitted executable, runs the
+  exact same per-row math as the slot-arena step
+  (``engine._decode_row`` / ``engine._spec_row`` — one definition, so
+  the two memory models cannot drift), and scatters only the block(s)
+  the step wrote back into the pool.  Blocks round-trip as byte
+  copies, so paged token streams are BIT-identical to the slot
+  engine's (tests/test_paged.py pins cold/warm/preempt-resume parity).
+  The gather materializes a transient ``(L, S, H, W, D)`` workspace
+  inside the executable — on hardware with a real paged-attention
+  kernel that workspace disappears into the kernel; the PERSISTENT KV
+  allocation (what the capacity model and ``bench_serve.py --paged``
+  count) is the pool alone;
+* **preemption / swap** — a request's blocks can be evicted to HOST
+  memory mid-decode (``swap_out``: one fixed-shape gather + device
+  sync) and restored later (``swap_in``: one scatter).  The copy is
+  byte-exact, so a preempted-and-resumed request's remaining tokens
+  are the ones the uninterrupted run would have produced — recompute
+  through ``prefill_chunk`` could NOT promise that (decode-step KV
+  drifts ~1e-6 from chunked prefill; see serve/prefix.py's
+  canonical-KV analysis), which is why resume restores bytes and the
+  chunked path is reserved for admissions;
+* **unified prefix cache** — with ``prefix_cache=`` on a paged engine
+  the radix tree allocates from THIS pool (``PrefixCache(arena=...)``):
+  warm admission shares the matched blocks by reference (zero copy),
+  retire donation ADOPTS the slot's private prompt blocks into the
+  tree (zero copy — ``PrefixCache.adopt_blocks``), and cached-but-
+  unreferenced blocks double as soft free space (``alloc`` evicts LRU
+  leaves under pressure before failing).
+
+Copy paths (gather/scatter/swap) check the ``serve.paged_copy`` fault
+site (singa_tpu.resilience): an injected copy failure fails the engine
+TYPED and the supervisor rebuild recovers (bench_chaos.py
+``chaos_paged`` gates zero wedged/lost requests under a fault
+mid-swap).
+
+Metrics ride the process-wide observe registry as
+``serve.paged.{blocks_free,blocks_used,preemptions,swap_in,swap_out}``
+with the owning engine's label, and surface in
+``health_report()["serve"]["paged"]``.
+
+Compile capture: the paged pool steps dispatch through a small AOT
+cache (:func:`_aot_call`) that lowers + compiles each new signature
+once, records the XLA cost-analysis table on a ``serve/compile`` trace
+span, and registers the tables with ``observe.monitor`` — so paged
+executables show up in Chrome traces and crash bundles exactly like
+``_GraphRunner`` train steps do (the VERDICT weak-#6 gap: serve-side
+``jax.jit`` dispatches used to compile invisibly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..observe import monitor as _monitor
+from ..observe import trace as _trace
+from ..observe.registry import registry as _default_registry
+from ..resilience import faults as _faults
+from ..utils.logging import get_channel
+
+__all__ = ["PagedConfig", "PagedKVArena"]
+
+
+@dataclass(frozen=True)
+class PagedConfig:
+    """Knobs for the paged KV arena (hand to
+    ``model.serve(paged=...)``; the supervisor/fleet forward it
+    verbatim, so every rebuilt replica allocates its own fresh pool).
+
+    ``block_size``: tokens per KV block — the allocation granularity
+    AND (when a prefix cache rides the same pool) the reuse
+    granularity.  The engine requires ``max_len % block_size == 0``.
+    ``num_blocks``: pool capacity in blocks; device memory is
+    ``2 * L * num_blocks * H_kv * block_size * D`` elements — compare
+    against the slot arena's ``2 * L * max_slots * max_len * H_kv * D``
+    to hold the byte budget fixed (docs/SERVING.md "Paged KV")."""
+
+    block_size: int = 32
+    num_blocks: int = 128
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}")
+        if self.num_blocks < 1:
+            raise ValueError(
+                f"num_blocks must be >= 1, got {self.num_blocks}")
+
+
+# -- pytree-generic fixed-shape copies ---------------------------------------
+# The generalization of serve/prefix.py's _blocks_to_row/_row_to_blocks:
+# identical math on dense (L, N+1, H, B, D) leaves, and the same
+# moveaxis/reshape on the trailing-axis-free (L, N+1, H, B) scales leaf
+# of an int8 pool — which is what makes quantized pools first-class
+# (the old int8 + prefix-cache refusal existed because these copies
+# were dense-only).  Shapes are keyed on (pool, row) geometry only, so
+# each compiles once per engine geometry and serves any chain length
+# (the index vector is always the full row's worth of lanes, unused
+# lanes masked / pointed at the trash block).
+
+def _leaf_to_row(pool, idx, n_used, block):
+    """One leaf's gather: (L, N+1, H, B, ...) pool -> (L, 1, H, W, ...)
+    row, lanes >= n_used zeroed (junk the chunked prefill and the
+    decode position mask never read live)."""
+    b = jnp.take(pool, idx, axis=1)              # (L, nb, H, B, ...)
+    b = jnp.moveaxis(b, 1, 2)                    # (L, H, nb, B, ...)
+    s = b.shape
+    row = b.reshape(s[0], s[1], s[2] * s[3], *s[4:])
+    live = (jnp.arange(s[2] * s[3]) < n_used * block)
+    live = live.reshape((1, 1, -1) + (1,) * (row.ndim - 3))
+    return jnp.where(live, row, 0)[:, None]      # (L, 1, H, W, ...)
+
+
+def _leaf_to_pool(pool, row, idx, block):
+    """One leaf's scatter: row lanes -> pool blocks at ``idx`` (lanes
+    that should not store anything point at the trash block)."""
+    r = row[:, 0]                                # (L, H, W, ...)
+    s = r.shape
+    b = r.reshape(s[0], s[1], idx.shape[0], block, *s[3:])
+    b = jnp.moveaxis(b, 2, 1)                    # (L, nb, H, B, ...)
+    return pool.at[:, idx].set(b)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def _pool_to_row(pool_k, pool_v, idx, n_used, block):
+    """Gather ``idx`` (nb,) pool blocks into fresh (L, 1, H, W, ...)
+    cache rows, tree-mapped over dense or (values, scales) pools."""
+    g = partial(_leaf_to_row, idx=idx, n_used=n_used, block=block)
+    return jax.tree.map(g, pool_k), jax.tree.map(g, pool_v)
+
+
+@partial(jax.jit, static_argnames=("block",), donate_argnums=(0, 1))
+def _row_to_pool(pool_k, pool_v, kc_row, vc_row, idx, block):
+    """Scatter cache-row lanes into the pool at ``idx``; pools DONATED
+    (the caller rebinds) so a donation/swap is a scatter in place, not
+    an O(pool) copy."""
+    s = partial(_leaf_to_pool, idx=idx, block=block)
+    return (jax.tree.map(lambda p, r: s(p, r), pool_k, kc_row),
+            jax.tree.map(lambda p, r: s(p, r), pool_v, vc_row))
+
+
+def _gather_leaf(pool, tbl):
+    """In-step row gather (no batch axis, no zero mask — the decode
+    position mask covers everything past ``pos``, and every position
+    <= pos lives in an allocated block by the engine's growth
+    invariant)."""
+    b = jnp.take(pool, tbl, axis=1)
+    b = jnp.moveaxis(b, 1, 2)
+    s = b.shape
+    return b.reshape(s[0], s[1], s[2] * s[3], *s[4:])
+
+
+def _slice_block(leaf, off, block):
+    """The (L, H, B, ...) block at position offset ``off`` (traced) of
+    one slot's (L, H, W, ...) cache leaf."""
+    start = (0, 0, off) + (0,) * (leaf.ndim - 3)
+    sizes = (leaf.shape[0], leaf.shape[1], block) + leaf.shape[3:]
+    return jax.lax.dynamic_slice(leaf, start, sizes)
+
+
+# -- paged pool steps --------------------------------------------------------
+# The per-row math is engine._decode_row/_spec_row — the SAME functions
+# the slot-arena steps vmap — so the paged engine's logits are bitwise
+# the slot engine's (the gathered row equals the slot row at every
+# position <= pos: blocks round-trip as byte copies, and positions
+# beyond pos are masked before they can contribute).  Imported lazily
+# at call time to avoid a module cycle (engine imports this module for
+# the arena class).
+
+@partial(jax.jit,
+         static_argnames=("block", "n_head", "eps", "moe_top_k",
+                          "top_k", "use_top_p"),
+         donate_argnums=(1, 2))
+def _paged_decode_step(params, pool_k, pool_v, tables, toks, pos, live,
+                       keys, temps, top_p, block, n_head, eps,
+                       moe_top_k, top_k, use_top_p):
+    """Advance EVERY slot one token against the block pool: tables
+    (S, W//B) int32 block ids (trash-padded), pools donated.  Per slot:
+    gather its blocks into a row, run the shared decode-row math, then
+    scatter ONLY the block containing ``pos`` back (one written block
+    per slot per step; dead slots write the trash block).  Returns
+    (next_toks, pool_k, pool_v, new_keys)."""
+    from .engine import _decode_row
+
+    trash = jax.tree.leaves(pool_k)[0].shape[1] - 1
+
+    def row(tbl, tok, pos_r, live_r, key, temp):
+        kc_r = jax.tree.map(lambda p: _gather_leaf(p, tbl), pool_k)
+        vc_r = jax.tree.map(lambda p: _gather_leaf(p, tbl), pool_v)
+        nxt, kc2, vc2, k2 = _decode_row(
+            params, kc_r, vc_r, tok, pos_r, live_r, key, temp, top_p,
+            n_head, eps, moe_top_k, top_k, use_top_p)
+        p_c = jnp.where(live_r, pos_r, 0)
+        blk = p_c // block
+        off = blk * block
+        kb = jax.tree.map(lambda a: _slice_block(a, off, block), kc2)
+        vb = jax.tree.map(lambda a: _slice_block(a, off, block), vc2)
+        dst = jnp.where(live_r, tbl[blk], trash)
+        return nxt, kb, vb, dst, k2
+
+    nxt, kb, vb, dst, keys2 = jax.vmap(
+        row, in_axes=(0, 0, 0, 0, 0, 0),
+        out_axes=(0, 1, 1, 0, 0))(tables, toks, pos, live, keys, temps)
+    pool_k = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_k, kb)
+    pool_v = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_v, vb)
+    return nxt, pool_k, pool_v, keys2
+
+
+@partial(jax.jit,
+         static_argnames=("block", "spec_k", "tn", "te", "tm", "dn",
+                          "de", "dm", "top_k", "use_top_p"),
+         donate_argnums=(2, 3, 4, 5))
+def _paged_spec_step(t_params, d_params, pool_k, pool_v, dkc, dvc,
+                     tables, toks, pos, live, keys, temps, top_p,
+                     block, spec_k, tn, te, tm, dn, de, dm, top_k,
+                     use_top_p):
+    """Speculative chunk against the block pool: the TARGET cache is
+    paged (gather row -> shared spec-row math -> scatter back the one
+    or two blocks the verify chunk wrote — ``spec_k <= block_size`` is
+    validated at engine construction so a chunk never spans more than
+    two); the DRAFT arena stays slot-shaped (donated, advanced in
+    lockstep — it is small by construction and carries no prefix
+    cache).  Returns (out, a_draft, pool_k, pool_v, dkc, dvc,
+    new_keys)."""
+    from .engine import _spec_row
+
+    trash = jax.tree.leaves(pool_k)[0].shape[1] - 1
+
+    def row(dkc_r, dvc_r, tbl, tok, pos_r, live_r, key, temp):
+        kc_r = jax.tree.map(lambda p: _gather_leaf(p, tbl), pool_k)
+        vc_r = jax.tree.map(lambda p: _gather_leaf(p, tbl), pool_v)
+        out, a_draft, kc2, vc2, dkc2, dvc2, k2 = _spec_row(
+            t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
+            live_r, key, temp, top_p, spec_k, tn, te, tm, dn, de, dm,
+            top_k, use_top_p)
+        p_c = jnp.where(live_r, pos_r, 0)
+        b0 = p_c // block
+        b1 = (p_c + spec_k - 1) // block
+        kb0 = jax.tree.map(
+            lambda a: _slice_block(a, b0 * block, block), kc2)
+        vb0 = jax.tree.map(
+            lambda a: _slice_block(a, b0 * block, block), vc2)
+        kb1 = jax.tree.map(
+            lambda a: _slice_block(a, b1 * block, block), kc2)
+        vb1 = jax.tree.map(
+            lambda a: _slice_block(a, b1 * block, block), vc2)
+        dst0 = jnp.where(live_r, tbl[b0], trash)
+        # same-block chunks route the second write to trash so the two
+        # scatters never collide on a real block
+        dst1 = jnp.where(live_r & (b1 > b0), tbl[b1], trash)
+        return (out, a_draft, kb0, vb0, dst0, kb1, vb1, dst1, dkc2,
+                dvc2, k2)
+
+    (out, a_draft, kb0, vb0, dst0, kb1, vb1, dst1, dkc, dvc,
+     keys2) = jax.vmap(
+        row, in_axes=(1, 1, 0, 0, 0, 0, 0, 0),
+        out_axes=(0, 0, 1, 1, 0, 1, 1, 0, 1, 1, 0))(
+        dkc, dvc, tables, toks, pos, live, keys, temps)
+    pool_k = jax.tree.map(lambda p, b: p.at[:, dst0].set(b), pool_k, kb0)
+    pool_v = jax.tree.map(lambda p, b: p.at[:, dst0].set(b), pool_v, vb0)
+    pool_k = jax.tree.map(lambda p, b: p.at[:, dst1].set(b), pool_k, kb1)
+    pool_v = jax.tree.map(lambda p, b: p.at[:, dst1].set(b), pool_v, vb1)
+    return out, a_draft, pool_k, pool_v, dkc, dvc, keys2
+
+
+# -- AOT compile capture (VERDICT weak #6) -----------------------------------
+# Serve-side executables used to compile invisibly: no span, no cost
+# table, nothing in crash bundles.  The paged steps dispatch through
+# this cache instead — each new (function, shapes, statics) signature
+# is lowered + compiled ONCE under a serve/compile span carrying the
+# XLA cost-analysis scalars, and the tables feed monitor crash bundles
+# through the registered cost source below.  Falls back to the plain
+# jit dispatch if AOT lowering is unavailable.
+
+_MISS = object()
+_aot_cache = {}          # (name, leaf shapes/dtypes, statics) -> Compiled|None
+_aot_costs = []          # [{"key": ..., "cost": {...}}] for crash bundles
+
+
+def _paged_cost_tables():
+    return list(_aot_costs)
+
+
+_monitor.register_cost_source(_paged_cost_tables)
+
+
+def _cost_scalars(cost):
+    try:
+        from ..model import _cost_args
+        return _cost_args(cost)
+    except Exception:
+        return {}
+
+
+def _aot_call(name, fn, *args, **statics):
+    """Dispatch ``fn(*args, **statics)`` through the AOT cache.  The
+    compiled executable takes only the traced args (statics were
+    consumed at lowering); the cache key mirrors jit's (leaf shapes +
+    dtypes + statics), so warm/timed engines, supervisor rebuilds, and
+    fleet replicas with identical geometry all share one compile —
+    the same restart-is-a-cache-hit contract the jitted paths keep."""
+    key = (name,
+           tuple((tuple(a.shape), str(a.dtype))
+                 for a in jax.tree.leaves(args)),
+           tuple(sorted(statics.items())))
+    entry = _aot_cache.get(key, _MISS)
+    if entry is _MISS:
+        with _trace.span("serve/compile", cat="serve", fn=name) as sp:
+            try:
+                compiled = fn.lower(*args, **statics).compile()
+                scalars = _cost_scalars(compiled.cost_analysis())
+                _aot_costs.append(
+                    {"key": f"serve.paged/{name}", "cost": scalars})
+                sp.set(**scalars)
+                entry = compiled
+            except Exception:
+                entry = None  # no AOT on this backend: plain jit path
+        _aot_cache[key] = entry
+    if entry is not None:
+        return entry(*args)
+    return fn(*args, **statics)
+
+
+def _compile_cache_size():
+    """Entries in the paged AOT cache — counted alongside the jitted
+    functions' ``_cache_size()`` by ``bench_serve._serve_jit_cache_size``
+    so the no-runtime-recompiles pin covers the paged dispatch path
+    too."""
+    return len(_aot_cache)
+
+
+# -- the arena ---------------------------------------------------------------
+
+class PagedKVArena:
+    """Host-side owner of the block pool: free list, block accounting,
+    the copy entry points the engine drives, swap buffers, and
+    metrics.  Allocation is block-granular, so there is no external
+    fragmentation by construction — any ``n`` free blocks satisfy any
+    ``n``-block request (tests/test_paged.py churn-checks the
+    accounting invariant ``free + used == num_blocks`` with cached
+    blocks counted in ``used``)."""
+
+    def __init__(self, config, n_layer, n_kv_head, head_dim, dtype,
+                 row_width, quant=False, engine_label="0", reg=None):
+        self.config = config
+        B, N = config.block_size, config.num_blocks
+        self.block_size = B
+        self.num_blocks = N
+        self.trash = N
+        if row_width % B != 0:
+            raise ValueError(
+                f"row width ({row_width}) must be a multiple of "
+                f"block_size ({B})")
+        self.row_blocks = row_width // B
+        self.quant = bool(quant)
+
+        def pool(shape_tail):
+            if quant:
+                return (jnp.zeros((n_layer, N + 1, n_kv_head, B)
+                                  + shape_tail, jnp.int8),
+                        jnp.zeros((n_layer, N + 1, n_kv_head, B),
+                                  jnp.float32))
+            return jnp.zeros((n_layer, N + 1, n_kv_head, B)
+                             + shape_tail, dtype)
+
+        self.pool_k = pool((head_dim,))
+        self.pool_v = pool((head_dim,))
+        self._free = list(range(N))
+        # soft free space: the engine wires this to the prefix cache's
+        # LRU leaf eviction so cached-but-unreferenced blocks are
+        # reclaimed before an allocation fails
+        self.evict_cb = None
+        self._log = get_channel("serve")
+        reg = reg if reg is not None else _default_registry()
+        lbl = dict(engine=engine_label)
+        self._g_free = reg.gauge(
+            "serve.paged.blocks_free",
+            help="pool blocks on the free list", **lbl)
+        self._g_used = reg.gauge(
+            "serve.paged.blocks_used",
+            help="pool blocks held by live slots or the prefix cache "
+                 "(a swapped-out request holds NONE — its blocks were "
+                 "freed at preemption and resume re-allocates its "
+                 "full need)", **lbl)
+        self._c_preempt = reg.counter(
+            "serve.paged.preemptions",
+            help="live requests preempted (blocks evicted to host)",
+            **lbl)
+        self._c_swap_out = reg.counter(
+            "serve.paged.swap_out",
+            help="request KV rows copied device -> host", **lbl)
+        self._c_swap_in = reg.counter(
+            "serve.paged.swap_in",
+            help="request KV rows restored host -> device", **lbl)
+        self._registered = [self._g_free, self._g_used, self._c_preempt,
+                            self._c_swap_out, self._c_swap_in]
+        self._registry = reg
+        self._update_gauges()
+
+    # -- accounting ------------------------------------------------------
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def _update_gauges(self):
+        self._g_free.set(self.blocks_free)
+        self._g_used.set(self.blocks_used)
+
+    def alloc(self, n) -> list | None:
+        """``n`` pool blocks, or None — all or nothing, so a partial
+        grab can never strand a request mid-allocation.  Under
+        pressure the prefix cache's LRU leaves are evicted first
+        (``evict_cb``); evicted blocks stay freed even when the
+        request ultimately does not fit."""
+        while len(self._free) < n and self.evict_cb is not None:
+            blk = self.evict_cb()
+            if blk is None:
+                break
+            self._free.append(blk)
+        if len(self._free) < n:
+            self._update_gauges()
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._update_gauges()
+        return out
+
+    def free(self, blocks):
+        self._free.extend(blocks)
+        self._update_gauges()
+
+    # -- device copies ---------------------------------------------------
+    def _pad_idx(self, blocks):
+        idx = np.full(self.row_blocks, self.trash, np.int32)
+        idx[:len(blocks)] = blocks
+        return jnp.asarray(idx)
+
+    def gather_row(self, blocks, n_used=None):
+        """Fixed-shape row holding ``blocks``' contents at lanes
+        [0, len(blocks)); lanes >= ``n_used`` (default: all of them)
+        zeroed.  One executable for every chain length."""
+        if _faults._armed:
+            _faults.check("serve.paged_copy")
+        n = len(blocks) if n_used is None else n_used
+        return _pool_to_row(self.pool_k, self.pool_v,
+                            self._pad_idx(blocks), jnp.int32(n),
+                            block=self.block_size)
+
+    def scatter_row(self, kc_row, vc_row, lanes):
+        """Write row lanes into pool blocks: ``lanes`` maps lane index
+        -> block id; unmapped lanes point at the trash block.  One
+        donated scatter — the pool updates in place."""
+        if _faults._armed:
+            _faults.check("serve.paged_copy")
+        idx = np.full(self.row_blocks, self.trash, np.int32)
+        for lane, blk in lanes.items():
+            idx[lane] = blk
+        self.pool_k, self.pool_v = _row_to_pool(
+            self.pool_k, self.pool_v, kc_row, vc_row,
+            jnp.asarray(idx), block=self.block_size)
+
+    # -- swap ------------------------------------------------------------
+    def swap_out(self, blocks, n_data):
+        """Copy ``blocks``' first ``n_data`` lanes to HOST memory (one
+        gather + device sync) — the preemption path.  Returns
+        (kc_host, vc_host) numpy pytrees shaped like a cache row."""
+        kc_row, vc_row = self.gather_row(blocks, n_used=n_data)
+        self._c_swap_out.inc()
+        return (jax.tree.map(np.asarray, kc_row),
+                jax.tree.map(np.asarray, vc_row))
+
+    def swap_in(self, kc_host, vc_host, blocks):
+        """Restore a swapped-out row's lanes into freshly allocated
+        ``blocks`` (one scatter — ``scatter_row`` carries the
+        ``serve.paged_copy`` fault check, so one logical restore is
+        one policy tick).  Byte-exact: the resumed request's cache
+        state is exactly what swap_out saved."""
+        self._c_swap_in.inc()
+        self.scatter_row(jax.tree.map(jnp.asarray, kc_host),
+                         jax.tree.map(jnp.asarray, vc_host),
+                         {j: b for j, b in enumerate(blocks)})
+
+    def on_preempt(self):
+        self._c_preempt.inc()
+
+    # -- lifecycle / reporting -------------------------------------------
+    def unregister(self):
+        """Release registry entries and the device pool (engine
+        close())."""
+        self._registry.remove(*self._registered)
+        self.pool_k = self.pool_v = None
+
+    def snapshot(self) -> dict:
+        return {
+            "block_size": self.block_size,
+            "num_blocks": self.num_blocks,
+            "blocks_free": self.blocks_free,
+            "blocks_used": self.blocks_used,
+            "preemptions": self._c_preempt.value,
+            "swap_out": self._c_swap_out.value,
+            "swap_in": self._c_swap_in.value,
+            "quant": self.quant,
+        }

@@ -94,24 +94,25 @@ class PrefixCacheConfig:
 # compiled once per engine and reused for any chain length, because the
 # block-index vector is always the full row's worth of block slots
 # (W // block_size entries) with unused lanes masked / pointed at the
-# trash block.
+# trash block.  PYTREE-GENERIC since the paged round (the leaf helpers
+# live in serve/paged.py): dense pools are plain arrays, int8 pools are
+# (values, scales) tuples — the per-leaf block width comes off the
+# leaf's own shape, so the trailing-axis-free scales leaf rides the
+# same executables.  This is what lifted the old int8 + prefix-cache
+# refusal.
 
 @jax.jit
 def _blocks_to_row(pool_k, pool_v, idx, n_used):
-    """Gather ``idx`` (nb,) pool blocks into a fresh (L, 1, H, W, D)
-    cache row: block j covers positions [j*B, (j+1)*B).  Lanes
-    ``>= n_used`` (traced) are zeroed — junk that the chunked prefill
-    and the decode mask never read live."""
-    L, _, H, B, D = pool_k.shape
-    nb = idx.shape[0]
+    """Gather ``idx`` (nb,) pool blocks into a fresh (L, 1, H, W, ...)
+    cache row per leaf: block j covers positions [j*B, (j+1)*B).
+    Lanes ``>= n_used`` (traced) are zeroed — junk that the chunked
+    prefill and the decode mask never read live."""
+    from .paged import _leaf_to_row
 
     def gather(pool):
-        blocks = jnp.take(pool, idx, axis=1)         # (L, nb, H, B, D)
-        row = blocks.transpose(0, 2, 1, 3, 4).reshape(L, H, nb * B, D)
-        live = (jnp.arange(nb * B) < n_used * B)[None, None, :, None]
-        return jnp.where(live, row, 0)[:, None]      # (L, 1, H, W, D)
+        return _leaf_to_row(pool, idx, n_used, pool.shape[3])
 
-    return gather(pool_k), gather(pool_v)
+    return jax.tree.map(gather, pool_k), jax.tree.map(gather, pool_v)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -125,24 +126,27 @@ def _row_to_blocks(pool_k, pool_v, kc_row, vc_row, idx):
     every retirement's donation would copy the whole pool (hundreds
     of MB at production block counts) instead of scattering in
     place."""
-    L, _, H, B, D = pool_k.shape
-    nb = idx.shape[0]
+    from .paged import _leaf_to_pool
 
     def scatter(pool, row):
-        blocks = row[:, 0].reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
-        return pool.at[:, idx].set(blocks)
+        return _leaf_to_pool(pool, row, idx, pool.shape[3])
 
-    return scatter(pool_k, kc_row), scatter(pool_v, vc_row)
+    return (jax.tree.map(scatter, pool_k, kc_row),
+            jax.tree.map(scatter, pool_v, vc_row))
 
 
 @jax.jit
 def _read_slot(kc_arena, vc_arena, slot):
-    """One slot's cache rows (L, 1, H, W, D) out of the engine arena."""
-    L, _, H, W, D = kc_arena.shape
-    sizes = (L, 1, H, W, D)
-    start = (0, slot, 0, 0, 0)
-    return (jax.lax.dynamic_slice(kc_arena, start, sizes),
-            jax.lax.dynamic_slice(vc_arena, start, sizes))
+    """One slot's cache rows (L, 1, H, W, ...) out of the engine
+    arena (per leaf — int8 arenas are (values, scales) tuples whose
+    scales leaf lacks the trailing D axis)."""
+
+    def rd(arena):
+        sizes = (arena.shape[0], 1) + arena.shape[2:]
+        start = (0, slot) + (0,) * (arena.ndim - 2)
+        return jax.lax.dynamic_slice(arena, start, sizes)
+
+    return jax.tree.map(rd, kc_arena), jax.tree.map(rd, vc_arena)
 
 
 class _Node:
@@ -213,17 +217,37 @@ class PrefixCache:
     the host-side tree, refcounts, LRU state, and metrics."""
 
     def __init__(self, config, n_layer, n_kv_head, head_dim, dtype,
-                 engine_label="0", reg=None):
+                 engine_label="0", reg=None, quant=False, arena=None):
         self.config = config
         B, N = config.block_size, config.num_blocks
         self.block_size = B
         self.num_blocks = N
-        # +1: the trash block scatter padding lands in (never read)
-        self._pool_k = jnp.zeros((n_layer, N + 1, n_kv_head, B,
-                                  head_dim), dtype)
-        self._pool_v = jnp.zeros_like(self._pool_k)
+        # ARENA mode (paged engines): the tree indexes blocks of the
+        # engine's shared PagedKVArena instead of owning a pool —
+        # capacity is the arena's, device copies route through it, and
+        # donation is zero-copy adoption (adopt_blocks)
+        self._arena = arena
+        if arena is not None:
+            self.num_blocks = arena.num_blocks
+            self._pool_k = self._pool_v = None
+        elif quant:
+            # (values, scales) pytree pool — same layout as the int8
+            # engine arena, so the generic copies round-trip it
+            self._pool_k = (
+                jnp.zeros((n_layer, N + 1, n_kv_head, B, head_dim),
+                          jnp.int8),
+                jnp.zeros((n_layer, N + 1, n_kv_head, B), jnp.float32))
+            self._pool_v = (
+                jnp.zeros((n_layer, N + 1, n_kv_head, B, head_dim),
+                          jnp.int8),
+                jnp.zeros((n_layer, N + 1, n_kv_head, B), jnp.float32))
+        else:
+            # +1: the trash block scatter padding lands in (never read)
+            self._pool_k = jnp.zeros((n_layer, N + 1, n_kv_head, B,
+                                      head_dim), dtype)
+            self._pool_v = jnp.zeros_like(self._pool_k)
         self._root = _Node((), None, -1, 0)
-        self._free = list(range(N))
+        self._free = [] if arena is not None else list(range(N))
         self._nodes_by_block = {}       # pool slot -> node
         self._tick = itertools.count(1)
         self._log = get_channel("serve")
@@ -354,6 +378,28 @@ class PrefixCache:
         self._g_cached.set(self.cached_blocks)
         return victim.block
 
+    def evictable_blocks(self) -> int:
+        """How many blocks LRU eviction could EVER reclaim: a node is
+        reclaimable only after its whole subtree is (evicting an
+        interior node would orphan children), so a referenced node
+        shields every ancestor.  The paged engine's allocation
+        feasibility check uses this to avoid preempting live work for
+        an allocation that could never fit anyway (pinned sessions
+        holding the pool)."""
+
+        def sub(node):
+            # (evictable count, whole subtree reclaimable)
+            total, fully = 0, True
+            for c in node.children.values():
+                ev, f = sub(c)
+                total += ev
+                fully = fully and f
+            if fully and node.refs == 0:
+                return total + 1, True
+            return total, False
+
+        return sum(sub(c)[0] for c in self._root.children.values())
+
     def _alloc(self):
         if self._free:
             return self._free.pop()
@@ -377,12 +423,46 @@ class PrefixCache:
 
     def copy_into_row(self, nodes):
         """Build a cache row holding ``nodes``' blocks at positions
-        [0, len(nodes)*B); the rest zeros.  One gather dispatch."""
+        [0, len(nodes)*B); the rest zeros.  One gather dispatch — out
+        of the shared paged arena in arena mode (its
+        ``serve.paged_copy`` fault site covers that path), out of the
+        cache-owned pool otherwise."""
+        if self._arena is not None:
+            return self._arena.gather_row([n.block for n in nodes],
+                                          n_used=len(nodes))
         if _faults._armed:
             _faults.check("serve.prefix_copy")
         idx = self._pad_idx([n.block for n in nodes], trash=0)
         return _blocks_to_row(self._pool_k, self._pool_v, idx,
                               jnp.int32(len(nodes)))
+
+    def adopt_blocks(self, tokens, blocks, n_goal):
+        """ZERO-COPY donation (arena mode): insert tree nodes that
+        take OWNERSHIP of a retiring slot's private pool blocks —
+        ``blocks[j]`` holds the canonical K/V for token block ``j`` of
+        ``tokens``, already sitting in the shared paged arena, so
+        donation moves a pointer, not bytes.  A lane that ALREADY has
+        a node (the slot's shared admission prefix, or a sibling's
+        earlier donation of the same content) keeps the tree's block
+        and the caller frees any duplicate (it is absent from the
+        returned path's block set).  Never skips, never allocates:
+        adoption cannot fail under pool pressure.  Returns the tree
+        path covering ``n_goal`` blocks."""
+        keys = self._block_keys(tokens)[:n_goal]
+        tick = next(self._tick)
+        path = []
+        node = self._root
+        for j, key in enumerate(keys):
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, node, blocks[j], tick)
+                node.children[key] = child
+                self._nodes_by_block[blocks[j]] = child
+            child.last_used = tick
+            path.append(child)
+            node = child
+        self._g_cached.set(self.cached_blocks)
+        return path
 
     def donate_from_row(self, tokens, kc_row, vc_row, n_blocks):
         """Insert ``tokens``' first ``n_blocks`` full blocks into the
@@ -390,7 +470,13 @@ class PrefixCache:
         row in ONE scatter dispatch.  Under pool pressure the
         donation stops at the first unallocatable block (the stored
         path must stay a contiguous prefix) — counted, never raised.
-        Returns the tree path covering what is now cached."""
+        Returns the tree path covering what is now cached.  Arena-mode
+        caches never call this — the paged engine donates by
+        :meth:`adopt_blocks` (zero copy)."""
+        if self._arena is not None:
+            raise RuntimeError(
+                "donate_from_row on an arena-backed prefix cache: "
+                "paged engines donate by adoption (adopt_blocks)")
         if _faults._armed:
             _faults.check("serve.prefix_copy")
         keys = self._block_keys(tokens)[:n_blocks]
@@ -433,7 +519,8 @@ class PrefixCache:
     # -- lifecycle / reporting -------------------------------------------
     def unregister(self):
         """Release registry entries and the device pool (engine
-        close())."""
+        close(); in arena mode the shared pool is the arena's to
+        release)."""
         self._registry.remove(*self._registered)
         self._pool_k = self._pool_v = None
 

@@ -80,6 +80,14 @@ class FIFOScheduler:
         self._queue.clear()
         return out
 
+    def requeue_front(self, request: GenerationRequest):
+        """Put a popped-but-unadmitted request back at the HEAD of the
+        queue (the paged engine's capacity-blocked admission path: the
+        request's blocks did not fit this step, so it waits at the
+        front — admission order blocks, it never skips).  No depth
+        check: the request was already admitted to the queue once."""
+        self._queue.appendleft(request)
+
     def shed_lowest(self, reason, below_priority=None):
         """Load shedding: remove and return the lowest-priority queued
         request (ties: the newest arrival — it has waited least), or
@@ -145,3 +153,52 @@ class FIFOScheduler:
                 spent += c
             admit.append(self._queue.popleft())
         return admit, expired
+
+
+class PriorityScheduler(FIFOScheduler):
+    """Strict-priority admission on top of the FIFO machinery: the
+    queue is kept ordered by ``GenerationRequest.priority`` (higher
+    first), FIFO WITHIN a priority class — so priority-0 traffic
+    behaves exactly like the FIFO scheduler until something more
+    urgent arrives.  Everything else (deadline expiry, the
+    prefill-interleave budget, ``drain``/``shed_lowest``/
+    ``requeue_front``, back-pressure) is inherited: ``schedule`` pops
+    from the head, and the head is by construction the
+    highest-priority oldest request.
+
+    Pairs with the paged engine's preemption (docs/SERVING.md
+    "Scheduler policy matrix"): a high-priority arrival that does not
+    fit in blocks PREEMPTS strictly-lower-priority live work (swap to
+    host, resume later) instead of waiting behind it — SLO pressure
+    preempts rather than sheds.  Construct per engine, or pass
+    ``scheduler="priority"`` so supervisors and fleets build one per
+    replica (an instance forwarded through ``engine_kw`` would be
+    shared)."""
+
+    def enqueue(self, request: GenerationRequest):
+        if len(self._queue) >= self.max_queue_depth:
+            raise QueueFullError(
+                f"scheduler queue full (depth {len(self._queue)} of "
+                f"max {self.max_queue_depth}); rejecting "
+                f"{request.request_id}")
+        p = getattr(request, "priority", 0)
+        i = len(self._queue)
+        while i > 0 and getattr(self._queue[i - 1], "priority", 0) < p:
+            i -= 1
+        self._queue.insert(i, request)
+        if _reqs._active:
+            # the request's actual queue position — ahead of every
+            # lower-priority request it just overtook
+            _reqs._ledger.annotate_hop(request.request_id,
+                                       queue_depth_at_enqueue=i)
+
+    def requeue_front(self, request: GenerationRequest):
+        """Head of the request's own priority CLASS: ahead of equal
+        priorities (it was popped first, so it was oldest), behind
+        anything strictly higher that arrived meanwhile."""
+        p = getattr(request, "priority", 0)
+        i = 0
+        while i < len(self._queue) \
+                and getattr(self._queue[i], "priority", 0) > p:
+            i += 1
+        self._queue.insert(i, request)

@@ -141,6 +141,9 @@ class EngineStats:
         # set by the engine when a prefix cache is attached: a
         # zero-arg callable returning the cache's snapshot dict
         self.prefix_source = None
+        # set by the engine in paged mode: the PagedKVArena's snapshot
+        # (blocks free/used, preemption and swap counters)
+        self.paged_source = None
         # speculative engines only: acceptance accounting (``spec`` is
         # set by the engine when a draft model is attached; a plain
         # engine registers nothing and snapshots spec: None)
@@ -358,6 +361,11 @@ class EngineStats:
             }),
             "prefix": (self.prefix_source()
                        if self.prefix_source is not None else None),
+            # add-only schema extension (paged round): None for
+            # slot-arena engines; block accounting + preemption/swap
+            # counters for paged ones
+            "paged": (self.paged_source()
+                      if self.paged_source is not None else None),
             # add-only schema extension (speculative round): None for
             # plain engines.  tokens_per_chunk = accepted proposals +
             # the chunk's bonus/correction token, per verify chunk —

@@ -8,7 +8,12 @@ This module is the layer that turns that clean death into continuity:
 * **rebuild** — the supervisor constructs a fresh engine with the SAME
   constructor arguments (same ``(max_slots, max_len)`` and statics, so
   every jitted executable is a cache hit — a restart costs an arena
-  allocation, not a recompile) and a fresh KV arena;
+  allocation, not a recompile) and a fresh KV arena.  Paged engines
+  (``paged=`` forwarded verbatim) rebuild with a fresh BLOCK POOL and
+  an empty radix tree: no block of the failed pool is ever carried,
+  so a corrupting copy fault cannot survive a restart.  Swapped-out
+  requests count as STARTED (tokens streamed before the preemption) —
+  they are rejected typed, never requeued;
 * **requeue** — requests the failed engine had NOT started (rejected
   with ``started=False``) are resubmitted to the new engine in their
   original arrival order; their caller-facing handles resolve as if

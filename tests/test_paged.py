@@ -1,0 +1,457 @@
+"""Paged KV arena (serve/paged.py + the engine's ``paged=`` mode):
+byte parity against the slot-arena oracle (cold / warm / int8 / GQA /
+speculative / preempt-resume), block accounting and leak checks,
+priority preemption ordering, config validation, and the
+observability surface (``serve.paged.*`` metrics, health section,
+request-ledger ``preempted`` phase).
+
+Everything deterministic on CPU: parity is np.array_equal on token
+streams, and the slot-arena engine (itself parity-pinned against
+single-prompt ``generate`` in tests/test_serve.py) is the oracle, so
+preemption/swap noise cannot hide behind tolerance."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.observe import health_report
+from singa_tpu.observe import requests as reqtrace
+from singa_tpu.observe.registry import registry
+from singa_tpu.resilience import FailAfterN, faults
+from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                             GenerationRequest, PagedConfig,
+                             PrefixCacheConfig, PriorityScheduler)
+
+
+def _build(cfg):
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _build(GPT2Config.tiny(dropout=0.0))
+
+
+@pytest.fixture(scope="module")
+def draft():
+    return _build(GPT2Config.tiny(dropout=0.0, n_layer=1))
+
+
+def _workload(seed, n, p_lo=3, p_hi=14, n_lo=2, n_hi=9, sampled=False):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        out.append(dict(
+            prompt=rng.randint(0, 256, rng.randint(p_lo, p_hi))
+            .astype(np.int32),
+            n_new=int(rng.randint(n_lo, n_hi)),
+            temperature=(float(rng.choice([0.0, 0.9]))
+                         if sampled else 0.0),
+            seed=int(rng.randint(0, 1000))))
+    return out
+
+
+def _run(m, work, max_slots=2, max_steps=4000, **kw):
+    eng = m.serve(max_slots=max_slots, **kw)
+    hs = [eng.submit(GenerationRequest(
+        w["prompt"], max_new_tokens=w["n_new"],
+        temperature=w["temperature"], seed=w["seed"]))
+        for w in work]
+    eng.run_until_complete(max_steps=max_steps)
+    outs = [h.result().tokens for h in hs]
+    snap = eng.stats.snapshot()
+    eng.close()
+    return outs, snap
+
+
+def test_cold_parity_and_clean_accounting(model):
+    """Cold paged streams (greedy AND seeded sampling mixed in one
+    pool) are byte-identical to the slot engine's, and a drained
+    engine returns every block."""
+    work = _workload(0, 8, sampled=True)
+    base, _ = _run(model, work)
+    outs, snap = _run(model, work,
+                      paged=PagedConfig(block_size=8, num_blocks=32))
+    assert all(np.array_equal(a, b) for a, b in zip(outs, base))
+    assert snap["paged"]["blocks_used"] == 0
+    assert snap["paged"]["preemptions"] == 0
+
+
+def test_preempt_resume_byte_parity(model):
+    """An over-committed pool forces mid-decode swaps; the resumed
+    streams (byte-copied KV + restored key chain) equal the
+    uninterrupted slot-engine run exactly — greedy and sampled."""
+    work = _workload(1, 6, n_lo=12, n_hi=30, p_lo=4, p_hi=20,
+                     sampled=True)
+    base, _ = _run(model, work, max_slots=4)
+    outs, snap = _run(model, work, max_slots=4,
+                      paged=PagedConfig(block_size=8, num_blocks=10))
+    assert all(np.array_equal(a, b) for a, b in zip(outs, base))
+    pg = snap["paged"]
+    assert pg["preemptions"] > 0 and pg["swap_in"] > 0
+    assert pg["blocks_used"] == 0, "leaked blocks after drain"
+
+
+def test_gqa_paged_parity():
+    """GQA models (narrow H_kv cache leaves) page identically."""
+    m = _build(GPT2Config.tiny(dropout=0.0, n_kv_head=2))
+    work = _workload(2, 5, n_lo=8, n_hi=20, p_lo=4, p_hi=16)
+    base, _ = _run(m, work, max_slots=3)
+    outs, snap = _run(m, work, max_slots=3,
+                      paged=PagedConfig(block_size=8, num_blocks=8))
+    assert all(np.array_equal(a, b) for a, b in zip(outs, base))
+    assert snap["paged"]["preemptions"] > 0  # pool was over-committed
+
+
+def test_spec_paged_greedy_parity(model, draft):
+    """Speculative decoding over the paged target arena: greedy
+    streams equal the plain engine's (verify chunks scatter one or
+    two blocks back per slot per step)."""
+    work = _workload(3, 5, n_lo=4, n_hi=12, p_lo=4, p_hi=12)
+    base, _ = _run(model, work, max_slots=3)
+    outs, snap = _run(model, work, max_slots=3, draft_model=draft,
+                      spec_k=3,
+                      paged=PagedConfig(block_size=8, num_blocks=32))
+    assert all(np.array_equal(a, b) for a, b in zip(outs, base))
+    assert snap["spec"]["chunks"] > 0
+
+
+def test_warm_prefix_zero_copy(model):
+    """The radix cache rides the SAME pool: warm admissions share
+    matched blocks by reference, donation adopts private blocks, and
+    after the drain every used block is a cached block (nothing
+    leaked, nothing copied)."""
+    rng = np.random.RandomState(4)
+    system = rng.randint(0, 256, 24).astype(np.int32)
+    work = [dict(prompt=np.concatenate(
+        [system, rng.randint(0, 256, rng.randint(3, 10))
+         .astype(np.int32)]),
+        n_new=int(rng.randint(3, 8)), temperature=0.0, seed=0)
+        for _ in range(8)]
+    base, _ = _run(model, work)
+    outs, snap = _run(model, work,
+                      paged=PagedConfig(block_size=8, num_blocks=48),
+                      prefix_cache=PrefixCacheConfig(block_size=8))
+    assert all(np.array_equal(a, b) for a, b in zip(outs, base))
+    assert snap["prefix"]["hit_tokens"] > 0
+    assert snap["paged"]["blocks_used"] == snap["prefix"]["cached_blocks"]
+    assert snap["prefix"]["donate_skipped"] == 0  # adoption never skips
+
+
+def test_warm_admission_never_evicts_its_own_match(model):
+    """Regression (review-confirmed): under pool pressure a warm
+    admission's block allocation runs the eviction path, which spares
+    only REFERENCED nodes — the matched-but-not-yet-pinned path could
+    be evicted mid-allocation and its block handed back to the SAME
+    request, aliasing one pool block in two table lanes (silent KV
+    corruption).  The fix acquires the match before allocating; this
+    pins byte parity on the exact repro: serve A, then B (pressure),
+    then A again warm against a pool with nothing else to evict."""
+    rng = np.random.RandomState(13)
+    A = rng.randint(0, 256, 12).astype(np.int32)
+    Bp = rng.randint(0, 256, 12).astype(np.int32)
+    oracle = {p.tobytes(): np.asarray(model.generate(
+        p, max_new_tokens=6, temperature=0.0)) for p in (A, Bp)}
+    eng = model.serve(max_slots=2,
+                      paged=PagedConfig(block_size=4, num_blocks=6),
+                      prefix_cache=PrefixCacheConfig(block_size=4))
+    for p in (A, Bp, A):
+        h = eng.submit(GenerationRequest(p, max_new_tokens=6,
+                                         temperature=0.0))
+        eng.run_until_complete(max_steps=1000)
+        np.testing.assert_array_equal(h.result().tokens,
+                                      oracle[p.tobytes()])
+    eng.close()
+
+
+def test_int8_paged_parity_vs_offline_oracle(model):
+    """int8 pools ((values, scales) pytree leaves) page byte-exactly:
+    engine streams equal the offline int8 generate oracle."""
+    work = _workload(5, 5, n_lo=3, n_hi=8)
+    from singa_tpu.models import gpt2_decode
+    base = [np.asarray(gpt2_decode.generate(
+        model, w["prompt"], max_new_tokens=w["n_new"], temperature=0,
+        cache_dtype="int8")) for w in work]
+    outs, snap = _run(model, work, cache_dtype="int8",
+                      paged=PagedConfig(block_size=8, num_blocks=32))
+    assert all(np.array_equal(a, b) for a, b in zip(outs, base))
+
+
+def test_int8_prefix_cache_lifted(model):
+    """The old int8 + prefix-cache refusal is LIFTED: quantized
+    engines get warm admissions through the chunked canonical form —
+    warm and cold streams are byte-identical to each other (two fresh
+    engines agree exactly), and the paged and slot-arena versions
+    agree too."""
+    rng = np.random.RandomState(6)
+    system = rng.randint(0, 256, 24).astype(np.int32)
+    work = [dict(prompt=np.concatenate(
+        [system, rng.randint(0, 256, rng.randint(3, 10))
+         .astype(np.int32)]),
+        n_new=int(rng.randint(3, 8)), temperature=0.0, seed=0)
+        for _ in range(6)]
+    kw = dict(cache_dtype="int8",
+              paged=PagedConfig(block_size=8, num_blocks=64),
+              prefix_cache=PrefixCacheConfig(block_size=8))
+    outs_a, snap_a = _run(model, work, **kw)   # cold tree
+    outs_b, _ = _run(model, work, **kw)        # fresh engine, again
+    assert all(np.array_equal(a, b) for a, b in zip(outs_a, outs_b))
+    assert snap_a["prefix"]["hit_tokens"] > 0
+    outs_c, snap_c = _run(
+        model, work, cache_dtype="int8",
+        prefix_cache=PrefixCacheConfig(block_size=8, num_blocks=64))
+    assert all(np.array_equal(a, b) for a, b in zip(outs_a, outs_c))
+    assert snap_c["prefix"]["hit_tokens"] > 0
+
+
+def test_session_multi_turn_on_paged(model):
+    """pin_session on a paged engine: the generated region is
+    re-canonicalized in place, the full sequence pinned, and turn 2
+    is a warm hit with oracle parity."""
+    rng = np.random.RandomState(7)
+    eng = model.serve(max_slots=2,
+                      paged=PagedConfig(block_size=8, num_blocks=64),
+                      prefix_cache=PrefixCacheConfig(block_size=8))
+    p = rng.randint(0, 256, 20).astype(np.int32)
+    h = eng.submit(GenerationRequest(p, max_new_tokens=6,
+                                     pin_session=True, temperature=0.0))
+    eng.run_until_complete(max_steps=1000)
+    sess = h.result().session
+    assert sess is not None and sess.pinned_blocks > 0
+    extra = rng.randint(0, 256, 5).astype(np.int32)
+    req2 = sess.request(extra, max_new_tokens=6, temperature=0.0)
+    hits0 = eng.prefix_cache.snapshot()["hit_tokens"]
+    h2 = eng.submit(req2)
+    eng.run_until_complete(max_steps=1000)
+    want = np.asarray(model.generate(req2.prompt_ids, max_new_tokens=6,
+                                     temperature=0.0))
+    np.testing.assert_array_equal(h2.result().tokens, want)
+    assert eng.prefix_cache.snapshot()["hit_tokens"] > hits0
+    sess.release()
+    eng.close()
+
+
+def test_priority_preemption_ordering(model):
+    """A high-priority arrival that does not fit in blocks PREEMPTS
+    the strictly-lower-priority live request (swap to host) instead of
+    waiting behind it: the urgent request finishes first, the victim
+    resumes byte-identically, and the ledger attributes the victim's
+    pause to the ``preempted`` phase with exact sums."""
+    rng = np.random.RandomState(8)
+    p_lo = rng.randint(0, 256, 10).astype(np.int32)
+    p_hi = rng.randint(0, 256, 12).astype(np.int32)
+    base_lo = np.asarray(model.generate(p_lo, max_new_tokens=16,
+                                        temperature=0.0))
+    base_hi = np.asarray(model.generate(p_hi, max_new_tokens=8,
+                                        temperature=0.0))
+    led = reqtrace.enable(capacity=64)
+    try:
+        eng = model.serve(max_slots=2, scheduler="priority",
+                          paged=PagedConfig(block_size=8, num_blocks=4))
+        h_lo = eng.submit(GenerationRequest(
+            p_lo, max_new_tokens=16, temperature=0.0, priority=0))
+        for _ in range(8):
+            eng.step()
+        h_hi = eng.submit(GenerationRequest(
+            p_hi, max_new_tokens=8, temperature=0.0, priority=5))
+        eng.run_until_complete(max_steps=2000)
+        np.testing.assert_array_equal(h_lo.result().tokens, base_lo)
+        np.testing.assert_array_equal(h_hi.result().tokens, base_hi)
+        assert eng.stats.snapshot()["paged"]["preemptions"] >= 1
+        # urgency won: the high-priority request retired first
+        assert (h_hi.result().finished_step
+                <= h_lo.result().finished_step)
+        e = led.entry(h_lo.request.request_id)
+        ph = e["phases"]
+        assert ph["preempted"] > 0
+        total = e["t_retire"] - e["t_submit"]
+        assert sum(ph.values()) == pytest.approx(total, abs=1e-9)
+        assert "preempted" in led.why_slow()["tpot_p99_attribution"]
+        eng.close()
+    finally:
+        reqtrace.disable()
+
+
+def test_priority_scheduler_queue_order():
+    """Host-only: PriorityScheduler pops higher priority first, FIFO
+    within a class, and requeue_front lands at the head of the
+    request's own class."""
+    sched = PriorityScheduler()
+    reqs = [GenerationRequest(np.ones(4, np.int32), priority=p)
+            for p in (0, 5, 0, 5, 2)]
+    for r in reqs:
+        sched.enqueue(r)
+    admit, _ = sched.schedule(5, now=0.0)
+    assert [r.priority for r in admit] == [5, 5, 2, 0, 0]
+    # FIFO within the class
+    assert admit[0] is reqs[1] and admit[1] is reqs[3]
+    # requeue_front: ahead of equals, behind strictly higher
+    for r in admit:
+        sched.enqueue(r)
+    sched.requeue_front(reqs[4])            # priority 2
+    admit2, _ = sched.schedule(5, now=0.0)
+    assert admit2[2] is reqs[4]
+
+
+def test_block_accounting_under_churn(model):
+    """Fragmentation-free allocation: across admit/preempt/retire
+    churn the accounting invariant ``free + used == num_blocks`` holds
+    at every step, and the drained engine holds exactly the cached
+    blocks."""
+    work = _workload(9, 12, n_lo=6, n_hi=24, p_lo=3, p_hi=20)
+    eng = model.serve(max_slots=4,
+                      paged=PagedConfig(block_size=8, num_blocks=12))
+    arena = eng.paged_arena
+    pending = list(work)
+    hs = []
+    while pending or eng.pending:
+        if pending:
+            w = pending.pop(0)
+            hs.append(eng.submit(GenerationRequest(
+                w["prompt"], max_new_tokens=w["n_new"],
+                temperature=0.0)))
+        eng.step()
+        assert arena.blocks_free + arena.blocks_used \
+            == arena.num_blocks
+        held = sum(len(s.blocks) - s.n_shared
+                   for s in eng._slots if s is not None)
+        assert arena.blocks_used == held  # no cache: used == slot-held
+    assert all(h.done() for h in hs)
+    assert arena.blocks_used == 0
+    assert eng.stats.snapshot()["paged"]["preemptions"] > 0
+    eng.close()
+
+
+def test_fail_rejects_swapped_started_true(model):
+    """Engine failure with swapped-out work: swapped requests are
+    STARTED (tokens streamed) — rejected typed started=True, never
+    requeue-safe, and live_request_ids includes them (the fleet's
+    failover verdict)."""
+    eng = model.serve(max_slots=2,
+                      paged=PagedConfig(block_size=8, num_blocks=6))
+    rng = np.random.RandomState(10)
+    hs = [eng.submit(GenerationRequest(
+        rng.randint(0, 256, 10).astype(np.int32), max_new_tokens=20,
+        temperature=0.0)) for _ in range(4)]
+    steps = 0
+    while not eng._swapped and steps < 60:
+        eng.step()
+        steps += 1
+    assert eng._swapped, "pool never over-committed"
+    swapped_ids = {sw.request.request_id for sw in eng._swapped}
+    assert swapped_ids <= eng.live_request_ids
+    faults.inject("serve.decode_step", FailAfterN(0, times=1))
+    try:
+        with pytest.raises(EngineFailedError):
+            while eng.pending:
+                eng.step()
+    finally:
+        faults.clear()
+    for h in hs:
+        assert h.done()
+        if h.request.request_id in swapped_ids:
+            with pytest.raises(EngineFailedError) as ei:
+                h.result()
+            assert ei.value.started is True
+    eng.close(force=True)
+
+
+def test_supervisor_restart_paged_parity(model):
+    """A decode fault against a paged engine: supervisor rebuild gets
+    a FRESH arena, never-started requests requeue with byte parity."""
+    work = _workload(11, 8, n_lo=3, n_hi=8)
+    base, _ = _run(model, work)
+    sup = EngineSupervisor(model, max_slots=2, restart_budget=2,
+                           paged=PagedConfig(block_size=8,
+                                             num_blocks=32))
+    arena0 = sup.engine.paged_arena
+    hs = [sup.submit(GenerationRequest(
+        w["prompt"], max_new_tokens=w["n_new"], temperature=0.0,
+        seed=w["seed"])) for w in work]
+    pol = faults.inject("serve.decode_step", FailAfterN(3, times=1))
+    try:
+        sup.run_until_complete(max_steps=4000)
+    finally:
+        faults.clear()
+    assert pol.fired == 1
+    assert sup.engine.paged_arena is not arena0
+    assert sup.engine.paged_arena.blocks_used == 0
+    done = typed = 0
+    for w, h, want in zip(work, hs, base):
+        try:
+            got = h.result().tokens
+            assert np.array_equal(
+                got, np.asarray(model.generate(
+                    w["prompt"], max_new_tokens=w["n_new"],
+                    temperature=0)))
+            done += 1
+        except EngineFailedError:
+            typed += 1
+    assert done + typed == len(work) and done > 0
+    sup.close()
+
+
+def test_config_validation_typed_errors(model, draft):
+    """Every impossible paged configuration fails typed at
+    construction or submit, never inside a jitted dispatch."""
+    with pytest.raises(ValueError, match="block_size"):
+        PagedConfig(block_size=0)
+    with pytest.raises(ValueError, match="num_blocks"):
+        PagedConfig(num_blocks=0)
+    with pytest.raises(ValueError, match="multiple"):
+        model.serve(paged=PagedConfig(block_size=7))  # 128 % 7 != 0
+    with pytest.raises(ValueError, match="paged must be"):
+        model.serve(paged="yes")
+    with pytest.raises(ValueError, match="spec_k"):
+        model.serve(draft_model=draft, spec_k=16,
+                    paged=PagedConfig(block_size=8))
+    with pytest.raises(ValueError, match="granularity|block_size"):
+        model.serve(paged=PagedConfig(block_size=8),
+                    prefix_cache=PrefixCacheConfig(block_size=16))
+    with pytest.raises(ValueError, match="unknown scheduler"):
+        model.serve(scheduler="lifo")
+    eng = model.serve(max_slots=1,
+                      paged=PagedConfig(block_size=8, num_blocks=4))
+    with pytest.raises(ValueError, match="KV blocks"):
+        # needs (20 + 40 - 1)//8 + 1 = 8 blocks > 4: could never fit
+        eng.submit(GenerationRequest(np.zeros(20, np.int32),
+                                     max_new_tokens=40))
+    eng.close()
+
+
+def test_metrics_and_health_surface(model):
+    """serve.paged.* metrics ride the process registry while the
+    engine lives (and unregister at close); health_report carries the
+    always-present serve.paged section."""
+    eng = model.serve(max_slots=2,
+                      paged=PagedConfig(block_size=8, num_blocks=6))
+    rng = np.random.RandomState(12)
+    hs = [eng.submit(GenerationRequest(
+        rng.randint(0, 256, 10).astype(np.int32), max_new_tokens=18,
+        temperature=0.0)) for _ in range(3)]
+    eng.run_until_complete(max_steps=2000)
+    assert all(h.done() for h in hs)
+    lbl = eng.stats.engine_label
+    snap = registry().snapshot()
+    assert snap["gauges"][
+        f"serve.paged.blocks_free{{engine={lbl}}}"] == 6
+    assert f"serve.paged.preemptions{{engine={lbl}}}" \
+        in snap["counters"]
+    hp = health_report(include_registry=False)["serve"]["paged"]
+    assert set(hp) == {"blocks_free", "blocks_used", "preemptions",
+                       "swap_out", "swap_in"}
+    assert hp["preemptions"] == eng.stats.snapshot()["paged"][
+        "preemptions"]
+    # cost-table capture (VERDICT weak #6): the paged steps' AOT
+    # compiles are visible to crash bundles
+    from singa_tpu.observe.monitor import _cost_tables
+    keys = [t["key"] for t in _cost_tables()]
+    assert any(k.startswith("serve.paged/") for k in keys), keys
+    eng.close()
+    snap2 = registry().snapshot()
+    assert f"serve.paged.blocks_free{{engine={lbl}}}" \
+        not in snap2["gauges"]

@@ -402,10 +402,14 @@ def test_config_validation_typed_errors():
     with pytest.raises(NotImplementedError, match="sliding-window"):
         target.serve(draft_model=win)
 
-    with pytest.raises(NotImplementedError, match="int8.*prefix|prefix.*int8"):
-        target.serve(cache_dtype="int8",
-                     prefix_cache=PrefixCacheConfig(block_size=8,
-                                                    num_blocks=16))
+    # int8 + prefix cache is SUPPORTED since the paged round (the
+    # block pool is pytree-leaf-generic): construction succeeds and
+    # admissions route through the chunked canonical form
+    eng8 = target.serve(cache_dtype="int8",
+                        prefix_cache=PrefixCacheConfig(block_size=8,
+                                                       num_blocks=16))
+    assert eng8.prefix_cache is not None
+    eng8.close()
     with pytest.raises(ValueError, match="cache_dtype"):
         target.serve(cache_dtype="int4")
 
