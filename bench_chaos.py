@@ -28,6 +28,12 @@ recovery invariants the unit tests assert piecewise:
   streamed, never requeued), the supervisor rebuild gets a FRESH pool,
   and requeued never-started streams keep byte parity, preemption and
   swap/resume included post-restart.
+* **fault at a TP collective (tensor-parallel engine)** — a
+  ``serve.tp_collective`` fault fires at a sharded-twin dispatch
+  mid-decode: the sharded engine fails typed, the supervisor rebuilds
+  it on the same device group (twin-cache hit, fresh sharded arenas),
+  requeued streams keep byte parity, zero wedged/lost, restarts ==
+  injected.
 * **replica kill + fleet failover** — the same decode fault against a
   ``ServeFleet`` replica with a ZERO restart budget kills that replica
   outright mid-decode; the fleet requeues its never-started work onto
@@ -477,6 +483,84 @@ def chaos_paged(report):
         f"restarts ({restarts}) != injected copy faults ({injected})"
 
 
+def chaos_tp(report):
+    """A fault at the ``serve.tp_collective`` site (every sharded-twin
+    dispatch of a tensor-parallel engine checks it) fires mid-decode:
+    the sharded engine fails TYPED — never wedges — and the supervisor
+    rebuilds it on the SAME device group (sharded-twin cache hit,
+    fresh sharded arenas).  Requeued never-started streams keep byte
+    parity with the uninterrupted single-device run; started requests
+    fail typed.  Zero wedged/lost, restarts == injected."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(11)
+    workload = [(rng.randint(0, 256, rng.randint(4, 12))
+                 .astype(np.int32), int(rng.randint(4, 10)))
+                for _ in range(10)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = 0
+    for fail_after in (4, 9):
+        sup = EngineSupervisor(m, max_slots=2, restart_budget=2, tp=2)
+        exec0 = sup.engine.tp_exec
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.tp_collective",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=4000)
+        faults.clear()
+        injected += pol.fired
+        if pol.fired:
+            assert sup.engine.tp_exec is not exec0, \
+                "rebuilt engine carried the failed TP executor"
+            assert sup.engine.tp_exec.tp == 2
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "TP token stream diverged after restart"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve_tp"] = {
+        "requests": 2 * len(workload),
+        "shards": 2,
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "collective_faults_injected": injected,
+        "engine_restarts": restarts,
+    }
+    assert wedged == 0, f"{wedged} TP requests wedged/lost"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0 and typed_failed > 0
+    assert restarts == injected > 0, \
+        f"restarts ({restarts}) != injected TP faults ({injected})"
+
+
 def chaos_fleet(report):
     """Kill one replica mid-decode (``serve.decode_step`` fault against
     a zero restart budget): the fleet marks it unhealthy, requeues its
@@ -587,12 +671,20 @@ def chaos_fleet(report):
 
 
 def main():
-    from singa_tpu import observe
-
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="CHAOS.json", metavar="PATH",
                     help="where to write the strict-JSON chaos report")
     args = ap.parse_args()
+
+    # chaos_tp needs a >=2-device mesh before jax initializes; the
+    # flag only affects the CPU platform (tests/conftest.py topology)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from singa_tpu import observe
 
     # the whole chaos run is monitored: recovery that hangs is failure
     observe.monitor.start(watchdog_timeout_s=900.0, crash_handler=True)
@@ -603,6 +695,7 @@ def main():
     chaos_prefix(report)
     chaos_spec(report)
     chaos_paged(report)
+    chaos_tp(report)
     chaos_fleet(report)
 
     health = observe.health_report(include_registry=False)

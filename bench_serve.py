@@ -38,7 +38,13 @@ PERF.md).  ``--fleet`` additionally replays the
 workload through a 2-replica ServeFleet (same total slot count) and
 embeds a ``fleet`` section — routing balance, per-stream parity
 against the engine run, and the jit-cache pin proving replicas share
-every executable.  The ``registry`` key embeds the
+every executable.  ``--tp K`` replays the workload through a K-shard
+TENSOR-PARALLEL paged engine (serve/tp.py: Megatron-sharded weights
+under shard_map, per-shard H_kv slices of the block pool) and embeds
+a ``tp`` section — per-stream parity against the single-device run,
+per-shard pool occupancy, psums per step, recompile pin (throughput
+is chip-pending: a 2-thread virtual CPU mesh pays the collectives
+without the memory win).  The ``registry`` key embeds the
 process-wide ``singa_tpu.observe`` metrics snapshot; ``--trace-out
 PATH`` additionally traces the timed engine run and writes a Chrome
 trace-event JSON there (open in https://ui.perfetto.dev — expect
@@ -57,6 +63,7 @@ Prometheus text exposition (bucketed histogram families) at exit.
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -179,10 +186,12 @@ def _serve_jit_cache_size():
     prefix cache, and paged arena dispatch — pinned across the timed
     runs to prove the warm path introduces ZERO runtime recompiles.
     The paged pool steps dispatch through their own AOT compile cache
-    (cost-table capture), so its entry count rides the same pin."""
+    (cost-table capture) and the TP backend through its sharded-twin
+    cache, so both entry counts ride the same pin."""
     from singa_tpu.serve import engine as E
     from singa_tpu.serve import paged as G
     from singa_tpu.serve import prefix as P
+    from singa_tpu.serve import tp as T
 
     total = 0
     for f in (E._pool_decode_step, E._pool_spec_step, E._prefill_one,
@@ -194,7 +203,10 @@ def _serve_jit_cache_size():
             total += f._cache_size()
         except Exception:
             return None  # jax without _cache_size: report honestly
-    return total + G._compile_cache_size()
+    twins = T._twin_cache_size()
+    if twins is None:
+        return None
+    return total + G._compile_cache_size() + twins
 
 
 def run_prefix_mix(max_slots):
@@ -688,6 +700,81 @@ def run_fleet_bench(m, workload, engine_outs, replicas=2, max_slots=4,
     }, reg_snap, health
 
 
+def run_tp(m, workload, engine_outs, tp, engine_section,
+           max_slots=8):
+    """The --tp measurement: the standard ragged workload through a
+    TENSOR-PARALLEL paged engine (serve/tp.py: Megatron-sharded
+    weights under shard_map, each shard owning the H_kv/tp slice of
+    the block pool) with per-stream parity against the (oracle-
+    verified) single-device engine run, per-shard pool occupancy
+    sampled per step, and the jit+twin cache pinned across the timed
+    run.  ``vs_single_device_tokens_per_s`` is the honest CPU caveat
+    number: the gated claims are parity / recompiles / occupancy —
+    on a 2-thread virtual CPU mesh the psums and per-shard dispatch
+    overhead price TP at/below 1.0, exactly like int8's dequant; the
+    knob exists for models bigger than one REAL device (chip-pending,
+    ROADMAP item 5)."""
+    from singa_tpu.serve import GenerationRequest, PagedConfig
+
+    pcfg = PagedConfig(block_size=16, num_blocks=48)
+    kw = dict(tp=tp, paged=pcfg)
+
+    def drive():
+        eng = m.serve(max_slots=max_slots, **kw)
+        handles = []
+        pending = list(workload)
+        peak_blocks = 0
+        t0 = time.perf_counter()
+        while pending or eng.pending:
+            while pending and pending[0]["arrival_step"] <= eng.step_count:
+                w = pending.pop(0)
+                handles.append(eng.submit(GenerationRequest(
+                    w["prompt"], max_new_tokens=w["n_new"])))
+            eng.step()
+            peak_blocks = max(peak_blocks,
+                              eng.paged_arena.blocks_used)
+        wall = time.perf_counter() - t0
+        outs = [h.result() for h in handles]
+        snap = eng.stats.snapshot()
+        eng.close()
+        return wall, outs, snap, peak_blocks
+
+    drive()  # warmup (compiles the sharded twins)
+    jit_before = _serve_jit_cache_size()
+    wall, outs, snap, peak_blocks = drive()
+    jit_after = _serve_jit_cache_size()
+
+    # engine_outs are oracle-verified by the main bench; per-stream
+    # equality here is transitively oracle parity
+    parity = all(np.array_equal(a.tokens, b.tokens)
+                 for a, b in zip(outs, engine_outs))
+    useful = sum(w["n_new"] for w in workload)
+    tp_snap = snap["tp"]
+    return {
+        "shards": tp_snap["shards"],
+        "devices": tp_snap["devices"],
+        "paged_pool": {"block_size": pcfg.block_size,
+                       "num_blocks": pcfg.num_blocks},
+        "wall_s": wall,
+        "tokens_per_s": useful / wall,
+        **_lat(snap),
+        "vs_single_device_tokens_per_s": (
+            (useful / wall) / engine_section["tokens_per_s"]),
+        "collectives_per_step": tp_snap["collectives_per_step"],
+        "sharded_dispatches": tp_snap["sharded_dispatches"],
+        "per_shard": {
+            "kv_bytes": tp_snap["kv_bytes_per_shard"],
+            "blocks_peak": peak_blocks,
+            "occupancy_peak": peak_blocks / pcfg.num_blocks,
+        },
+        "blocks_leaked": snap["paged"]["blocks_used"],
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": parity,
+        "chip_pending": True,  # CPU numbers; see docs/SERVING.md
+    }
+
+
 def run_static(m, workload, max_slots):
     """Arrival-order batches of max_slots, each to its longest row."""
     from singa_tpu.models import gpt2_decode
@@ -710,12 +797,6 @@ def run_static(m, workload, max_slots):
 
 
 def main():
-    import jax
-
-    from singa_tpu import observe, tensor
-    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
-    from singa_tpu.utils.metrics import percentile
-
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="write a Chrome trace-event JSON of the timed "
@@ -763,7 +844,34 @@ def main():
                          "int8-KV-arena engine (tokens/s, TTFT/TPOT "
                          "percentiles, parity vs the offline int8 "
                          "oracle, recompile pin; chip-pending row)")
+    ap.add_argument("--tp", type=int, default=None, metavar="K",
+                    help="also run the standard workload through a "
+                         "K-shard TENSOR-PARALLEL paged engine "
+                         "(serve/tp.py) with per-stream parity "
+                         "against the single-device run, per-shard "
+                         "occupancy, recompile pin (the tp section)")
     args = ap.parse_args()
+
+    # --tp needs a >=K-device mesh BEFORE jax initializes its backend;
+    # the flag only affects the CPU platform (a real slice already has
+    # its chips), mirroring tests/conftest.py's virtual topology
+    if args.tp:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{max(8, args.tp)}").strip()
+
+    import jax
+
+    from singa_tpu import observe, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.utils.metrics import percentile
+
+    if args.tp and len(jax.devices()) < args.tp:
+        raise SystemExit(
+            f"--tp {args.tp} needs {args.tp} devices, have "
+            f"{len(jax.devices())} ({jax.devices()[0].platform})")
 
     # active monitoring rides the whole bench: flight recorder + hang
     # watchdog (generous timeout — a CPU compile legitimately takes
@@ -888,6 +996,12 @@ def main():
             engine_snapshots=[snap], include_registry=False)
     if args.spec:
         report["spec"] = run_spec(max_slots)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.tp:
+        report["tp"] = run_tp(m, workload, outs_e, args.tp,
+                              report["engine"], max_slots=max_slots)
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(
             engine_snapshots=[snap], include_registry=False)

@@ -380,8 +380,16 @@ class GPT2LMHead(model.Model):
         pool shared with the prefix cache: admission by blocks-free,
         block-by-block growth, priority preemption with byte-exact
         swap/resume; pair with ``scheduler="priority"`` for strict-
-        priority admission).  See docs/SERVING.md "Fast decode" and
-        "Paged KV and preemption"."""
+        priority admission).  ``tp=k`` — tensor-parallel serving
+        (serve/tp.py): ONE engine's weights and KV arenas shard
+        across a k-device mesh (Megatron column/row layout under
+        shard_map, attention heads + MLP columns partitioned, one
+        psum per attention output and per MLP fc2, each shard owning
+        the (…, H_kv/k, …) slice of every cache pool) — the
+        larger-than-one-device serving story, with token streams
+        pinned identical to the single-device engine and every other
+        knob composing unchanged.  See docs/SERVING.md "Fast decode",
+        "Paged KV and preemption", and "Tensor-parallel serving"."""
         from ..serve import InferenceEngine
 
         return InferenceEngine(self, **kw)
@@ -396,7 +404,11 @@ class GPT2LMHead(model.Model):
         args: ``router``, ``restart_budget``, ``budget_reset_after_s``,
         ``shed_on_slo_pressure``, ``hedge_after_steps``, plus
         everything :meth:`serve` accepts (forwarded to every replica's
-        engine).  See docs/SERVING.md "Fleet serving"."""
+        engine).  ``tp=k`` builds a fleet of TENSOR-PARALLEL replicas:
+        the device mesh partitions into ``replicas`` disjoint k-wide
+        groups (tp inside each replica, data parallelism across them;
+        ``tp x replicas`` must fit the mesh).  See docs/SERVING.md
+        "Fleet serving" and "Tensor-parallel serving"."""
         from ..serve import ServeFleet
 
         return ServeFleet(self, replicas=replicas, **kw)

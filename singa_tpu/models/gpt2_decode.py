@@ -183,6 +183,34 @@ def _ln(x, s, b, eps):
     return ((xf - mu) * jax.lax.rsqrt(var + eps) * s + b).astype(x.dtype)
 
 
+# -- tensor-parallel threading (serve/tp.py) ---------------------------------
+# Every block function below takes ``tp_axis``/``tp_world`` kwargs
+# (default None/1).  Unset, each expression is LITERALLY the pre-TP
+# one — ``(a @ wo) + bo`` with no reduction reordered — so the
+# single-device paths stay bit-identical.  Set (inside a shard_map
+# over a ``tp`` mesh axis), the attention/MLP weights arrive COLUMN/
+# ROW-sharded Megatron-style (parallel/tensor_parallel.py's layout,
+# specs from ``decode_param_specs``): q/k/v/fc1 are column-local (the
+# per-shard head/column slice needs no communication), and the two
+# row-parallel products — attention out-proj and MLP fc2 — each close
+# with ONE psum here, bias added AFTER the reduction (added per shard
+# it would be multiplied by the world size).
+
+def _tp_psum(y, axis, world):
+    """All-reduce a row-parallel partial product over the ``axis``
+    mesh axis; ``axis=None`` returns ``y`` untouched (the serial
+    path).  The collective is recorded through the communicator's
+    observe hook at trace time — op, payload bytes, axis name, and
+    mesh size — so TP-serve psums are attributable in Chrome traces
+    next to the training collectives."""
+    if axis is None:
+        return y
+    from ..parallel.communicator import _record_collective
+
+    _record_collective("psum", [y], axis=axis, world=world)
+    return jax.lax.psum(y, axis)
+
+
 # -- int8 KV cache (round 5) ------------------------------------------------
 # The GQA measurement (PERF.md §8) showed decode tokens/sec scales
 # near-linearly with cache BYTES — so halving bytes/element is the same
@@ -218,7 +246,7 @@ def _cache_stack(layers):
     return jnp.stack(layers)
 
 
-def _attn_full(q, k, v, n_head, start=None, window=None):
+def _attn_full(q, k, v, n_head, start=None, window=None, tp_world=1):
     """Causal attention over the full (B, S, E) prefill block.
     ``start``: optional (B,) first-live window position per row
     (left-padded batch) — keys before it are masked out.  GQA models
@@ -227,18 +255,22 @@ def _attn_full(q, k, v, n_head, start=None, window=None):
     broadcast over its query-head group, matching the training stack's
     RepeatKV (parallel/tensor_parallel.py ParallelMHA).  ``window``:
     sliding-window band (query i sees keys [i-window+1, i]), matching
-    the training stack's banded _sdpa."""
+    the training stack's banded _sdpa.  ``tp_world`` > 1: q/k/v carry
+    only this shard's heads (1/tp_world of the widths) — attention is
+    head-local, so the per-shard math below is exactly the serial
+    math on the head slice."""
     b, s, e = q.shape
-    d = e // n_head
+    d = (e * tp_world) // n_head
+    n_local = n_head // tp_world
     n_kv = k.shape[-1] // d
 
     def heads(t, nh):
         return t.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
 
-    qh, kh, vh = heads(q, n_head), heads(k, n_kv), heads(v, n_kv)
-    if n_kv != n_head:
-        kh = jnp.repeat(kh, n_head // n_kv, axis=1)
-        vh = jnp.repeat(vh, n_head // n_kv, axis=1)
+    qh, kh, vh = heads(q, n_local), heads(k, n_kv), heads(v, n_kv)
+    if n_kv != n_local:
+        kh = jnp.repeat(kh, n_local // n_kv, axis=1)
+        vh = jnp.repeat(vh, n_local // n_kv, axis=1)
     sc = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(d)
     cm = jnp.tril(jnp.ones((s, s), bool))
     if window is not None:
@@ -259,20 +291,21 @@ def _attn_full(q, k, v, n_head, start=None, window=None):
 
 
 def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2,
-                   window=None):
+                   window=None, tp_axis=None, tp_world=1):
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = h @ p["wq"] + p["bq"]
     k = h @ p["wk"] + p["bk"]
     v = h @ p["wv"] + p["bv"]
-    a = _attn_full(q, k, v, n_head, start=start, window=window)
-    x = x + (a @ p["wo"] + p["bo"])
+    a = _attn_full(q, k, v, n_head, start=start, window=window,
+                   tp_world=tp_world)
+    x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
     return x, k, v
 
 
 def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
-                  moe_top_k=2, window=None):
+                  moe_top_k=2, window=None, tp_axis=None, tp_world=1):
     """x: (B, 1, E); k/v_cache: (B, H_kv, ctx, D) with this step's K/V
     already written at ``pos``.  Attends to positions <= pos (and
     >= ``start`` per row for left-padded batches).
@@ -298,8 +331,8 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
     kq = k_cache[0] if quant else k_cache
     b, _, e = x.shape
     d = e // n_head
-    n_kv = kq.shape[1]
-    g = n_head // n_kv
+    n_kv = kq.shape[1]          # LOCAL kv heads (H_kv / tp_world)
+    g = n_head // (n_kv * tp_world)
     ctx = kq.shape[2]
     if window is not None:
         assert ctx == window, (
@@ -356,10 +389,11 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
     else:
         a = jnp.einsum("bkgt,bktd->bkgd", p_attn, v_cache)
     # (B, H_kv, G, D) in head-major order == (B, 1, E) concat of heads
-    a = a.reshape(b, 1, e)
-    x = x + (a @ p["wo"] + p["bo"])
+    # (this shard's slice of it when tp_world > 1)
+    a = a.reshape(b, 1, e // tp_world)
+    x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
     return x, k_cache, v_cache
 
 
@@ -402,12 +436,20 @@ def _moe_ffn(h, p, top_k):
     return y
 
 
-def _mlp(h, p, moe_top_k):
+def _mlp(h, p, moe_top_k, tp_axis=None, tp_world=1):
     """The block's feed-forward: dense two-layer gelu MLP, or the
-    expert-routed MoE when the block carries ``moe_*`` weights."""
+    expert-routed MoE when the block carries ``moe_*`` weights.  Under
+    ``tp_axis`` the dense path is column-fc1 / row-fc2 with ONE psum
+    (Megatron); MoE blocks are expert-parallel, not tensor-parallel —
+    the serve TP backend rejects them at construction."""
     if "moe_wg" in p:
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "MoE blocks are not tensor-parallel in the serve TP "
+                "backend (expert weights shard over the expert axis)")
         return _moe_ffn(h, p, moe_top_k)
-    return jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+    return _tp_psum(jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"],
+                    tp_axis, tp_world) + p["b2"]
 
 
 def _logits(x, params):
@@ -418,7 +460,8 @@ def _logits(x, params):
 
 
 def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
-            quant_cache=False, window=None, prompt_end=None):
+            quant_cache=False, window=None, prompt_end=None,
+            tp_axis=None, tp_world=1):
     """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
     v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
     rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
@@ -454,7 +497,8 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
     ks, vs = [], []
     for p in params["blocks"]:
         x, k, v = _block_prefill(x, p, n_head, eps, start=start,
-                                 moe_top_k=moe_top_k, window=window)
+                                 moe_top_k=moe_top_k, window=window,
+                                 tp_axis=tp_axis, tp_world=tp_world)
         e = x.shape[-1]
         d = e // n_head
         n_kv = k.shape[-1] // d  # GQA caches hold n_kv_head heads
@@ -472,7 +516,7 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
 
 
 def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
-                 moe_top_k=2, window=None):
+                 moe_top_k=2, window=None, tp_axis=None, tp_world=1):
     """Advance one decode step through every block: x (B, 1, E) at
     position ``pos`` against caches (L, B, H, ctx, D).  Returns
     ((B, V) logits, new kc, new vc).  Shared by sampling
@@ -483,7 +527,8 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
         x, kl, vl = _block_decode(x, p, _cache_layer(kc, li),
                                   _cache_layer(vc, li), pos, n_head,
                                   eps, start=start, moe_top_k=moe_top_k,
-                                  window=window)
+                                  window=window, tp_axis=tp_axis,
+                                  tp_world=tp_world)
         new_kc.append(kl)
         new_vc.append(vl)
     kc = _cache_stack(new_kc)
@@ -493,7 +538,7 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
 
 
 def decode_step(params, x, kc, vc, pos, n_head, eps, *, start=None,
-                moe_top_k=2, window=None):
+                moe_top_k=2, window=None, tp_axis=None, tp_world=1):
     """PUBLIC single-step decode core with an EXTERNALIZED cache carry
     (the serve engine's contract; round 6).  The generation loops in
     this module own their KV cache inside a ``lax.scan`` carry; an
@@ -506,13 +551,20 @@ def decode_step(params, x, kc, vc, pos, n_head, eps, *, start=None,
     caches RETURNED (functional carry; the caller rebinds).  Returns
     ``((B, V) logits, new kc, new vc)``.  Exactly the math every
     sampling/beam/speculative path here uses (_advance_one), so an
-    external cache owner cannot drift from ``generate``."""
+    external cache owner cannot drift from ``generate``.
+
+    ``tp_axis``/``tp_world`` (serve/tp.py): inside a shard_map over a
+    ``tp`` mesh axis with Megatron-sharded params and head-sharded
+    caches, the step runs one psum per attention output and per MLP
+    fc2 and returns replicated logits.  Defaults leave the serial
+    math bit-identical."""
     return _advance_one(params, x, kc, vc, pos, n_head, eps,
-                        start=start, moe_top_k=moe_top_k, window=window)
+                        start=start, moe_top_k=moe_top_k, window=window,
+                        tp_axis=tp_axis, tp_world=tp_world)
 
 
 def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
-                 moe_top_k=2):
+                 moe_top_k=2, tp_axis=None, tp_world=1):
     """Chunked cache advance: x (B, K, E) are K consecutive tokens at
     positions pos..pos+K-1.  Writes all K K/V rows in one contiguous
     dynamic_update_slice and attends the K queries against the cache
@@ -525,8 +577,8 @@ def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
     kq0 = k_cache[0] if quant else k_cache
     b, klen, e = x.shape
     d = e // n_head
-    n_kv = kq0.shape[1]
-    g = n_head // n_kv
+    n_kv = kq0.shape[1]         # LOCAL kv heads (H_kv / tp_world)
+    g = n_head // (n_kv * tp_world)
     ctx = kq0.shape[2]
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = (h @ p["wq"] + p["bq"]).reshape(b, klen, n_kv, g, d) \
@@ -563,14 +615,15 @@ def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
         a = jnp.einsum("bkgqt,bktd->bkgqd", pv, vqv.astype(x.dtype))
     else:
         a = jnp.einsum("bkgqt,bktd->bkgqd", p_attn, v_cache)
-    a = a.transpose(0, 3, 1, 2, 4).reshape(b, klen, e)
-    x = x + (a @ p["wo"] + p["bo"])
+    a = a.transpose(0, 3, 1, 2, 4).reshape(b, klen, e // tp_world)
+    x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
     return x, k_cache, v_cache
 
 
-def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2):
+def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2,
+                  tp_axis=None, tp_world=1):
     """PUBLIC offset-prefill entry (the prefix cache's contract;
     serve.prefix round).  Advance every layer by a K-token chunk —
     ``x``: (B, K, E) embedded inputs at positions ``pos..pos+K-1``
@@ -601,20 +654,23 @@ def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2):
     for li, p in enumerate(params["blocks"]):
         x, kl, vl = _block_chunk(x, p, _cache_layer(kc, li),
                                  _cache_layer(vc, li), pos, n_head,
-                                 eps, moe_top_k=moe_top_k)
+                                 eps, moe_top_k=moe_top_k,
+                                 tp_axis=tp_axis, tp_world=tp_world)
         new_kc.append(kl)
         new_vc.append(vl)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
     return x, _cache_stack(new_kc), _cache_stack(new_vc)
 
 
-def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2):
+def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2,
+                   tp_axis=None, tp_world=1):
     """Advance every block by a K-token chunk (x: (B, K, E) embedded
     inputs at positions pos..pos+K-1).  Returns ((B, K, V) logits,
     new kc, new vc).  The speculative verify step — routed through
     :func:`prefill_chunk` so the chunked cache math exists once."""
     x, kc, vc = prefill_chunk(params, x, kc, vc, pos, n_head, eps,
-                              moe_top_k=moe_top_k)
+                              moe_top_k=moe_top_k, tp_axis=tp_axis,
+                              tp_world=tp_world)
     return _logits(x, params), kc, vc
 
 

@@ -152,6 +152,29 @@ def _spec_section(snap: dict) -> dict:
     }
 
 
+def _tp_section(snap: dict) -> dict:
+    """The ``serve.tp`` health section: tensor-parallel serving
+    (serve/tp.py) — shard width, per-shard KV bytes, and sharded
+    dispatch counts (zeros when no TP engine ever ran — always
+    present so dashboards can alert unconditionally).  ``shards`` is
+    the WIDEST live engine's mesh (gauges max, not sum: two tp=2
+    replicas are not a tp=4 engine); bytes/dispatches sum across
+    engines."""
+    counters, gauges = snap["counters"], snap["gauges"]
+    prefix = "serve.tp.shards{"
+    widths = [v for k, v in gauges.items()
+              if k == "serve.tp.shards" or k.startswith(prefix)]
+    return {
+        "shards": max(widths) if widths else 0,
+        "kv_bytes_per_shard": _sum_metric(
+            gauges, "serve.tp.kv_bytes_per_shard"),
+        "collectives_per_step": _sum_metric(
+            gauges, "serve.tp.collectives_per_step"),
+        "sharded_dispatches": _sum_metric(
+            counters, "serve.tp.sharded_dispatches"),
+    }
+
+
 def _fleet_section(snap: dict) -> dict:
     """The ``serve.fleet`` health section: replicated-serve routing and
     failover counters summed across fleets (zeros when no fleet ever
@@ -279,6 +302,7 @@ def health_report(reg=None, engine_snapshots=(),
             "prefix": _prefix_section(snap),
             "paged": _paged_section(snap),
             "spec": _spec_section(snap),
+            "tp": _tp_section(snap),
             "fleet": _fleet_section(snap),
             # tail-latency attribution from the request ledger
             # (observe.requests): always present; {"enabled": False}

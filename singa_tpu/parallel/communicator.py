@@ -64,12 +64,18 @@ _COMM_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.01,
                           max_delay_s=0.1)
 
 
-def _record_collective(op, arrs):
+def _record_collective(op, arrs, axis=None, world=None):
     """Observe hook for one collective issue: per-op count + payload
     bytes (registry ``comms.collectives``/``comms.bytes``) and a trace
     instant.  Collectives execute inside compiled steps, so this fires
     at TRACE time — counts are per-compile, not per-replayed-step
     (a replay issues the same collectives XLA baked in).
+
+    ``axis``/``world``: the mesh axis the collective reduces over and
+    its size.  They ride the trace event's args so a Chrome trace can
+    tell a TP-serve psum over the ``tp`` axis (serve/tp.py, via
+    ``gpt2_decode._tp_psum``) from a data-parallel gradient all-reduce
+    — previously every collective looked alike in the trace.
 
     Also the ``comm.collective`` fault-injection site: armed INJECTED
     faults fire here (host side, trace time) and transient ones retry
@@ -94,7 +100,8 @@ def _record_collective(op, arrs):
                 help="collective payload bytes (at trace time)",
                 op=op).inc(n)
     _otrace.event(f"comms/{op}", cat="comms", bytes=n,
-                  arrays=len(arrs))
+                  arrays=len(arrs), axis=axis,
+                  world=world)
 
 
 def _wait_for_coordinator(address, timeout):
@@ -197,7 +204,8 @@ class Communicator:
     def all_reduce(self, arr, average=False):
         if not self._in_step(arr):
             return arr  # eager / unsharded: world-1 identity (see above)
-        _record_collective("all_reduce", [arr])
+        _record_collective("all_reduce", [arr],
+                           axis=self.axis_name, world=self.world_size)
         out = lax.psum(arr, self.axis_name)
         return out / self.world_size if average else out
 
@@ -212,7 +220,8 @@ class Communicator:
             return []
         if not self._in_step(arrs[0]):
             return list(arrs)
-        _record_collective("fused_synch", arrs)
+        _record_collective("fused_synch", arrs,
+                           axis=self.axis_name, world=self.world_size)
         shapes = [a.shape for a in arrs]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
         flat = jnp.concatenate([a.reshape(-1) for a in arrs])
@@ -230,7 +239,8 @@ class Communicator:
     def synch_half(self, arr, average=False):
         if not self._in_step(arr):
             return arr.astype(jnp.bfloat16).astype(arr.dtype)
-        _record_collective("synch_half", [arr])
+        _record_collective("synch_half", [arr],
+                           axis=self.axis_name, world=self.world_size)
         red = lax.psum(arr.astype(jnp.bfloat16), self.axis_name)
         red = red.astype(arr.dtype)
         return red / self.world_size if average else red
@@ -240,7 +250,8 @@ class Communicator:
             return []
         if not self._in_step(arrs[0]):
             return [a.astype(jnp.bfloat16).astype(a.dtype) for a in arrs]
-        _record_collective("fused_synch_half", arrs)
+        _record_collective("fused_synch_half", arrs,
+                           axis=self.axis_name, world=self.world_size)
         shapes = [a.shape for a in arrs]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
         flat = jnp.concatenate([a.reshape(-1) for a in arrs]).astype(jnp.bfloat16)
@@ -266,7 +277,8 @@ class Communicator:
         in_step = self._in_step(arr)
         if in_step:
             _record_collective(
-                "sparse_topk" if topK else "sparse_threshold", [arr])
+                "sparse_topk" if topK else "sparse_threshold", [arr],
+                axis=self.axis_name, world=self.world_size)
         acc = residual + arr
         flat = acc.reshape(-1)
         n = flat.shape[0]

@@ -44,8 +44,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import autograd
 
 __all__ = [
-    "DATA", "MODEL", "SEQ", "PIPE", "EXPERT", "AXES",
-    "create_mesh", "ShardingPlan", "constrain", "plan_active",
+    "DATA", "MODEL", "SEQ", "PIPE", "EXPERT", "TP", "AXES",
+    "create_mesh", "create_tp_mesh", "ShardingPlan", "constrain",
+    "plan_active",
 ]
 
 DATA = "data"
@@ -54,6 +55,14 @@ SEQ = "seq"
 PIPE = "pipe"
 EXPERT = "expert"
 AXES = (DATA, MODEL, SEQ, PIPE, EXPERT)
+
+#: the SERVE-side tensor-parallel axis (singa_tpu/serve/tp.py): a
+#: standalone 1-D mesh over which one inference engine's weights and
+#: paged KV arena shard.  Deliberately NOT one of the training AXES —
+#: a serve process owns its decode mesh outright, and keeping the name
+#: distinct means a Chrome trace can tell a TP-serve psum from a
+#: training ``model``-axis collective at a glance.
+TP = "tp"
 
 # True while a graph-mode step is being traced under a ShardingPlan;
 # constrain() is the identity otherwise (eager compile-time dummy
@@ -103,6 +112,25 @@ def create_mesh(dp=1, tp=1, sp=1, pp=1, ep=1, devices=None) -> Mesh:
     arr = np.asarray(devices[:n]).reshape(
         sizes["dp"], sizes["tp"], sizes["sp"], sizes["pp"], sizes["ep"])
     return Mesh(arr, (DATA, MODEL, SEQ, PIPE, EXPERT))
+
+
+def create_tp_mesh(tp, devices=None) -> Mesh:
+    """1-D serve-side tensor-parallel mesh over the first ``tp``
+    devices (axis name :data:`TP`).  The serve TP backend
+    (singa_tpu/serve/tp.py) runs every engine executable under a
+    ``shard_map`` over this mesh; on a chipless box provision a CPU
+    virtual mesh exactly like the training tests do."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices, have {len(devices)} — "
+            f"provision a virtual CPU mesh via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={tp} "
+            f"(tests/conftest.py) or lower tp")
+    return Mesh(np.asarray(devices[:tp]), (TP,))
 
 
 class ShardingPlan:

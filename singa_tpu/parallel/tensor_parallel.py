@@ -41,7 +41,71 @@ from .sharding import DATA, MODEL, SEQ, P, ShardingPlan, constrain
 __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelMLP", "ParallelMHA", "ParallelTransformerBlock",
+    "decode_param_specs", "decode_cache_spec",
 ]
+
+
+# ---------------------------------------------------------------------------
+# decode-shaped partition plans (the serve TP backend's layout;
+# singa_tpu/serve/tp.py).  The layer classes above shard TRAINING
+# tensors via ``partition_spec`` attributes; inference runs on the raw
+# pytree ``models/gpt2_decode.extract_params`` extracts, so the same
+# Megatron column/row decisions are restated here against that pytree's
+# key names.
+# ---------------------------------------------------------------------------
+
+#: per-block key -> how its weight shards over the TP axis.  Column
+#: weights (q/k/v projections, MLP fc1) split their OUTPUT dim — the
+#: per-shard head/column slice needs no communication; row weights
+#: (attention out-proj, MLP fc2) split their INPUT dim and close with
+#: the block's one psum; everything else (LayerNorms, row biases,
+#: embeddings, the LM head) is replicated.
+_DECODE_COL_W = ("wq", "wk", "wv", "w1")
+_DECODE_COL_B = ("bq", "bk", "bv", "b1")
+_DECODE_ROW_W = ("wo", "w2")
+
+
+def decode_param_specs(params, axis=MODEL):
+    """PartitionSpec pytree (same structure as ``params``) laying an
+    ``extract_params`` decode pytree out Megatron-style over ``axis``:
+    attention heads + MLP columns partitioned, out-proj/fc2 row-
+    partitioned, embeddings/norms/head replicated.  MoE blocks are
+    expert-parallel, not tensor-parallel — they are rejected here so
+    the failure is a typed construction error, not a shape mismatch
+    deep inside a shard_map trace."""
+    blocks = []
+    for li, blk in enumerate(params["blocks"]):
+        if "moe_wg" in blk:
+            raise NotImplementedError(
+                f"block {li} is an MoE block: expert weights shard "
+                f"over the expert axis, not the tensor-parallel axis "
+                f"(serve TP supports dense/GQA models only)")
+        spec = {}
+        for k in blk:
+            if k in _DECODE_COL_W:
+                spec[k] = P(None, axis)
+            elif k in _DECODE_COL_B:
+                spec[k] = P(axis)
+            elif k in _DECODE_ROW_W:
+                spec[k] = P(axis, None)
+            else:
+                spec[k] = P()
+        blocks.append(spec)
+    out = {k: (None if v is None else P())
+           for k, v in params.items() if k != "blocks"}
+    out["blocks"] = blocks
+    return out
+
+
+def decode_cache_spec(axis=MODEL):
+    """PartitionSpec for every KV-cache pytree leaf the serve engine
+    owns — slot arenas ``(L, S, H_kv, W, D)``, paged pools
+    ``(L, num_blocks+1, H_kv, B, D)``, cache rows ``(L, 1, H_kv, W,
+    D)`` and their trailing-axis-free int8 scales leaves: the KV-HEAD
+    axis (always axis 2) shards over ``axis``, everything else stays
+    local.  One spec serves every leaf rank because PartitionSpec
+    trailing dims default to unsharded."""
+    return P(None, None, axis)
 
 
 class ColumnParallelLinear(Layer):

@@ -43,11 +43,24 @@ bitwise identical to the slot engine's.  See docs/SERVING.md
     eng = model.serve(max_slots=16, scheduler="priority",
                       paged=PagedConfig(block_size=16, num_blocks=256),
                       prefix_cache=PrefixCacheConfig(block_size=16))
+
+Since the TP round, ``tp=k`` shards ONE engine's weights and KV
+memory across a k-device mesh (Megatron column/row layout under
+``shard_map``, one psum per attention output and MLP fc2, the paged
+pool sliced per shard on the H_kv axis) — models bigger than one
+device, token streams pinned identical to the single-device engine.
+Composes with everything above; ``serve_fleet(tp=k, replicas=n)``
+partitions the mesh into n disjoint k-wide groups.  See
+docs/SERVING.md "Tensor-parallel serving"::
+
+    eng = model.serve(max_slots=8, tp=2,
+                      paged=PagedConfig(block_size=16, num_blocks=256))
 """
 
 from .engine import InferenceEngine  # noqa: F401
 from .fleet import Router, ServeFleet  # noqa: F401
 from .paged import PagedConfig, PagedKVArena  # noqa: F401
+from .tp import TPConfig, TPExecutor  # noqa: F401
 from .prefix import (PrefixCache, PrefixCacheConfig,  # noqa: F401
                      SessionHandle)
 from .request import (DeadlineExceededError, EngineFailedError,  # noqa: F401

@@ -119,20 +119,23 @@ def _select_sample(logit, key, temp, top_k, top_p, use_top_p):
 
 
 def _decode_row(params, kc_r, vc_r, tok, pos_r, live_r, key, temp,
-                top_p, n_head, eps, moe_top_k, top_k, use_top_p):
+                top_p, n_head, eps, moe_top_k, top_k, use_top_p,
+                tp_axis=None, tp_world=1):
     """ONE slot's decode-step math — kc_r/vc_r: (L, H_kv, max_len, D)
     cache rows (int8 arenas are (values, scales) pytrees, so the
     batch-axis insert/strip is tree-mapped rather than indexed).
     Shared by the slot-arena pool step below AND the paged pool step
     (serve/paged.py), so the two memory models run literally the same
-    per-row ops and cannot drift."""
+    per-row ops and cannot drift.  ``tp_axis``/``tp_world`` thread the
+    tensor-parallel mesh axis through (serve/tp.py's sharded twins;
+    defaults leave the serial math bit-identical)."""
     p_c = jnp.where(live_r, pos_r, 0)
     t_c = jnp.where(live_r, tok, 0)
     x = (params["wte"][t_c] + params["wpe"][p_c])[None, None, :]
     logits, kc2, vc2 = decode_step(
         params, x, jax.tree.map(lambda a: a[:, None], kc_r),
         jax.tree.map(lambda a: a[:, None], vc_r), p_c, n_head, eps,
-        moe_top_k=moe_top_k)
+        moe_top_k=moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
     ks = jax.random.split(key)
     nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
                          use_top_p)
@@ -142,10 +145,11 @@ def _decode_row(params, kc_r, vc_r, tok, pos_r, live_r, key, temp,
 
 @partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "top_k",
-                          "use_top_p"),
+                          "use_top_p", "tp_axis", "tp_world"),
          donate_argnums=(1, 2))
 def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
-                      top_p, n_head, eps, moe_top_k, top_k, use_top_p):
+                      top_p, n_head, eps, moe_top_k, top_k, use_top_p,
+                      tp_axis=None, tp_world=1):
     """Advance EVERY slot one token: toks/pos/live/temps (S,), keys
     (S, 2), arenas (L, S, H_kv, max_len, D) — donated, so the arena
     updates in place across steps.  Dead slots run the same math on
@@ -157,7 +161,8 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
     def row(kc_r, vc_r, tok, pos_r, live_r, key, temp):
         return _decode_row(params, kc_r, vc_r, tok, pos_r, live_r,
                            key, temp, top_p, n_head, eps, moe_top_k,
-                           top_k, use_top_p)
+                           top_k, use_top_p, tp_axis=tp_axis,
+                           tp_world=tp_world)
 
     return jax.vmap(row, in_axes=(1, 1, 0, 0, 0, 0, 0),
                     out_axes=(0, 1, 1, 0))(kc, vc, toks, pos, live,
@@ -166,9 +171,10 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
 
 @partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "top_k",
-                          "use_top_p", "quant"))
+                          "use_top_p", "quant", "tp_axis", "tp_world"))
 def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
-                 eps, moe_top_k, top_k, use_top_p, quant=False):
+                 eps, moe_top_k, top_k, use_top_p, quant=False,
+                 tp_axis=None, tp_world=1):
     """Admission prefill for ONE request: ids (1, max_len)
     right-padded.  Returns (first token, carried key, kc_row, vc_row)
     with cache rows (L, 1, H_kv, max_len, D) ready to write into the
@@ -176,7 +182,8 @@ def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
     mode).  ``prompt_len`` is traced, so every admission reuses one
     executable regardless of prompt length."""
     hidden, kc, vc = prefill(params, ids, n_head, eps,
-                             moe_top_k=moe_top_k, quant_cache=quant)
+                             moe_top_k=moe_top_k, quant_cache=quant,
+                             tp_axis=tp_axis, tp_world=tp_world)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)      # (1, E)
     logit0 = _logits(last_h[:, None, :], params)[0, 0]       # (V,)
@@ -199,10 +206,11 @@ def _prefill_rows(params, ids, n_head, eps, moe_top_k, quant=False):
 
 
 @partial(jax.jit,
-         static_argnames=("n_head", "eps", "moe_top_k", "chunk"),
+         static_argnames=("n_head", "eps", "moe_top_k", "chunk",
+                          "tp_axis", "tp_world"),
          donate_argnums=(2, 3))
 def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
-               moe_top_k, chunk):
+               moe_top_k, chunk, tp_axis=None, tp_world=1):
     """Offset prefill of ONE block-width window: embed tokens at
     positions [off, off+chunk) of the padded ``ids`` row and advance
     them through ``gpt2_decode.prefill_chunk`` against a cache row
@@ -215,7 +223,8 @@ def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
     x = jnp.take(params["wte"], toks[0], axis=0)[None] + \
         jnp.take(params["wpe"], pos, axis=0)[None]
     return prefill_chunk(params, x, kc_row, vc_row, off, n_head, eps,
-                         moe_top_k=moe_top_k)
+                         moe_top_k=moe_top_k, tp_axis=tp_axis,
+                         tp_world=tp_world)
 
 
 @partial(jax.jit, static_argnames=("top_k", "use_top_p"))
@@ -237,7 +246,7 @@ def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
 
 def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
               live_r, key, temp, top_p, spec_k, tn, te, tm, dn, de, dm,
-              top_k, use_top_p):
+              top_k, use_top_p, tp_axis=None, tp_world=1):
     """ONE slot's speculative-chunk math: ``spec_k`` sequential DRAFT
     decode steps propose ``spec_k - 1`` tokens (the extra step
     processes the last proposal as an input so a full-accept chunk
@@ -287,9 +296,14 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
     xs = (jnp.take(t_params["wte"], chunk_toks, axis=0)
           + jnp.take(t_params["wpe"],
                      p_c + jnp.arange(spec_k), axis=0))[None]
+    # only the TARGET side shards under TP (serve/tp.py): the draft
+    # scan above runs replicated on every shard (same inputs → same
+    # proposals bitwise), which is what keeps any draft geometry legal
+    # whatever the tp width
     lg, kc2, vc2 = _advance_chunk(t_params, xs, batch(kc_r),
                                   batch(vc_r), p_c, tn, te,
-                                  moe_top_k=tm)
+                                  moe_top_k=tm, tp_axis=tp_axis,
+                                  tp_world=tp_world)
     out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
                                temp, top_p, top_k, use_top_p)
     return (out, a_draft, unbatch(kc2), unbatch(vc2),
@@ -298,11 +312,12 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
 
 @partial(jax.jit,
          static_argnames=("spec_k", "tn", "te", "tm", "dn", "de", "dm",
-                          "top_k", "use_top_p"),
+                          "top_k", "use_top_p", "tp_axis", "tp_world"),
          donate_argnums=(2, 3, 4, 5))
 def _pool_spec_step(t_params, d_params, kc, vc, dkc, dvc, toks, pos,
                     live, keys, temps, top_p, spec_k, tn, te, tm,
-                    dn, de, dm, top_k, use_top_p):
+                    dn, de, dm, top_k, use_top_p, tp_axis=None,
+                    tp_world=1):
     """Advance EVERY slot one speculative chunk (the per-slot math is
     :func:`_spec_row`).  Arenas (target AND draft) are donated and
     update in place; dead slots run the same math on clamped inputs,
@@ -318,7 +333,8 @@ def _pool_spec_step(t_params, d_params, kc, vc, dkc, dvc, toks, pos,
     def row(kc_r, vc_r, dkc_r, dvc_r, tok, pos_r, live_r, key, temp):
         return _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r,
                          tok, pos_r, live_r, key, temp, top_p, spec_k,
-                         tn, te, tm, dn, de, dm, top_k, use_top_p)
+                         tn, te, tm, dn, de, dm, top_k, use_top_p,
+                         tp_axis=tp_axis, tp_world=tp_world)
 
     return jax.vmap(row, in_axes=(1, 1, 1, 1, 0, 0, 0, 0, 0),
                     out_axes=(0, 0, 1, 1, 1, 1, 0))(
@@ -338,6 +354,73 @@ def _write_slot(kc_arena, vc_arena, kc_row, vc_row, slot):
 
     return (jax.tree.map(wr, kc_arena, kc_row),
             jax.tree.map(wr, vc_arena, vc_row))
+
+
+class _LocalExec:
+    """The engine's default (single-device) executor: every dispatch
+    the engine makes goes through this surface, so the TP backend
+    (serve/tp.py ``TPExecutor``) can plug sharded twins in its place
+    without the host-side step loop knowing.  Methods bind the
+    engine's statics onto the module-level jitted executables — the
+    paged pool steps keep their AOT cost-capture dispatch."""
+
+    def __init__(self, eng):
+        self._e = eng
+
+    def pool_decode_step(self, params, kc, vc, toks, pos, live, keys,
+                         temps, top_p):
+        return _pool_decode_step(params, kc, vc, toks, pos, live,
+                                 keys, temps, top_p,
+                                 **self._e._statics)
+
+    def pool_spec_step(self, t_params, d_params, kc, vc, dkc, dvc,
+                       toks, pos, live, keys, temps, top_p):
+        e = self._e
+        st = e._statics
+        return _pool_spec_step(t_params, d_params, kc, vc, dkc, dvc,
+                               toks, pos, live, keys, temps, top_p,
+                               spec_k=e.spec_k, tn=st["n_head"],
+                               te=st["eps"], tm=st["moe_top_k"],
+                               dn=e._d_statics[0], de=e._d_statics[1],
+                               dm=e._d_statics[2], top_k=st["top_k"],
+                               use_top_p=st["use_top_p"])
+
+    def paged_decode_step(self, params, pool_k, pool_v, tables, toks,
+                          pos, live, keys, temps, top_p, block):
+        return _aot_call("paged_decode_step", _paged_decode_step,
+                         params, pool_k, pool_v, tables, toks, pos,
+                         live, keys, temps, top_p, block=block,
+                         **self._e._statics)
+
+    def paged_spec_step(self, t_params, d_params, pool_k, pool_v, dkc,
+                        dvc, tables, toks, pos, live, keys, temps,
+                        top_p, block):
+        e = self._e
+        st = e._statics
+        return _aot_call("paged_spec_step", _paged_spec_step,
+                         t_params, d_params, pool_k, pool_v, dkc, dvc,
+                         tables, toks, pos, live, keys, temps, top_p,
+                         block=block, spec_k=e.spec_k,
+                         tn=st["n_head"], te=st["eps"],
+                         tm=st["moe_top_k"], dn=e._d_statics[0],
+                         de=e._d_statics[1], dm=e._d_statics[2],
+                         top_k=st["top_k"],
+                         use_top_p=st["use_top_p"])
+
+    def prefill_one(self, params, ids, prompt_len, key, temp, top_p):
+        e = self._e
+        return _prefill_one(params, ids, prompt_len, key, temp, top_p,
+                            **e._statics, quant=e._quant)
+
+    def chunk_row(self, params, ids, kc_row, vc_row, off):
+        return _chunk_row(params, ids, kc_row, vc_row, off,
+                          **self._e._chunk_statics)
+
+    def write_slot(self, kc, vc, kc_row, vc_row, slot):
+        return _write_slot(kc, vc, kc_row, vc_row, slot)
+
+    def read_slot(self, kc, vc, slot):
+        return _read_slot(kc, vc, slot)
 
 
 class _Slot:
@@ -429,7 +512,7 @@ class InferenceEngine:
                  scheduler=None, top_k=0, top_p=None,
                  clock=time.monotonic, slo=None, prefix_cache=None,
                  draft_model=None, spec_k=None, cache_dtype=None,
-                 paged=None):
+                 paged=None, tp=None):
         cfg = model.cfg
         if _norm_window(cfg) is not None:
             raise NotImplementedError(
@@ -516,6 +599,26 @@ class InferenceEngine:
             n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
             moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
             top_k=self._top_k, use_top_p=self._use_top_p)
+        # -- tensor-parallel backend (serve/tp.py): shard the decode
+        # math + every KV arena over a `tp` mesh axis.  The executor
+        # re-places the extracted weights Megatron-style and supplies
+        # sharded twins for every dispatch below; the host-side step
+        # loop, paging, prefix cache, and ledger see a single logical
+        # engine either way (self._x is the pluggable dispatch seam)
+        self.tp_exec = None
+        if tp is not None and tp is not False:
+            from .tp import TPExecutor, as_tp_config
+            tp = as_tp_config(tp)
+            if tp.tp > 1:
+                self.tp_exec = TPExecutor(
+                    tp, cfg, statics=self._statics, quant=self._quant,
+                    model_plan=getattr(model, "plan", None),
+                    engine_label=self.stats.engine_label,
+                    reg=self.stats.registry)
+                self._params = self.tp_exec.place_params(self._params)
+                self.stats.tp_source = self.tp_exec.snapshot
+        self._x = (self.tp_exec if self.tp_exec is not None
+                   else _LocalExec(self))
         # fixed-shape KV arena keyed on (max_slots, max_len): L layers,
         # H_kv heads (GQA keeps the narrow cache), compute dtype —
         # or (int8 values, f32 scales) tuples for cache_dtype="int8"
@@ -526,11 +629,19 @@ class InferenceEngine:
         D = cfg.n_embd // cfg.n_head
         cdt = self._params["wte"].dtype
 
-        def _arena(L_, H_, D_):
+        def _arena(L_, H_, D_, shard=True):
             if self._quant:
-                return (jnp.zeros((L_, S, H_, W, D_), jnp.int8),
-                        jnp.zeros((L_, S, H_, W), jnp.float32))
-            return jnp.zeros((L_, S, H_, W, D_), cdt)
+                z = (jnp.zeros((L_, S, H_, W, D_), jnp.int8),
+                     jnp.zeros((L_, S, H_, W), jnp.float32))
+            else:
+                z = jnp.zeros((L_, S, H_, W, D_), cdt)
+            if self.tp_exec is None:
+                return z
+            # target arenas shard on the H_kv axis; the DRAFT arena
+            # (shard=False) replicates — every shard runs the full
+            # draft, which is what keeps any draft geometry legal
+            return (self.tp_exec.place_cache(z) if shard
+                    else self.tp_exec.place_replicated(z))
 
         # -- paged KV mode (serve/paged.py): ONE block pool replaces
         # the per-slot worst-case arena; capacity becomes "blocks
@@ -565,7 +676,7 @@ class InferenceEngine:
                 paged, L, H_kv, D, cdt, row_width=W,
                 quant=self._quant,
                 engine_label=self.stats.engine_label,
-                reg=self.stats.registry)
+                reg=self.stats.registry, tp=self.tp_exec)
             self.stats.paged_source = self.paged_arena.snapshot
             self._kc = self._vc = None
         else:
@@ -583,15 +694,23 @@ class InferenceEngine:
             self._d_statics = (dcfg.n_head, float(dcfg.layer_norm_eps),
                                int(getattr(dcfg, "moe_top_k", 2) or 2))
             self._dkc = _arena(dcfg.n_layer, dcfg.n_kv_head,
-                               dcfg.n_embd // dcfg.n_head)
+                               dcfg.n_embd // dcfg.n_head, shard=False)
             self._dvc = _arena(dcfg.n_layer, dcfg.n_kv_head,
-                               dcfg.n_embd // dcfg.n_head)
+                               dcfg.n_embd // dcfg.n_head, shard=False)
+            if self.tp_exec is not None:
+                self._d_params = self.tp_exec.place_replicated(
+                    self._d_params)
+                self.tp_exec.set_spec(self.spec_k, self._d_statics)
         # per-slot host state + device sampling keys
         self._slots = [None] * S            # _Slot or None
         self._toks = np.zeros(S, np.int32)  # last emitted token
         self._pos = np.zeros(S, np.int32)
         self._temps = np.zeros(S, np.float32)
         self._keys = jnp.zeros((S, 2), jnp.uint32)
+        if self.tp_exec is not None:
+            # committed replicated so the sharded twins never pay a
+            # per-dispatch broadcast for the key table
+            self._keys = self.tp_exec.place_replicated(self._keys)
         self._handles = {}
         self._swapped = []                  # paged mode: _Swapped list
         self._swap_seq = itertools.count()
@@ -646,7 +765,7 @@ class InferenceEngine:
                 prefix_cache, L, H_kv, D, cdt,
                 engine_label=self.stats.engine_label,
                 reg=self.stats.registry, quant=self._quant,
-                arena=self.paged_arena)
+                arena=self.paged_arena, tp=self.tp_exec)
             self.prefix_cache.attach_row_geometry(W)
             if self.paged_arena is not None:
                 # cached-but-unreferenced blocks are soft free space:
@@ -657,6 +776,8 @@ class InferenceEngine:
                 n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
                 moe_top_k=self._statics["moe_top_k"],
                 chunk=prefix_cache.block_size)
+            if self.tp_exec is not None:
+                self.tp_exec.set_chunk(self._chunk_statics)
             self.stats.prefix_source = self.prefix_cache.snapshot
             # prefill-interleave pricing: warm admissions that
             # recompute at most one chunk don't consume the cold
@@ -671,7 +792,7 @@ class InferenceEngine:
                 pass
         self._log.info(
             "engine up: slots=%d max_len=%d cache_dtype=%s "
-            "prefix_cache=%s spec=%s paged=%s",
+            "prefix_cache=%s spec=%s paged=%s tp=%s",
             S, W, cache_dtype or str(cdt),
             "off" if self.prefix_cache is None else
             f"{self.prefix_cache.num_blocks}x"
@@ -679,7 +800,9 @@ class InferenceEngine:
             "off" if self.draft is None else f"k={self.spec_k}",
             "off" if self.paged_arena is None else
             f"{self.paged_arena.num_blocks}x"
-            f"{self.paged_arena.block_size}")
+            f"{self.paged_arena.block_size}",
+            "off" if self.tp_exec is None
+            else f"{self.tp_exec.tp} shards")
 
     # -- submission ------------------------------------------------------
     def submit(self, request) -> RequestHandle:
@@ -791,6 +914,8 @@ class InferenceEngine:
             self.prefix_cache.unregister()
         if self.paged_arena is not None:
             self.paged_arena.unregister()
+        if self.tp_exec is not None:
+            self.tp_exec.unregister()
         self._kc = self._vc = None
         self._dkc = self._dvc = None
         self._params = self._d_params = None
@@ -1038,37 +1163,28 @@ class InferenceEngine:
         a_draft = None
         arena = self.paged_arena
         if self.draft is not None:
-            tn, te, tm = (self._statics["n_head"], self._statics["eps"],
-                          self._statics["moe_top_k"])
             with _trace.span("serve/spec_step", cat="serve",
                              step=self.step_count, live=n_live,
                              paged=arena is not None):
                 if arena is not None:
                     (out, a_draft, arena.pool_k, arena.pool_v,
-                     self._dkc, self._dvc, self._keys) = _aot_call(
-                        "paged_spec_step", _paged_spec_step,
+                     self._dkc, self._dvc,
+                     self._keys) = self._x.paged_spec_step(
                         self._params, self._d_params, arena.pool_k,
                         arena.pool_v, self._dkc, self._dvc,
                         self._block_tables(), jnp.asarray(self._toks),
                         jnp.asarray(self._pos), jnp.asarray(live),
                         self._keys, jnp.asarray(self._temps),
-                        self._top_p, block=arena.block_size,
-                        spec_k=self.spec_k, tn=tn, te=te, tm=tm,
-                        dn=self._d_statics[0], de=self._d_statics[1],
-                        dm=self._d_statics[2], top_k=self._top_k,
-                        use_top_p=self._use_top_p)
+                        self._top_p, arena.block_size)
                 else:
                     (out, a_draft, self._kc, self._vc, self._dkc,
-                     self._dvc, self._keys) = _pool_spec_step(
+                     self._dvc, self._keys) = self._x.pool_spec_step(
                         self._params, self._d_params, self._kc,
                         self._vc, self._dkc, self._dvc,
                         jnp.asarray(self._toks),
                         jnp.asarray(self._pos), jnp.asarray(live),
                         self._keys, jnp.asarray(self._temps),
-                        self._top_p, spec_k=self.spec_k, tn=tn, te=te,
-                        tm=tm, dn=self._d_statics[0],
-                        de=self._d_statics[1], dm=self._d_statics[2],
-                        top_k=self._top_k, use_top_p=self._use_top_p)
+                        self._top_p)
                 out = np.asarray(out)
                 a_draft = np.asarray(a_draft)
         else:
@@ -1077,23 +1193,20 @@ class InferenceEngine:
                              paged=arena is not None):
                 if arena is not None:
                     (next_toks, arena.pool_k, arena.pool_v,
-                     self._keys) = _aot_call(
-                        "paged_decode_step", _paged_decode_step,
+                     self._keys) = self._x.paged_decode_step(
                         self._params, arena.pool_k, arena.pool_v,
                         self._block_tables(), jnp.asarray(self._toks),
                         jnp.asarray(self._pos), jnp.asarray(live),
                         self._keys, jnp.asarray(self._temps),
-                        self._top_p, block=arena.block_size,
-                        **self._statics)
+                        self._top_p, arena.block_size)
                 else:
                     next_toks, self._kc, self._vc, self._keys = \
-                        _pool_decode_step(
+                        self._x.pool_decode_step(
                             self._params, self._kc, self._vc,
                             jnp.asarray(self._toks),
                             jnp.asarray(self._pos),
                             jnp.asarray(live), self._keys,
-                            jnp.asarray(self._temps), self._top_p,
-                            **self._statics)
+                            jnp.asarray(self._temps), self._top_p)
                 next_toks = np.asarray(next_toks)
         if _mon:
             _monitor.heartbeat(
@@ -1470,9 +1583,9 @@ class InferenceEngine:
                     ids[0, :total] = result.tokens
                     ids_j = jnp.asarray(ids)
                     for j in range(plen // B, n_goal):
-                        _, kc_row, vc_row = _chunk_row(
+                        _, kc_row, vc_row = self._x.chunk_row(
                             self._params, ids_j, kc_row, vc_row,
-                            jnp.int32(j * B), **self._chunk_statics)
+                            jnp.int32(j * B))
                     arena.scatter_row(
                         kc_row, vc_row,
                         {j: slot.blocks[j]
@@ -1526,17 +1639,16 @@ class InferenceEngine:
                     cache.touch(existing)
                     path = existing
                 else:
-                    kc_row, vc_row = _read_slot(self._kc, self._vc,
-                                                jnp.int32(idx))
+                    kc_row, vc_row = self._x.read_slot(
+                        self._kc, self._vc, jnp.int32(idx))
                     if want_session and total // B > plen // B:
                         ids = np.zeros((1, self.max_len), np.int32)
                         ids[0, :total] = result.tokens
                         ids_j = jnp.asarray(ids)
                         for j in range(plen // B, total // B):
-                            _, kc_row, vc_row = _chunk_row(
+                            _, kc_row, vc_row = self._x.chunk_row(
                                 self._params, ids_j, kc_row, vc_row,
-                                jnp.int32(j * B),
-                                **self._chunk_statics)
+                                jnp.int32(j * B))
                     path = cache.donate_from_row(result.tokens, kc_row,
                                                  vc_row, n_goal)
             if want_session:
@@ -1689,9 +1801,9 @@ class InferenceEngine:
                     ids, plen, nodes, key0, temp,
                     rid=req.request_id)
             else:
-                tok0, carry_key, kc_row, vc_row = _prefill_one(
+                tok0, carry_key, kc_row, vc_row = self._x.prefill_one(
                     self._params, ids_j, plen, key0, temp,
-                    self._top_p, **self._statics, quant=self._quant)
+                    self._top_p)
             if arena is not None:
                 # the prefilled lanes past the shared prefix scatter
                 # into the request's freshly-allocated pool blocks;
@@ -1701,9 +1813,9 @@ class InferenceEngine:
                     kc_row, vc_row,
                     {m + j: b for j, b in enumerate(new_blocks)})
             else:
-                self._kc, self._vc = _write_slot(self._kc, self._vc,
-                                                 kc_row, vc_row,
-                                                 jnp.int32(idx))
+                self._kc, self._vc = self._x.write_slot(
+                    self._kc, self._vc, kc_row, vc_row,
+                    jnp.int32(idx))
             if self.draft is not None:
                 # the draft sees the SAME prompt cold (its prefill is
                 # cheap by construction; the prefix cache stores only
@@ -1758,9 +1870,8 @@ class InferenceEngine:
         off = len(nodes) * B
         hidden = None
         while off <= last_off:
-            hidden, kc_row, vc_row = _chunk_row(
-                self._params, ids_j, kc_row, vc_row, jnp.int32(off),
-                **self._chunk_statics)
+            hidden, kc_row, vc_row = self._x.chunk_row(
+                self._params, ids_j, kc_row, vc_row, jnp.int32(off))
             if _reqs._active and rid is not None:
                 _reqs._ledger.on_prefill_chunk(
                     rid, engine=self.stats.engine_label,

@@ -204,6 +204,18 @@ class ServeFleet:
         self._model = model
         self._clock = clock
         self._engine_kw = dict(engine_kw)
+        # tensor-parallel replicas (serve/tp.py): a fleet of TP
+        # engines partitions the device mesh — replica i's shards own
+        # devices [i*tp, (i+1)*tp), tensor parallelism inside each
+        # replica and data parallelism across them.  Validated here
+        # (tp x replicas must fit the mesh) and pinned per replica so
+        # a supervisor rebuild or revive() lands on the SAME device
+        # group and reuses the same compiled twins.
+        self._tp_cfgs = None
+        if engine_kw.get("tp") not in (None, False):
+            from .tp import fleet_tp_configs
+
+            self._tp_cfgs = fleet_tp_configs(engine_kw["tp"], replicas)
         self._sup_kw = dict(
             restart_budget=restart_budget,
             budget_reset_after_s=budget_reset_after_s,
@@ -243,7 +255,7 @@ class ServeFleet:
                             + self._c_hedges)
         self._replicas = [
             _Replica(i, EngineSupervisor(model, **self._sup_kw,
-                                         **self._engine_kw))
+                                         **self._replica_kw(i)))
             for i in range(replicas)]
         self._g_healthy.set(replicas)
         # fleet-owned completion routing (the supervisor pattern, one
@@ -258,6 +270,16 @@ class ServeFleet:
         self._log.info(
             "fleet up: %d replicas x (slots=%d) [fleet=%s]", replicas,
             self._replicas[0].sup.engine.max_slots, self.fleet_label)
+
+    def _replica_kw(self, idx):
+        """Engine kwargs for replica ``idx``: the shared engine_kw,
+        with ``tp`` swapped for the replica's pinned device-group
+        TPConfig on a tensor-parallel fleet."""
+        if self._tp_cfgs is None:
+            return self._engine_kw
+        kw = dict(self._engine_kw)
+        kw["tp"] = self._tp_cfgs[idx]
+        return kw
 
     # -- introspection ---------------------------------------------------
     @property
@@ -611,7 +633,7 @@ class ServeFleet:
         if not rep.sup.engine._closed:
             rep.sup.close(force=True)
         rep.sup = EngineSupervisor(self._model, **self._sup_kw,
-                                   **self._engine_kw)
+                                   **self._replica_kw(idx))
         rep.healthy = True
         rep.needs_failover = False
         rep.down_error = None

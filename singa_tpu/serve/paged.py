@@ -197,11 +197,12 @@ def _slice_block(leaf, off, block):
 
 @partial(jax.jit,
          static_argnames=("block", "n_head", "eps", "moe_top_k",
-                          "top_k", "use_top_p"),
+                          "top_k", "use_top_p", "tp_axis", "tp_world"),
          donate_argnums=(1, 2))
 def _paged_decode_step(params, pool_k, pool_v, tables, toks, pos, live,
                        keys, temps, top_p, block, n_head, eps,
-                       moe_top_k, top_k, use_top_p):
+                       moe_top_k, top_k, use_top_p, tp_axis=None,
+                       tp_world=1):
     """Advance EVERY slot one token against the block pool: tables
     (S, W//B) int32 block ids (trash-padded), pools donated.  Per slot:
     gather its blocks into a row, run the shared decode-row math, then
@@ -217,7 +218,8 @@ def _paged_decode_step(params, pool_k, pool_v, tables, toks, pos, live,
         vc_r = jax.tree.map(lambda p: _gather_leaf(p, tbl), pool_v)
         nxt, kc2, vc2, k2 = _decode_row(
             params, kc_r, vc_r, tok, pos_r, live_r, key, temp, top_p,
-            n_head, eps, moe_top_k, top_k, use_top_p)
+            n_head, eps, moe_top_k, top_k, use_top_p,
+            tp_axis=tp_axis, tp_world=tp_world)
         p_c = jnp.where(live_r, pos_r, 0)
         blk = p_c // block
         off = blk * block
@@ -236,12 +238,13 @@ def _paged_decode_step(params, pool_k, pool_v, tables, toks, pos, live,
 
 @partial(jax.jit,
          static_argnames=("block", "spec_k", "tn", "te", "tm", "dn",
-                          "de", "dm", "top_k", "use_top_p"),
+                          "de", "dm", "top_k", "use_top_p", "tp_axis",
+                          "tp_world"),
          donate_argnums=(2, 3, 4, 5))
 def _paged_spec_step(t_params, d_params, pool_k, pool_v, dkc, dvc,
                      tables, toks, pos, live, keys, temps, top_p,
                      block, spec_k, tn, te, tm, dn, de, dm, top_k,
-                     use_top_p):
+                     use_top_p, tp_axis=None, tp_world=1):
     """Speculative chunk against the block pool: the TARGET cache is
     paged (gather row -> shared spec-row math -> scatter back the one
     or two blocks the verify chunk wrote — ``spec_k <= block_size`` is
@@ -260,7 +263,7 @@ def _paged_spec_step(t_params, d_params, pool_k, pool_v, dkc, dvc,
         out, a_draft, kc2, vc2, dkc2, dvc2, k2 = _spec_row(
             t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
             live_r, key, temp, top_p, spec_k, tn, te, tm, dn, de, dm,
-            top_k, use_top_p)
+            top_k, use_top_p, tp_axis=tp_axis, tp_world=tp_world)
         p_c = jnp.where(live_r, pos_r, 0)
         b0 = p_c // block
         b1 = (p_c + spec_k - 1) // block
@@ -369,7 +372,8 @@ class PagedKVArena:
     blocks counted in ``used``)."""
 
     def __init__(self, config, n_layer, n_kv_head, head_dim, dtype,
-                 row_width, quant=False, engine_label="0", reg=None):
+                 row_width, quant=False, engine_label="0", reg=None,
+                 tp=None):
         self.config = config
         B, N = config.block_size, config.num_blocks
         self.block_size = B
@@ -381,15 +385,24 @@ class PagedKVArena:
                 f"block_size ({B})")
         self.row_blocks = row_width // B
         self.quant = bool(quant)
+        # tensor-parallel executor (serve/tp.py): the pool leaves are
+        # placed SHARDED over the tp mesh's H_kv axis (each shard owns
+        # a (L, N+1, H_kv/tp, B, D) slice + its scales slice) and the
+        # gather/scatter/swap copies dispatch through the executor's
+        # sharded twins.  Host-side block accounting is untouched —
+        # block ids are the same on every shard
+        self._tp = tp
 
         def pool(shape_tail):
             if quant:
-                return (jnp.zeros((n_layer, N + 1, n_kv_head, B)
-                                  + shape_tail, jnp.int8),
-                        jnp.zeros((n_layer, N + 1, n_kv_head, B),
-                                  jnp.float32))
-            return jnp.zeros((n_layer, N + 1, n_kv_head, B)
-                             + shape_tail, dtype)
+                z = (jnp.zeros((n_layer, N + 1, n_kv_head, B)
+                               + shape_tail, jnp.int8),
+                     jnp.zeros((n_layer, N + 1, n_kv_head, B),
+                               jnp.float32))
+            else:
+                z = jnp.zeros((n_layer, N + 1, n_kv_head, B)
+                              + shape_tail, dtype)
+            return z if tp is None else tp.place_cache(z)
 
         self.pool_k = pool((head_dim,))
         self.pool_v = pool((head_dim,))
@@ -473,6 +486,10 @@ class PagedKVArena:
         if _faults._armed:
             _faults.check("serve.paged_copy")
         n = len(blocks) if n_used is None else n_used
+        if self._tp is not None:
+            return self._tp.pool_to_row(self.pool_k, self.pool_v,
+                                        self._pad_idx(blocks),
+                                        jnp.int32(n))
         return _pool_to_row(self.pool_k, self.pool_v,
                             self._pad_idx(blocks), jnp.int32(n),
                             block=self.block_size)
@@ -486,6 +503,11 @@ class PagedKVArena:
         idx = np.full(self.row_blocks, self.trash, np.int32)
         for lane, blk in lanes.items():
             idx[lane] = blk
+        if self._tp is not None:
+            self.pool_k, self.pool_v = self._tp.row_to_pool(
+                self.pool_k, self.pool_v, kc_row, vc_row,
+                jnp.asarray(idx))
+            return
         self.pool_k, self.pool_v = _row_to_pool(
             self.pool_k, self.pool_v, kc_row, vc_row,
             jnp.asarray(idx), block=self.block_size)

@@ -217,7 +217,8 @@ class PrefixCache:
     the host-side tree, refcounts, LRU state, and metrics."""
 
     def __init__(self, config, n_layer, n_kv_head, head_dim, dtype,
-                 engine_label="0", reg=None, quant=False, arena=None):
+                 engine_label="0", reg=None, quant=False, arena=None,
+                 tp=None):
         self.config = config
         B, N = config.block_size, config.num_blocks
         self.block_size = B
@@ -227,25 +228,38 @@ class PrefixCache:
         # capacity is the arena's, device copies route through it, and
         # donation is zero-copy adoption (adopt_blocks)
         self._arena = arena
+        # tensor-parallel executor (serve/tp.py): cache rows and the
+        # cache-owned pool become SHARDED pytrees over the tp mesh's
+        # H_kv axis, and the pool<->row copies dispatch through the
+        # executor's sharded twins.  The host-side radix tree, ref
+        # counts, and LRU state are untouched — a cached block is the
+        # same logical block on every shard
+        self._tp = tp
         if arena is not None:
             self.num_blocks = arena.num_blocks
             self._pool_k = self._pool_v = None
-        elif quant:
-            # (values, scales) pytree pool — same layout as the int8
-            # engine arena, so the generic copies round-trip it
-            self._pool_k = (
-                jnp.zeros((n_layer, N + 1, n_kv_head, B, head_dim),
-                          jnp.int8),
-                jnp.zeros((n_layer, N + 1, n_kv_head, B), jnp.float32))
-            self._pool_v = (
-                jnp.zeros((n_layer, N + 1, n_kv_head, B, head_dim),
-                          jnp.int8),
-                jnp.zeros((n_layer, N + 1, n_kv_head, B), jnp.float32))
         else:
-            # +1: the trash block scatter padding lands in (never read)
-            self._pool_k = jnp.zeros((n_layer, N + 1, n_kv_head, B,
-                                      head_dim), dtype)
-            self._pool_v = jnp.zeros_like(self._pool_k)
+            if quant:
+                # (values, scales) pytree pool — same layout as the
+                # int8 engine arena, so the generic copies round-trip
+                self._pool_k = (
+                    jnp.zeros((n_layer, N + 1, n_kv_head, B, head_dim),
+                              jnp.int8),
+                    jnp.zeros((n_layer, N + 1, n_kv_head, B),
+                              jnp.float32))
+                self._pool_v = (
+                    jnp.zeros((n_layer, N + 1, n_kv_head, B, head_dim),
+                              jnp.int8),
+                    jnp.zeros((n_layer, N + 1, n_kv_head, B),
+                              jnp.float32))
+            else:
+                # +1: trash block scatter padding lands in (never read)
+                self._pool_k = jnp.zeros((n_layer, N + 1, n_kv_head, B,
+                                          head_dim), dtype)
+                self._pool_v = jnp.zeros_like(self._pool_k)
+            if tp is not None:
+                self._pool_k = tp.place_cache(self._pool_k)
+                self._pool_v = tp.place_cache(self._pool_v)
         self._root = _Node((), None, -1, 0)
         self._free = [] if arena is not None else list(range(N))
         self._nodes_by_block = {}       # pool slot -> node
@@ -433,6 +447,9 @@ class PrefixCache:
         if _faults._armed:
             _faults.check("serve.prefix_copy")
         idx = self._pad_idx([n.block for n in nodes], trash=0)
+        if self._tp is not None:
+            return self._tp.pool_to_row(self._pool_k, self._pool_v,
+                                        idx, jnp.int32(len(nodes)))
         return _blocks_to_row(self._pool_k, self._pool_v, idx,
                               jnp.int32(len(nodes)))
 
@@ -507,9 +524,14 @@ class PrefixCache:
                               np.int32)
                 for j, child in new_nodes:
                     idx[j] = child.block
-                self._pool_k, self._pool_v = _row_to_blocks(
-                    self._pool_k, self._pool_v, kc_row, vc_row,
-                    jnp.asarray(idx))
+                if self._tp is not None:
+                    self._pool_k, self._pool_v = self._tp.row_to_pool(
+                        self._pool_k, self._pool_v, kc_row, vc_row,
+                        jnp.asarray(idx))
+                else:
+                    self._pool_k, self._pool_v = _row_to_blocks(
+                        self._pool_k, self._pool_v, kc_row, vc_row,
+                        jnp.asarray(idx))
                 self._g_cached.set(self.cached_blocks)
         finally:
             for n in path:

@@ -144,6 +144,9 @@ class EngineStats:
         # set by the engine in paged mode: the PagedKVArena's snapshot
         # (blocks free/used, preemption and swap counters)
         self.paged_source = None
+        # set by the engine in tensor-parallel mode: the TPExecutor's
+        # snapshot (shard count, per-shard KV bytes, dispatch counts)
+        self.tp_source = None
         # speculative engines only: acceptance accounting (``spec`` is
         # set by the engine when a draft model is attached; a plain
         # engine registers nothing and snapshots spec: None)
@@ -366,6 +369,11 @@ class EngineStats:
             # counters for paged ones
             "paged": (self.paged_source()
                       if self.paged_source is not None else None),
+            # add-only schema extension (TP-serve round): None for
+            # single-device engines; shard/mesh/dispatch accounting
+            # for tensor-parallel ones (serve/tp.py)
+            "tp": (self.tp_source()
+                   if self.tp_source is not None else None),
             # add-only schema extension (speculative round): None for
             # plain engines.  tokens_per_chunk = accepted proposals +
             # the chunk's bonus/correction token, per verify chunk —

@@ -199,12 +199,14 @@ def test_stats_schema_stable():
     eng.run_until_complete(max_steps=50)
     snap = eng.stats.snapshot()
     assert set(snap) == {"requests", "throughput", "latency", "queue",
-                         "slots", "slo", "prefix", "spec", "paged"}
-    # no prefix cache / draft model / paged arena configured: present
-    # but None
+                         "slots", "slo", "prefix", "spec", "paged",
+                         "tp"}
+    # no prefix cache / draft model / paged arena / tp mesh
+    # configured: present but None
     assert snap["prefix"] is None
     assert snap["spec"] is None
     assert snap["paged"] is None
+    assert snap["tp"] is None
     assert set(snap["requests"]) == {
         "submitted", "completed", "rejected_deadline",
         "rejected_queue_full"}
