@@ -429,10 +429,17 @@ def chaos_paged(report):
         "resilience.engine_restarts", 0)
     completed = wedged = typed_failed = 0
     preempted_total = 0
+    # default PagedConfig kernel: the BLOCK-NATIVE decode path (the
+    # gather-tax round) — the recovery invariants below therefore
+    # cover the kernel, and the serve.paged_copy fault site still
+    # fires on the admission scatter and the swap gather/scatter
+    # (those copies kept their fixed-shape form; swap is off the hot
+    # path — docs/SERVING.md)
+    pcfg = PagedConfig(block_size=8, num_blocks=6)
+    assert pcfg.kernel == "block"
     for fail_after in (2, 7):
         sup = EngineSupervisor(
-            m, max_slots=2, restart_budget=2,
-            paged=PagedConfig(block_size=8, num_blocks=6))
+            m, max_slots=2, restart_budget=2, paged=pcfg)
         arena0 = sup.engine.paged_arena
         handles = [sup.submit(GenerationRequest(
             p, max_new_tokens=n, temperature=0.0))
@@ -473,6 +480,7 @@ def chaos_paged(report):
         "engine_restarts": restarts,
         "preemptions": preempted_total,
         "blocks_leaked": 0,
+        "kernel": pcfg.kernel,
     }
     assert wedged == 0, f"{wedged} paged requests wedged/lost"
     assert completed + typed_failed == 2 * len(workload)

@@ -31,10 +31,14 @@ exercised mid-run, recompile pin).  ``--spec`` trains a bench-scale
 target/draft pair and measures speculative serve (spec_k=4) against
 the plain engine on the same target — tokens/s, acceptance,
 accepted-tokens/chunk, byte parity, recompile pin (the ``spec``
-section).  ``--cache-int8`` replays the standard workload through an
-int8-KV-arena engine with byte parity against the offline int8 oracle
-(the ``cache_int8`` section; CPU-measured, chip-pending — see
-PERF.md).  ``--fleet`` additionally replays the
+section).  ``--spec-sweep`` additionally sweeps spec_k ∈ {2, 4, 8} on
+the same trained pair and commits tokens/s vs MEASURED acceptance per
+k (the ``spec_sweep`` section, ``chip_pending: true`` — the
+acceptance-sweep characterization the ``generate_speculative``
+crossover cost model cross-links).  ``--cache-int8`` replays the
+standard workload through an int8-KV-arena engine with byte parity
+against the offline int8 oracle (the ``cache_int8`` section;
+CPU-measured, chip-pending — see PERF.md).  ``--fleet`` additionally replays the
 workload through a 2-replica ServeFleet (same total slot count) and
 embeds a ``fleet`` section — routing balance, per-stream parity
 against the engine run, and the jit-cache pin proving replicas share
@@ -195,10 +199,13 @@ def _serve_jit_cache_size():
 
     total = 0
     for f in (E._pool_decode_step, E._pool_spec_step, E._prefill_one,
-              E._prefill_rows, E._write_slot, E._chunk_row,
+              E._prefill_batch, E._prefill_rows, E._write_slot,
+              E._chunk_row,
               E._first_from_hidden, P._blocks_to_row,
               P._row_to_blocks, P._read_slot, G._paged_decode_step,
-              G._paged_spec_step, G._pool_to_row, G._row_to_pool):
+              G._paged_spec_step, G._paged_decode_kernel,
+              G._paged_spec_kernel, G._pool_to_row, G._row_to_pool,
+              G._rows_to_pool):
         try:
             total += f._cache_size()
         except Exception:
@@ -386,6 +393,13 @@ def run_paged(m, workload, engine_outs):
         },
         "concurrency_gain": peak_p / peak_s,
         "speedup_tokens_per_s": wall_s / wall_p,
+        # the block-native decode kernel (PagedConfig default since
+        # the gather-tax round) — CI gates that the hot path is the
+        # kernel and that its decode TPOT stays within 2x of the slot
+        # arena's (the gather path priced this at ~6x)
+        "kernel": pcfg.kernel,
+        "tpot_p50_ratio": (snap_p["latency"]["tpot"]["p50"]
+                           / snap_s["latency"]["tpot"]["p50"]),
         "preemptions": pg["preemptions"],
         "swap_in": pg["swap_in"],
         "swap_out": pg["swap_out"],
@@ -535,14 +549,20 @@ def make_spec_workload(ids, n_requests=32, seed=4):
     return reqs
 
 
-def run_spec(max_slots, spec_k=4):
+def run_spec(max_slots, spec_k=4, pair=None, return_baseline=False):
     """The --spec measurement: the trained-pair workload through the
     PLAIN engine (the PR-6 serve path on the same target — the
     baseline speculation must strictly beat) and through the
     SPECULATIVE engine at ``spec_k``, with byte parity for every
     stream (spec == plain == single-prompt oracle) and the jit cache
-    pinned across both timed runs."""
-    target, draft, ids = _train_spec_pair()
+    pinned across both timed runs.  ``pair``: a pre-trained
+    (target, draft, ids) triple — main() trains ONCE and shares it
+    with --spec-sweep (60 training steps are the expensive part);
+    ``return_baseline`` additionally hands back (wall_p, outs_p) so
+    the sweep reuses this plain-engine measurement instead of
+    replaying it."""
+    target, draft, ids = pair if pair is not None else \
+        _train_spec_pair()
     workload = make_spec_workload(ids)
     useful = sum(w["n_new"] for w in workload)
 
@@ -568,7 +588,7 @@ def run_spec(max_slots, spec_k=4):
         parity &= bool(np.array_equal(b.tokens, a.tokens))
 
     spec = snap_s["spec"]
-    return {
+    section = {
         "workload": {"requests": len(workload),
                      "useful_tokens": useful, "seed": 4},
         "pair": {"target_layers": 4, "draft_layers": 1,
@@ -584,6 +604,71 @@ def run_spec(max_slots, spec_k=4):
         "recompiles": (None if jit_before is None
                        else jit_after - jit_before),
         "parity": parity,
+    }
+    if return_baseline:
+        return section, (wall_p, outs_p)
+    return section
+
+
+def run_spec_sweep(max_slots, ks=(2, 4, 8), pair=None,
+                   baseline=None):
+    """The --spec-sweep measurement (VERDICT next-round #5):
+    characterize ACCEPTANCE vs throughput across spec_k ∈ {2, 4, 8}
+    on the same trained pair and the same decode-heavy workload, so
+    the crossover cost model in ``generate_speculative``'s docstring
+    has measured (tokens/s, acceptance, tokens/chunk) points per k
+    instead of a single operating point.  Expected shape: emitted
+    tokens/chunk saturate at ``1/(1 - acceptance)`` while draft cost
+    grows linearly in k, so tokens/s peaks at a finite k — where it
+    peaks is a property of the pair and the BACKEND's relative
+    draft/verify pricing, hence ``chip_pending: true`` (CPU prices
+    the k sequential draft steps differently from a chip).  Every
+    row keeps byte parity against the plain engine on the same
+    target.  ``pair``: share main()'s trained triple with --spec —
+    the 60 training steps are the expensive part."""
+    target, draft, ids = pair if pair is not None else \
+        _train_spec_pair()
+    workload = make_spec_workload(ids)
+    useful = sum(w["n_new"] for w in workload)
+
+    if baseline is not None:
+        # --spec already measured the identical plain-engine run on
+        # this pair and workload; reuse it instead of replaying
+        wall_p, outs_p = baseline
+    else:
+        run_engine(target, workload, max_slots,
+                   close_after=True)  # warmup
+        wall_p, outs_p, _ = run_engine(target, workload, max_slots,
+                                       close_after=True)
+    rows = []
+    for k in ks:
+        run_engine(target, workload, max_slots, close_after=True,
+                   draft_model=draft, spec_k=k)  # warmup (compiles)
+        wall, outs, snap = run_engine(target, workload, max_slots,
+                                      close_after=True,
+                                      draft_model=draft, spec_k=k)
+        parity = all(np.array_equal(a.tokens, b.tokens)
+                     for a, b in zip(outs, outs_p))
+        spec = snap["spec"]
+        rows.append({
+            "spec_k": k,
+            "wall_s": wall,
+            "tokens_per_s": useful / wall,
+            "speedup_tokens_per_s": wall_p / wall,
+            "acceptance_rate": spec["acceptance_rate"],
+            "accepted_tokens_per_chunk": spec["tokens_per_chunk"],
+            "parity": bool(parity),
+        })
+    return {
+        "workload": {"requests": len(workload),
+                     "useful_tokens": useful, "seed": 4},
+        "pair": {"target_layers": 4, "draft_layers": 1,
+                 "train_steps": 60},
+        "baseline_tokens_per_s": useful / wall_p,
+        "sweep": rows,
+        "crossover_model":
+            "gpt2_decode.generate_speculative docstring",
+        "chip_pending": True,  # CPU draft/verify pricing; PERF.md §10
     }
 
 
@@ -839,6 +924,13 @@ def main():
                          "plain engine on the same trained target "
                          "(tokens/s, acceptance, accepted-tokens/"
                          "chunk, parity, recompile pin)")
+    ap.add_argument("--spec-sweep", action="store_true",
+                    help="also sweep spec_k in {2,4,8} on the trained "
+                         "pair and embed the spec_sweep section "
+                         "(tokens/s vs measured acceptance per k, "
+                         "parity per row; chip-pending — VERDICT "
+                         "next-round #5's acceptance-sweep "
+                         "characterization)")
     ap.add_argument("--cache-int8", action="store_true",
                     help="also run the standard workload through an "
                          "int8-KV-arena engine (tokens/s, TTFT/TPOT "
@@ -994,8 +1086,22 @@ def main():
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(
             engine_snapshots=[snap], include_registry=False)
+    spec_pair = (_train_spec_pair()
+                 if (args.spec or args.spec_sweep) else None)
+    spec_baseline = None
     if args.spec:
-        report["spec"] = run_spec(max_slots)
+        if args.spec_sweep:
+            report["spec"], spec_baseline = run_spec(
+                max_slots, pair=spec_pair, return_baseline=True)
+        else:
+            report["spec"] = run_spec(max_slots, pair=spec_pair)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.spec_sweep:
+        report["spec_sweep"] = run_spec_sweep(max_slots,
+                                              pair=spec_pair,
+                                              baseline=spec_baseline)
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(
             engine_snapshots=[snap], include_registry=False)

@@ -674,6 +674,253 @@ def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2,
     return _logits(x, params), kc, vc
 
 
+# -- block-native paged decode attention (the gather-tax round) --------------
+# The serve engine's paged pool steps (serve/paged.py) used to gather
+# every live slot's blocks into a fixed (max_len)-wide row inside the
+# executable before attention ran — a transient O(max_len) workspace a
+# real PagedAttention kernel (vLLM) never allocates, and O(max_len)
+# attention work whatever the slot's actual length.  The kernel below
+# computes flash-style attention DIRECTLY over the block pool with the
+# block table as the index structure: a ``lax.fori_loop`` over the
+# slot's live blocks with online-softmax accumulation (running max,
+# rescaled partial sums — the FlashAttention recurrence), trash-block
+# and beyond-``pos`` lanes masked, the current step's K/V attended as
+# one extra lane (it is not in the pool yet).  The workspace drops to
+# O(block_size) and the loop runs ``ceil(pos / block)`` iterations, so
+# long-context slots stop paying for their own padding.
+#
+# Parity pins (docs/SERVING.md "Paged KV and preemption"): online
+# softmax REORDERS the float reduction, so bitwise equality to the
+# row-softmax gather path is impossible by construction — the contract
+# is (a) token streams identical to the gather path (and therefore to
+# the slot engine / offline oracles) away from exact argmax/CDF ties,
+# the same caveat TP serving documents for its psum, and (b) per-step
+# logits allclose to the gather oracle (tests/test_paged.py pins both,
+# plus byte equality of the untouched lanes of every written block —
+# the read-modify-write below keeps pool bytes round-tripping).  int8
+# pools dequantize PER BLOCK inside the accumulator (the same folded
+# scale placement as _block_decode: scores scale by kscale outside the
+# int8 contraction, probabilities by vscale before the value einsum).
+
+def _paged_attn(q, pool_k_l, pool_v_l, tbl, p_limit, n_blk, block,
+                trash, k_cur, v_cur, cur_mask, scale):
+    """Online-softmax attention of ``q`` (n_kv, g, Q, d) against one
+    slot's paged KV: pool lanes at positions < ``p_limit`` (blocks
+    ``tbl[0:n_blk]``; trash lanes masked) plus the current chunk's
+    keys ``k_cur``/``v_cur`` (n_kv, Q_k, d, quantized tuples on int8
+    pools) under ``cur_mask`` (Q, Q_k) — the chunk's own causal mask.
+    Accumulates in f32; returns (n_kv, g, Q, d)."""
+    quant = isinstance(pool_k_l, tuple)
+    qf = q.astype(jnp.float32)
+    n_kv, g, nq, d = qf.shape
+    m0 = jnp.full((n_kv, g, nq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n_kv, g, nq), jnp.float32)
+    a0 = jnp.zeros((n_kv, g, nq, d), jnp.float32)
+
+    def update(carry, sc, live, vb, vsc):
+        m, l, acc = carry
+        sc = jnp.where(live, sc, NEG_INF)
+        m2 = jnp.maximum(m, jnp.max(sc, axis=-1))
+        alpha = jnp.exp(m - m2)
+        pr = jnp.exp(sc - m2[..., None])
+        # explicit zero, not just NEG_INF scores: a fully-masked block
+        # leaves m2 at NEG_INF and exp(NEG_INF - NEG_INF) would be 1
+        pr = jnp.where(live, pr, 0.0)
+        l2 = l * alpha + jnp.sum(pr, axis=-1)
+        if vsc is not None:
+            pr = pr * vsc[:, None, None, :]
+        upd = jnp.einsum("kgqb,kbd->kgqd", pr, vb.astype(jnp.float32))
+        return m2, l2, acc * alpha[..., None] + upd
+
+    def body(j, carry):
+        blk = tbl[j]
+        if quant:
+            kb, ksc = pool_k_l[0][blk], pool_k_l[1][blk]
+            vb, vsc = pool_v_l[0][blk], pool_v_l[1][blk]
+            sc = jnp.einsum("kgqd,kbd->kgqb", qf,
+                            kb.astype(jnp.float32))
+            sc = sc * ksc[:, None, None, :] * scale
+        else:
+            kb, vb, vsc = pool_k_l[blk], pool_v_l[blk], None
+            sc = jnp.einsum("kgqd,kbd->kgqb", qf,
+                            kb.astype(jnp.float32)) * scale
+        lane = j * block + jnp.arange(block)
+        live = ((lane < p_limit) & (blk != trash))[None, None, None, :]
+        return update(carry, sc, live, vb, vsc)
+
+    carry = jax.lax.fori_loop(0, n_blk, body, (m0, l0, a0))
+    # the chunk's own keys — computed this step, not yet in the pool
+    if quant:
+        (kc, kcs), (vc, vcs) = k_cur, v_cur
+        sc = jnp.einsum("kgqd,kbd->kgqb", qf, kc.astype(jnp.float32))
+        sc = sc * kcs[:, None, None, :] * scale
+    else:
+        kc, vc, vcs = k_cur, v_cur, None
+        sc = jnp.einsum("kgqd,kbd->kgqb", qf,
+                        kc.astype(jnp.float32)) * scale
+    m, l, acc = update(carry, sc, cur_mask[None, None], vc, vcs)
+    return acc / l[..., None]
+
+
+def _paged_qkv(x, p, n_head, eps):
+    """The pre-attention half of a decode/chunk block, shared by the
+    paged kernels below: LN, projections, and the grouped-query
+    reshape.  x (1, Q, E) -> (q (n_kv, g, Q, d), k/v (n_kv, Q, d))
+    with n_kv the LOCAL kv-head count read off the weight widths
+    (which is also why no tp_world is needed here — shard-local
+    widths carry the layout)."""
+    _, nq, e = x.shape
+    d = e // n_head
+    h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
+    q = h @ p["wq"] + p["bq"]
+    k = h @ p["wk"] + p["bk"]
+    v = h @ p["wv"] + p["bv"]
+    n_kv = k.shape[-1] // d
+    g = q.shape[-1] // (n_kv * d)
+    q = q.reshape(nq, n_kv, g, d).transpose(1, 2, 0, 3)
+    k = k.reshape(nq, n_kv, d).transpose(1, 0, 2)
+    v = v.reshape(nq, n_kv, d).transpose(1, 0, 2)
+    return q, k, v
+
+
+def _block_decode_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
+                        n_head, eps, block, trash, moe_top_k=2,
+                        tp_axis=None, tp_world=1):
+    """One layer's block-native decode step: x (1, 1, E) at position
+    ``pos``, one layer's pool leaves ((N+1, H_kv, B, D) dense or
+    (values, scales)), ``tbl`` the slot's trash-padded block table.
+    Returns (x, kb, vb) where kb/vb are the UPDATED block containing
+    ``pos`` — a read-modify-write of one pool block (this step's K/V
+    row inserted at pos % block, every other lane a byte copy), which
+    is what the caller scatters back.  The attention itself never
+    materializes a row: O(block_size) workspace, ``n_blk`` loop
+    iterations (trash / beyond-``pos`` lanes masked)."""
+    quant = isinstance(pool_k_l, tuple)
+    _, _, e = x.shape
+    d = e // n_head        # full head dim: x is replicated under TP
+    q, k_new, v_new = _paged_qkv(x, p, n_head, eps)
+    if quant:
+        k_cur, v_cur = _quantize_kv(k_new), _quantize_kv(v_new)
+    else:
+        k_cur, v_cur = k_new, v_new
+    a = _paged_attn(q, pool_k_l, pool_v_l, tbl, pos, n_blk, block,
+                    trash, k_cur, v_cur,
+                    jnp.ones((1, 1), bool), 1.0 / math.sqrt(d))
+    a = a.astype(x.dtype).transpose(2, 0, 1, 3).reshape(
+        1, 1, e // tp_world)
+    x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
+    h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+    off = pos % block
+    cur = tbl[pos // block]
+
+    def rmw(pool_l, new):
+        b = pool_l[cur]
+        start = (0, off) + (0,) * (b.ndim - 2)
+        return jax.lax.dynamic_update_slice(b, new, start)
+
+    if quant:
+        kb = (rmw(pool_k_l[0], k_cur[0]), rmw(pool_k_l[1], k_cur[1]))
+        vb = (rmw(pool_v_l[0], v_cur[0]), rmw(pool_v_l[1], v_cur[1]))
+    else:
+        kb, vb = rmw(pool_k_l, k_cur), rmw(pool_v_l, v_cur)
+    return x, kb, vb
+
+
+def _block_chunk_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
+                       n_head, eps, block, trash, moe_top_k=2,
+                       tp_axis=None, tp_world=1):
+    """The chunk-query variant (speculative verify): x (1, K, E) at
+    positions ``pos..pos+K-1``.  Pool lanes < ``pos`` are visible to
+    every query; the chunk's own keys are causal within the chunk —
+    the same mask structure _block_chunk applies to its materialized
+    row.  Returns (x, kdbl, vdbl): the DOUBLE block (blocks pos // B
+    and (pos+K-1) // B concatenated on the position axis, K <= B so a
+    chunk spans at most two) with the chunk's K/V rows inserted at
+    pos % B — the caller splits and scatters the halves."""
+    quant = isinstance(pool_k_l, tuple)
+    _, klen, e = x.shape
+    d = e // n_head
+    q, k_new, v_new = _paged_qkv(x, p, n_head, eps)
+    if quant:
+        k_cur, v_cur = _quantize_kv(k_new), _quantize_kv(v_new)
+    else:
+        k_cur, v_cur = k_new, v_new
+    cur_mask = jnp.tril(jnp.ones((klen, klen), bool))
+    a = _paged_attn(q, pool_k_l, pool_v_l, tbl, pos, n_blk, block,
+                    trash, k_cur, v_cur, cur_mask,
+                    1.0 / math.sqrt(d))
+    a = a.astype(x.dtype).transpose(2, 0, 1, 3).reshape(
+        1, klen, e // tp_world)
+    x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
+    h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+    b0 = pos // block
+    b1 = (pos + klen - 1) // block
+    off = pos % block
+
+    def rmw2(pool_l, new):
+        dd = jnp.concatenate([pool_l[tbl[b0]], pool_l[tbl[b1]]],
+                             axis=1)
+        start = (0, off) + (0,) * (dd.ndim - 2)
+        return jax.lax.dynamic_update_slice(dd, new, start)
+
+    if quant:
+        kdbl = (rmw2(pool_k_l[0], k_cur[0]),
+                rmw2(pool_k_l[1], k_cur[1]))
+        vdbl = (rmw2(pool_v_l[0], v_cur[0]),
+                rmw2(pool_v_l[1], v_cur[1]))
+    else:
+        kdbl, vdbl = rmw2(pool_k_l, k_cur), rmw2(pool_v_l, v_cur)
+    return x, kdbl, vdbl
+
+
+def decode_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
+                      n_head, eps, *, block, trash, moe_top_k=2,
+                      tp_axis=None, tp_world=1):
+    """PUBLIC block-native single-step decode (the paged serve
+    engine's hot path; serve/paged.py ``_paged_decode_kernel``).
+    ``x``: (1, 1, E) embedded input at ``pos``; ``pool_k/v``: the full
+    (L, N+1, H_kv, B, D) pools (int8 pools are (values, scales));
+    ``tbl``: (W//B,) trash-padded block table; ``n_blk``: loop bound —
+    any traced value >= ceil(pos / block) (the pool-step wrapper
+    passes the max over live slots so one executable serves the whole
+    pool).  Returns ((1, V) logits, kb, vb) with kb/vb the updated
+    (L, H_kv, B, D)-stacked blocks containing ``pos``."""
+    kbs, vbs = [], []
+    for li, p in enumerate(params["blocks"]):
+        x, kb, vb = _block_decode_paged(
+            x, p, _cache_layer(pool_k, li), _cache_layer(pool_v, li),
+            tbl, pos, n_blk, n_head, eps, block, trash,
+            moe_top_k=moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+        kbs.append(kb)
+        vbs.append(vb)
+    x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
+    return _logits(x, params)[:, 0], _cache_stack(kbs), \
+        _cache_stack(vbs)
+
+
+def chunk_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
+                     n_head, eps, *, block, trash, moe_top_k=2,
+                     tp_axis=None, tp_world=1):
+    """PUBLIC block-native chunk advance (speculative verify against
+    the pool; serve/paged.py ``_paged_spec_kernel``).  ``x``:
+    (1, K, E) embedded chunk at ``pos..pos+K-1``.  Returns
+    ((1, K, V) logits, kdbl, vdbl) with the double blocks
+    (L, H_kv, 2B, D)-stacked — the caller splits the halves and
+    scatters them at ``tbl[pos // B]`` / ``tbl[(pos+K-1) // B]``."""
+    kds, vds = [], []
+    for li, p in enumerate(params["blocks"]):
+        x, kd, vd = _block_chunk_paged(
+            x, p, _cache_layer(pool_k, li), _cache_layer(pool_v, li),
+            tbl, pos, n_blk, n_head, eps, block, trash,
+            moe_top_k=moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+        kds.append(kd)
+        vds.append(vd)
+    x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
+    return _logits(x, params), _cache_stack(kds), _cache_stack(vds)
+
+
 def spec_verify(t_logits, d_probs, props, key, temp, top_p, top_k,
                 use_top_p):
     """Rejection-sampling chunk verify — the sampled half of
@@ -1435,7 +1682,13 @@ def generate_speculative(target, draft, prompt_ids, max_new_tokens=20,
     acceptance (< ~0.3 at spec_k=4) or an expensive draft (r < 2)
     means the unrolled sequential loop is the faster choice; raising
     spec_k helps only while acceptance stays high (expected emitted
-    tokens saturate at ``1/(1−acceptance)``).  The serve engine
+    tokens saturate at ``1/(1−acceptance)``).  Measured points for
+    this model: ``bench_serve.py --spec-sweep`` runs spec_k ∈
+    {2, 4, 8} on a trained pair and commits tokens/s vs measured
+    acceptance per k to BENCH_SERVE.json (the ``spec_sweep``
+    section, ``chip_pending`` — CPU prices the k sequential draft
+    steps differently from a chip, so the peak-k is ratified on
+    hardware).  The serve engine
     exposes the same trade via ``model.serve(draft_model=,
     spec_k=)``, where per-engine ``serve.spec.{accepted,drafted}``
     metrics measure the realized acceptance on live traffic; sampled

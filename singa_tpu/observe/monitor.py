@@ -420,7 +420,14 @@ class MfuMeter:
         self._last = (now, steps)
         rate = (steps - s0) / dt if dt > 0 else float("nan")
         f = step_flops()
-        model_fps = f * rate  # nan propagates from either factor
+        # a ZERO-step interval (a process serving, checkpointing, or
+        # between phases) publishes nan, not a hard 0.0: a busy
+        # process must never read as 0 flops/s, and model_flops_per_s
+        # and mfu must go honest-nan TOGETHER — unknown-peak backends
+        # used to report flops 0.0 next to mfu null, an inconsistent
+        # pair (the committed BENCH_SERVE health.train bug)
+        model_fps = (f * rate if steps != s0
+                     else float("nan"))  # nan propagates from f/rate
         peak = peak_flops()
         mfu = model_fps / peak  # nan when peak unknown (CPU)
         self._g_flops.set(model_fps)

@@ -93,7 +93,8 @@ from ..observe import trace as _trace
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
 from .paged import (PagedConfig, PagedKVArena, _aot_call,
-                    _paged_decode_step, _paged_spec_step)
+                    _paged_decode_kernel, _paged_decode_step,
+                    _paged_spec_kernel, _paged_spec_step)
 from .prefix import (PrefixCache, PrefixCacheConfig, SessionHandle,
                      _read_slot)
 from .request import (DeadlineExceededError, EngineFailedError,
@@ -193,6 +194,39 @@ def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
 
 
 @partial(jax.jit,
+         static_argnames=("n_head", "eps", "moe_top_k", "top_k",
+                          "use_top_p", "quant", "tp_axis", "tp_world"))
+def _prefill_batch(params, ids, plens, seeds, temps, top_p, n_head,
+                   eps, moe_top_k, top_k, use_top_p, quant=False,
+                   tp_axis=None, tp_world=1):
+    """BATCHED cold admission (the gather-tax round): R requests'
+    prefills in ONE dispatch — ids (R, W) right-padded at the pass's
+    shared narrow width, plens/seeds/temps (R,).  vmaps the exact
+    :func:`_prefill_one` row body (key chain included: PRNGKey(seed)
+    -> split -> sample/carry, moved inside the executable), so every
+    row's (tok0, carried key, cache rows) is BITWISE the per-request
+    call's — pinned by tests/test_paged.py::test_prefill_batch
+    _bitwise_equals_single.  One scheduling pass that admits K
+    requests pays one dispatch + one host sync instead of K, which
+    is what keeps an arrival burst from stalling live decode lanes
+    (the paged bench's TPOT tax).  Returns (tok0 (R,), keys (R, 2),
+    kc rows (L, R, H, W, D), vc rows) — the caller scatters each
+    row's lanes into its freshly-allocated blocks."""
+    def row(ids_r, plen, seed, temp):
+        key0 = jax.random.split(jax.random.PRNGKey(seed), 1)[0]
+        return _prefill_one.__wrapped__(
+            params, ids_r[None], plen, key0, temp, top_p, n_head,
+            eps, moe_top_k, top_k, use_top_p, quant=quant,
+            tp_axis=tp_axis, tp_world=tp_world)
+
+    tok0, keys, kc, vc = jax.vmap(row, in_axes=(0, 0, 0, 0),
+                                  out_axes=(0, 0, 1, 1))(
+        ids, plens, seeds, temps)
+    sq = lambda a: a[:, :, 0]   # drop the vmapped rows' B=1 axis
+    return tok0, keys, jax.tree.map(sq, kc), jax.tree.map(sq, vc)
+
+
+@partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "quant"))
 def _prefill_rows(params, ids, n_head, eps, moe_top_k, quant=False):
     """DRAFT-side admission prefill: cache rows only, no sampling (the
@@ -244,31 +278,27 @@ def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
     return tok0, ks[1]
 
 
-def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
-              live_r, key, temp, top_p, spec_k, tn, te, tm, dn, de, dm,
-              top_k, use_top_p, tp_axis=None, tp_world=1):
-    """ONE slot's speculative-chunk math: ``spec_k`` sequential DRAFT
-    decode steps propose ``spec_k - 1`` tokens (the extra step
-    processes the last proposal as an input so a full-accept chunk
-    leaves the draft cache a valid row ahead — the same trick as the
-    offline ``_spec_row``), then ONE target chunk advance
-    (``_advance_chunk`` — a single cache read serves all ``spec_k``
-    positions), then :func:`~singa_tpu.models.gpt2_decode.spec_verify`
-    decides the accept count: greedy match for ``temp <= 0`` rows,
-    rejection sampling with residual resample for sampled rows — both
-    in the SAME executable (temp is traced, like ``_select_sample``).
-    Shared by the slot-arena spec step and the paged spec step
-    (serve/paged.py) — one definition, no drift."""
-    p_c = jnp.where(live_r, pos_r, 0)
-    t_c = jnp.where(live_r, tok, 0)
+def _batch1(c):
+    """Insert the width-1 batch axis on a cache pytree (dense arrays
+    or (values, scales) tuples)."""
+    return jax.tree.map(lambda a: a[:, None], c)
 
-    def batch(c):
-        return jax.tree.map(lambda a: a[:, None], c)
 
-    def unbatch(c):
-        return jax.tree.map(lambda a: a[:, 0], c)
+def _unbatch1(c):
+    return jax.tree.map(lambda a: a[:, 0], c)
 
-    k_draft, k_verify, k_next = jax.random.split(key, 3)
+
+def _draft_propose(d_params, dkc_r, dvc_r, t_c, p_c, k_draft, temp,
+                   top_p, spec_k, dn, de, dm, top_k, use_top_p):
+    """The DRAFT half of one slot's speculative chunk: ``spec_k``
+    sequential draft decode steps propose ``spec_k - 1`` tokens (the
+    extra step processes the last proposal as an input so a
+    full-accept chunk leaves the draft cache a valid row ahead — the
+    same trick as the offline ``_spec_row``).  Shared by the
+    slot-arena spec row and the paged-kernel spec row, so the
+    proposal chain (and therefore the verify outcome) cannot drift
+    between memory models.  Returns (props (spec_k-1,), d_probs
+    (spec_k-1, V), dkc_b, dvc_b) with the draft rows batched."""
     ts = jnp.maximum(temp, 1e-6)
 
     def dstep(c, k):
@@ -288,9 +318,30 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
 
     dkeys = jax.random.split(k_draft, spec_k)
     (dkc_b, dvc_b, _, _), (props_all, q_all) = jax.lax.scan(
-        dstep, (batch(dkc_r), batch(dvc_r), t_c, p_c), dkeys)
-    props = props_all[:-1]                      # (spec_k - 1,)
-    d_probs = q_all[:-1]                        # (spec_k - 1, V)
+        dstep, (_batch1(dkc_r), _batch1(dvc_r), t_c, p_c), dkeys)
+    return props_all[:-1], q_all[:-1], dkc_b, dvc_b
+
+
+def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
+              live_r, key, temp, top_p, spec_k, tn, te, tm, dn, de, dm,
+              top_k, use_top_p, tp_axis=None, tp_world=1):
+    """ONE slot's speculative-chunk math: the shared draft proposal
+    scan (:func:`_draft_propose`), then ONE target chunk advance
+    (``_advance_chunk`` — a single cache read serves all ``spec_k``
+    positions), then :func:`~singa_tpu.models.gpt2_decode.spec_verify`
+    decides the accept count: greedy match for ``temp <= 0`` rows,
+    rejection sampling with residual resample for sampled rows — both
+    in the SAME executable (temp is traced, like ``_select_sample``).
+    Shared by the slot-arena spec step and the paged GATHER spec step
+    (serve/paged.py) — one definition, no drift; the paged BLOCK
+    kernel's row is :func:`_spec_row_paged` below (same draft scan
+    and verify, chunk-query block-native target attention)."""
+    p_c = jnp.where(live_r, pos_r, 0)
+    t_c = jnp.where(live_r, tok, 0)
+    k_draft, k_verify, k_next = jax.random.split(key, 3)
+    props, d_probs, dkc_b, dvc_b = _draft_propose(
+        d_params, dkc_r, dvc_r, t_c, p_c, k_draft, temp, top_p,
+        spec_k, dn, de, dm, top_k, use_top_p)
 
     chunk_toks = jnp.concatenate([t_c[None], props])
     xs = (jnp.take(t_params["wte"], chunk_toks, axis=0)
@@ -300,14 +351,78 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
     # scan above runs replicated on every shard (same inputs → same
     # proposals bitwise), which is what keeps any draft geometry legal
     # whatever the tp width
-    lg, kc2, vc2 = _advance_chunk(t_params, xs, batch(kc_r),
-                                  batch(vc_r), p_c, tn, te,
+    lg, kc2, vc2 = _advance_chunk(t_params, xs, _batch1(kc_r),
+                                  _batch1(vc_r), p_c, tn, te,
                                   moe_top_k=tm, tp_axis=tp_axis,
                                   tp_world=tp_world)
     out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
                                temp, top_p, top_k, use_top_p)
-    return (out, a_draft, unbatch(kc2), unbatch(vc2),
-            unbatch(dkc_b), unbatch(dvc_b), k_next)
+    return (out, a_draft, _unbatch1(kc2), _unbatch1(vc2),
+            _unbatch1(dkc_b), _unbatch1(dvc_b), k_next)
+
+
+def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
+                      key, temp, top_p, n_blk, block, trash, n_head,
+                      eps, moe_top_k, top_k, use_top_p, tp_axis=None,
+                      tp_world=1):
+    """ONE slot's BLOCK-NATIVE decode-step math (the gather-tax
+    round): same embed / sample chain as :func:`_decode_row`, but the
+    attention runs directly over the block pool through
+    ``gpt2_decode.decode_step_paged`` — no materialized row, and the
+    only cache state returned is the one (L, H_kv, B, D) block the
+    step wrote (read-modify-write, so untouched lanes stay byte
+    copies).  Logits agree with the gather path to float
+    reduction-order (online softmax), which is token-identity away
+    from exact argmax/CDF ties — the parity pin tests/test_paged.py
+    holds the kernel to."""
+    from ..models.gpt2_decode import decode_step_paged
+
+    p_c = jnp.where(live_r, pos_r, 0)
+    t_c = jnp.where(live_r, tok, 0)
+    x = (params["wte"][t_c] + params["wpe"][p_c])[None, None, :]
+    logits, kb, vb = decode_step_paged(
+        params, x, pool_k, pool_v, tbl, p_c, n_blk, n_head, eps,
+        block=block, trash=trash, moe_top_k=moe_top_k,
+        tp_axis=tp_axis, tp_world=tp_world)
+    ks = jax.random.split(key)
+    nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
+                         use_top_p)
+    return nxt, kb, vb, ks[1]
+
+
+def _spec_row_paged(t_params, d_params, pool_k, pool_v, dkc_r, dvc_r,
+                    tbl, tok, pos_r, live_r, key, temp, top_p, n_blk,
+                    spec_k, block, trash, tn, te, tm, dn, de, dm,
+                    top_k, use_top_p, tp_axis=None, tp_world=1):
+    """ONE slot's BLOCK-NATIVE speculative chunk: the SAME draft
+    proposal scan and the SAME ``spec_verify`` as :func:`_spec_row`
+    (shared helpers — the accept logic cannot drift), with the target
+    chunk advance running block-natively over the pool
+    (``gpt2_decode.chunk_step_paged`` — the chunk-query variant of
+    the online-softmax accumulator).  Returns the DOUBLE blocks the
+    chunk wrote (kdbl/vdbl, (L, H_kv, 2B, D)-stacked); the pool step
+    splits the halves and scatters them."""
+    from ..models.gpt2_decode import chunk_step_paged
+
+    p_c = jnp.where(live_r, pos_r, 0)
+    t_c = jnp.where(live_r, tok, 0)
+    k_draft, k_verify, k_next = jax.random.split(key, 3)
+    props, d_probs, dkc_b, dvc_b = _draft_propose(
+        d_params, dkc_r, dvc_r, t_c, p_c, k_draft, temp, top_p,
+        spec_k, dn, de, dm, top_k, use_top_p)
+
+    chunk_toks = jnp.concatenate([t_c[None], props])
+    xs = (jnp.take(t_params["wte"], chunk_toks, axis=0)
+          + jnp.take(t_params["wpe"],
+                     p_c + jnp.arange(spec_k), axis=0))[None]
+    lg, kdbl, vdbl = chunk_step_paged(
+        t_params, xs, pool_k, pool_v, tbl, p_c, n_blk, tn, te,
+        block=block, trash=trash, moe_top_k=tm, tp_axis=tp_axis,
+        tp_world=tp_world)
+    out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
+                               temp, top_p, top_k, use_top_p)
+    return (out, a_draft, kdbl, vdbl,
+            _unbatch1(dkc_b), _unbatch1(dvc_b), k_next)
 
 
 @partial(jax.jit,
@@ -341,6 +456,29 @@ def _pool_spec_step(t_params, d_params, kc, vc, dkc, dvc, toks, pos,
         kc, vc, dkc, dvc, toks, pos, live, keys, temps)
 
 
+@jax.jit
+def _take_rows(a, idx):
+    """Jitted row gather — the compacted paged dispatch's key-table
+    select.  One jitted call instead of an eager op: eager jnp
+    dispatches carry ~2-3x the per-call overhead, which is real money
+    on the per-step path."""
+    return jnp.take(a, idx, axis=0)
+
+
+@jax.jit
+def _set_rows(a, idx, vals):
+    """Jitted row scatter (key-table write-back) — same eager-op
+    avoidance as :func:`_take_rows`."""
+    return a.at[idx].set(vals)
+
+
+@jax.jit
+def _merge_keys(keys_tbl, keys_b, idxs, rs):
+    """One-dispatch key flush for a batched admission pass: rows
+    ``rs`` of the pass's carried keys land at slots ``idxs``."""
+    return keys_tbl.at[idxs].set(jnp.take(keys_b, rs, axis=0))
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _write_slot(kc_arena, vc_arena, kc_row, vc_row, slot):
     """Install an admitted request's prefilled cache rows at ``slot``
@@ -366,6 +504,7 @@ class _LocalExec:
 
     def __init__(self, eng):
         self._e = eng
+        self._aot_memo = {}   # (name, width) -> full AOT cache key
 
     def pool_decode_step(self, params, kc, vc, toks, pos, live, keys,
                          temps, top_p):
@@ -386,20 +525,31 @@ class _LocalExec:
                                use_top_p=st["use_top_p"])
 
     def paged_decode_step(self, params, pool_k, pool_v, tables, toks,
-                          pos, live, keys, temps, top_p, block):
-        return _aot_call("paged_decode_step", _paged_decode_step,
+                          pos, live, keys, temps, top_p, block,
+                          kernel="block"):
+        name, fn = (("paged_decode_kernel", _paged_decode_kernel)
+                    if kernel == "block"
+                    else ("paged_decode_step", _paged_decode_step))
+        return _aot_call(name, fn,
                          params, pool_k, pool_v, tables, toks, pos,
                          live, keys, temps, top_p, block=block,
+                         _memo=self._aot_memo,
+                         _token=(name, toks.shape[0]),
                          **self._e._statics)
 
     def paged_spec_step(self, t_params, d_params, pool_k, pool_v, dkc,
                         dvc, tables, toks, pos, live, keys, temps,
-                        top_p, block):
+                        top_p, block, kernel="block"):
         e = self._e
         st = e._statics
-        return _aot_call("paged_spec_step", _paged_spec_step,
+        name, fn = (("paged_spec_kernel", _paged_spec_kernel)
+                    if kernel == "block"
+                    else ("paged_spec_step", _paged_spec_step))
+        return _aot_call(name, fn,
                          t_params, d_params, pool_k, pool_v, dkc, dvc,
                          tables, toks, pos, live, keys, temps, top_p,
+                         _memo=self._aot_memo,
+                         _token=(name, toks.shape[0]),
                          block=block, spec_k=e.spec_k,
                          tn=st["n_head"], te=st["eps"],
                          tm=st["moe_top_k"], dn=e._d_statics[0],
@@ -411,6 +561,11 @@ class _LocalExec:
         e = self._e
         return _prefill_one(params, ids, prompt_len, key, temp, top_p,
                             **e._statics, quant=e._quant)
+
+    def prefill_batch(self, params, ids, plens, seeds, temps, top_p):
+        e = self._e
+        return _prefill_batch(params, ids, plens, seeds, temps,
+                              top_p, **e._statics, quant=e._quant)
 
     def chunk_row(self, params, ids, kc_row, vc_row, off):
         return _chunk_row(params, ids, kc_row, vc_row, off,
@@ -505,8 +660,14 @@ class InferenceEngine:
     the engine PREEMPTS (swap a lower-priority request's blocks to
     host, resume byte-identically later) instead of stalling.  Pair
     with ``scheduler="priority"`` so urgent arrivals overtake and
-    preempt background work.  Token streams stay bitwise identical to
-    the slot engine's — both vmap the same per-row math."""
+    preempt background work.  Decode runs the BLOCK-NATIVE
+    online-softmax kernel by default (``PagedConfig.kernel``),
+    admissions prefill at narrow widths and batch per scheduling
+    pass, and the pool step dispatches at a compacted width covering
+    only the live slots — token streams stay identical to the slot
+    engine's (bitwise under ``kernel="gather"``; token-identical
+    with an allclose logits pin under the kernel — docs/SERVING.md
+    "Paged KV and preemption" has the full pin taxonomy)."""
 
     def __init__(self, model, max_slots=8, max_len=None, dtype=None,
                  scheduler=None, top_k=0, top_p=None,
@@ -713,6 +874,14 @@ class InferenceEngine:
             self._keys = self.tp_exec.place_replicated(self._keys)
         self._handles = {}
         self._swapped = []                  # paged mode: _Swapped list
+        # batched-admission deferral (the gather-tax round): one
+        # scheduling pass's prefilled rows (_prefill_admissions) plus
+        # the per-request scatter/key writes deferred onto them —
+        # flushed as ONE pool scatter + ONE key write per pass
+        self._admit_batch = None            # (keys, kc, vc) device
+        self._pending_scatter = []          # [(batch row, lanes dict)]
+        self._pending_keys = []             # [(slot idx, batch row)]
+        self._batch_cache = None            # last pass's pure batch
         self._swap_seq = itertools.count()
         self._closed = False
         self._failed = False
@@ -1006,6 +1175,16 @@ class InferenceEngine:
         arena and params stay allocated until ``close()`` — the
         supervisor reads nothing from them, but a debugger might."""
         self._failed = True
+        # drop any deferred admission writes FIRST: the teardown loop
+        # below frees blocks (whose _free_slot_blocks guard would
+        # otherwise re-run the very flush that may have just raised —
+        # a second raise mid-loop would abandon the remaining handles,
+        # breaking the no-dangling-handle contract), and a failing
+        # engine's pool state is garbage to be released, not written
+        self._pending_scatter = []
+        self._pending_keys = []
+        self._admit_batch = None
+        self._batch_cache = None
         step = self.step_count
         msg = f"engine failed at step {step}: {cause!r}"
         self._log.error("%s — rejecting %d in-flight and %d queued "
@@ -1162,6 +1341,10 @@ class InferenceEngine:
         _hb_t0 = time.perf_counter() if _mon else 0.0
         a_draft = None
         arena = self.paged_arena
+        # (speculative paged steps run at full width: the DRAFT arena
+        # is slot-indexed — compacting would have to gather/scatter
+        # draft cache rows per step, which is exactly the copy tax
+        # the block tables exist to avoid on the target side)
         if self.draft is not None:
             with _trace.span("serve/spec_step", cat="serve",
                              step=self.step_count, live=n_live,
@@ -1175,7 +1358,8 @@ class InferenceEngine:
                         self._block_tables(), jnp.asarray(self._toks),
                         jnp.asarray(self._pos), jnp.asarray(live),
                         self._keys, jnp.asarray(self._temps),
-                        self._top_p, arena.block_size)
+                        self._top_p, arena.block_size,
+                        kernel=arena.config.kernel)
                 else:
                     (out, a_draft, self._kc, self._vc, self._dkc,
                      self._dvc, self._keys) = self._x.pool_spec_step(
@@ -1192,13 +1376,53 @@ class InferenceEngine:
                              step=self.step_count, live=n_live,
                              paged=arena is not None):
                 if arena is not None:
-                    (next_toks, arena.pool_k, arena.pool_v,
-                     self._keys) = self._x.paged_decode_step(
-                        self._params, arena.pool_k, arena.pool_v,
-                        self._block_tables(), jnp.asarray(self._toks),
-                        jnp.asarray(self._pos), jnp.asarray(live),
-                        self._keys, jnp.asarray(self._temps),
-                        self._top_p, arena.block_size)
+                    # COMPACTED dispatch (the gather-tax round): run
+                    # the pool step at the smallest width bucket
+                    # covering the live slots instead of always at
+                    # max_slots.  Legal precisely because the pool is
+                    # paged — block tables address the KV, so a lane
+                    # permutation is pure host bookkeeping (per-slot
+                    # math is lane-independent; pad lanes are dead:
+                    # clamped inputs, trash-table writes, keys never
+                    # written back).  An over-provisioned engine
+                    # (many slots, few live) stops paying dead-lane
+                    # MLP/vocab/sampling work per step.
+                    lanes = np.flatnonzero(live)
+                    width = self._paged_width(len(lanes))
+                    if width < self.max_slots:
+                        sel = np.full(width, -1, np.intp)
+                        sel[:len(lanes)] = lanes
+                        live_w = np.zeros(width, bool)
+                        live_w[:len(lanes)] = True
+                        sel_in = np.where(sel < 0, 0, sel)
+                        keys_w = _take_rows(self._keys,
+                                            jnp.asarray(sel_in))
+                        (nt_w, arena.pool_k, arena.pool_v,
+                         keys2) = self._x.paged_decode_step(
+                            self._params, arena.pool_k, arena.pool_v,
+                            self._block_tables(list(sel)),
+                            jnp.asarray(self._toks[sel_in]),
+                            jnp.asarray(self._pos[sel_in]),
+                            jnp.asarray(live_w), keys_w,
+                            jnp.asarray(self._temps[sel_in]),
+                            self._top_p, arena.block_size,
+                            kernel=arena.config.kernel)
+                        self._keys = _set_rows(
+                            self._keys, jnp.asarray(lanes),
+                            keys2[:len(lanes)])
+                        next_toks = np.zeros(self.max_slots, np.int32)
+                        next_toks[lanes] = \
+                            np.asarray(nt_w)[:len(lanes)]
+                    else:
+                        (next_toks, arena.pool_k, arena.pool_v,
+                         self._keys) = self._x.paged_decode_step(
+                            self._params, arena.pool_k, arena.pool_v,
+                            self._block_tables(),
+                            jnp.asarray(self._toks),
+                            jnp.asarray(self._pos), jnp.asarray(live),
+                            self._keys, jnp.asarray(self._temps),
+                            self._top_p, arena.block_size,
+                            kernel=arena.config.kernel)
                 else:
                     next_toks, self._kc, self._vc, self._keys = \
                         self._x.pool_decode_step(
@@ -1349,22 +1573,55 @@ class InferenceEngine:
     def _free_slot_blocks(self, slot):
         """Teardown for a paged slot that will not retire normally:
         free its private blocks (shared prefix blocks are only
-        ref-released, by ``_release_prefix``)."""
+        ref-released, by ``_release_prefix``).  Deferred admission
+        writes flush FIRST: a block freed here could be re-allocated
+        by a later same-pass admission, and a pending scatter landing
+        after that would clobber the new owner."""
+        if self._pending_scatter or self._pending_keys:
+            self._flush_admission_writes()
         if self.paged_arena is not None and slot.blocks:
             self.paged_arena.free(slot.blocks[slot.n_shared:])
             slot.blocks = []
 
-    def _block_tables(self):
+    def _block_tables(self, idxs=None):
         """The (S, W//B) int32 block-table input of the paged pool
         steps: each live slot's block list, trash-padded (dead slots
-        are all-trash, so their writes land in the trash block)."""
+        are all-trash, so their writes land in the trash block).
+        ``idxs``: optional slot-id row order for a COMPACTED step
+        (entries < 0 are pad lanes — all-trash rows)."""
         arena = self.paged_arena
-        tables = np.full((self.max_slots, arena.row_blocks),
+        rows = (range(self.max_slots) if idxs is None else idxs)
+        tables = np.full((len(rows), arena.row_blocks),
                          arena.trash, np.int32)
-        for i, slot in enumerate(self._slots):
+        for r, i in enumerate(rows):
+            slot = self._slots[i] if i >= 0 else None
             if slot is not None:
-                tables[i, :len(slot.blocks)] = slot.blocks
+                tables[r, :len(slot.blocks)] = slot.blocks
         return jnp.asarray(tables)
+
+    def _paged_width(self, n_live):
+        """Decode-dispatch width for ``n_live`` live slots: the
+        smallest HALVING bucket of ``max_slots`` still covering them
+        ({S, S/2, S/4, ...} — one compiled signature per bucket,
+        ~log2(S) of them, all covered by a warmup pass over the same
+        workload, since the live trajectory is deterministic).
+        The paged pool makes this free: KV is addressed by BLOCK
+        TABLES, not by slot index, so a step over any subset of slots
+        is just a shorter table/token batch — no cache rows move.
+        The slot arena cannot compact (its KV is indexed by slot),
+        which is why over-provisioned paged engines stop paying the
+        dead-lane tax the moment occupancy sits below the peak — the
+        per-step decode cost is COMPUTE-bound in the lane count
+        (MLP + vocab per lane), so width tracks occupancy nearly 1:1
+        in step time.  Halving (not a finer ladder) is deliberate:
+        each sub-width step pays two small key-compaction dispatches,
+        so buckets must buy a real width drop to be worth switching
+        (measured: a 3/4 ladder was net SLOWER at the bench
+        geometry)."""
+        w = self.max_slots
+        while w >= 2 and w >= 2 * n_live:
+            w //= 2
+        return max(w, n_live)
 
     def _grow_live_slots(self):
         """Block-by-block growth: before the pool step dispatches,
@@ -1455,6 +1712,12 @@ class InferenceEngine:
         serve/paged.py's module docstring for why recompute-on-resume
         could not promise that."""
         arena = self.paged_arena
+        # a same-pass admission's deferred writes must land before
+        # this gather reads the pool (and before self._keys[idx] is
+        # snapshotted below) — the victim could be a slot admitted
+        # earlier in the very pass that is now preempting
+        if self._pending_scatter or self._pending_keys:
+            self._flush_admission_writes()
         slot = self._slots[idx]
         req = slot.handle.request
         rid = req.request_id
@@ -1668,11 +1931,20 @@ class InferenceEngine:
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free and self.scheduler.queue_depth == 0:
             return
+        navail = len(free)
+        if self.paged_arena is not None \
+                and self.paged_arena.config.admit_per_step is not None:
+            # admission interleave budget (PagedConfig.admit_per_step):
+            # bound prefills per pass so an arrival burst cannot stall
+            # every live slot's decode cadence behind a wall of
+            # admissions — the same total prefill work, spread
+            navail = min(navail,
+                         self.paged_arena.config.admit_per_step)
         if self._sched_cost is not None:
             admit, expired = self.scheduler.schedule(
-                len(free), now, cost=self._sched_cost)
+                navail, now, cost=self._sched_cost)
         else:
-            admit, expired = self.scheduler.schedule(len(free), now)
+            admit, expired = self.scheduler.schedule(navail, now)
         for req in expired:
             self.stats.on_deadline_expired(req.request_id)
             _trace.event("serve/request_rejected", cat="serve",
@@ -1694,10 +1966,56 @@ class InferenceEngine:
         # still overtake (they outrank it for preemption anyway)
         blocked_p = (max(sw.priority for sw in self._swapped)
                      if self._swapped else None)
+        # BATCHED pass prefill (the gather-tax round): a multi-request
+        # pass on a cold paged engine (no prefix cache to consult, no
+        # draft rows to build) prefills every admission in ONE
+        # dispatch + one host sync up front, so an arrival burst costs
+        # the live decode lanes one prefill's latency instead of K —
+        # the computation is pure (block allocation happens per
+        # request below), so a request that ultimately requeues only
+        # wasted its row, never pool state
+        # only the prefix that will actually be admitted is worth
+        # prefilling: admission order blocks at the first request a
+        # swapped higher-priority request outranks, so batching past
+        # it would pay a whole discarded dispatch + sync EVERY pass
+        # for as long as the blockage lasts
+        batchable = admit
+        if blocked_p is not None:
+            batchable = []
+            for r in admit:
+                if getattr(r, "priority", 0) <= blocked_p:
+                    break
+                batchable.append(r)
+        prefilled = {}
+        if (self.paged_arena is not None and self.draft is None
+                and self.prefix_cache is None and len(batchable) > 1
+                # int32 seed lanes: an exotic >= 2^31 seed keeps the
+                # per-request path (identical streams either way — the
+                # batch must never silently rekey a request)
+                and all(0 <= int(r.seed) < 2 ** 31 for r in batchable)):
+            # prefilled rows are PURE functions of (prompt, seed,
+            # temp): when a capacity-blocked pass requeues the same
+            # requests, reuse the batch instead of re-dispatching it
+            # every step for as long as the blockage lasts.  Keyed on
+            # request OBJECT identity (the cache holds the refs, so
+            # an id cannot be recycled under it); any change in the
+            # pass's membership recomputes
+            cached = self._batch_cache
+            if (cached is not None
+                    and len(cached[0]) == len(batchable)
+                    and all(a is b
+                            for a, b in zip(cached[0], batchable))):
+                prefilled, self._admit_batch = cached[1], cached[2]
+            else:
+                prefilled = self._prefill_admissions(batchable)
+                self._batch_cache = (tuple(batchable), prefilled,
+                                     self._admit_batch)
         for k, req in enumerate(admit):
             if (blocked_p is not None
                     and getattr(req, "priority", 0) <= blocked_p) \
-                    or not self._admit(free.pop(0), req, now):
+                    or not self._admit(free.pop(0), req, now,
+                                       prefilled=prefilled.get(
+                                           req.request_id)):
                 # capacity block: the head request's blocks do not fit
                 # even after eviction + priority preemption (or a
                 # swapped request outranks it).  Push it AND
@@ -1707,6 +2025,14 @@ class InferenceEngine:
                 for r in reversed(admit[k:]):
                     self.scheduler.requeue_front(r)
                 break
+        else:
+            # every scheduled request admitted: the cached pass batch
+            # can never recur, so release its device rows — without
+            # this, one large burst's stacked prefill KV would stay
+            # pinned for the engine's lifetime
+            self._batch_cache = None
+        if self._admit_batch is not None:
+            self._flush_admission_writes(drop_batch=True)
 
     def _prefill_cost(self, req):
         """Scheduler interleave price of admitting ``req`` now: 0 for
@@ -1722,11 +2048,82 @@ class InferenceEngine:
             return 0
         return 1
 
-    def _admit(self, idx, req, now):
+    def _prefill_admissions(self, reqs):
+        """One batched prefill dispatch for a scheduling pass's cold
+        paged admissions (:func:`_prefill_batch`): all R requests ride
+        one (R, W) executable at the pass's shared narrow width (the
+        largest per-request block-multiple width — rows are bitwise
+        invariant to extra pad width, so sharing the widest is free)
+        and ONE host sync fetches every first token.  Returns
+        ``{request_id: (tok0, batch row index)}``; the stacked rows
+        and carried keys stay on the device in ``self._admit_batch``
+        for the deferred per-request writes to flush against."""
+        B = self.paged_arena.block_size
+        wn = min(self.max_len,
+                 max((len(r.prompt_ids) // B + 1) * B for r in reqs))
+        R = len(reqs)
+        ids = np.zeros((R, wn), np.int32)
+        plens = np.zeros(R, np.int32)
+        seeds = np.zeros(R, np.int32)
+        temps = np.zeros(R, np.float32)
+        for r, req in enumerate(reqs):
+            plen = len(req.prompt_ids)
+            ids[r, :plen] = req.prompt_ids
+            plens[r] = plen
+            seeds[r] = int(req.seed)
+            temps[r] = req.temperature
+        tok0, keys, kc, vc = self._x.prefill_batch(
+            self._params, jnp.asarray(ids), jnp.asarray(plens),
+            jnp.asarray(seeds), jnp.asarray(temps), self._top_p)
+        tok0 = np.asarray(tok0)      # ONE sync for the whole pass
+        # rows stay STACKED on the device: per-request scatters and
+        # key writes are deferred against this batch and flushed as
+        # one dispatch each at the end of the pass
+        # (_flush_admission_writes) — per-admission device work
+        # inside the pass drops to zero
+        self._admit_batch = (keys, kc, vc)
+        return {req.request_id: (int(tok0[r]), r)
+                for r, req in enumerate(reqs)}
+
+    def _flush_admission_writes(self, drop_batch=False):
+        """Write one scheduling pass's deferred admission state: ONE
+        batched pool scatter (``arena.scatter_rows``) for every
+        admitted request's prefilled lanes and ONE key-table write
+        for their carried sampling keys.  Called at the end of
+        ``_schedule`` (``drop_batch=True`` — the pass is over) and
+        defensively before any same-pass path that reads pool or key
+        state a deferred write still owns (preemption's swap gather,
+        block frees on instant retire/reject — a freed block could be
+        re-allocated and the late flush would then clobber the new
+        owner)."""
+        if self._pending_scatter:
+            _, kc_b, vc_b = self._admit_batch
+            self.paged_arena.scatter_rows(
+                kc_b, vc_b,
+                [r for r, _ in self._pending_scatter],
+                [l for _, l in self._pending_scatter])
+            self._pending_scatter = []
+        if self._pending_keys:
+            keys_b = self._admit_batch[0]
+            idxs = jnp.asarray(np.asarray(
+                [i for i, _ in self._pending_keys], np.int32))
+            rs = jnp.asarray(np.asarray(
+                [r for _, r in self._pending_keys], np.int32))
+            self._keys = _merge_keys(self._keys, keys_b, idxs, rs)
+            self._pending_keys = []
+        if drop_batch:
+            self._admit_batch = None
+
+    def _admit(self, idx, req, now, prefilled=None):
         """Prefill one request into slot ``idx`` and emit its first
         token.  Mirrors the offline key chain exactly: generate() makes
         per-row keys with split(PRNGKey(seed), B)[row]; a single-prompt
-        call is B=1, row 0.
+        call is B=1, row 0.  ``prefilled``: this request's
+        ``(tok0, batch row index)`` from a BATCHED pass prefill
+        (:meth:`_prefill_admissions`) — the cache rows and carried
+        key stay STACKED in ``self._admit_batch`` and the writes
+        defer onto that batch (cold paged admissions only, so the
+        warm/draft branches below never see it).
 
         With a prefix cache, the longest cached block-prefix is copied
         into the slot and only the suffix past the divergence boundary
@@ -1782,11 +2179,13 @@ class InferenceEngine:
                          prompt_len=plen, step=self.step_count,
                          cached_tokens=(len(nodes) * cache.block_size
                                         if cache is not None else 0)):
-            ids = np.zeros((1, self.max_len), np.int32)
-            ids[0, :plen] = req.prompt_ids
-            ids_j = jnp.asarray(ids)
-            key0 = jax.random.split(
-                jax.random.PRNGKey(int(req.seed)), 1)[0]
+            ids_j = None
+            if prefilled is None:
+                ids = np.zeros((1, self.max_len), np.int32)
+                ids[0, :plen] = req.prompt_ids
+                ids_j = jnp.asarray(ids)
+                key0 = jax.random.split(
+                    jax.random.PRNGKey(int(req.seed)), 1)[0]
             temp = np.float32(req.temperature)
             # int8 + prefix cache: EVERY admission (cold included)
             # runs the chunked path, because a quantized engine's
@@ -1796,22 +2195,57 @@ class InferenceEngine:
             # and warm admissions share one canonical form, and
             # chunked-quantized is the one donation can store (docs/
             # SERVING.md "int8 and the prefix cache")
-            if nodes or (cache is not None and self._quant):
+            deferred_row = None
+            if prefilled is not None:
+                # batched-pass fast path (_prefill_admissions): this
+                # request's prefill — key chain included — already ran
+                # in ONE dispatch for the whole scheduling pass, and
+                # its row stays in the stacked device batch: the
+                # scatter and key write below DEFER onto it (one
+                # flushed dispatch each per pass), so admitting K
+                # requests costs the live decode lanes one write, not K
+                tok0, deferred_row = prefilled
+                carry_key = kc_row = vc_row = None
+            elif nodes or (cache is not None and self._quant):
                 tok0, carry_key, kc_row, vc_row = self._admit_warm(
                     ids, plen, nodes, key0, temp,
                     rid=req.request_id)
             else:
+                pf_ids = ids_j
+                if arena is not None:
+                    # narrow-width admission (the gather-tax round):
+                    # prefill at the smallest block-multiple width
+                    # whose lanes cover the blocks this admission
+                    # scatters, not max_len — prefill cost tracks the
+                    # PROMPT's length, so a burst of short admissions
+                    # stops stalling the decode lanes behind
+                    # O(max_len) pad work (the paged bench's TPOT
+                    # tax).  Prefill rows are bitwise invariant to
+                    # the padded width (every op is row-independent
+                    # over positions; pinned by
+                    # tests/test_paged.py::test_prefill_width_bitwise
+                    # _invariance), so streams are unchanged.  One
+                    # executable per distinct width, bounded by
+                    # max_len // block_size — the warmup pass covers
+                    # the workload's widths, keeping the recompile
+                    # pin intact
+                    wn = min(self.max_len,
+                             (plen // arena.block_size + 1)
+                             * arena.block_size)
+                    pf_ids = ids_j[:, :wn]
                 tok0, carry_key, kc_row, vc_row = self._x.prefill_one(
-                    self._params, ids_j, plen, key0, temp,
+                    self._params, pf_ids, plen, key0, temp,
                     self._top_p)
             if arena is not None:
                 # the prefilled lanes past the shared prefix scatter
                 # into the request's freshly-allocated pool blocks;
                 # matched lanes never move (shared by reference)
                 m = len(nodes)
-                arena.scatter_row(
-                    kc_row, vc_row,
-                    {m + j: b for j, b in enumerate(new_blocks)})
+                lanes = {m + j: b for j, b in enumerate(new_blocks)}
+                if deferred_row is not None:
+                    self._pending_scatter.append((deferred_row, lanes))
+                else:
+                    arena.scatter_row(kc_row, vc_row, lanes)
             else:
                 self._kc, self._vc = self._x.write_slot(
                     self._kc, self._vc, kc_row, vc_row,
@@ -1853,7 +2287,10 @@ class InferenceEngine:
         self._toks[idx] = tok0
         self._pos[idx] = plen
         self._temps[idx] = temp
-        self._keys = self._keys.at[idx].set(carry_key)
+        if deferred_row is not None:
+            self._pending_keys.append((idx, deferred_row))
+        else:
+            self._keys = self._keys.at[idx].set(carry_key)
         self._emit(idx, slot, tok0, t_first)
         return True
 

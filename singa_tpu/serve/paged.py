@@ -21,17 +21,25 @@ carry.  This module collapses both into one pool:
   grown block-by-block as decode advances.  Capacity is "blocks free",
   not "slots free": a 20-token request holds one block, not a
   ``max_len`` row, so far more requests fit the same bytes;
-* **paged pool step** — each decode step gathers every live slot's
-  blocks into a fixed-shape row INSIDE one jitted executable, runs the
-  exact same per-row math as the slot-arena step
-  (``engine._decode_row`` / ``engine._spec_row`` — one definition, so
-  the two memory models cannot drift), and scatters only the block(s)
-  the step wrote back into the pool.  Blocks round-trip as byte
-  copies, so paged token streams are BIT-identical to the slot
-  engine's (tests/test_paged.py pins cold/warm/preempt-resume parity).
-  The gather materializes a transient ``(L, S, H, W, D)`` workspace
-  inside the executable — on hardware with a real paged-attention
-  kernel that workspace disappears into the kernel; the PERSISTENT KV
+* **paged pool step** — TWO implementations behind
+  ``PagedConfig.kernel``.  The default (``"block"``) is a
+  BLOCK-NATIVE online-softmax decode kernel
+  (``gpt2_decode.decode_step_paged`` / ``chunk_step_paged``,
+  dispatched by ``_paged_decode_kernel`` / ``_paged_spec_kernel``
+  below): flash-style attention directly over the pool with the
+  block table as the index structure — a ``fori_loop`` over each
+  slot's live blocks (bound = the longest LIVE slot's block count,
+  one traced scalar), running-max + rescaled-partial-sum
+  accumulation, trash and beyond-``pos`` lanes masked, int8
+  dequantized per block inside the accumulator; the workspace is
+  O(block_size) and the write-back is a read-modify-write of the one
+  or two blocks the step touched, so pool bytes still round-trip
+  exactly.  ``"gather"`` keeps the original materialize-a-row path
+  (``engine._decode_row`` / ``_spec_row`` on a transient
+  ``(L, S, H, W, D)`` workspace — bitwise the slot engine's math) as
+  the parity oracle: kernel streams are pinned TOKEN-identical to it
+  with an allclose logits oracle (online softmax reorders the float
+  reduction; tests/test_paged.py).  Either way the PERSISTENT KV
   allocation (what the capacity model and ``bench_serve.py --paged``
   count) is the pool alone;
 * **preemption / swap** — a request's blocks can be evicted to HOST
@@ -101,10 +109,34 @@ class PagedConfig:
     ``num_blocks``: pool capacity in blocks; device memory is
     ``2 * L * num_blocks * H_kv * block_size * D`` elements — compare
     against the slot arena's ``2 * L * max_slots * max_len * H_kv * D``
-    to hold the byte budget fixed (docs/SERVING.md "Paged KV")."""
+    to hold the byte budget fixed (docs/SERVING.md "Paged KV").
+    ``kernel``: how the pool steps read KV — ``"block"`` (default)
+    runs the block-native online-softmax decode kernel
+    (``gpt2_decode.decode_step_paged``: O(block_size) workspace,
+    attention work proportional to each step's LIVE blocks, trash and
+    beyond-``pos`` lanes masked); ``"gather"`` keeps the original
+    materialize-a-row path (O(max_len) workspace and attention work —
+    bitwise the slot engine's math) as the parity oracle and an
+    escape hatch.  Streams are token-identical between the two
+    (tests/test_paged.py pins kernel-vs-gather token identity plus an
+    allclose logits oracle; online softmax reorders the float
+    reduction, so bitwise logit equality is impossible by
+    construction).
+    ``admit_per_step``: optional ADMISSION INTERLEAVE BUDGET — at
+    most this many prefills per scheduling pass (None = unlimited,
+    the historical behavior).  A paged engine admits by blocks free,
+    so a burst of arrivals otherwise prefills en masse inside one
+    step and every live slot's decode TPOT absorbs the stall; a
+    small budget (2–3) spreads the same prefill work across steps,
+    trading a little TTFT headroom (paged TTFT is ~10-20x below the
+    slot arena's to begin with) for flat decode cadence — the
+    Sarathi-style chunked-prefill budget in miniature (ROADMAP item
+    2a; the request ledger's stall phase is the proof metric)."""
 
     block_size: int = 32
     num_blocks: int = 128
+    kernel: str = "block"
+    admit_per_step: int | None = None
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -113,6 +145,15 @@ class PagedConfig:
         if self.num_blocks < 1:
             raise ValueError(
                 f"num_blocks must be >= 1, got {self.num_blocks}")
+        if self.kernel not in ("block", "gather"):
+            raise ValueError(
+                f"kernel must be 'block' (block-native online-softmax "
+                f"decode) or 'gather' (materialized-row oracle), got "
+                f"{self.kernel!r}")
+        if self.admit_per_step is not None and self.admit_per_step < 1:
+            raise ValueError(
+                f"admit_per_step must be >= 1 (or None for "
+                f"unlimited), got {self.admit_per_step}")
 
 
 # -- pytree-generic fixed-shape copies ---------------------------------------
@@ -165,6 +206,25 @@ def _row_to_pool(pool_k, pool_v, kc_row, vc_row, idx, block):
     s = partial(_leaf_to_pool, idx=idx, block=block)
     return (jax.tree.map(lambda p, r: s(p, r), pool_k, kc_row),
             jax.tree.map(lambda p, r: s(p, r), pool_v, vc_row))
+
+
+@partial(jax.jit, static_argnames=("block",), donate_argnums=(0, 1))
+def _rows_to_pool(pool_k, pool_v, kc_rows, vc_rows, sel, idx, block):
+    """Batched admission scatter (the gather-tax round): rows
+    (L, R, H, W, ...) from ONE batched pass prefill, ``sel`` (R',)
+    the successfully-admitted row indices, ``idx`` (R' * W//B,) the
+    flattened per-row block targets (trash for unmapped lanes) — ONE
+    donated scatter writes every admission of a scheduling pass, so
+    K admissions stop costing the live decode lanes K dispatches."""
+    def leaf(pool, rows):
+        r = jnp.take(rows, sel, axis=1)          # (L, R', H, W, ...)
+        r = jnp.moveaxis(r, 1, 2)                # (L, H, R', W, ...)
+        s = r.shape
+        r = r.reshape(s[0], 1, s[1], s[2] * s[3], *s[4:])
+        return _leaf_to_pool(pool, r, idx, block)
+
+    return (jax.tree.map(lambda p, r: leaf(p, r), pool_k, kc_rows),
+            jax.tree.map(lambda p, r: leaf(p, r), pool_v, vc_rows))
 
 
 def _gather_leaf(pool, tbl):
@@ -294,6 +354,113 @@ def _paged_spec_step(t_params, d_params, pool_k, pool_v, dkc, dvc,
     return out, a_draft, pool_k, pool_v, dkc, dvc, keys2
 
 
+# -- block-native pool steps (the gather-tax round) --------------------------
+# Same signatures and scatter-back write path as the gather steps
+# above, but the per-row math is engine._decode_row_paged /
+# _spec_row_paged: flash-style online-softmax attention DIRECTLY over
+# the (L, N+1, H_kv, B, D) pool with the block table as the index
+# structure — a fori_loop over each slot's live blocks, O(block_size)
+# workspace, no materialized (max_len) row.  The loop bound is the
+# MAX live-block count across the pool (one traced scalar, so one
+# executable serves every step and work scales with the longest LIVE
+# slot, not with max_len).  Host-side block accounting, growth,
+# preemption/swap, and the prefix cache are untouched — they see the
+# same (tables, pools, written blocks) contract.
+
+@partial(jax.jit,
+         static_argnames=("block", "n_head", "eps", "moe_top_k",
+                          "top_k", "use_top_p", "tp_axis", "tp_world"),
+         donate_argnums=(1, 2))
+def _paged_decode_kernel(params, pool_k, pool_v, tables, toks, pos,
+                         live, keys, temps, top_p, block, n_head, eps,
+                         moe_top_k, top_k, use_top_p, tp_axis=None,
+                         tp_world=1):
+    """Advance EVERY slot one token against the block pool WITHOUT
+    gathering rows: per slot, online-softmax attention over its live
+    blocks (beyond-``pos`` and trash lanes masked) plus the step's
+    own K/V as the current lane, then scatter back ONLY the
+    read-modified block containing ``pos`` (dead slots write the
+    trash block).  Returns (next_toks, pool_k, pool_v, new_keys) —
+    the same contract as :func:`_paged_decode_step`."""
+    from .engine import _decode_row_paged
+
+    trash = jax.tree.leaves(pool_k)[0].shape[1] - 1
+    p_all = jnp.where(live, pos, 0)
+    n_blk = jnp.max((p_all + block - 1) // block)
+
+    def row(tbl, tok, pos_r, live_r, key, temp):
+        nxt, kb, vb, k2 = _decode_row_paged(
+            params, pool_k, pool_v, tbl, tok, pos_r, live_r, key,
+            temp, top_p, n_blk, block, trash, n_head, eps, moe_top_k,
+            top_k, use_top_p, tp_axis=tp_axis, tp_world=tp_world)
+        p_c = jnp.where(live_r, pos_r, 0)
+        dst = jnp.where(live_r, tbl[p_c // block], trash)
+        return nxt, kb, vb, dst, k2
+
+    nxt, kb, vb, dst, keys2 = jax.vmap(
+        row, in_axes=(0, 0, 0, 0, 0, 0),
+        out_axes=(0, 1, 1, 0, 0))(tables, toks, pos, live, keys, temps)
+    pool_k = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_k, kb)
+    pool_v = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_v, vb)
+    return nxt, pool_k, pool_v, keys2
+
+
+@partial(jax.jit,
+         static_argnames=("block", "spec_k", "tn", "te", "tm", "dn",
+                          "de", "dm", "top_k", "use_top_p", "tp_axis",
+                          "tp_world"),
+         donate_argnums=(2, 3, 4, 5))
+def _paged_spec_kernel(t_params, d_params, pool_k, pool_v, dkc, dvc,
+                       tables, toks, pos, live, keys, temps, top_p,
+                       block, spec_k, tn, te, tm, dn, de, dm, top_k,
+                       use_top_p, tp_axis=None, tp_world=1):
+    """Speculative chunk against the block pool, block-natively: the
+    draft scan and verify are the gather step's (shared helpers in
+    engine.py), the TARGET chunk attends the pool through the
+    chunk-query online-softmax accumulator, and the write-back
+    splits each slot's returned DOUBLE block into the one or two
+    blocks the chunk spans (same dst0/dst1 trash-routing as the
+    gather step — ``spec_k <= block_size`` is validated at engine
+    construction).  Returns (out, a_draft, pool_k, pool_v, dkc, dvc,
+    new_keys)."""
+    from .engine import _spec_row_paged
+
+    trash = jax.tree.leaves(pool_k)[0].shape[1] - 1
+    p_all = jnp.where(live, pos, 0)
+    n_blk = jnp.max((p_all + block - 1) // block)
+
+    def row(dkc_r, dvc_r, tbl, tok, pos_r, live_r, key, temp):
+        out, a_draft, kdbl, vdbl, dkc2, dvc2, k2 = _spec_row_paged(
+            t_params, d_params, pool_k, pool_v, dkc_r, dvc_r, tbl,
+            tok, pos_r, live_r, key, temp, top_p, n_blk, spec_k,
+            block, trash, tn, te, tm, dn, de, dm, top_k, use_top_p,
+            tp_axis=tp_axis, tp_world=tp_world)
+        p_c = jnp.where(live_r, pos_r, 0)
+        b0 = p_c // block
+        b1 = (p_c + spec_k - 1) // block
+        kb0 = jax.tree.map(lambda a: a[:, :, :block], kdbl)
+        vb0 = jax.tree.map(lambda a: a[:, :, :block], vdbl)
+        kb1 = jax.tree.map(lambda a: a[:, :, block:], kdbl)
+        vb1 = jax.tree.map(lambda a: a[:, :, block:], vdbl)
+        dst0 = jnp.where(live_r, tbl[b0], trash)
+        # same-block chunks route the second write to trash so the two
+        # scatters never collide on a real block
+        dst1 = jnp.where(live_r & (b1 > b0), tbl[b1], trash)
+        return (out, a_draft, kb0, vb0, dst0, kb1, vb1, dst1, dkc2,
+                dvc2, k2)
+
+    (out, a_draft, kb0, vb0, dst0, kb1, vb1, dst1, dkc, dvc,
+     keys2) = jax.vmap(
+        row, in_axes=(1, 1, 0, 0, 0, 0, 0, 0),
+        out_axes=(0, 0, 1, 1, 0, 1, 1, 0, 1, 1, 0))(
+        dkc, dvc, tables, toks, pos, live, keys, temps)
+    pool_k = jax.tree.map(lambda p, b: p.at[:, dst0].set(b), pool_k, kb0)
+    pool_v = jax.tree.map(lambda p, b: p.at[:, dst0].set(b), pool_v, vb0)
+    pool_k = jax.tree.map(lambda p, b: p.at[:, dst1].set(b), pool_k, kb1)
+    pool_v = jax.tree.map(lambda p, b: p.at[:, dst1].set(b), pool_v, vb1)
+    return out, a_draft, pool_k, pool_v, dkc, dvc, keys2
+
+
 # -- AOT compile capture (VERDICT weak #6) -----------------------------------
 # Serve-side executables used to compile invisibly: no span, no cost
 # table, nothing in crash bundles.  The paged steps dispatch through
@@ -323,17 +490,26 @@ def _cost_scalars(cost):
         return {}
 
 
-def _aot_call(name, fn, *args, **statics):
+def _aot_call(name, fn, *args, _memo=None, _token=None, **statics):
     """Dispatch ``fn(*args, **statics)`` through the AOT cache.  The
     compiled executable takes only the traced args (statics were
     consumed at lowering); the cache key mirrors jit's (leaf shapes +
     dtypes + statics), so warm/timed engines, supervisor rebuilds, and
     fleet replicas with identical geometry all share one compile —
-    the same restart-is-a-cache-hit contract the jitted paths keep."""
-    key = (name,
-           tuple((tuple(a.shape), str(a.dtype))
-                 for a in jax.tree.leaves(args)),
-           tuple(sorted(statics.items())))
+    the same restart-is-a-cache-hit contract the jitted paths keep.
+    ``_memo``/``_token``: optional caller-owned signature memo — an
+    engine's dispatch shapes are FIXED per (step, batch width), so
+    the executor caches the expensive leaf-shape key under a cheap
+    token instead of re-walking ~80 param leaves every decode step
+    (a measurable host tax on the per-step path)."""
+    key = _memo.get(_token) if _memo is not None else None
+    if key is None:
+        key = (name,
+               tuple((tuple(a.shape), str(a.dtype))
+                     for a in jax.tree.leaves(args)),
+               tuple(sorted(statics.items())))
+        if _memo is not None:
+            _memo[_token] = key
     entry = _aot_cache.get(key, _MISS)
     if entry is _MISS:
         with _trace.span("serve/compile", cat="serve", fn=name) as sp:
@@ -497,10 +673,15 @@ class PagedKVArena:
     def scatter_row(self, kc_row, vc_row, lanes):
         """Write row lanes into pool blocks: ``lanes`` maps lane index
         -> block id; unmapped lanes point at the trash block.  One
-        donated scatter — the pool updates in place."""
+        donated scatter — the pool updates in place.  The lane count
+        comes off the ROW's own width, so NARROW rows (the paged
+        cold-admission fast path prefills at the smallest
+        block-multiple width covering the prompt, not max_len) scatter
+        through the same entry point."""
         if _faults._armed:
             _faults.check("serve.paged_copy")
-        idx = np.full(self.row_blocks, self.trash, np.int32)
+        row_w = jax.tree.leaves(kc_row)[0].shape[3]
+        idx = np.full(row_w // self.block_size, self.trash, np.int32)
         for lane, blk in lanes.items():
             idx[lane] = blk
         if self._tp is not None:
@@ -511,6 +692,32 @@ class PagedKVArena:
         self.pool_k, self.pool_v = _row_to_pool(
             self.pool_k, self.pool_v, kc_row, vc_row,
             jnp.asarray(idx), block=self.block_size)
+
+    def scatter_rows(self, kc_rows, vc_rows, sel, lanes_list):
+        """Batched admission scatter: ``kc_rows``/``vc_rows`` the
+        (L, R, H, W, ...) stacked rows of one pass prefill, ``sel``
+        the admitted row indices, ``lanes_list`` one lane->block dict
+        per selected row.  ONE device dispatch for the whole pass
+        (``_rows_to_pool``); one ``serve.paged_copy`` policy tick —
+        one logical admission write."""
+        if _faults._armed:
+            _faults.check("serve.paged_copy")
+        row_w = jax.tree.leaves(kc_rows)[0].shape[3]
+        nb = row_w // self.block_size
+        idx = np.full(len(sel) * nb, self.trash, np.int32)
+        for r, lanes in enumerate(lanes_list):
+            for lane, blk in lanes.items():
+                idx[r * nb + lane] = blk
+        if self._tp is not None:
+            self.pool_k, self.pool_v = self._tp.rows_to_pool(
+                self.pool_k, self.pool_v, kc_rows, vc_rows,
+                jnp.asarray(np.asarray(sel, np.int32)),
+                jnp.asarray(idx))
+            return
+        self.pool_k, self.pool_v = _rows_to_pool(
+            self.pool_k, self.pool_v, kc_rows, vc_rows,
+            jnp.asarray(np.asarray(sel, np.int32)), jnp.asarray(idx),
+            block=self.block_size)
 
     # -- swap ------------------------------------------------------------
     def swap_out(self, blocks, n_data):

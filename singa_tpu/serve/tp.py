@@ -341,6 +341,7 @@ class TPExecutor:
             "pool_spec": (ps, _R, _CS, _CS, _R, _R, _R, _R, _R, _R,
                           _R, _R),
             "prefill_one": (ps, _R, _R, _R, _R, _R),
+            "prefill_batch": (ps, _R, _R, _R, _R, _R),
             "chunk_row": (ps, _R, _CS, _CS, _R),
             "paged_decode": (ps, _CS, _CS, _R, _R, _R, _R, _R, _R,
                              _R),
@@ -350,6 +351,7 @@ class TPExecutor:
             "read_slot": (_CS, _CS, _R),
             "pool_to_row": (_CS, _CS, _R, _R),
             "row_to_pool": (_CS, _CS, _CS, _CS, _R),
+            "rows_to_pool": (_CS, _CS, _CS, _CS, _R, _R),
         }[base]
 
     def _out_specs(self, base):
@@ -357,6 +359,7 @@ class TPExecutor:
             "pool_decode": (_R, _CS, _CS, _R),
             "pool_spec": (_R, _R, _CS, _CS, _R, _R, _R),
             "prefill_one": (_R, _R, _CS, _CS),
+            "prefill_batch": (_R, _R, _CS, _CS),
             "chunk_row": (_R, _CS, _CS),
             "paged_decode": (_R, _CS, _CS, _R),
             "paged_spec": (_R, _R, _CS, _CS, _R, _R, _R),
@@ -364,6 +367,7 @@ class TPExecutor:
             "read_slot": (_CS, _CS),
             "pool_to_row": (_CS, _CS),
             "row_to_pool": (_CS, _CS),
+            "rows_to_pool": (_CS, _CS),
         }[base]
 
     # -- the executor surface (mirrors engine._LocalExec) -----------------
@@ -404,14 +408,17 @@ class TPExecutor:
                               top_p)
 
     def paged_decode_step(self, params, pool_k, pool_v, tables, toks,
-                          pos, live, keys, temps, top_p, block):
+                          pos, live, keys, temps, top_p, block,
+                          kernel="block"):
         from functools import partial
 
-        from .paged import _paged_decode_step
+        from .paged import _paged_decode_kernel, _paged_decode_step
 
+        base = (_paged_decode_kernel if kernel == "block"
+                else _paged_decode_step)
         fn = self._twin(
-            "paged_decode", (block,),
-            lambda: partial(_paged_decode_step.__wrapped__,
+            "paged_decode", (block, kernel),
+            lambda: partial(base.__wrapped__,
                             block=block, **self._statics,
                             tp_axis=TP_AXIS, tp_world=self.tp),
             donate=(1, 2))
@@ -420,16 +427,18 @@ class TPExecutor:
 
     def paged_spec_step(self, t_params, d_params, pool_k, pool_v, dkc,
                         dvc, tables, toks, pos, live, keys, temps,
-                        top_p, block):
+                        top_p, block, kernel="block"):
         from functools import partial
 
-        from .paged import _paged_spec_step
+        from .paged import _paged_spec_kernel, _paged_spec_step
 
         st = self._statics
         spec_k, (dn, de, dm) = self._spec
+        base = (_paged_spec_kernel if kernel == "block"
+                else _paged_spec_step)
         fn = self._twin(
-            "paged_spec", (block, spec_k, dn, de, dm),
-            lambda: partial(_paged_spec_step.__wrapped__, block=block,
+            "paged_spec", (block, kernel, spec_k, dn, de, dm),
+            lambda: partial(base.__wrapped__, block=block,
                             spec_k=spec_k, tn=st["n_head"],
                             te=st["eps"], tm=st["moe_top_k"], dn=dn,
                             de=de, dm=dm, top_k=st["top_k"],
@@ -451,6 +460,19 @@ class TPExecutor:
                             quant=self._quant, tp_axis=TP_AXIS,
                             tp_world=self.tp))
         return self._dispatch(fn, params, ids, prompt_len, key, temp,
+                              top_p)
+
+    def prefill_batch(self, params, ids, plens, seeds, temps, top_p):
+        from functools import partial
+
+        from .engine import _prefill_batch
+
+        fn = self._twin(
+            "prefill_batch", (),
+            lambda: partial(_prefill_batch.__wrapped__,
+                            **self._statics, quant=self._quant,
+                            tp_axis=TP_AXIS, tp_world=self.tp))
+        return self._dispatch(fn, params, ids, plens, seeds, temps,
                               top_p)
 
     def chunk_row(self, params, ids, kc_row, vc_row, off):
@@ -489,6 +511,12 @@ class TPExecutor:
         fn = self._twin("row_to_pool", (), lambda: _row_to_pool_body,
                         donate=(0, 1))
         return self._dispatch(fn, pool_k, pool_v, kc_row, vc_row, idx)
+
+    def rows_to_pool(self, pool_k, pool_v, kc_rows, vc_rows, sel, idx):
+        fn = self._twin("rows_to_pool", (),
+                        lambda: _rows_to_pool_body, donate=(0, 1))
+        return self._dispatch(fn, pool_k, pool_v, kc_rows, vc_rows,
+                              sel, idx)
 
     # -- lifecycle / reporting -------------------------------------------
     def unregister(self):
@@ -531,3 +559,19 @@ def _row_to_pool_body(pool_k, pool_v, kc_row, vc_row, idx):
 
     return (jax.tree.map(scatter, pool_k, kc_row),
             jax.tree.map(scatter, pool_v, vc_row))
+
+
+def _rows_to_pool_body(pool_k, pool_v, kc_rows, vc_rows, sel, idx):
+    import jax.numpy as jnp
+
+    from .paged import _leaf_to_pool
+
+    def scatter(pool, rows):
+        r = jnp.take(rows, sel, axis=1)
+        r = jnp.moveaxis(r, 1, 2)
+        s = r.shape
+        r = r.reshape(s[0], 1, s[1], s[2] * s[3], *s[4:])
+        return _leaf_to_pool(pool, r, idx, pool.shape[3])
+
+    return (jax.tree.map(scatter, pool_k, kc_rows),
+            jax.tree.map(scatter, pool_v, vc_rows))

@@ -270,6 +270,35 @@ def test_mfu_math_against_known_peak(monkeypatch):
     assert reg.gauge("train.mfu").value == pytest.approx(50 / 275)
 
 
+def test_mfu_zero_step_interval_is_nan_pair(monkeypatch):
+    """An interval with ZERO train steps (a process serving, not
+    training) publishes model_flops_per_s AND mfu as nan TOGETHER —
+    never a hard 0.0 flops/s next to a null mfu (the committed
+    BENCH_SERVE health.train inconsistency on unknown-peak backends):
+    a busy process must never read as 0 flops/s, whatever the
+    backend's peak table knows."""
+    monkeypatch.setattr(monitor, "step_flops", lambda: 1e12)
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    meter = monitor.MfuMeter(reg=reg, clock=clk)
+    for peak in (275e12, float("nan")):   # known AND unknown peak
+        monkeypatch.setattr(monitor, "peak_flops",
+                            lambda device_kind=None, p=peak: p)
+        clk.advance(5.0)                  # a real interval, 0 steps
+        s = meter.sample()
+        assert math.isnan(s["model_flops_per_s"]), s
+        assert math.isnan(s["mfu"]), s
+        assert math.isnan(
+            reg.gauge("train.model_flops_per_s").value)
+        assert math.isnan(reg.gauge("train.mfu").value)
+    # and a real training interval afterwards still rates normally
+    monkeypatch.setattr(monitor, "peak_flops",
+                        lambda device_kind=None: 100e12)
+    reg.counter("train.steps").inc(10)
+    clk.advance(10.0)
+    assert meter.sample()["model_flops_per_s"] == pytest.approx(1e12)
+
+
 def test_mfu_read_does_not_reset_the_sampling_window(monkeypatch):
     """health_report() must not shrink the watchdog thread's rate
     interval to ~0 (which would publish a misleading 0 for a process

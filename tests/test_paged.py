@@ -423,6 +423,222 @@ def test_config_validation_typed_errors(model, draft):
     eng.close()
 
 
+def test_kernel_vs_gather_token_identity(model, draft):
+    """The block-native kernel (PagedConfig default) and the
+    materialized-row gather path (``kernel="gather"``) stream
+    TOKEN-IDENTICAL — greedy and seeded sampling mixed in one pool,
+    plain and speculative.  Online softmax reorders the float
+    reduction, so this (plus the logits oracle below) is the parity
+    pin; bitwise logit equality is impossible by construction
+    (docs/SERVING.md "Paged KV and preemption")."""
+    assert PagedConfig().kernel == "block"  # the kernel IS the default
+    work = _workload(20, 8, sampled=True)
+    outs_g, _ = _run(model, work,
+                     paged=PagedConfig(block_size=8, num_blocks=32,
+                                       kernel="gather"))
+    outs_k, _ = _run(model, work,
+                     paged=PagedConfig(block_size=8, num_blocks=32))
+    assert all(np.array_equal(a, b) for a, b in zip(outs_k, outs_g))
+    # speculative chunks too: the chunk-query accumulator against the
+    # same draft proposal chain
+    work2 = _workload(21, 4, n_lo=4, n_hi=10, p_lo=4, p_hi=12)
+    sg, _ = _run(model, work2, max_slots=3, draft_model=draft,
+                 spec_k=3, paged=PagedConfig(block_size=8,
+                                             num_blocks=32,
+                                             kernel="gather"))
+    sk, _ = _run(model, work2, max_slots=3, draft_model=draft,
+                 spec_k=3, paged=PagedConfig(block_size=8,
+                                             num_blocks=32))
+    assert all(np.array_equal(a, b) for a, b in zip(sk, sg))
+
+
+def test_kernel_logits_allclose_gather_oracle(model):
+    """Unit-level oracle for the online-softmax accumulator: one
+    decode step through ``decode_step_paged`` against a random pool
+    vs the row-math ``decode_step`` on the SAME KV materialized into
+    a row — logits allclose (reduction order is the only difference),
+    the written block's untouched lanes BYTE-equal to the pool (the
+    read-modify-write round-trips bytes), and layer 0's written K row
+    bitwise equal to the row path's (identical input, identical
+    projection)."""
+    from singa_tpu.models import gpt2_decode as gd
+    import jax.numpy as jnp
+
+    params = gd.extract_params(model)
+    cfg = model.cfg
+    L, H = cfg.n_layer, cfg.n_kv_head
+    D = cfg.n_embd // cfg.n_head
+    B, N = 8, 6
+    rng = np.random.RandomState(0)
+    pool_k = rng.randn(L, N + 1, H, B, D).astype(np.float32)
+    pool_v = rng.randn(L, N + 1, H, B, D).astype(np.float32)
+    pos, tok = 13, 7              # mid-block: block 1, offset 5
+    tbl = np.full(4, N, np.int32)
+    tbl[:2] = [3, 1]              # non-contiguous blocks, trash-padded
+    x = (params["wte"][tok] + params["wpe"][pos])[None, None, :]
+    n_blk = (pos + B - 1) // B
+    eps = float(cfg.layer_norm_eps)
+    logits_k, kb, vb = gd.decode_step_paged(
+        params, x, jnp.asarray(pool_k), jnp.asarray(pool_v),
+        jnp.asarray(tbl), jnp.int32(pos), jnp.int32(n_blk),
+        cfg.n_head, eps, block=B, trash=N)
+    # oracle: the same KV materialized into a (max_len) row
+    W = len(tbl) * B
+    row_k = np.zeros((L, 1, H, W, D), np.float32)
+    row_v = np.zeros((L, 1, H, W, D), np.float32)
+    for j, b in enumerate(tbl[:2]):
+        row_k[:, 0, :, j * B:(j + 1) * B] = pool_k[:, b]
+        row_v[:, 0, :, j * B:(j + 1) * B] = pool_v[:, b]
+    logits_r, kc2, vc2 = gd.decode_step(
+        params, x, jnp.asarray(row_k), jnp.asarray(row_v),
+        jnp.int32(pos), cfg.n_head, eps)
+    np.testing.assert_allclose(np.asarray(logits_k)[0],
+                               np.asarray(logits_r)[0],
+                               rtol=2e-5, atol=2e-5)
+    # written block = pool block tbl[1], lane pos % B replaced
+    kb = np.asarray(kb)           # (L, H, B, D)
+    off = pos % B
+    untouched = [i for i in range(B) if i != off]
+    np.testing.assert_array_equal(kb[:, :, untouched],
+                                  pool_k[:, 1][:, :, untouched])
+    # layer 0's K row: same x, same projection — bitwise
+    np.testing.assert_array_equal(
+        kb[0][:, off], np.asarray(kc2)[0, 0][:, pos])
+    np.testing.assert_allclose(
+        kb[:, :, off], np.asarray(kc2)[:, 0][:, :, pos],
+        rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_width_bitwise_invariance(model):
+    """The paged cold-admission fast path prefills at the smallest
+    block-multiple width covering the prompt instead of max_len
+    (engine._admit).  The claim it leans on, pinned here empirically:
+    prefill rows (K/V at positions < plen AND the sampled first
+    token) are BITWISE invariant to the padded width — every op in
+    the prefill stack is row-independent over the position axis, so
+    right-pad lanes cannot reach live rows."""
+    import jax
+    import jax.numpy as jnp
+    from singa_tpu.serve.engine import _prefill_one
+    from singa_tpu.models.gpt2_decode import extract_params
+
+    cfg = model.cfg
+    params = extract_params(model)
+    statics = dict(n_head=cfg.n_head,
+                   eps=float(cfg.layer_norm_eps),
+                   moe_top_k=2, top_k=0, use_top_p=False)
+    rng = np.random.RandomState(23)
+    plen = 20
+    prompt = rng.randint(0, 256, plen).astype(np.int32)
+    key0 = jax.random.PRNGKey(0)
+    outs = {}
+    for W in (32, cfg.n_positions):
+        ids = np.zeros((1, W), np.int32)
+        ids[0, :plen] = prompt
+        tok0, _, kc, vc = _prefill_one(
+            params, jnp.asarray(ids), jnp.int32(plen), key0,
+            np.float32(0.0), jnp.float32(1.0), **statics)
+        outs[W] = (int(tok0), np.asarray(kc)[:, :, :, :plen],
+                   np.asarray(vc)[:, :, :, :plen])
+    assert outs[32][0] == outs[cfg.n_positions][0]
+    np.testing.assert_array_equal(outs[32][1],
+                                  outs[cfg.n_positions][1])
+    np.testing.assert_array_equal(outs[32][2],
+                                  outs[cfg.n_positions][2])
+
+
+def test_prefill_batch_bitwise_equals_single(model):
+    """The batched pass prefill (engine._prefill_batch — one dispatch
+    for a scheduling pass's cold paged admissions) produces each
+    row's (first token, carried key, cache rows) BITWISE equal to
+    the per-request ``_prefill_one`` call, key chain included."""
+    import jax
+    import jax.numpy as jnp
+    from singa_tpu.serve.engine import _prefill_batch, _prefill_one
+    from singa_tpu.models.gpt2_decode import extract_params
+
+    cfg = model.cfg
+    params = extract_params(model)
+    statics = dict(n_head=cfg.n_head,
+                   eps=float(cfg.layer_norm_eps),
+                   moe_top_k=2, top_k=0, use_top_p=False)
+    rng = np.random.RandomState(24)
+    R, W = 3, 32
+    ids = np.zeros((R, W), np.int32)
+    plens = np.array([20, 7, 13], np.int32)
+    for r, p in enumerate(plens):
+        ids[r, :p] = rng.randint(0, 256, p)
+    seeds = np.array([5, 99, 0], np.int32)
+    temps = np.array([0.0, 0.9, 0.9], np.float32)
+    top_p = jnp.float32(1.0)
+    t_b, k_b, kc_b, vc_b = _prefill_batch(
+        params, jnp.asarray(ids), jnp.asarray(plens),
+        jnp.asarray(seeds), jnp.asarray(temps), top_p, **statics)
+    for r in range(R):
+        key0 = jax.random.split(
+            jax.random.PRNGKey(int(seeds[r])), 1)[0]
+        t1, k1, kc1, vc1 = _prefill_one(
+            params, jnp.asarray(ids[r:r + 1]), jnp.int32(int(plens[r])),
+            key0, np.float32(temps[r]), top_p, **statics)
+        assert int(t1) == int(t_b[r])
+        np.testing.assert_array_equal(np.asarray(k1),
+                                      np.asarray(k_b[r]))
+        np.testing.assert_array_equal(
+            np.asarray(kc1)[:, 0, :, :plens[r]],
+            np.asarray(kc_b)[:, r, :, :plens[r]])
+        np.testing.assert_array_equal(
+            np.asarray(vc1)[:, 0, :, :plens[r]],
+            np.asarray(vc_b)[:, r, :, :plens[r]])
+
+
+def test_kernel_edge_geometry(model):
+    """The kernel's edge cases, each pinned token-identical to the
+    slot engine: block_size ∈ {1, 8, 16} (block_size=1 was a prior
+    bug site — session donation clamp, round 14), a partially-filled
+    final block, prompts landing ``pos`` EXACTLY on a block boundary
+    at admission, and a slot whose block list is length 1."""
+    rng = np.random.RandomState(22)
+    for B, N in ((1, 64), (8, 16), (16, 16)):
+        work = []
+        # plen % B == 0: admission's first decode write lands on a
+        # block boundary (a fresh block's lane 0)
+        for plen, n_new in ((max(B, 4), 5), (2 * max(B, 2), 3),
+                            (3, 4), (5, 2)):
+            work.append(dict(
+                prompt=rng.randint(0, 256, plen).astype(np.int32),
+                n_new=n_new,
+                temperature=float(rng.choice([0.0, 0.9])),
+                seed=int(rng.randint(0, 1000))))
+        base, _ = _run(model, work)
+        outs, snap = _run(model, work,
+                          paged=PagedConfig(block_size=B,
+                                            num_blocks=N))
+        assert all(np.array_equal(a, b)
+                   for a, b in zip(outs, base)), f"B={B}"
+        assert snap["paged"]["blocks_used"] == 0
+    # single-block list + trash-lane masking: ONE live request in a
+    # 4-slot pool (three dead slots carry all-trash tables through
+    # the same executable) whose whole lifetime fits block 0
+    p = rng.randint(0, 256, 4).astype(np.int32)
+    want = np.asarray(model.generate(p, max_new_tokens=4,
+                                     temperature=0.0))
+    eng = model.serve(max_slots=4,
+                      paged=PagedConfig(block_size=16, num_blocks=8))
+    h = eng.submit(GenerationRequest(p, max_new_tokens=4,
+                                     temperature=0.0))
+    peak_blocks = 0
+    steps = 0
+    while eng.pending and steps < 200:
+        eng.step()
+        steps += 1
+        peak_blocks = max([peak_blocks] + [len(s.blocks)
+                                           for s in eng._slots
+                                           if s is not None])
+    np.testing.assert_array_equal(h.result().tokens, want)
+    assert peak_blocks == 1   # the whole lifetime fit ONE block
+    eng.close()
+
+
 def test_metrics_and_health_surface(model):
     """serve.paged.* metrics ride the process registry while the
     engine lives (and unregister at close); health_report carries the
