@@ -569,6 +569,96 @@ def chaos_tp(report):
         f"restarts ({restarts}) != injected TP faults ({injected})"
 
 
+def chaos_longctx(report):
+    """A fault BETWEEN budgeted prefill chunks (the
+    ``serve.prefill_chunk`` site, armed while a 72-token admission is
+    mid-split under ``prefill_token_budget``): the engine fails TYPED
+    mid-prefill — the chunked request has streamed NOTHING, so it
+    rejects requeue-safe and the supervisor replays it to byte parity
+    on the rebuilt engine; the partial chunks' blocks return to the
+    free list (zero leaked on the failed engine AND zero on the
+    drained rebuild).  Zero wedged/lost, restarts == injected."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest, PagedConfig)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(9)
+    # one long document + chat tails: the long admission's 9 chunks
+    # (72 tokens at an 8-token budget) are where the fault lands
+    workload = [(rng.randint(0, 256, 72).astype(np.int32), 3)] + \
+        [(rng.randint(0, 256, rng.randint(4, 10)).astype(np.int32),
+          int(rng.randint(3, 7))) for _ in range(5)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    pcfg = PagedConfig(block_size=8, num_blocks=32,
+                       prefill_token_budget=8)
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = 0
+    for fail_after in (3, 6):
+        sup = EngineSupervisor(m, max_slots=3, restart_budget=2,
+                               paged=pcfg)
+        arena0 = sup.engine.paged_arena
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.prefill_chunk",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=4000)
+        faults.clear()
+        injected += pol.fired
+        if pol.fired:
+            assert sup.engine.paged_arena is not arena0, \
+                "rebuilt engine carried the old paged arena"
+            assert arena0.blocks_used == 0, \
+                f"failed engine leaked {arena0.blocks_used} blocks " \
+                f"behind partial prefill chunks"
+        pg = sup.engine.stats.snapshot()["paged"]
+        assert pg["blocks_used"] == 0, \
+            f"drained longctx engine leaked {pg['blocks_used']} blocks"
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "budgeted-prefill stream diverged after restart"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve_longctx"] = {
+        "requests": 2 * len(workload),
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "chunk_faults_injected": injected,
+        "engine_restarts": restarts,
+        "blocks_leaked": 0,
+        "prefill_token_budget": pcfg.prefill_token_budget,
+    }
+    assert wedged == 0, f"{wedged} longctx requests wedged/lost"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0
+    assert restarts == injected > 0, \
+        f"restarts ({restarts}) != injected chunk faults ({injected})"
+
+
 def chaos_fleet(report):
     """Kill one replica mid-decode (``serve.decode_step`` fault against
     a zero restart budget): the fleet marks it unhealthy, requeues its
@@ -703,6 +793,7 @@ def main():
     chaos_prefix(report)
     chaos_spec(report)
     chaos_paged(report)
+    chaos_longctx(report)
     chaos_tp(report)
     chaos_fleet(report)
 

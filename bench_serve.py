@@ -860,6 +860,266 @@ def run_tp(m, workload, engine_outs, tp, engine_section,
     }
 
 
+def _longctx_mix(rng, vocab, n_chat=10, long_len=384, n_long=2):
+    """Document-analysis serve mix: short chat traffic arriving every
+    step, two LONG admissions (a ``long_len``-token document each)
+    landing early in the burst, and two pinned continuations (a chat
+    turn re-sent through its session handle).  The long prompts are
+    what an unbudgeted engine stalls every decode lane behind."""
+    chats = []
+    for i in range(n_chat):
+        chats.append(dict(
+            prompt=rng.randint(0, vocab,
+                               int(rng.randint(8, 17))).astype(np.int32),
+            n_new=8, arrival_step=i,
+            pin=(i in (1, 4))))
+    longs = [dict(prompt=rng.randint(0, vocab,
+                                     long_len).astype(np.int32),
+                  n_new=4, arrival_step=2 + j)
+             for j in range(n_long)]
+    return chats, longs
+
+
+def run_longctx():
+    """The --longctx measurement (the long-context round): the
+    document-analysis mix through three engines on a dedicated
+    512-position model —
+
+    * **baseline**: chat traffic only (no long admissions) — the
+      decode TPOT reference;
+    * **budgeted**: the full mix with
+      ``PagedConfig(prefill_token_budget=32)`` — each 384-token
+      admission splits into 16-token ``_chunk_row`` windows, two per
+      step, so decode lanes keep their cadence;
+    * **unbudgeted**: the full mix with whole-prompt admission — one
+      384-token prefill lands inside a single step and every live
+      chat lane's inter-token gap absorbs it (the stall spike).
+
+    Gated claims (tier1 serve gate + the LONGCTX.json serve rows):
+    budgeted chat decode TPOT p50 within 1.5x the baseline's while
+    the unbudgeted run's worst chat inter-token gap spikes measurably
+    above the budgeted run's; the ledger's stall-phase fraction of
+    chat latency stays bounded under the budget; every stream (chat,
+    long, continuation) byte-equal to the offline oracle; zero
+    blocks leaked; zero runtime recompiles.  A second, WINDOWED
+    section long-chats a sliding-window model (attn_window=64) 320
+    tokens deep and gates the O(window) memory model: peak blocks
+    per slot <= ceil(window/block)+1 with out-of-window drops
+    observed, stream token-equal to the offline rolling-cache
+    oracle."""
+    from singa_tpu import observe, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe import requests as reqtrace
+    from singa_tpu.serve import GenerationRequest, PagedConfig
+    from singa_tpu.utils.metrics import percentile
+
+    cfg = GPT2Config(vocab_size=512, n_positions=512, n_embd=128,
+                     n_layer=2, n_head=4, n_inner=256, dropout=0.0,
+                     attn_impl="fused")
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    rng = np.random.RandomState(12)
+    chats, longs = _longctx_mix(rng, cfg.vocab_size)
+    block = 16
+
+    own_ledger = not reqtrace._active
+    led = reqtrace.enable(capacity=4096) if own_ledger \
+        else reqtrace._ledger
+
+    def drive(include_long, budget):
+        pcfg = PagedConfig(block_size=block, num_blocks=96,
+                           prefill_token_budget=budget)
+        eng = m.serve(max_slots=8, paged=pcfg)
+        work = sorted(
+            [dict(w, long=False) for w in chats]
+            + ([dict(w, long=True) for w in longs]
+               if include_long else []),
+            key=lambda w: w["arrival_step"])
+        pending = list(work)
+        rows = []      # (kind, request, handle)
+        continued = []
+        t0 = time.perf_counter()
+        while pending or eng.pending or \
+                any(not h.done() for _, _, h in rows):
+            while pending and \
+                    pending[0]["arrival_step"] <= eng.step_count:
+                w = pending.pop(0)
+                req = GenerationRequest(
+                    w["prompt"], max_new_tokens=w["n_new"],
+                    pin_session=bool(w.get("pin")))
+                rows.append(("long" if w["long"] else "chat",
+                             req, eng.submit(req)))
+            # pinned chat turns continue once their first turn
+            # retires (sessions run cold here — no prefix cache —
+            # which keeps the leak pin exact: used == 0 after drain)
+            for kind, req, h in list(rows):
+                if kind == "chat" and getattr(req, "pin_session",
+                                              False) \
+                        and h.done() and id(h) not in continued:
+                    continued.append(id(h))
+                    req2 = h.result().session.request(
+                        rng.randint(0, cfg.vocab_size,
+                                    6).astype(np.int32),
+                        max_new_tokens=8)
+                    rows.append(("chat", req2, eng.submit(req2)))
+            eng.step()
+        wall = time.perf_counter() - t0
+        outs = [(kind, req, h.result()) for kind, req, h in rows]
+        leaked = eng.paged_arena.blocks_used
+        eng.close()
+        return wall, outs, leaked
+
+    # warmup all three configurations (compiles; chunk widths, the
+    # budgeted admission path, and the narrow whole-prompt width all
+    # enter the jit/AOT caches here)
+    for inc, bud in ((False, 32), (True, 32), (True, None)):
+        drive(inc, bud)
+
+    jit_before = _serve_jit_cache_size()
+    wall_base, outs_base, leak_base = drive(False, 32)
+    wall_b, outs_b, leak_b = drive(True, 32)
+    wall_u, outs_u, leak_u = drive(True, None)
+    jit_after = _serve_jit_cache_size()
+
+    # parity: every stream equals its offline oracle
+    parity = True
+    for outs in (outs_base, outs_b, outs_u):
+        for kind, req, res in outs:
+            want = m.generate(req.prompt_ids,
+                              max_new_tokens=req.max_new_tokens,
+                              temperature=0)
+            parity &= bool(np.array_equal(res.tokens, want))
+    for _, req, res in outs_base:
+        if res.session is not None:
+            res.session.release()
+
+    def chat_stats(outs):
+        tpots = [res.tpot for kind, _, res in outs
+                 if kind == "chat" and res.tpot is not None]
+        return percentile(tpots, 50)
+
+    def gap_stats(outs):
+        """Worst chat inter-token gap + ledger stall fraction — the
+        stall-spike evidence (exact ledger arithmetic, PR-8/13)."""
+        by_rid = {e["request_id"]: e for e in led.entries()}
+        worst = 0.0
+        stall = total = 0.0
+        for kind, req, _ in outs:
+            e = by_rid.get(req.request_id)
+            if kind != "chat" or e is None or not e["phases"]:
+                continue
+            hop = e["hops"][e["final_hop"]]
+            t = hop["t_first_token"]
+            for s in hop["steps"]:
+                worst = max(worst, s[0] - t)
+                t = s[0]
+            stall += e["phases"].get("stall", 0.0)
+            total += (e["t_retire"] - e["t_submit"])
+        return worst, (stall / total if total else 0.0)
+
+    tpot_base = chat_stats(outs_base)
+    tpot_b = chat_stats(outs_b)
+    tpot_u = chat_stats(outs_u)
+    gap_b, stall_b = gap_stats(outs_b)
+    gap_u, stall_u = gap_stats(outs_u)
+    if own_ledger:
+        reqtrace.disable()
+
+    # -- windowed long chat: O(window) blocks, offline-oracle parity --
+    wcfg = GPT2Config(vocab_size=512, n_positions=512, n_embd=128,
+                      n_layer=2, n_head=4, n_inner=256, dropout=0.0,
+                      attn_impl="fused", attn_window=64)
+    wm = GPT2LMHead(wcfg)
+    wm.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+               is_train=False, use_graph=False)
+    wm.set_states(m.get_states())
+
+    def drive_windowed():
+        eng = wm.serve(max_slots=2, paged=PagedConfig(
+            block_size=block, num_blocks=12))
+        prompt = rng2.randint(0, cfg.vocab_size, 16).astype(np.int32)
+        h = eng.submit(GenerationRequest(prompt, max_new_tokens=320))
+        peak = 0
+        t0 = time.perf_counter()
+        while eng.pending:
+            eng.step()
+            s = eng._slots[0]
+            if s is not None:
+                peak = max(peak,
+                           sum(1 for b in s.blocks
+                               if b != eng.paged_arena.trash))
+        wall = time.perf_counter() - t0
+        drops = eng.paged_arena.window_drops
+        leaked = eng.paged_arena.blocks_used
+        toks = h.result().tokens
+        eng.close()
+        return wall, prompt, toks, peak, drops, leaked
+
+    rng2 = np.random.RandomState(13)
+    drive_windowed()                   # warmup
+    w_jit_before = _serve_jit_cache_size()
+    wall_w, wprompt, wtoks, peak, drops, leak_w = drive_windowed()
+    w_jit_after = _serve_jit_cache_size()
+    w_want = wm.generate(wprompt, max_new_tokens=320, temperature=0)
+    w_parity = bool(np.array_equal(wtoks, w_want))
+
+    recompiles = (None if jit_before is None
+                  else (jit_after - jit_before)
+                  + (w_jit_after - w_jit_before))
+    section = {
+        "model": {"n_positions": 512, "n_embd": 128, "n_layer": 2,
+                  "long_prompt_tokens": 384, "chat_prompts": "8-16"},
+        "pool": {"block_size": block, "num_blocks": 96},
+        "prefill_token_budget": 32,
+        "baseline_no_long": {
+            "wall_s": wall_base, "chat_tpot_p50_s": tpot_base},
+        "budgeted": {
+            "wall_s": wall_b, "chat_tpot_p50_s": tpot_b,
+            "worst_chat_gap_s": gap_b, "chat_stall_frac": stall_b},
+        "unbudgeted": {
+            "wall_s": wall_u, "chat_tpot_p50_s": tpot_u,
+            "worst_chat_gap_s": gap_u, "chat_stall_frac": stall_u},
+        # THE gated numbers: budget keeps chat decode cadence at the
+        # no-long-traffic baseline while the unbudgeted run's worst
+        # gap carries the whole 384-token prefill
+        "tpot_p50_ratio_budgeted": tpot_b / tpot_base,
+        "tpot_p50_ratio_unbudgeted": tpot_u / tpot_base,
+        "stall_spike_ratio": (gap_u / gap_b) if gap_b else None,
+        "windowed": {
+            "attn_window": 64, "block_size": block,
+            "generated_tokens": 320, "wall_s": wall_w,
+            "peak_blocks_held": peak,
+            "max_blocks_allowed": 64 // block + 1,
+            "window_drops": drops,
+            "blocks_leaked": leak_w,
+            "parity_vs_offline_windowed": w_parity,
+        },
+        "blocks_leaked": leak_base + leak_b + leak_u,
+        "recompiles": recompiles,
+        "parity": bool(parity),
+    }
+    return section
+
+
+def _write_longctx_rows(section):
+    """Commit the serve section into LONGCTX.json NEXT TO the train
+    cells (the file the long-context training crossover harness owns)
+    — serve and train long-context evidence live side by side."""
+    from singa_tpu.observe.export import json_sanitize
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "LONGCTX.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc["serve"] = json_sanitize(section)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, allow_nan=False)
+
+
 def run_static(m, workload, max_slots):
     """Arrival-order batches of max_slots, each to its longest row."""
     from singa_tpu.models import gpt2_decode
@@ -936,6 +1196,14 @@ def main():
                          "int8-KV-arena engine (tokens/s, TTFT/TPOT "
                          "percentiles, parity vs the offline int8 "
                          "oracle, recompile pin; chip-pending row)")
+    ap.add_argument("--longctx", action="store_true",
+                    help="also run the long-context document-analysis "
+                         "serve mix (chunked-prefill token budget vs "
+                         "unbudgeted vs no-long-traffic baseline, "
+                         "plus a windowed long-chat O(window)-blocks "
+                         "run) — embeds the longctx section and "
+                         "commits the same rows into LONGCTX.json "
+                         "next to the train cells")
     ap.add_argument("--tp", type=int, default=None, metavar="K",
                     help="also run the standard workload through a "
                          "K-shard TENSOR-PARALLEL paged engine "
@@ -1108,6 +1376,12 @@ def main():
     if args.tp:
         report["tp"] = run_tp(m, workload, outs_e, args.tp,
                               report["engine"], max_slots=max_slots)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.longctx:
+        report["longctx"] = run_longctx()
+        _write_longctx_rows(report["longctx"])
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(
             engine_snapshots=[snap], include_registry=False)

@@ -388,8 +388,16 @@ class GPT2LMHead(model.Model):
         the (…, H_kv/k, …) slice of every cache pool) — the
         larger-than-one-device serving story, with token streams
         pinned identical to the single-device engine and every other
-        knob composing unchanged.  See docs/SERVING.md "Fast decode",
-        "Paged KV and preemption", and "Tensor-parallel serving"."""
+        knob composing unchanged.  Long-context serving (the
+        long-context round): ``PagedConfig(prefill_token_budget=)``
+        splits a long admission's prefill across steps in
+        block-width chunks so decode lanes never stall behind it;
+        sliding-window models (``GPT2Config(attn_window=)``) serve
+        in paged mode holding O(window) blocks per slot; and
+        ``TPConfig(ring_prefill=True)`` prefills cold long prompts
+        sequence-sharded over the tp mesh.  See docs/SERVING.md
+        "Fast decode", "Paged KV and preemption", "Tensor-parallel
+        serving", and "Long-context serving"."""
         from ..serve import InferenceEngine
 
         return InferenceEngine(self, **kw)

@@ -461,7 +461,7 @@ def _logits(x, params):
 
 def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
             quant_cache=False, window=None, prompt_end=None,
-            tp_axis=None, tp_world=1):
+            rolling=True, tp_axis=None, tp_world=1):
     """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
     v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
     rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
@@ -484,13 +484,19 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
     x = jnp.take(params["wte"], ids, axis=0) + \
         jnp.take(params["wpe"], pos, axis=0)
     roll = None
-    if window is not None and window < sp:
+    if window is not None and window < sp and rolling:
         # ROLLING cache (sliding window): slot w <- the last prompt
         # position p < prompt_end with p ≡ w (mod window); decode
         # writes position pos into slot pos % window, so the slot
         # mapping must be position-mod from the start.  Gathering by
         # prompt_end (not the padded width sp) keeps right-pad
         # garbage from overwriting real prompt K/V in its slot.
+        # ``rolling=False`` keeps the banded attention mask but a
+        # LINEAR position-indexed cache — the paged serve engine's
+        # windowed mode (block tables address positions directly and
+        # drop out-of-window blocks; the roll would scramble its
+        # block arithmetic).  The K/V VALUES are identical either
+        # way: the roll is a pure reorder after they are computed.
         pe_ = (sp if prompt_end is None else prompt_end) - 1
         w = jnp.arange(window)
         roll = jnp.clip(pe_ - ((pe_ - w) % window), 0, sp - 1)
@@ -564,7 +570,7 @@ def decode_step(params, x, kc, vc, pos, n_head, eps, *, start=None,
 
 
 def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
-                 moe_top_k=2, tp_axis=None, tp_world=1):
+                 moe_top_k=2, window=None, tp_axis=None, tp_world=1):
     """Chunked cache advance: x (B, K, E) are K consecutive tokens at
     positions pos..pos+K-1.  Writes all K K/V rows in one contiguous
     dynamic_update_slice and attends the K queries against the cache
@@ -572,7 +578,11 @@ def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
     <= pos + i).  The speculative verify step: ONE cache read serves
     K token positions, which is where the speedup over K sequential
     decode steps comes from on a cache-read-bound loop.  Dense or
-    int8 caches; GQA via the same grouped layout as _block_decode."""
+    int8 caches; GQA via the same grouped layout as _block_decode.
+    ``window``: sliding-window band — query i additionally masks
+    positions <= pos + i - window (LINEAR cache, the paged serve
+    engine's windowed chunk prefill; the rolling-cache decode path
+    is _block_decode's)."""
     quant = isinstance(k_cache, tuple)
     kq0 = k_cache[0] if quant else k_cache
     b, klen, e = x.shape
@@ -608,6 +618,9 @@ def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
             / math.sqrt(d)
     live = (jnp.arange(ctx)[None, :]
             <= (pos + jnp.arange(klen))[:, None])       # (K, ctx)
+    if window is not None:
+        live = live & (jnp.arange(ctx)[None, :]
+                       > (pos + jnp.arange(klen))[:, None] - window)
     sc = jnp.where(live[None, None, None], sc, NEG_INF)
     p_attn = jax.nn.softmax(sc, axis=-1)
     if quant:
@@ -623,7 +636,7 @@ def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
 
 
 def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2,
-                  tp_axis=None, tp_world=1):
+                  window=None, tp_axis=None, tp_world=1):
     """PUBLIC offset-prefill entry (the prefix cache's contract;
     serve.prefix round).  Advance every layer by a K-token chunk —
     ``x``: (B, K, E) embedded inputs at positions ``pos..pos+K-1``
@@ -655,6 +668,7 @@ def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2,
         x, kl, vl = _block_chunk(x, p, _cache_layer(kc, li),
                                  _cache_layer(vc, li), pos, n_head,
                                  eps, moe_top_k=moe_top_k,
+                                 window=window,
                                  tp_axis=tp_axis, tp_world=tp_world)
         new_kc.append(kl)
         new_vc.append(vl)
@@ -703,13 +717,25 @@ def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2,
 # int8 contraction, probabilities by vscale before the value einsum).
 
 def _paged_attn(q, pool_k_l, pool_v_l, tbl, p_limit, n_blk, block,
-                trash, k_cur, v_cur, cur_mask, scale):
+                trash, k_cur, v_cur, cur_mask, scale, window=None,
+                blk_lo=None):
     """Online-softmax attention of ``q`` (n_kv, g, Q, d) against one
     slot's paged KV: pool lanes at positions < ``p_limit`` (blocks
     ``tbl[0:n_blk]``; trash lanes masked) plus the current chunk's
     keys ``k_cur``/``v_cur`` (n_kv, Q_k, d, quantized tuples on int8
     pools) under ``cur_mask`` (Q, Q_k) — the chunk's own causal mask.
-    Accumulates in f32; returns (n_kv, g, Q, d)."""
+    Accumulates in f32; returns (n_kv, g, Q, d).
+
+    ``window`` (static): sliding-window band — query i (at position
+    ``p_limit + i``) additionally masks pool lanes at positions
+    <= p_limit + i - window, matching the banded prefill/_block_decode
+    semantics on a LINEAR layout.  ``blk_lo`` (traced, default 0):
+    loop start — any value <= the first block holding an in-window
+    lane (the pool-step wrapper passes the min over live slots, so a
+    windowed long chat pays O(window / block) loop iterations instead
+    of O(pos / block); out-of-window blocks the engine already
+    dropped to the free list sit below it as trash-table entries, so
+    correctness never depends on the bound — only work does)."""
     quant = isinstance(pool_k_l, tuple)
     qf = q.astype(jnp.float32)
     n_kv, g, nq, d = qf.shape
@@ -745,10 +771,18 @@ def _paged_attn(q, pool_k_l, pool_v_l, tbl, p_limit, n_blk, block,
             sc = jnp.einsum("kgqd,kbd->kgqb", qf,
                             kb.astype(jnp.float32)) * scale
         lane = j * block + jnp.arange(block)
-        live = ((lane < p_limit) & (blk != trash))[None, None, None, :]
+        live = (lane < p_limit) & (blk != trash)         # (B,)
+        if window is not None:
+            qpos = p_limit + jnp.arange(nq)              # (Q,)
+            live = (live[None, :]
+                    & (lane[None, :] > qpos[:, None] - window))
+            live = live[None, None]                      # (1,1,Q,B)
+        else:
+            live = live[None, None, None, :]
         return update(carry, sc, live, vb, vsc)
 
-    carry = jax.lax.fori_loop(0, n_blk, body, (m0, l0, a0))
+    lo = jnp.int32(0) if blk_lo is None else blk_lo
+    carry = jax.lax.fori_loop(lo, n_blk, body, (m0, l0, a0))
     # the chunk's own keys — computed this step, not yet in the pool
     if quant:
         (kc, kcs), (vc, vcs) = k_cur, v_cur
@@ -785,7 +819,8 @@ def _paged_qkv(x, p, n_head, eps):
 
 def _block_decode_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
                         n_head, eps, block, trash, moe_top_k=2,
-                        tp_axis=None, tp_world=1):
+                        window=None, blk_lo=None, tp_axis=None,
+                        tp_world=1):
     """One layer's block-native decode step: x (1, 1, E) at position
     ``pos``, one layer's pool leaves ((N+1, H_kv, B, D) dense or
     (values, scales)), ``tbl`` the slot's trash-padded block table.
@@ -805,7 +840,8 @@ def _block_decode_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
         k_cur, v_cur = k_new, v_new
     a = _paged_attn(q, pool_k_l, pool_v_l, tbl, pos, n_blk, block,
                     trash, k_cur, v_cur,
-                    jnp.ones((1, 1), bool), 1.0 / math.sqrt(d))
+                    jnp.ones((1, 1), bool), 1.0 / math.sqrt(d),
+                    window=window, blk_lo=blk_lo)
     a = a.astype(x.dtype).transpose(2, 0, 1, 3).reshape(
         1, 1, e // tp_world)
     x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
@@ -829,7 +865,8 @@ def _block_decode_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
 
 def _block_chunk_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
                        n_head, eps, block, trash, moe_top_k=2,
-                       tp_axis=None, tp_world=1):
+                       window=None, blk_lo=None, tp_axis=None,
+                       tp_world=1):
     """The chunk-query variant (speculative verify): x (1, K, E) at
     positions ``pos..pos+K-1``.  Pool lanes < ``pos`` are visible to
     every query; the chunk's own keys are causal within the chunk —
@@ -847,9 +884,14 @@ def _block_chunk_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
     else:
         k_cur, v_cur = k_new, v_new
     cur_mask = jnp.tril(jnp.ones((klen, klen), bool))
+    if window is not None:
+        # within-chunk banding: query i attends chunk key j at
+        # position pos+j only when (pos+i) - (pos+j) < window
+        i = jnp.arange(klen)
+        cur_mask = cur_mask & (i[:, None] - i[None, :] < window)
     a = _paged_attn(q, pool_k_l, pool_v_l, tbl, pos, n_blk, block,
                     trash, k_cur, v_cur, cur_mask,
-                    1.0 / math.sqrt(d))
+                    1.0 / math.sqrt(d), window=window, blk_lo=blk_lo)
     a = a.astype(x.dtype).transpose(2, 0, 1, 3).reshape(
         1, klen, e // tp_world)
     x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
@@ -877,7 +919,8 @@ def _block_chunk_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
 
 def decode_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
                       n_head, eps, *, block, trash, moe_top_k=2,
-                      tp_axis=None, tp_world=1):
+                      window=None, blk_lo=None, tp_axis=None,
+                      tp_world=1):
     """PUBLIC block-native single-step decode (the paged serve
     engine's hot path; serve/paged.py ``_paged_decode_kernel``).
     ``x``: (1, 1, E) embedded input at ``pos``; ``pool_k/v``: the full
@@ -892,7 +935,8 @@ def decode_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
         x, kb, vb = _block_decode_paged(
             x, p, _cache_layer(pool_k, li), _cache_layer(pool_v, li),
             tbl, pos, n_blk, n_head, eps, block, trash,
-            moe_top_k=moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+            moe_top_k=moe_top_k, window=window, blk_lo=blk_lo,
+            tp_axis=tp_axis, tp_world=tp_world)
         kbs.append(kb)
         vbs.append(vb)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
@@ -902,7 +946,8 @@ def decode_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
 
 def chunk_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
                      n_head, eps, *, block, trash, moe_top_k=2,
-                     tp_axis=None, tp_world=1):
+                     window=None, blk_lo=None, tp_axis=None,
+                     tp_world=1):
     """PUBLIC block-native chunk advance (speculative verify against
     the pool; serve/paged.py ``_paged_spec_kernel``).  ``x``:
     (1, K, E) embedded chunk at ``pos..pos+K-1``.  Returns
@@ -914,7 +959,8 @@ def chunk_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
         x, kd, vd = _block_chunk_paged(
             x, p, _cache_layer(pool_k, li), _cache_layer(pool_v, li),
             tbl, pos, n_blk, n_head, eps, block, trash,
-            moe_top_k=moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+            moe_top_k=moe_top_k, window=window, blk_lo=blk_lo,
+            tp_axis=tp_axis, tp_world=tp_world)
         kds.append(kd)
         vds.append(vd)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)

@@ -62,9 +62,31 @@ host byte-exactly, resume later) instead of stalling.  The paged pool
 steps vmap the same ``_decode_row``/``_spec_row`` math the slot-arena
 steps do, so the two memory models produce bit-identical streams.
 
+Long-context serving (the long-context round; docs/SERVING.md
+"Long-context serving"):
+
+* **chunked-prefill token budget**
+  (``PagedConfig(prefill_token_budget=)``): a Sarathi-style per-step
+  prefill TOKEN budget — an admission whose prompt exceeds it splits
+  across consecutive steps in block-width ``_chunk_row`` windows
+  (bitwise the unbudgeted prefill), so one 32k document admission
+  never stalls the live decode lanes for more than one chunk per
+  step (the request ledger's stall phase is the proof metric);
+* **windowed paged decode**: sliding-window models
+  (``GPT2Config(attn_window=W)``) serve on the PAGED engine — block
+  tables drop fully-out-of-window blocks back to the free list as
+  ``pos`` advances, so a long chat holds O(window) blocks whatever
+  its length, and the block-native kernel masks + loop-bounds the
+  attention to the window;
+* **ring-attention prefill** (``TPConfig(ring_prefill=True)``): cold
+  long-prompt admissions on a TP engine prefill SEQUENCE-sharded
+  over the mesh (parallel/ring_attention.py), for prompts beyond one
+  shard's flash tile.
+
 Scope: dense/GQA/MoE models (everything _advance_one supports with a
-position-indexed dense cache).  Sliding-window models (rolling cache
-slot arithmetic) are rejected with NotImplementedError;
+position-indexed dense cache).  Sliding-window models serve in paged
+mode only (windowed without ``paged=``, windowed + prefix cache, and
+windowed + ``kernel="gather"`` stay rejected typed);
 repetition_penalty/min_p are offline-only knobs.  int8 arenas compose
 with the prefix cache since the paged round (pytree-generic block
 pools; cache-enabled int8 engines route every admission through the
@@ -75,6 +97,7 @@ from __future__ import annotations
 
 import inspect
 import itertools
+import math
 import time
 from functools import partial
 
@@ -172,18 +195,24 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
 
 @partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "top_k",
-                          "use_top_p", "quant", "tp_axis", "tp_world"))
+                          "use_top_p", "quant", "window", "tp_axis",
+                          "tp_world"))
 def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
                  eps, moe_top_k, top_k, use_top_p, quant=False,
-                 tp_axis=None, tp_world=1):
+                 window=None, tp_axis=None, tp_world=1):
     """Admission prefill for ONE request: ids (1, max_len)
     right-padded.  Returns (first token, carried key, kc_row, vc_row)
     with cache rows (L, 1, H_kv, max_len, D) ready to write into the
     arena ((values, scales) tuples when ``quant`` — the int8 arena
     mode).  ``prompt_len`` is traced, so every admission reuses one
-    executable regardless of prompt length."""
+    executable regardless of prompt length.  ``window``: banded
+    (sliding-window) prefill with a LINEAR cache layout
+    (``rolling=False`` — the paged engine's block tables address
+    positions directly; the offline rolling layout would scramble
+    them)."""
     hidden, kc, vc = prefill(params, ids, n_head, eps,
                              moe_top_k=moe_top_k, quant_cache=quant,
+                             window=window, rolling=False,
                              tp_axis=tp_axis, tp_world=tp_world)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)      # (1, E)
@@ -195,10 +224,11 @@ def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
 
 @partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "top_k",
-                          "use_top_p", "quant", "tp_axis", "tp_world"))
+                          "use_top_p", "quant", "window", "tp_axis",
+                          "tp_world"))
 def _prefill_batch(params, ids, plens, seeds, temps, top_p, n_head,
                    eps, moe_top_k, top_k, use_top_p, quant=False,
-                   tp_axis=None, tp_world=1):
+                   window=None, tp_axis=None, tp_world=1):
     """BATCHED cold admission (the gather-tax round): R requests'
     prefills in ONE dispatch — ids (R, W) right-padded at the pass's
     shared narrow width, plens/seeds/temps (R,).  vmaps the exact
@@ -217,7 +247,7 @@ def _prefill_batch(params, ids, plens, seeds, temps, top_p, n_head,
         return _prefill_one.__wrapped__(
             params, ids_r[None], plen, key0, temp, top_p, n_head,
             eps, moe_top_k, top_k, use_top_p, quant=quant,
-            tp_axis=tp_axis, tp_world=tp_world)
+            window=window, tp_axis=tp_axis, tp_world=tp_world)
 
     tok0, keys, kc, vc = jax.vmap(row, in_axes=(0, 0, 0, 0),
                                   out_axes=(0, 0, 1, 1))(
@@ -241,10 +271,11 @@ def _prefill_rows(params, ids, n_head, eps, moe_top_k, quant=False):
 
 @partial(jax.jit,
          static_argnames=("n_head", "eps", "moe_top_k", "chunk",
-                          "tp_axis", "tp_world"),
+                          "window", "tp_axis", "tp_world"),
          donate_argnums=(2, 3))
 def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
-               moe_top_k, chunk, tp_axis=None, tp_world=1):
+               moe_top_k, chunk, window=None, tp_axis=None,
+               tp_world=1):
     """Offset prefill of ONE block-width window: embed tokens at
     positions [off, off+chunk) of the padded ``ids`` row and advance
     them through ``gpt2_decode.prefill_chunk`` against a cache row
@@ -257,8 +288,8 @@ def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
     x = jnp.take(params["wte"], toks[0], axis=0)[None] + \
         jnp.take(params["wpe"], pos, axis=0)[None]
     return prefill_chunk(params, x, kc_row, vc_row, off, n_head, eps,
-                         moe_top_k=moe_top_k, tp_axis=tp_axis,
-                         tp_world=tp_world)
+                         moe_top_k=moe_top_k, window=window,
+                         tp_axis=tp_axis, tp_world=tp_world)
 
 
 @partial(jax.jit, static_argnames=("top_k", "use_top_p"))
@@ -363,8 +394,8 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
 
 def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
                       key, temp, top_p, n_blk, block, trash, n_head,
-                      eps, moe_top_k, top_k, use_top_p, tp_axis=None,
-                      tp_world=1):
+                      eps, moe_top_k, top_k, use_top_p, window=None,
+                      blk_lo=None, tp_axis=None, tp_world=1):
     """ONE slot's BLOCK-NATIVE decode-step math (the gather-tax
     round): same embed / sample chain as :func:`_decode_row`, but the
     attention runs directly over the block pool through
@@ -383,6 +414,7 @@ def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
     logits, kb, vb = decode_step_paged(
         params, x, pool_k, pool_v, tbl, p_c, n_blk, n_head, eps,
         block=block, trash=trash, moe_top_k=moe_top_k,
+        window=window, blk_lo=blk_lo,
         tp_axis=tp_axis, tp_world=tp_world)
     ks = jax.random.split(key)
     nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
@@ -393,7 +425,8 @@ def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
 def _spec_row_paged(t_params, d_params, pool_k, pool_v, dkc_r, dvc_r,
                     tbl, tok, pos_r, live_r, key, temp, top_p, n_blk,
                     spec_k, block, trash, tn, te, tm, dn, de, dm,
-                    top_k, use_top_p, tp_axis=None, tp_world=1):
+                    top_k, use_top_p, window=None, blk_lo=None,
+                    tp_axis=None, tp_world=1):
     """ONE slot's BLOCK-NATIVE speculative chunk: the SAME draft
     proposal scan and the SAME ``spec_verify`` as :func:`_spec_row`
     (shared helpers — the accept logic cannot drift), with the target
@@ -417,8 +450,8 @@ def _spec_row_paged(t_params, d_params, pool_k, pool_v, dkc_r, dvc_r,
                      p_c + jnp.arange(spec_k), axis=0))[None]
     lg, kdbl, vdbl = chunk_step_paged(
         t_params, xs, pool_k, pool_v, tbl, p_c, n_blk, tn, te,
-        block=block, trash=trash, moe_top_k=tm, tp_axis=tp_axis,
-        tp_world=tp_world)
+        block=block, trash=trash, moe_top_k=tm, window=window,
+        blk_lo=blk_lo, tp_axis=tp_axis, tp_world=tp_world)
     out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
                                temp, top_p, top_k, use_top_p)
     return (out, a_draft, kdbl, vdbl,
@@ -530,12 +563,14 @@ class _LocalExec:
         name, fn = (("paged_decode_kernel", _paged_decode_kernel)
                     if kernel == "block"
                     else ("paged_decode_step", _paged_decode_step))
+        extra = ({"window": self._e._window} if kernel == "block"
+                 else {})  # gather path is refused for windowed models
         return _aot_call(name, fn,
                          params, pool_k, pool_v, tables, toks, pos,
                          live, keys, temps, top_p, block=block,
                          _memo=self._aot_memo,
                          _token=(name, toks.shape[0]),
-                         **self._e._statics)
+                         **self._e._statics, **extra)
 
     def paged_spec_step(self, t_params, d_params, pool_k, pool_v, dkc,
                         dvc, tables, toks, pos, live, keys, temps,
@@ -545,11 +580,13 @@ class _LocalExec:
         name, fn = (("paged_spec_kernel", _paged_spec_kernel)
                     if kernel == "block"
                     else ("paged_spec_step", _paged_spec_step))
+        extra = ({"window": e._window} if kernel == "block" else {})
         return _aot_call(name, fn,
                          t_params, d_params, pool_k, pool_v, dkc, dvc,
                          tables, toks, pos, live, keys, temps, top_p,
                          _memo=self._aot_memo,
                          _token=(name, toks.shape[0]),
+                         **extra,
                          block=block, spec_k=e.spec_k,
                          tn=st["n_head"], te=st["eps"],
                          tm=st["moe_top_k"], dn=e._d_statics[0],
@@ -560,12 +597,14 @@ class _LocalExec:
     def prefill_one(self, params, ids, prompt_len, key, temp, top_p):
         e = self._e
         return _prefill_one(params, ids, prompt_len, key, temp, top_p,
-                            **e._statics, quant=e._quant)
+                            **e._statics, quant=e._quant,
+                            window=e._window)
 
     def prefill_batch(self, params, ids, plens, seeds, temps, top_p):
         e = self._e
         return _prefill_batch(params, ids, plens, seeds, temps,
-                              top_p, **e._statics, quant=e._quant)
+                              top_p, **e._statics, quant=e._quant,
+                              window=e._window)
 
     def chunk_row(self, params, ids, kc_row, vc_row, off):
         return _chunk_row(params, ids, kc_row, vc_row, off,
@@ -603,6 +642,24 @@ class _Slot:
         self.n_shared = 0        # leading blocks shared with the cache
 
 
+class _Prefilling:
+    """Host-side state of one IN-FLIGHT chunked-prefill admission
+    (the ``PagedConfig(prefill_token_budget=)`` path): the request
+    holds a reserved slot index and its pool blocks, but its cache
+    rows live in a private device row (``kc_row``/``vc_row``) that
+    block-width ``_chunk_row`` windows advance across STEPS — only
+    when the last chunk lands does the first token sample, the row
+    scatter into the blocks, and the slot go live.  Nothing has
+    streamed, so an engine failure mid-prefill rejects these
+    requeue-safe (``started=False``) and returns their blocks to the
+    free list."""
+
+    __slots__ = ("handle", "request", "ids_j", "kc_row", "vc_row",
+                 "hidden", "off", "last_off", "blocks", "n_shared",
+                 "nodes", "key0", "temp", "t_admit", "admitted_step",
+                 "seq")
+
+
 class _Swapped:
     """A preempted request's complete host-side state: byte copies of
     its target cache lanes (and draft rows on a speculative engine),
@@ -615,7 +672,7 @@ class _Swapped:
     __slots__ = ("handle", "request", "emitted", "remaining",
                  "first_token_time", "admit_time", "admitted_step",
                  "pos", "tok", "temp", "key", "kc_h", "vc_h", "dkc_h",
-                 "dvc_h", "n_data", "seq", "t_preempt")
+                 "dvc_h", "n_data", "seq", "t_preempt", "j_lo")
 
     @property
     def priority(self):
@@ -675,11 +732,50 @@ class InferenceEngine:
                  draft_model=None, spec_k=None, cache_dtype=None,
                  paged=None, tp=None):
         cfg = model.cfg
-        if _norm_window(cfg) is not None:
+        # sliding-window models serve in PAGED mode only (the
+        # long-context round): block tables are position-indexed, so
+        # a windowed slot drops fully-out-of-window blocks back to
+        # the free list as ``pos`` advances — long chats hold
+        # O(window) blocks instead of O(length).  The slot arena's
+        # worst-case rows still cannot roll, so windowed WITHOUT
+        # paged= stays refused, as does the "gather" parity kernel
+        # (it materializes the whole row and would attend freed
+        # blocks) — both checked below once the paged config parses.
+        self._window = _norm_window(cfg)
+        if self._window is not None and (paged is None
+                                         or paged is False):
             raise NotImplementedError(
-                "serve engine does not support sliding-window models "
-                f"(attn_window={cfg.attn_window}): the rolling cache's "
-                "slot arithmetic assumes a scan-carried cache")
+                "serve engine supports sliding-window models only in "
+                f"paged mode (attn_window={cfg.attn_window}): pass "
+                "paged=PagedConfig(...) for windowed decode in "
+                "O(window) blocks (docs/SERVING.md 'Long-context "
+                "serving'); without paged= the slot arena's "
+                "position-indexed rows cannot roll — offline "
+                "windowed GPT2LMHead.generate covers the no-engine "
+                "case")
+        if self._window is not None:
+            # the remaining windowed composition limits, checked
+            # BEFORE any registry/arena state exists so a refused
+            # construction leaks nothing
+            _pk = (paged.kernel if isinstance(paged, PagedConfig)
+                   else paged.get("kernel", "block")
+                   if isinstance(paged, dict) else "block")
+            if _pk != "block":
+                raise ValueError(
+                    f"sliding-window serving requires "
+                    f"PagedConfig(kernel='block'), got {_pk!r}: the "
+                    f"gather oracle materializes the full row and "
+                    f"would attend blocks the windowed slot already "
+                    f"dropped")
+            if prefix_cache is not None and prefix_cache is not False:
+                raise NotImplementedError(
+                    "prefix_cache on a sliding-window model: windowed "
+                    "slots drop out-of-window blocks, so a retiring "
+                    "request's prompt chain is no longer a contiguous "
+                    "block prefix the radix tree could adopt; serve "
+                    "windowed models without a prefix cache "
+                    "(docs/SERVING.md 'Long-context serving' "
+                    "composition matrix)")
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.model = model
@@ -732,6 +828,42 @@ class InferenceEngine:
                     "speculative serve does not support sliding-window "
                     f"drafts (attn_window={dcfg.attn_window}); same "
                     "rolling-cache restriction as the target")
+        # ring-prefill composition limits (TPConfig(ring_prefill=)),
+        # checked BEFORE any registry/executor/arena state exists so
+        # a refused construction leaks nothing; the tp branch below
+        # re-coerces idempotently
+        if tp is not None and tp is not False:
+            from .tp import as_tp_config
+            tp = as_tp_config(tp)
+            if tp.tp > 1 and tp.ring_prefill:
+                if paged is None or paged is False:
+                    raise ValueError(
+                        "ring_prefill requires paged= (the ring twin "
+                        "scatters narrow block-multiple rows; the "
+                        "slot arena's full-width write path is not "
+                        "wired)")
+                if prefix_cache is not None \
+                        and prefix_cache is not False:
+                    raise ValueError(
+                        "ring_prefill with a prefix_cache: ring "
+                        "attention reorders the float reduction, so "
+                        "its K/V is not byte-canonical with chunked "
+                        "prefill — donated blocks would poison the "
+                        "cache's warm==cold byte-identity contract")
+                if self._window is not None:
+                    raise NotImplementedError(
+                        "ring_prefill on a sliding-window model is "
+                        "not implemented (the ring's causal skip has "
+                        "no banded variant here); windowed long "
+                        "prompts admit through the chunked-prefill "
+                        "budget instead")
+                if self._quant:
+                    raise ValueError(
+                        "ring_prefill with cache_dtype='int8': the "
+                        "engine's int8 parity pin is byte equality "
+                        "with the offline oracle, which ring "
+                        "reduction reordering cannot keep through "
+                        "quantization bins; serve int8 without ring")
         self._clock = clock
         # string schedulers construct PER ENGINE — an object instance
         # forwarded through supervisor/fleet engine_kw would be SHARED
@@ -767,15 +899,27 @@ class InferenceEngine:
         # loop, paging, prefix cache, and ledger see a single logical
         # engine either way (self._x is the pluggable dispatch seam)
         self.tp_exec = None
+        self._tp_cfg = None
+        host_params = None
         if tp is not None and tp is not False:
             from .tp import TPExecutor, as_tp_config
             tp = as_tp_config(tp)
+            self._tp_cfg = tp
             if tp.tp > 1:
                 self.tp_exec = TPExecutor(
                     tp, cfg, statics=self._statics, quant=self._quant,
                     model_plan=getattr(model, "plan", None),
                     engine_label=self.stats.engine_label,
                     reg=self.stats.registry)
+                self.tp_exec.set_window(self._window)
+                # ring prefill keeps a REPLICATED full-weight copy
+                # (context parallelism over the same mesh: sequence
+                # sharded, weights whole) — grab the host tree before
+                # the Megatron placement below consumes it; the ring
+                # composition checks run once paged/prefix parse
+                host_params = (self._params
+                               if getattr(tp, "ring_prefill", False)
+                               else None)
                 self._params = self.tp_exec.place_params(self._params)
                 self.stats.tp_source = self.tp_exec.snapshot
         self._x = (self.tp_exec if self.tp_exec is not None
@@ -892,6 +1036,7 @@ class InferenceEngine:
         # fresh one (cold but correct) from the forwarded config.
         self.prefix_cache = None
         self._sched_cost = None
+        self._chunk_statics = None
         # identity check, not truthiness: prefix_cache={} means
         # "enable with defaults", and silently disabling on a falsy
         # dict would only surface as stats["prefix"] == None much later
@@ -944,7 +1089,7 @@ class InferenceEngine:
             self._chunk_statics = dict(
                 n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
                 moe_top_k=self._statics["moe_top_k"],
-                chunk=prefix_cache.block_size)
+                chunk=prefix_cache.block_size, window=self._window)
             if self.tp_exec is not None:
                 self.tp_exec.set_chunk(self._chunk_statics)
             self.stats.prefix_source = self.prefix_cache.snapshot
@@ -959,6 +1104,42 @@ class InferenceEngine:
                     self._sched_cost = self._prefill_cost
             except (TypeError, ValueError):
                 pass
+        # -- chunked-prefill token budget (the long-context round):
+        # PagedConfig(prefill_token_budget=) splits admissions across
+        # steps in block-width _chunk_row windows — host state for the
+        # in-flight chunked prefills lives in self._prefilling (slot
+        # index -> _Prefilling; the slot is RESERVED but not live, so
+        # the decode dispatch never sees it until the first token
+        # samples)
+        self._budget = (self.paged_arena.config.prefill_token_budget
+                        if self.paged_arena is not None else None)
+        self._prefilling = {}
+        self._prefill_seq = itertools.count()
+        self._own_metrics = []
+        if self._budget is not None:
+            if self._chunk_statics is None:
+                self._chunk_statics = dict(
+                    n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
+                    moe_top_k=self._statics["moe_top_k"],
+                    chunk=self.paged_arena.block_size,
+                    window=self._window)
+                if self.tp_exec is not None:
+                    self.tp_exec.set_chunk(self._chunk_statics)
+            self._c_budget_chunks = self.stats.registry.counter(
+                "serve.prefill.budget_chunks",
+                help="block-width chunk dispatches the chunked-"
+                     "prefill token budget split admissions into",
+                engine=self.stats.engine_label)
+            self._own_metrics.append(self._c_budget_chunks)
+        # -- ring-attention prefill (TPConfig(ring_prefill=True)):
+        # cold long-prompt admissions prefill SEQUENCE-sharded over
+        # the tp mesh (parallel/ring_attention.py) — composition was
+        # validated up top, before any registration
+        self._ring = bool(self.tp_exec is not None and self._tp_cfg
+                          and getattr(self._tp_cfg, "ring_prefill",
+                                      False))
+        if self._ring:
+            self.tp_exec.enable_ring(host_params)
         self._log.info(
             "engine up: slots=%d max_len=%d cache_dtype=%s "
             "prefix_cache=%s spec=%s paged=%s tp=%s",
@@ -994,18 +1175,42 @@ class InferenceEngine:
             # verify-chunk headroom past the last emitted token (the
             # same rule as generate_speculative) — checked HERE so the
             # failure is a submit-time ValueError, not a clipped
-            # dynamic_update_slice corrupting a neighbor's rows
+            # dynamic_update_slice corrupting a neighbor's rows.
+            # max_len is a POSITION-EMBEDDING bound (<= n_positions),
+            # not a memory one: within it, the long-context serve
+            # path handles long traffic first-class — a chunked-
+            # prefill token budget (PagedConfig(prefill_token_budget=)
+            # splits a long admission across steps so decode lanes
+            # never stall) and, for sliding-window models, windowed
+            # paged decode in O(window) blocks.  Only generations
+            # whose POSITIONS exceed n_positions remain offline-only
+            # (the windowed GPT2LMHead.generate fallback); see
+            # docs/SERVING.md "Long-context serving" for what still
+            # refuses (windowed without paged=, windowed + prefix
+            # cache, windowed + kernel='gather').
             raise ValueError(
                 f"prompt ({len(request.prompt_ids)}) + max_new_tokens "
                 f"({request.max_new_tokens})"
                 + (f" + spec_k-1 ({spec_pad})" if spec_pad else "")
-                + f" exceeds the engine arena max_len ({self.max_len});"
-                f" use the offline windowed GPT2LMHead.generate for "
-                f"over-length generations")
+                + f" exceeds the engine arena max_len ({self.max_len})"
+                f" — the model's position space, not a memory limit "
+                f"(long admissions within it serve via the chunked-"
+                f"prefill budget / windowed paged decode; docs/"
+                f"SERVING.md 'Long-context serving'); only beyond-"
+                f"n_positions generations need the offline windowed "
+                f"GPT2LMHead.generate")
         if self.paged_arena is not None:
             B = self.paged_arena.block_size
             worst = ((len(request.prompt_ids) + request.max_new_tokens
                       - 1 + spec_pad) // B) + 1
+            if self._window is not None:
+                # a windowed slot never holds more than the blocks
+                # covering one window span plus the block being
+                # written — out-of-window blocks return to the free
+                # list as pos advances, so worst-case footprint is
+                # O(window), not O(prompt + generation)
+                worst = min(worst,
+                            (self._window - 1 + spec_pad) // B + 2)
             if worst > self.paged_arena.num_blocks:
                 # a request that could never fit the pool ALONE would
                 # deadlock the growth loop; fail it at submit, typed
@@ -1052,10 +1257,11 @@ class InferenceEngine:
 
     @property
     def pending(self) -> bool:
-        """True while any request is queued, occupying a slot, or
-        swapped out awaiting resume."""
+        """True while any request is queued, occupying a slot,
+        mid-chunked-prefill, or swapped out awaiting resume."""
         return (self.scheduler.queue_depth > 0
                 or any(s is not None for s in self._slots)
+                or bool(self._prefilling)
                 or bool(self._swapped))
 
     # -- lifecycle -------------------------------------------------------
@@ -1085,10 +1291,13 @@ class InferenceEngine:
             self.paged_arena.unregister()
         if self.tp_exec is not None:
             self.tp_exec.unregister()
+        self.stats.registry.remove(*self._own_metrics)
+        self._own_metrics = []
         self._kc = self._vc = None
         self._dkc = self._dvc = None
         self._params = self._d_params = None
         self._swapped = []
+        self._prefilling = {}
         self._closed = True
 
     def __enter__(self):
@@ -1221,6 +1430,34 @@ class InferenceEngine:
                 started=True, engine_step=step))
             self._slots[i] = None
             self._handles.pop(rid, None)
+        # mid-chunked-prefill requests (the token-budget path) have
+        # streamed NOTHING — their first token samples only when the
+        # last chunk lands — so they reject requeue-safe
+        # (started=False), and their partially-filled blocks return
+        # to the free list HERE: a supervisor restart must find zero
+        # leaked blocks behind a fault that fired between chunks
+        # (docs/RESILIENCE.md; chaos_longctx gates it)
+        for idx, pf in list(self._prefilling.items()):
+            rid = pf.request.request_id
+            if self.prefix_cache is not None and pf.nodes:
+                self.prefix_cache.release(pf.nodes)
+            if self.paged_arena is not None and pf.blocks:
+                self.paged_arena.free(
+                    [b for b in pf.blocks[pf.n_shared:]
+                     if b != self.paged_arena.trash])
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=rid, reason="engine_failed",
+                         started=False)
+            if _reqs._active:
+                _reqs._ledger.on_reject(rid, t=t_fail,
+                                        reason="engine_failed",
+                                        engine=lbl, started=False)
+            pf.handle._reject(EngineFailedError(
+                f"{msg} ({rid} was mid-chunked-prefill at offset "
+                f"{pf.off}, nothing streamed)", request_id=rid,
+                started=False, engine_step=step))
+            self._handles.pop(rid, None)
+        self._prefilling = {}
         # swapped-out requests are STARTED (tokens streamed before the
         # preemption): typed started=True, never requeued — without
         # this pass the generic not-done sweep below would misread
@@ -1580,7 +1817,12 @@ class InferenceEngine:
         if self._pending_scatter or self._pending_keys:
             self._flush_admission_writes()
         if self.paged_arena is not None and slot.blocks:
-            self.paged_arena.free(slot.blocks[slot.n_shared:])
+            # windowed slots hold trash sentinels at already-dropped
+            # leading lanes — those were freed when they left the
+            # window, so only real ids return to the free list
+            self.paged_arena.free(
+                [b for b in slot.blocks[slot.n_shared:]
+                 if b != self.paged_arena.trash])
             slot.blocks = []
 
     def _block_tables(self, idxs=None):
@@ -1634,11 +1876,33 @@ class InferenceEngine:
         never livelocks with every slot too big to advance."""
         arena = self.paged_arena
         B = arena.block_size
+        W = self._window
         for i in range(self.max_slots):
             slot = self._slots[i]
             if slot is None:
                 continue
-            need = (int(self._pos[i]) + self._spec_pad) // B + 1
+            pos = int(self._pos[i])
+            if W is not None:
+                # DROP out-of-window blocks first (so this slot's own
+                # freed block can satisfy its growth below): block j
+                # is fully dead once its last position (j+1)*B - 1
+                # falls below the lowest key the next query attends
+                # (pos - W + 1) — the long-chat O(window) memory
+                # model.  The table lane keeps a trash sentinel so
+                # block indices stay positional.
+                dead = max(0, (pos - W + 1) // B)
+                drop = [b for b in slot.blocks[:dead]
+                        if b != arena.trash]
+                if drop:
+                    if self._pending_scatter or self._pending_keys:
+                        # a deferred admission write could target a
+                        # block about to be freed-and-reallocated
+                        self._flush_admission_writes()
+                    arena.free(drop)
+                    arena.on_window_drop(len(drop))
+                    for j in range(min(dead, len(slot.blocks))):
+                        slot.blocks[j] = arena.trash
+            need = (pos + self._spec_pad) // B + 1
             short = need - len(slot.blocks)
             if short <= 0:
                 continue
@@ -1669,8 +1933,9 @@ class InferenceEngine:
         avail = arena.blocks_free
         if self.prefix_cache is not None:
             avail += self.prefix_cache.evictable_blocks()
+        trash = arena.trash
         avail += sum(
-            len(s.blocks) - s.n_shared
+            sum(1 for b in s.blocks[s.n_shared:] if b != trash)
             for i, s in enumerate(self._slots)
             if s is not None and i != exclude_idx
             and getattr(s.handle.request, "priority", 0) < priority)
@@ -1734,17 +1999,29 @@ class InferenceEngine:
         sw.tok = int(self._toks[idx])
         sw.temp = float(self._temps[idx])
         sw.key = np.asarray(self._keys[idx])
-        sw.n_data = (pos - 1) // arena.block_size + 1
+        # windowed slots: leading lanes already dropped to trash hold
+        # no bytes — swap only the live tail, and remember the lane
+        # offset so resume rebuilds the same positional table (the
+        # swap image stays O(window) like the device footprint)
+        sw.j_lo = 0
+        if self._window is not None:
+            while sw.j_lo < len(slot.blocks) \
+                    and slot.blocks[sw.j_lo] == arena.trash:
+                sw.j_lo += 1
+        sw.n_data = max(0, (pos - 1) // arena.block_size + 1
+                        - sw.j_lo)
         sw.seq = next(self._swap_seq)
         sw.t_preempt = self._clock()
-        sw.kc_h, sw.vc_h = arena.swap_out(slot.blocks, sw.n_data)
+        sw.kc_h, sw.vc_h = arena.swap_out(slot.blocks[sw.j_lo:],
+                                          sw.n_data)
         sw.dkc_h = sw.dvc_h = None
         if self.draft is not None:
             dkc_row, dvc_row = _read_slot(self._dkc, self._dvc,
                                           jnp.int32(idx))
             sw.dkc_h = jax.tree.map(np.asarray, dkc_row)
             sw.dvc_h = jax.tree.map(np.asarray, dvc_row)
-        n_freed = len(slot.blocks) - slot.n_shared
+        n_freed = sum(1 for b in slot.blocks[slot.n_shared:]
+                      if b != arena.trash)
         self._free_slot_blocks(slot)
         self._release_prefix(slot)
         self._slots[idx] = None
@@ -1776,11 +2053,15 @@ class InferenceEngine:
             # strictly-lower live slot) APPENDS to the swap list, and
             # the next head must still be the highest-priority oldest
             self._swapped.sort(key=lambda s: (-s.priority, s.seq))
-            free = [i for i, s in enumerate(self._slots) if s is None]
+            # a slot reserved by an in-flight chunked prefill is NOT
+            # free: a resume landing there would be clobbered when
+            # _finish_prefilling promotes the reservation
+            free = self._free_slots()
             if not free:
                 return
             sw = self._swapped[0]
-            need = (sw.pos + self._spec_pad) // B + 1
+            j_lo = getattr(sw, "j_lo", 0)
+            need = (sw.pos + self._spec_pad) // B + 1 - j_lo
             blocks = self._alloc_blocks(need, sw.priority)
             if blocks is None:
                 return
@@ -1796,7 +2077,10 @@ class InferenceEngine:
                          sw.admitted_step)
             slot.emitted = sw.emitted
             slot.first_token_time = sw.first_token_time
-            slot.blocks = blocks
+            # windowed: rebuild the positional table with the dropped
+            # leading lanes as trash sentinels (same shape the
+            # uninterrupted slot would hold at this pos)
+            slot.blocks = [arena.trash] * j_lo + blocks
             slot.n_shared = 0
             self._slots[idx] = slot
             self._toks[idx] = sw.tok
@@ -1928,44 +2212,19 @@ class InferenceEngine:
             # them swapped behind fresh arrivals would invert both the
             # priority order and the latency story
             self._try_resume(now)
-        free = [i for i, s in enumerate(self._slots) if s is None]
+        if self._budget is not None:
+            # chunked-prefill token budget (the long-context round):
+            # a dedicated pass that first advances in-flight chunked
+            # prefills and then admits new work against the step's
+            # remaining token budget — one admission can span many
+            # steps, so the whole-prompt flow below does not apply
+            self._schedule_budgeted(now)
+            return
+        free = self._free_slots()
         if not free and self.scheduler.queue_depth == 0:
             return
-        navail = len(free)
-        if self.paged_arena is not None \
-                and self.paged_arena.config.admit_per_step is not None:
-            # admission interleave budget (PagedConfig.admit_per_step):
-            # bound prefills per pass so an arrival burst cannot stall
-            # every live slot's decode cadence behind a wall of
-            # admissions — the same total prefill work, spread
-            navail = min(navail,
-                         self.paged_arena.config.admit_per_step)
-        if self._sched_cost is not None:
-            admit, expired = self.scheduler.schedule(
-                navail, now, cost=self._sched_cost)
-        else:
-            admit, expired = self.scheduler.schedule(navail, now)
-        for req in expired:
-            self.stats.on_deadline_expired(req.request_id)
-            _trace.event("serve/request_rejected", cat="serve",
-                         request=req.request_id, reason="deadline")
-            if _reqs._active:
-                _reqs._ledger.on_reject(req.request_id, t=now,
-                                        reason="deadline",
-                                        engine=self.stats.engine_label,
-                                        started=False)
-            self._handles.pop(req.request_id)._reject(
-                DeadlineExceededError(
-                    f"{req.request_id}: deadline {req.deadline} passed "
-                    f"at {now} before a slot was available"))
-        # a swapped request still waiting after the resume pass is
-        # blocked on CAPACITY: fresh arrivals at or below its priority
-        # must not eat the blocks/slots it is waiting for (it already
-        # streamed tokens — letting new work overtake it would grow
-        # its latency without bound); strictly-higher arrivals may
-        # still overtake (they outrank it for preemption anyway)
-        blocked_p = (max(sw.priority for sw in self._swapped)
-                     if self._swapped else None)
+        admit = self._sched_admissions(len(free), now)
+        blocked_p = self._blocked_priority()
         # BATCHED pass prefill (the gather-tax round): a multi-request
         # pass on a cold paged engine (no prefix cache to consult, no
         # draft rows to build) prefills every admission in ONE
@@ -1988,7 +2247,8 @@ class InferenceEngine:
                 batchable.append(r)
         prefilled = {}
         if (self.paged_arena is not None and self.draft is None
-                and self.prefix_cache is None and len(batchable) > 1
+                and self.prefix_cache is None and not self._ring
+                and len(batchable) > 1
                 # int32 seed lanes: an exotic >= 2^31 seed keeps the
                 # per-request path (identical streams either way — the
                 # batch must never silently rekey a request)
@@ -2033,6 +2293,303 @@ class InferenceEngine:
             self._batch_cache = None
         if self._admit_batch is not None:
             self._flush_admission_writes(drop_batch=True)
+
+    def _free_slots(self):
+        """Slot indices genuinely available for admission or resume:
+        unoccupied AND not reserved by an in-flight chunked prefill."""
+        return [i for i, s in enumerate(self._slots)
+                if s is None and i not in self._prefilling]
+
+    def _sched_admissions(self, navail, now):
+        """One scheduler consultation, shared by the whole-prompt and
+        budgeted passes so the two cannot drift: cap by the
+        admission-interleave knob, pass the warm-prefix cost pricer
+        when the scheduler takes one, and reject deadline-expired
+        requests.  Returns the admit list."""
+        if self.paged_arena is not None \
+                and self.paged_arena.config.admit_per_step is not None:
+            navail = min(navail,
+                         self.paged_arena.config.admit_per_step)
+        if self._sched_cost is not None:
+            admit, expired = self.scheduler.schedule(
+                navail, now, cost=self._sched_cost)
+        else:
+            admit, expired = self.scheduler.schedule(navail, now)
+        self._reject_expired(expired, now)
+        return admit
+
+    def _blocked_priority(self):
+        """The capacity-block fairness bound: a swapped request still
+        waiting after the resume pass outranks fresh arrivals at or
+        below its priority (it already streamed tokens — letting new
+        work overtake would grow its latency without bound)."""
+        return (max(sw.priority for sw in self._swapped)
+                if self._swapped else None)
+
+    def _reject_expired(self, expired, now):
+        for req in expired:
+            self.stats.on_deadline_expired(req.request_id)
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=req.request_id, reason="deadline")
+            if _reqs._active:
+                _reqs._ledger.on_reject(req.request_id, t=now,
+                                        reason="deadline",
+                                        engine=self.stats.engine_label,
+                                        started=False)
+            self._handles.pop(req.request_id)._reject(
+                DeadlineExceededError(
+                    f"{req.request_id}: deadline {req.deadline} passed "
+                    f"at {now} before a slot was available"))
+
+    # -- chunked-prefill token budget (the long-context round) -----------
+    def _schedule_budgeted(self, now):
+        """One scheduling pass under ``prefill_token_budget``: spend
+        at most that many prefill TOKENS this step — first on
+        in-flight chunked prefills (admission order: the FIFO contract
+        holds across steps, an expensive head request BLOCKS the
+        budget, it is never skipped), then on new admissions.  A new
+        admission whose prompt exceeds the remaining budget simply
+        carries over: its chunks continue next step, which is the
+        whole point — decode lanes dispatched BEFORE this pass
+        (step() order) never wait for more than one step's budget of
+        prefill work."""
+        left = self._budget
+        B = self.paged_arena.block_size
+        for idx in sorted(self._prefilling,
+                          key=lambda i: self._prefilling[i].seq):
+            if left < B:
+                break
+            left = self._advance_prefilling(idx, left, now)
+        free = self._free_slots()
+        if not free and self.scheduler.queue_depth == 0:
+            return
+        admit = self._sched_admissions(len(free), now)
+        blocked_p = self._blocked_priority()
+        for k, req in enumerate(admit):
+            ok = False
+            admissible = (left >= B
+                          and (blocked_p is None
+                               or getattr(req, "priority", 0)
+                               > blocked_p))
+            if admissible and self._ring_eligible(
+                    len(req.prompt_ids)):
+                # ring prefill is ONE mesh-sharded dispatch for the
+                # whole prompt — admit whole and charge the budget,
+                # so no further prefill stacks onto this step
+                ok = self._admit(free[0], req, now)
+                if ok:
+                    free.pop(0)
+                    left = max(0, left - len(req.prompt_ids))
+            elif admissible:
+                idx = self._start_prefilling(free[0], req, now)
+                if idx is not None:
+                    free.pop(0)
+                    ok = True
+                    left = self._advance_prefilling(idx, left, now)
+            if not ok:
+                # budget exhausted or capacity-blocked: everything
+                # scheduled from here returns to the queue FRONT in
+                # original order — admission order blocks, it never
+                # skips
+                for r in reversed(admit[k:]):
+                    self.scheduler.requeue_front(r)
+                break
+
+    def _start_prefilling(self, idx, req, now):
+        """Begin a chunked-prefill admission at slot ``idx``: acquire
+        any cached prefix, allocate the request's prompt blocks (all
+        of them up front — a mid-prefill capacity dance would
+        deadlock against other prefills), and park the request in
+        ``self._prefilling`` with a fresh full-width cache row.
+        Returns the slot index, or None when the blocks do not fit
+        (caller requeues at the queue front)."""
+        arena = self.paged_arena
+        B = arena.block_size
+        plen = len(req.prompt_ids)
+        cache = self.prefix_cache
+        nodes = []
+        if cache is not None:
+            nodes = cache.lookup(req.prompt_ids)[:(plen - 1) // B]
+            if nodes:
+                cache.acquire(nodes)
+        j_lo0 = 0
+        if self._window is not None:
+            # a windowed admission only ever stores the lanes a
+            # future query can attend: blocks below the first
+            # in-window lane are never allocated at all
+            j_lo0 = max(0, (plen - self._window + 1) // B)
+        n_new = plen // B + 1 - j_lo0 - len(nodes)
+        new_blocks = self._alloc_blocks(n_new,
+                                        getattr(req, "priority", 0))
+        if new_blocks is None:
+            if cache is not None and nodes:
+                cache.release(nodes)
+            return None
+        if _reqs._active:
+            _reqs._ledger.on_admit(req.request_id,
+                                   engine=self.stats.engine_label,
+                                   t=now, slot=idx,
+                                   step=self.step_count)
+        if cache is not None:
+            cache.on_admit(len(nodes), plen,
+                           request_id=req.request_id)
+        try:
+            if nodes:
+                kc_row, vc_row = cache.copy_into_row(nodes)
+            else:
+                # a fresh zero row of the full width — the same
+                # chunk-from-scratch canonical form the int8+cache
+                # cold path runs (chunked == full prefill bitwise on
+                # dense rows, pinned by tests/test_prefix.py)
+                kc_row, vc_row = arena.gather_row([], n_used=0)
+        except Exception:
+            # the copies above check fault sites (serve.prefix_copy /
+            # serve.paged_copy): a raise here is BEFORE the blocks are
+            # registered in self._prefilling, so _fail's sweep would
+            # never see them — return them ourselves or they leak
+            arena.free(new_blocks)
+            if cache is not None and nodes:
+                cache.release(nodes)
+            raise
+        ids = np.zeros((1, self.max_len), np.int32)
+        ids[0, :plen] = req.prompt_ids
+        pf = _Prefilling()
+        pf.handle = self._handles[req.request_id]
+        pf.request = req
+        pf.ids_j = jnp.asarray(ids)
+        pf.kc_row, pf.vc_row = kc_row, vc_row
+        pf.hidden = None
+        pf.off = len(nodes) * B
+        pf.last_off = ((plen - 1) // B) * B
+        if self._window is not None:
+            pf.blocks = [arena.trash] * j_lo0 + new_blocks
+        else:
+            pf.blocks = [n.block for n in nodes] + new_blocks
+        pf.n_shared = len(nodes)
+        pf.nodes = nodes
+        pf.key0 = jax.random.split(
+            jax.random.PRNGKey(int(req.seed)), 1)[0]
+        pf.temp = np.float32(req.temperature)
+        pf.t_admit = now
+        pf.admitted_step = self.step_count
+        pf.seq = next(self._prefill_seq)
+        self._prefilling[idx] = pf
+        _trace.event("serve/prefill_budgeted", cat="serve",
+                     request=req.request_id, slot=idx,
+                     prompt_len=plen, step=self.step_count,
+                     chunks=(pf.last_off - pf.off) // B + 1)
+        return idx
+
+    def _advance_prefilling(self, idx, left, now):
+        """Spend up to ``left`` budget tokens on slot ``idx``'s
+        chunked prefill (block-width ``_chunk_row`` windows — the
+        exact executable warm admission rides, so a budgeted stream
+        is byte-identical to an unbudgeted one).  Completes the
+        admission when the last chunk lands.  Returns the remaining
+        budget."""
+        pf = self._prefilling[idx]
+        B = self.paged_arena.block_size
+        rid = pf.request.request_id
+        while left >= B and pf.off <= pf.last_off:
+            if _faults._armed:
+                # chaos hook: a fault BETWEEN chunks models a raising
+                # mid-prefill dispatch — step() fails the engine
+                # typed, the rejection is started=False (nothing
+                # streamed), and _fail returns the partial blocks to
+                # the free list (RESILIENCE.md; chaos_longctx)
+                _faults.check("serve.prefill_chunk")
+            pf.hidden, pf.kc_row, pf.vc_row = self._x.chunk_row(
+                self._params, pf.ids_j, pf.kc_row, pf.vc_row,
+                jnp.int32(pf.off))
+            self._c_budget_chunks.inc()
+            if _reqs._active:
+                _reqs._ledger.on_prefill_chunk(
+                    rid, engine=self.stats.engine_label,
+                    t=self._clock(), offset=pf.off)
+            pf.off += B
+            left -= B
+        if pf.off > pf.last_off:
+            self._finish_prefilling(idx, pf)
+        return left
+
+    def _finish_prefilling(self, idx, pf):
+        """The last chunk landed: sample the admission token from the
+        final chunk's hidden block (mirrors ``_prefill_one``'s tail
+        via ``_first_from_hidden`` — bitwise the unbudgeted token),
+        scatter the row's lanes into the request's pool blocks, and
+        promote the reservation to a LIVE slot."""
+        arena = self.paged_arena
+        req = pf.request
+        plen = len(req.prompt_ids)
+        tok0, carry_key = _first_from_hidden(
+            self._params, pf.hidden,
+            jnp.int32(plen - 1 - pf.last_off), pf.key0, pf.temp,
+            self._top_p, top_k=self._statics["top_k"],
+            use_top_p=self._statics["use_top_p"])
+        lanes = {j: pf.blocks[j]
+                 for j in range(pf.n_shared, plen // arena.block_size
+                                + 1)
+                 if pf.blocks[j] != arena.trash}
+        arena.scatter_row(pf.kc_row, pf.vc_row, lanes)
+        if self.draft is not None:
+            # the draft prefills whole at completion — it is cheap by
+            # construction (the whole point of a draft), so it never
+            # needed the budget's protection
+            dkc_row, dvc_row = _prefill_rows(
+                self._d_params, pf.ids_j, *self._d_statics,
+                quant=self._quant)
+            self._dkc, self._dvc = _write_slot(
+                self._dkc, self._dvc, dkc_row, dvc_row,
+                jnp.int32(idx))
+        self.stats.on_prefill()
+        slot = _Slot(pf.handle, req.max_new_tokens, pf.t_admit,
+                     pf.admitted_step)
+        slot.prefix_nodes = pf.nodes
+        slot.blocks = pf.blocks
+        slot.n_shared = pf.n_shared
+        del self._prefilling[idx]
+        self._slots[idx] = slot
+        tok0 = int(np.asarray(tok0))   # device sync: prefill done
+        t_first = self._clock()
+        submit_t = getattr(pf.handle, "_submit_time", pf.t_admit)
+        self.stats.on_admission(pf.t_admit - submit_t,
+                                t_first - pf.t_admit,
+                                warm=bool(pf.nodes))
+        if _reqs._active:
+            _reqs._ledger.on_first_token(
+                req.request_id, engine=self.stats.engine_label,
+                t=t_first)
+        self._toks[idx] = tok0
+        self._pos[idx] = plen
+        self._temps[idx] = pf.temp
+        self._keys = self._keys.at[idx].set(carry_key)
+        self._emit(idx, slot, tok0, t_first)
+
+    # -- ring-attention prefill (the long-context round, part 3) ---------
+    def _ring_width(self, plen):
+        """The padded prompt width a ring prefill runs at: the
+        smallest width that is both a block multiple (the scatter's
+        lane granularity) and divisible by the mesh width (equal
+        per-shard sequence chunks), or None when that exceeds
+        ``max_len`` (the caller falls back to the serial prefill)."""
+        B = self.paged_arena.block_size
+        tpw = self.tp_exec.tp
+        # the admission scatters plen//B + 1 lanes (the last one is
+        # the block the first decode write lands in — same as the
+        # serial narrow path), so the row must be at least that wide
+        wn0 = (plen // B + 1) * B
+        step = B * tpw // math.gcd(B, tpw)
+        wn = -(-wn0 // step) * step
+        return wn if wn <= self.max_len else None
+
+    def _ring_eligible(self, plen):
+        """Ring prefill fires for cold admissions at or above
+        ``TPConfig.ring_min_tokens`` when a legal padded width
+        exists."""
+        if not self._ring:
+            return False
+        mt = getattr(self._tp_cfg, "ring_min_tokens", 0) or 0
+        return plen >= mt and self._ring_width(plen) is not None
 
     def _prefill_cost(self, req):
         """Scheduler interleave price of admitting ``req`` now: 0 for
@@ -2159,9 +2716,18 @@ class InferenceEngine:
             # request
             if cache is not None and nodes:
                 cache.acquire(nodes)
+            j_lo0 = 0
+            if self._window is not None:
+                # windowed admission: lanes below the first in-window
+                # position are never attended by any future query, so
+                # their blocks are never allocated — a long prompt on
+                # a windowed model admits in O(window) blocks
+                j_lo0 = max(0,
+                            (plen - self._window + 1)
+                            // arena.block_size)
             n0 = plen // arena.block_size + 1
             new_blocks = self._alloc_blocks(
-                n0 - len(nodes), getattr(req, "priority", 0))
+                n0 - j_lo0 - len(nodes), getattr(req, "priority", 0))
             if new_blocks is None:
                 if cache is not None and nodes:
                     cache.release(nodes)
@@ -2210,6 +2776,22 @@ class InferenceEngine:
                 tok0, carry_key, kc_row, vc_row = self._admit_warm(
                     ids, plen, nodes, key0, temp,
                     rid=req.request_id)
+            elif arena is not None and self._ring_eligible(plen):
+                # ring-attention prefill (the long-context round):
+                # the prompt's sequence axis shards over the tp mesh
+                # and K/V blocks rotate the ICI ring
+                # (parallel/ring_attention.py via the executor seam)
+                # — ONE dispatch whose attention workspace per shard
+                # is O((S/tp)^2) instead of O(S^2), for prompts
+                # beyond one shard's flash tile.  Token-identical to
+                # the serial prefill (logsumexp merge reorders the
+                # float reduction — same caveat as the TP psum),
+                # pinned by tests/test_serve_longctx.py.
+                wn = self._ring_width(plen)
+                tok0, carry_key, kc_row, vc_row = \
+                    self.tp_exec.ring_prefill_one(
+                        self._params, ids_j[:, :wn], plen, key0,
+                        temp, self._top_p)
             else:
                 pf_ids = ids_j
                 if arena is not None:
@@ -2239,8 +2821,10 @@ class InferenceEngine:
             if arena is not None:
                 # the prefilled lanes past the shared prefix scatter
                 # into the request's freshly-allocated pool blocks;
-                # matched lanes never move (shared by reference)
-                m = len(nodes)
+                # matched lanes never move (shared by reference).
+                # Windowed admissions start at the first in-window
+                # lane instead (below it nothing was allocated)
+                m = len(nodes) + j_lo0
                 lanes = {m + j: b for j, b in enumerate(new_blocks)}
                 if deferred_row is not None:
                     self._pending_scatter.append((deferred_row, lanes))
@@ -2272,7 +2856,8 @@ class InferenceEngine:
         slot = _Slot(handle, req.max_new_tokens, now, self.step_count)
         slot.prefix_nodes = nodes
         if arena is not None:
-            slot.blocks = [n.block for n in nodes] + new_blocks
+            slot.blocks = ([n.block for n in nodes]
+                           + [arena.trash] * j_lo0 + new_blocks)
             slot.n_shared = len(nodes)
         self._slots[idx] = slot
         tok0 = int(np.asarray(tok0))  # device sync: prefill is done

@@ -131,12 +131,26 @@ class PagedConfig:
     trading a little TTFT headroom (paged TTFT is ~10-20x below the
     slot arena's to begin with) for flat decode cadence — the
     Sarathi-style chunked-prefill budget in miniature (ROADMAP item
-    2a; the request ledger's stall phase is the proof metric)."""
+    2a; the request ledger's stall phase is the proof metric).
+    ``prefill_token_budget``: the REAL Sarathi-style chunked-prefill
+    budget (the long-context round): at most this many prefill
+    TOKENS per engine step, and — unlike ``admit_per_step``, which
+    only caps how many whole prefills a pass runs — a single
+    admission whose prompt exceeds the budget is SPLIT across
+    consecutive steps in block-multiple chunks (the engine's
+    ``_chunk_row`` / ``gpt2_decode.prefill_chunk`` executables,
+    chunk rows pinned bitwise against full prefill), so one 32k
+    document admission can never stall the live decode lanes for
+    more than one chunk's latency per step.  Must be a multiple of
+    ``block_size``; None = off (whole-prompt admissions, the
+    historical behavior).  docs/SERVING.md "Long-context serving"
+    has the budget-vs-admit_per_step semantics table."""
 
     block_size: int = 32
     num_blocks: int = 128
     kernel: str = "block"
     admit_per_step: int | None = None
+    prefill_token_budget: int | None = None
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -154,6 +168,15 @@ class PagedConfig:
             raise ValueError(
                 f"admit_per_step must be >= 1 (or None for "
                 f"unlimited), got {self.admit_per_step}")
+        if self.prefill_token_budget is not None:
+            if self.prefill_token_budget < self.block_size \
+                    or self.prefill_token_budget % self.block_size:
+                raise ValueError(
+                    f"prefill_token_budget "
+                    f"({self.prefill_token_budget}) must be a "
+                    f"positive multiple of block_size "
+                    f"({self.block_size}): chunked prefill advances "
+                    f"in block-width windows")
 
 
 # -- pytree-generic fixed-shape copies ---------------------------------------
@@ -369,30 +392,46 @@ def _paged_spec_step(t_params, d_params, pool_k, pool_v, dkc, dvc,
 
 @partial(jax.jit,
          static_argnames=("block", "n_head", "eps", "moe_top_k",
-                          "top_k", "use_top_p", "tp_axis", "tp_world"),
+                          "top_k", "use_top_p", "window", "tp_axis",
+                          "tp_world"),
          donate_argnums=(1, 2))
 def _paged_decode_kernel(params, pool_k, pool_v, tables, toks, pos,
                          live, keys, temps, top_p, block, n_head, eps,
-                         moe_top_k, top_k, use_top_p, tp_axis=None,
-                         tp_world=1):
+                         moe_top_k, top_k, use_top_p, window=None,
+                         tp_axis=None, tp_world=1):
     """Advance EVERY slot one token against the block pool WITHOUT
     gathering rows: per slot, online-softmax attention over its live
     blocks (beyond-``pos`` and trash lanes masked) plus the step's
     own K/V as the current lane, then scatter back ONLY the
     read-modified block containing ``pos`` (dead slots write the
     trash block).  Returns (next_toks, pool_k, pool_v, new_keys) —
-    the same contract as :func:`_paged_decode_step`."""
+    the same contract as :func:`_paged_decode_step`.
+
+    ``window`` (static): sliding-window decode (the long-context
+    round) — each slot's query additionally masks pool lanes at
+    positions <= pos - window, and the block loop STARTS at the
+    lowest in-window block across live slots, so a windowed long
+    chat's attention work is O(window) blocks regardless of how far
+    ``pos`` has advanced (the engine drops fully-out-of-window
+    blocks back to the free list host-side; their table entries are
+    trash by then, so the bound is a work optimization, never a
+    correctness input)."""
     from .engine import _decode_row_paged
 
     trash = jax.tree.leaves(pool_k)[0].shape[1] - 1
     p_all = jnp.where(live, pos, 0)
     n_blk = jnp.max((p_all + block - 1) // block)
+    blk_lo = None
+    if window is not None:
+        lo = jnp.maximum(0, (p_all - window + 1) // block)
+        blk_lo = jnp.min(jnp.where(live, lo, n_blk))
 
     def row(tbl, tok, pos_r, live_r, key, temp):
         nxt, kb, vb, k2 = _decode_row_paged(
             params, pool_k, pool_v, tbl, tok, pos_r, live_r, key,
             temp, top_p, n_blk, block, trash, n_head, eps, moe_top_k,
-            top_k, use_top_p, tp_axis=tp_axis, tp_world=tp_world)
+            top_k, use_top_p, window=window, blk_lo=blk_lo,
+            tp_axis=tp_axis, tp_world=tp_world)
         p_c = jnp.where(live_r, pos_r, 0)
         dst = jnp.where(live_r, tbl[p_c // block], trash)
         return nxt, kb, vb, dst, k2
@@ -407,13 +446,14 @@ def _paged_decode_kernel(params, pool_k, pool_v, tables, toks, pos,
 
 @partial(jax.jit,
          static_argnames=("block", "spec_k", "tn", "te", "tm", "dn",
-                          "de", "dm", "top_k", "use_top_p", "tp_axis",
-                          "tp_world"),
+                          "de", "dm", "top_k", "use_top_p", "window",
+                          "tp_axis", "tp_world"),
          donate_argnums=(2, 3, 4, 5))
 def _paged_spec_kernel(t_params, d_params, pool_k, pool_v, dkc, dvc,
                        tables, toks, pos, live, keys, temps, top_p,
                        block, spec_k, tn, te, tm, dn, de, dm, top_k,
-                       use_top_p, tp_axis=None, tp_world=1):
+                       use_top_p, window=None, tp_axis=None,
+                       tp_world=1):
     """Speculative chunk against the block pool, block-natively: the
     draft scan and verify are the gather step's (shared helpers in
     engine.py), the TARGET chunk attends the pool through the
@@ -428,12 +468,19 @@ def _paged_spec_kernel(t_params, d_params, pool_k, pool_v, dkc, dvc,
     trash = jax.tree.leaves(pool_k)[0].shape[1] - 1
     p_all = jnp.where(live, pos, 0)
     n_blk = jnp.max((p_all + block - 1) // block)
+    blk_lo = None
+    if window is not None:
+        # the LOWEST query of a verify chunk is position pos itself,
+        # so the same bound as the decode kernel's covers every query
+        lo = jnp.maximum(0, (p_all - window + 1) // block)
+        blk_lo = jnp.min(jnp.where(live, lo, n_blk))
 
     def row(dkc_r, dvc_r, tbl, tok, pos_r, live_r, key, temp):
         out, a_draft, kdbl, vdbl, dkc2, dvc2, k2 = _spec_row_paged(
             t_params, d_params, pool_k, pool_v, dkc_r, dvc_r, tbl,
             tok, pos_r, live_r, key, temp, top_p, n_blk, spec_k,
             block, trash, tn, te, tm, dn, de, dm, top_k, use_top_p,
+            window=window, blk_lo=blk_lo,
             tp_axis=tp_axis, tp_world=tp_world)
         p_c = jnp.where(live_r, pos_r, 0)
         b0 = p_c // block
@@ -609,8 +656,15 @@ class PagedKVArena:
         self._c_swap_in = reg.counter(
             "serve.paged.swap_in",
             help="request KV rows restored host -> device", **lbl)
+        self._c_window_drop = reg.counter(
+            "serve.paged.window_drops",
+            help="out-of-window blocks a sliding-window slot dropped "
+                 "back to the free list as its position advanced "
+                 "(the O(window) memory model's reclaim path)", **lbl)
+        self.window_drops = 0
         self._registered = [self._g_free, self._g_used, self._c_preempt,
-                            self._c_swap_out, self._c_swap_in]
+                            self._c_swap_out, self._c_swap_in,
+                            self._c_window_drop]
         self._registry = reg
         self._update_gauges()
 
@@ -742,6 +796,13 @@ class PagedKVArena:
 
     def on_preempt(self):
         self._c_preempt.inc()
+
+    def on_window_drop(self, n):
+        """Account ``n`` out-of-window blocks freed by a windowed
+        slot's advance (the engine already returned them via
+        :meth:`free`)."""
+        self.window_drops += n
+        self._c_window_drop.inc(n)
 
     # -- lifecycle / reporting -------------------------------------------
     def unregister(self):

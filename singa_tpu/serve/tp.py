@@ -120,10 +120,29 @@ class TPConfig:
     ``tp``: shard count (the mesh width; 1 = tensor parallelism off).
     ``devices``: explicit device tuple (default: the first ``tp`` of
     ``jax.devices()``) — the fleet hands each TP replica a disjoint
-    slice (:func:`fleet_tp_configs`)."""
+    slice (:func:`fleet_tp_configs`).
+    ``ring_prefill``: RING-ATTENTION prefill for cold long-prompt
+    admissions (the long-context round): the prompt's sequence axis
+    shards over the SAME tp mesh and K/V blocks rotate the ICI ring
+    (``parallel/ring_attention.ring_self_attention``, causal), so
+    prefill attention workspace per shard is O((S/tp)^2) — prompts
+    beyond one shard's flash tile stop being the admission
+    bottleneck.  The ring path keeps a REPLICATED full-weight copy
+    (context parallelism: sequence sharded, weights whole — the
+    attention heads cannot stay Megatron-column-sharded when the
+    visiting K/V block carries a different rank's sequence chunk),
+    so it trades one extra weight copy for the sequence-memory win;
+    composition limits (no prefix cache, no sliding window, no int8)
+    are typed at engine construction — docs/SERVING.md "Long-context
+    serving".
+    ``ring_min_tokens``: only prompts at least this long take the
+    ring path (shorter ones stay on the serial narrow-width
+    prefill, which is cheaper than paying ppermute latency)."""
 
     tp: int = 2
     devices: tuple | None = None
+    ring_prefill: bool = False
+    ring_min_tokens: int = 256
 
     def __post_init__(self):
         if self.tp < 1:
@@ -133,6 +152,10 @@ class TPConfig:
             raise ValueError(
                 f"TPConfig(tp={self.tp}) with only "
                 f"{len(self.devices)} explicit devices")
+        if self.ring_min_tokens < 0:
+            raise ValueError(
+                f"ring_min_tokens must be >= 0, got "
+                f"{self.ring_min_tokens}")
 
 
 def as_tp_config(tp):
@@ -214,6 +237,8 @@ class TPExecutor:
         self._quant = bool(quant)
         self._spec = None      # (spec_k, (dn, de, dm)) once set_spec
         self._chunk = None     # chunk statics dict once set_chunk
+        self._window = None    # sliding window once set_window
+        self._ring_params = None   # replicated copy once enable_ring
         self._top = None
         self._pspec = None     # set by place_params
         self._cache_sh = NamedSharding(self.mesh, _CS)
@@ -234,6 +259,7 @@ class TPExecutor:
                      self._quant)
         reg = reg if reg is not None else _default_registry()
         lbl = dict(engine=engine_label)
+        self._lbl = lbl
         self._g_shards = reg.gauge(
             "serve.tp.shards",
             help="tensor-parallel shard count of this engine's mesh",
@@ -300,6 +326,28 @@ class TPExecutor:
     def set_chunk(self, chunk_statics):
         self._chunk = dict(chunk_statics)
 
+    def set_window(self, window):
+        """Sliding-window width (or None) — a STATIC every prefill
+        and block-kernel twin bakes in, so it rides each twin's
+        ``extra`` key slot (two engines for the same weights with
+        different windows must not share a twin)."""
+        self._window = None if window is None else int(window)
+
+    def enable_ring(self, host_params):
+        """Arm ring-attention prefill: commit a REPLICATED full-weight
+        copy for the sequence-sharded twin (the Megatron column shards
+        cannot serve it — a visiting K/V block carries another rank's
+        sequence chunk for ALL heads) and register the dispatch
+        counter.  The engine runs the composition checks before
+        calling this (no prefix cache / window / int8)."""
+        self._ring_params = self.place_replicated(host_params)
+        self._c_ring = self._registry.counter(
+            "serve.tp.ring_prefills",
+            help="cold admissions prefilled via ring attention "
+                 "(sequence sharded over the tp mesh)", **self._lbl)
+        self._registered.append(self._c_ring)
+        self.ring_prefills = 0
+
     # -- twin dispatch ----------------------------------------------------
     def _twin(self, base, extra, make, donate=()):
         key = (base, extra, self._key)
@@ -352,6 +400,8 @@ class TPExecutor:
             "pool_to_row": (_CS, _CS, _R, _R),
             "row_to_pool": (_CS, _CS, _CS, _CS, _R),
             "rows_to_pool": (_CS, _CS, _CS, _CS, _R, _R),
+            # ring prefill: replicated weights, SEQUENCE-sharded ids
+            "ring_prefill": (_R, P(None, TP_AXIS)),
         }[base]
 
     def _out_specs(self, base):
@@ -368,6 +418,11 @@ class TPExecutor:
             "pool_to_row": (_CS, _CS),
             "row_to_pool": (_CS, _CS),
             "rows_to_pool": (_CS, _CS),
+            # (hidden, kc_row, vc_row) — everything sharded on the
+            # SEQUENCE axis; ring_prefill_one re-places afterwards
+            "ring_prefill": (P(None, TP_AXIS, None),
+                             P(None, None, None, TP_AXIS, None),
+                             P(None, None, None, TP_AXIS, None)),
         }[base]
 
     # -- the executor surface (mirrors engine._LocalExec) -----------------
@@ -416,10 +471,13 @@ class TPExecutor:
 
         base = (_paged_decode_kernel if kernel == "block"
                 else _paged_decode_step)
+        # only the block kernel takes the window static (the gather
+        # oracle is refused for windowed engines at construction)
+        wkw = ({"window": self._window} if kernel == "block" else {})
         fn = self._twin(
-            "paged_decode", (block, kernel),
+            "paged_decode", (block, kernel, self._window),
             lambda: partial(base.__wrapped__,
-                            block=block, **self._statics,
+                            block=block, **self._statics, **wkw,
                             tp_axis=TP_AXIS, tp_world=self.tp),
             donate=(1, 2))
         return self._dispatch(fn, params, pool_k, pool_v, tables,
@@ -436,13 +494,15 @@ class TPExecutor:
         spec_k, (dn, de, dm) = self._spec
         base = (_paged_spec_kernel if kernel == "block"
                 else _paged_spec_step)
+        wkw = ({"window": self._window} if kernel == "block" else {})
         fn = self._twin(
-            "paged_spec", (block, kernel, spec_k, dn, de, dm),
+            "paged_spec", (block, kernel, spec_k, dn, de, dm,
+                           self._window),
             lambda: partial(base.__wrapped__, block=block,
                             spec_k=spec_k, tn=st["n_head"],
                             te=st["eps"], tm=st["moe_top_k"], dn=dn,
                             de=de, dm=dm, top_k=st["top_k"],
-                            use_top_p=st["use_top_p"],
+                            use_top_p=st["use_top_p"], **wkw,
                             tp_axis=TP_AXIS, tp_world=self.tp),
             donate=(2, 3, 4, 5))
         return self._dispatch(fn, t_params, d_params, pool_k, pool_v,
@@ -455,10 +515,10 @@ class TPExecutor:
         from .engine import _prefill_one
 
         fn = self._twin(
-            "prefill_one", (),
+            "prefill_one", (self._window,),
             lambda: partial(_prefill_one.__wrapped__, **self._statics,
-                            quant=self._quant, tp_axis=TP_AXIS,
-                            tp_world=self.tp))
+                            quant=self._quant, window=self._window,
+                            tp_axis=TP_AXIS, tp_world=self.tp))
         return self._dispatch(fn, params, ids, prompt_len, key, temp,
                               top_p)
 
@@ -468,9 +528,10 @@ class TPExecutor:
         from .engine import _prefill_batch
 
         fn = self._twin(
-            "prefill_batch", (),
+            "prefill_batch", (self._window,),
             lambda: partial(_prefill_batch.__wrapped__,
                             **self._statics, quant=self._quant,
+                            window=self._window,
                             tp_axis=TP_AXIS, tp_world=self.tp))
         return self._dispatch(fn, params, ids, plens, seeds, temps,
                               top_p)
@@ -482,7 +543,7 @@ class TPExecutor:
 
         ck = self._chunk
         fn = self._twin(
-            "chunk_row", (ck["chunk"],),
+            "chunk_row", tuple(sorted(ck.items())),
             lambda: partial(_chunk_row.__wrapped__, **ck,
                             tp_axis=TP_AXIS, tp_world=self.tp),
             donate=(2, 3))
@@ -517,6 +578,106 @@ class TPExecutor:
                         lambda: _rows_to_pool_body, donate=(0, 1))
         return self._dispatch(fn, pool_k, pool_v, kc_rows, vc_rows,
                               sel, idx)
+
+    def _make_ring_body(self):
+        """The ring-prefill twin body: per rank, embed the LOCAL
+        sequence chunk, and per layer run causal
+        ``ring_self_attention`` over the tp axis (K/V blocks rotate
+        the ICI ring; logsumexp-exact partial merges) with the
+        REPLICATED weights, dense Megatron-free MLP, and collect the
+        chunk's K/V in the GQA-narrow head count.  Returns
+        (final-LN hidden, kc, vc) — all sequence-sharded; the
+        dispatch wrapper re-places them."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..models import gpt2_decode as G
+        from ..parallel.communicator import _record_collective
+        from ..parallel.ring_attention import ring_self_attention
+
+        st = self._statics
+        n_head, eps = st["n_head"], st["eps"]
+        moe_top_k = st["moe_top_k"]
+        tp = self.tp
+
+        def body(params, ids):
+            rank = lax.axis_index(TP_AXIS)
+            s_loc = ids.shape[1]
+            pos = rank * s_loc + jnp.arange(s_loc)
+            x = (jnp.take(params["wte"], ids[0], axis=0)[None]
+                 + jnp.take(params["wpe"], pos, axis=0)[None])
+            ks, vs = [], []
+            for p in params["blocks"]:
+                h = G._ln(x, p["ln1_s"], p["ln1_b"], eps)
+                q = h @ p["wq"] + p["bq"]
+                k = h @ p["wk"] + p["bk"]
+                v = h @ p["wv"] + p["bv"]
+                b, s, e = x.shape
+                d = e // n_head
+                n_kv = k.shape[-1] // d
+                qh = q.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+                kh = k.reshape(b, s, n_kv, d).transpose(0, 2, 1, 3)
+                vh = v.reshape(b, s, n_kv, d).transpose(0, 2, 1, 3)
+                krep, vrep = kh, vh
+                if n_kv != n_head:
+                    # the ring rotates FULL query-head-width K/V (its
+                    # per-step kernel has no grouped layout); the
+                    # cache keeps the narrow GQA heads below
+                    krep = jnp.repeat(kh, n_head // n_kv, axis=1)
+                    vrep = jnp.repeat(vh, n_head // n_kv, axis=1)
+                # trace-time observe hook: one ring pass issues
+                # axis_size ppermutes of this K/V block pair —
+                # attributable in Chrome traces like every other
+                # collective (axis + world recorded)
+                _record_collective("ring_ppermute", [krep, vrep],
+                                   axis=TP_AXIS, world=tp)
+                a = ring_self_attention(qh, krep, vrep, TP_AXIS,
+                                        causal=True, remat=False)
+                a = a.transpose(0, 2, 1, 3).reshape(b, s, e)
+                x = x + (a @ p["wo"] + p["bo"])
+                h2 = G._ln(x, p["ln2_s"], p["ln2_b"], eps)
+                x = x + G._mlp(h2, p, moe_top_k)
+                ks.append(kh)
+                vs.append(vh)
+            x = G._ln(x, params["lnf_s"], params["lnf_b"], eps)
+            return x, G._cache_stack(ks), G._cache_stack(vs)
+
+        return body
+
+    def ring_prefill_one(self, params, ids, plen, key, temp, top_p):
+        """Ring-attention cold admission prefill (the long-context
+        round): ``ids`` (1, wn) right-padded at a width divisible by
+        both the block size and the mesh width.  One sequence-sharded
+        dispatch computes hidden + K/V for the whole prompt — per
+        shard the attention tile is O((wn/tp)^2) — then the outputs
+        re-place (hidden replicated, rows onto the head-axis cache
+        sharding every copy twin expects; one explicit transfer per
+        long admission, off the decode hot path) and the admission
+        token samples through the same ``_first_from_hidden`` tail
+        the chunked path uses.  Token-identical to the serial
+        prefill: the logsumexp partial merge reorders the float
+        reduction, the same caveat as the decode psum.  Returns the
+        ``prefill_one`` contract (tok0, carried key, kc_row,
+        vc_row)."""
+        import jax.numpy as jnp
+
+        from .engine import _first_from_hidden
+
+        st = self._statics
+        fn = self._twin("ring_prefill", (), self._make_ring_body)
+        hidden, kc_row, vc_row = self._dispatch(
+            fn, self._ring_params, ids)
+        hidden = jax.device_put(hidden, self._repl_sh)
+        kc_row = jax.tree.map(
+            lambda a: jax.device_put(a, self._cache_sh), kc_row)
+        vc_row = jax.tree.map(
+            lambda a: jax.device_put(a, self._cache_sh), vc_row)
+        self._c_ring.inc()
+        self.ring_prefills += 1
+        tok0, carry_key = _first_from_hidden(
+            params, hidden, jnp.int32(plen - 1), key, temp, top_p,
+            top_k=st["top_k"], use_top_p=st["use_top_p"])
+        return tok0, carry_key, kc_row, vc_row
 
     # -- lifecycle / reporting -------------------------------------------
     def unregister(self):

@@ -238,7 +238,12 @@ def test_watchdog_multi_step_beat_normalizes_per_step():
 def test_mfu_gauge_is_nan_without_cost_table_or_known_backend(
         monkeypatch):
     # no compiled graph step anywhere: step_flops has no table to read
+    # (extra cost sources too — serve-side AOT compiles from earlier
+    # test modules register paged cost tables process-wide, and this
+    # test's contract is "no table ANYWHERE")
     monkeypatch.setattr("singa_tpu.model._graph_runners", [])
+    monkeypatch.setattr(
+        "singa_tpu.observe.monitor._extra_cost_sources", [])
     clk = FakeClock()
     reg = MetricsRegistry()
     meter = monitor.MfuMeter(reg=reg, clock=clk)
