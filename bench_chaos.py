@@ -40,6 +40,12 @@ recovery invariants the unit tests assert piecewise:
   the survivor (stream parity), fails started work typed, keeps
   serving new requests, and the jit cache stays pinned at zero
   recompiles across the failover.
+* **disaggregated fleet under fire** — a ``serve.kv_ship`` fault
+  mid-transfer requeues the shipped request COLD with byte parity
+  (nothing streams during a ship) and leaks zero blocks on either
+  replica; a chunk fault with a zero restart budget KILLS a prefill
+  specialist mid-build and the fleet serves everything cold on the
+  decode side — zero wedged, zero lost, zero leaked.
 
 The whole run happens under active monitoring; the report embeds
 ``observe.health_report()`` and the bench FAILS unless
@@ -768,6 +774,105 @@ def chaos_fleet(report):
     assert sf["recompiles"] in (0, None), sf["recompiles"]
 
 
+def chaos_disagg(report):
+    """Disaggregated fleet under fire, two scenarios on a
+    2-replica prefill/decode fleet:
+
+    (a) an injected ``serve.kv_ship`` fault mid-transfer — the ship
+        aborts, the request is requeued COLD onto the decode replica
+        (byte parity: nothing streamed during a ship), zero leaked
+        blocks on either replica, both replicas stay healthy (a ship
+        fault is a transfer failure, not an engine death);
+    (b) a ``serve.prefill_chunk`` fault with a ZERO restart budget
+        KILLS the prefill specialist mid-build — the fleet fails it
+        over, the mid-ship request (and everything queued) completes
+        cold on the decode replica with parity, the dead arena holds
+        zero blocks behind the partial build.
+
+    Zero wedged/lost across both."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.resilience import FailOnce, faults
+    from singa_tpu.serve import (GenerationRequest, PagedConfig,
+                                 PrefixCacheConfig, ServeFleet)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(15)
+    workload = [(rng.randint(0, 256, 48).astype(np.int32), 3)] + \
+        [(rng.randint(0, 256, rng.randint(3, 7)).astype(np.int32),
+          int(rng.randint(2, 5))) for _ in range(4)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+    kw = dict(roles=("prefill", "decode"), max_slots=2,
+              paged=PagedConfig(block_size=8, num_blocks=48),
+              prefix_cache=PrefixCacheConfig(block_size=8))
+
+    def run(site, restart_budget):
+        fleet = ServeFleet(m, replicas=2, restart_budget=restart_budget,
+                           **kw)
+        pol = faults.inject(site, FailOnce())
+        handles = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        fleet.run_until_complete(max_steps=800)
+        faults.clear()
+        completed = wedged = 0
+        for h, want in zip(handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            got = h.result().tokens
+            assert np.array_equal(got, want), \
+                "disagg stream diverged across the fault"
+            completed += 1
+        leaked = sum(
+            fleet.supervisor(i).engine.paged_arena.blocks_used
+            - fleet.supervisor(i).engine.prefix_cache.cached_blocks
+            for i in range(2)
+            if not fleet.supervisor(i).engine._closed)
+        snap = fleet.snapshot()
+        arena0 = fleet.supervisor(0).engine.paged_arena
+        fleet.close()
+        return pol.fired, completed, wedged, leaked, snap, arena0
+
+    # (a) mid-transfer ship fault: cold requeue, nobody dies
+    ship_fired, comp_a, wedged_a, leak_a, snap_a, _ = run(
+        "serve.kv_ship", restart_budget=2)
+    assert snap_a["replicas_healthy"] == 2
+    assert snap_a["ship_fallbacks"] >= 1
+    # (b) specialist killed mid-build: failover, cold completion
+    chunk_fired, comp_b, wedged_b, leak_b, snap_b, arena0 = run(
+        "serve.prefill_chunk", restart_budget=0)
+    assert snap_b["replicas_healthy"] == 1
+    assert snap_b["failovers"] == 1
+    assert arena0.blocks_used == 0, \
+        f"dead specialist leaked {arena0.blocks_used} blocks"
+
+    report["serve_disagg"] = {
+        "requests": 2 * len(workload),
+        "completed_with_parity": comp_a + comp_b,
+        "wedged_or_lost": wedged_a + wedged_b,
+        "ship_faults_injected": ship_fired,
+        "chunk_faults_injected": chunk_fired,
+        "failovers": snap_b["failovers"],
+        "ship_fallbacks": (snap_a["ship_fallbacks"]
+                           + snap_b["ship_fallbacks"]),
+        "blocks_leaked": leak_a + leak_b,
+    }
+    sd = report["serve_disagg"]
+    assert sd["wedged_or_lost"] == 0, \
+        f"{sd['wedged_or_lost']} disagg requests wedged/lost"
+    assert sd["completed_with_parity"] == sd["requests"]
+    assert sd["ship_faults_injected"] == 1
+    assert sd["chunk_faults_injected"] == 1
+    assert sd["blocks_leaked"] == 0, sd["blocks_leaked"]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="CHAOS.json", metavar="PATH",
@@ -796,6 +901,7 @@ def main():
     chaos_longctx(report)
     chaos_tp(report)
     chaos_fleet(report)
+    chaos_disagg(report)
 
     health = observe.health_report(include_registry=False)
     report["health"] = health

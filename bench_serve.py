@@ -415,9 +415,9 @@ def _request_log_section(led, path, recompiles=None):
     as strict JSONL at ``path`` and self-check the acceptance
     invariants — every completed request's timeline is COMPLETE
     (submit -> admission -> first token -> retire) and MONOTONIC, and
-    the phase attribution (hops + queue + prefill) reproduces each
-    request's measured TTFT — so the CI gate reads verdicts instead of
-    re-deriving them from raw timelines."""
+    the phase attribution (hops + ship + queue + prefill) reproduces
+    each request's measured TTFT — so the CI gate reads verdicts
+    instead of re-deriving them from raw timelines."""
     from singa_tpu.observe import requests as reqtrace
 
     n = reqtrace.write_request_log(path, ledger_=led)
@@ -454,8 +454,8 @@ def _request_log_section(led, path, recompiles=None):
                 monotonic &= e["t_retire"] >= t
         ph = e["phases"]
         if e["ttft_s"] > 0:
-            err = abs(ph["hops"] + ph["queue"] + ph["prefill"]
-                      - e["ttft_s"]) / e["ttft_s"]
+            err = abs(ph["hops"] + ph.get("ship", 0.0) + ph["queue"]
+                      + ph["prefill"] - e["ttft_s"]) / e["ttft_s"]
             max_rel_err = max(max_rel_err, err)
     return {
         "path": path,
@@ -1102,6 +1102,147 @@ def run_longctx():
     return section
 
 
+def _disagg_mix(rng, vocab, n_chat=10, long_len=384, n_long=3):
+    """Prefill-heavy serve mix for the disaggregation measurement:
+    short chat traffic arriving every step plus ``n_long``
+    ``long_len``-token document admissions landing early — the LAST
+    document re-sends the FIRST one's prompt, so a fleet-level prefix
+    cache can prove a cross-replica warm hit (prefilled once, never
+    re-prefilled)."""
+    chats = [dict(prompt=rng.randint(0, vocab, int(rng.randint(
+                      8, 17))).astype(np.int32),
+                  n_new=8, arrival_step=i, kind="chat")
+             for i in range(n_chat)]
+    longs = [dict(prompt=rng.randint(0, vocab,
+                                     long_len).astype(np.int32),
+                  n_new=4, arrival_step=1 + j, kind="long")
+             for j in range(n_long)]
+    longs[-1]["prompt"] = longs[0]["prompt"].copy()
+    longs[-1]["arrival_step"] = 1 + n_long
+    return sorted(chats + longs, key=lambda w: w["arrival_step"])
+
+
+def run_disagg():
+    """The --disagg measurement (the disaggregation round): the
+    prefill-heavy mix through TWO fleets of four replicas on the
+    dedicated 512-position model —
+
+    * **symmetric**: 4 mixed replicas (the classic fleet) — every
+      384-token document prefills INSIDE a replica that is also
+      decoding chat traffic, so chat TPOT absorbs the interference
+      DistServe/Splitwise describe;
+    * **disagg**: 2 prefill specialists + 2 decode specialists —
+      documents build on the specialists and SHIP their KV blocks to
+      the decode side as validated host images; decode replicas never
+      run a long prefill.
+
+    Gated claims (tier1 serve gate): chat decode TPOT p50 under the
+    concurrent long admissions <= the symmetric fleet's
+    (``tpot_p50_ratio_disagg`` <= 1.0 — TTFT and TPOT stop
+    contending), ship_count > 0, shared-prefix hit rate > 0 across
+    replicas (the repeated document is prefilled ONCE fleet-wide),
+    per-stream parity vs the single-engine/offline oracle, zero
+    leaked blocks, zero runtime recompiles."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.serve import (GenerationRequest, PagedConfig,
+                                 PrefixCacheConfig, ServeFleet)
+    from singa_tpu.utils.metrics import percentile
+
+    cfg = GPT2Config(vocab_size=512, n_positions=512, n_embd=128,
+                     n_layer=2, n_head=4, n_inner=256, dropout=0.0,
+                     attn_impl="fused")
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    rng = np.random.RandomState(17)
+    work = _disagg_mix(rng, cfg.vocab_size)
+    block = 16
+    kw = dict(max_slots=2,
+              paged=PagedConfig(block_size=block, num_blocks=96),
+              prefix_cache=PrefixCacheConfig(block_size=block))
+
+    def drive(roles):
+        fleet = ServeFleet(m, replicas=4, roles=roles, **kw)
+        pending = list(work)
+        rows = []
+        t0 = time.perf_counter()
+        while pending or fleet.pending:
+            while pending and \
+                    pending[0]["arrival_step"] <= fleet.step_count:
+                w = pending.pop(0)
+                rows.append((w, fleet.submit(GenerationRequest(
+                    w["prompt"], max_new_tokens=w["n_new"],
+                    temperature=0.0))))
+            fleet.step()
+        wall = time.perf_counter() - t0
+        outs = [(w, h.result()) for w, h in rows]
+        snap = fleet.snapshot()
+        leaked = sum(
+            fleet.supervisor(i).engine.paged_arena.blocks_used
+            - fleet.supervisor(i).engine.prefix_cache.cached_blocks
+            for i in range(fleet.replicas))
+        fleet.close()
+        return wall, outs, snap, leaked
+
+    roles_disagg = ("prefill", "prefill", "decode", "decode")
+    for roles in (None, roles_disagg):          # warmup compiles
+        drive(roles)
+    jit_before = _serve_jit_cache_size()
+    wall_sym, outs_sym, snap_sym, leak_sym = drive(None)
+    wall_d, outs_d, snap_d, leak_d = drive(roles_disagg)
+    jit_after = _serve_jit_cache_size()
+
+    # per-stream parity vs the single-engine oracle (m.generate IS
+    # the engine oracle — the engine==generate pin is the suite's)
+    parity = True
+    oracle = {}
+    for outs in (outs_sym, outs_d):
+        for w, res in outs:
+            key = (w["prompt"].tobytes(), w["n_new"])
+            if key not in oracle:
+                oracle[key] = np.asarray(m.generate(
+                    w["prompt"], max_new_tokens=w["n_new"],
+                    temperature=0))
+            parity &= bool(np.array_equal(res.tokens, oracle[key]))
+
+    def chat_tpot(outs):
+        return percentile([res.tpot for w, res in outs
+                           if w["kind"] == "chat"
+                           and res.tpot is not None], 50)
+
+    tpot_sym = chat_tpot(outs_sym)
+    tpot_d = chat_tpot(outs_d)
+    return {
+        "model": {"n_positions": 512, "n_embd": 128, "n_layer": 2,
+                  "long_prompt_tokens": 384, "chat_prompts": "8-16"},
+        "pool": {"block_size": block, "num_blocks": 96},
+        "fleet": {"replicas": 4, "max_slots_each": 2,
+                  "roles_disagg": list(roles_disagg)},
+        "symmetric": {
+            "wall_s": wall_sym, "chat_tpot_p50_s": tpot_sym,
+            "ships": snap_sym["ships"],
+            "routed": snap_sym["routed"]},
+        "disagg": {
+            "wall_s": wall_d, "chat_tpot_p50_s": tpot_d,
+            "ships": snap_d["ships"],
+            "ship_bytes": snap_d["ship_bytes"],
+            "shared_prefix_hits": snap_d["shared_prefix_hits"],
+            "ship_fallbacks": snap_d["ship_fallbacks"],
+            "routed": snap_d["routed"]},
+        # THE gated numbers: decode TPOT stops contending with long
+        # prefill, the documents shipped, and the repeated document
+        # warmed a sibling replica instead of re-prefilling
+        "tpot_p50_ratio_disagg": tpot_d / tpot_sym,
+        "ships": snap_d["ships"],
+        "shared_prefix_hits": snap_d["shared_prefix_hits"],
+        "blocks_leaked": leak_sym + leak_d,
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": bool(parity),
+    }
+
+
 def _write_longctx_rows(section):
     """Commit the serve section into LONGCTX.json NEXT TO the train
     cells (the file the long-context training crossover harness owns)
@@ -1204,6 +1345,14 @@ def main():
                          "run) — embeds the longctx section and "
                          "commits the same rows into LONGCTX.json "
                          "next to the train cells")
+    ap.add_argument("--disagg", action="store_true",
+                    help="also run the prefill-heavy mix through a "
+                         "2-prefill/2-decode disaggregated fleet vs "
+                         "4 symmetric replicas (KV shipping, fleet "
+                         "prefix index) and embed the disagg section "
+                         "(chat TPOT under long admissions, ships, "
+                         "cross-replica shared-prefix hits, parity, "
+                         "leak + recompile pins)")
     ap.add_argument("--tp", type=int, default=None, metavar="K",
                     help="also run the standard workload through a "
                          "K-shard TENSOR-PARALLEL paged engine "
@@ -1376,6 +1525,11 @@ def main():
     if args.tp:
         report["tp"] = run_tp(m, workload, outs_e, args.tp,
                               report["engine"], max_slots=max_slots)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.disagg:
+        report["disagg"] = run_disagg()
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(
             engine_snapshots=[snap], include_registry=False)

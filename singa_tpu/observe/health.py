@@ -188,6 +188,15 @@ def _fleet_section(snap: dict) -> dict:
         "requeues": _sum_metric(counters, "serve.fleet.requeues"),
         "hedges": _sum_metric(counters, "serve.fleet.hedges"),
         "routed": _by_label(counters, "serve.fleet.routed", "replica"),
+        # disaggregated serving (the disagg round): completed KV
+        # ships, their host bytes, fleet-index warm hits, and
+        # cold-but-correct fallbacks
+        "ships": _sum_metric(counters, "serve.fleet.ships"),
+        "ship_bytes": _sum_metric(counters, "serve.fleet.ship_bytes"),
+        "shared_prefix_hits": _sum_metric(
+            counters, "serve.fleet.shared_prefix_hits"),
+        "ship_fallbacks": _sum_metric(
+            counters, "serve.fleet.ship_fallbacks"),
     }
 
 

@@ -26,16 +26,20 @@ structured timeline per ``GenerationRequest.request_id``:
   and ``serve/fleet.py``.
 * **phase attribution** — at retire the timeline is decomposed into
   ``hops`` (time burned on earlier hops before the final submission),
-  ``queue`` (final-hop submit → admission), ``prefill`` (admission →
-  first token), ``decode`` (first token → retire, stall and
-  preemption removed), ``stall`` (inter-token gaps far beyond the
+  ``ship`` (a disaggregated admission's KV transfer — the fleet
+  stamps the final ``via=kv_ship`` hop with its measured ``ship_s``,
+  carved OUT of ``hops`` so a slow interconnect reads "ship", not
+  "hops"), ``queue`` (final-hop submit → admission), ``prefill``
+  (admission → first token), ``decode`` (first token → retire, stall
+  and preemption removed), ``stall`` (inter-token gaps far beyond the
   request's own median — the spec-verify / scheduler-starvation
   signature) and ``preempted`` (time the paged engine held the
   request swapped out to host; swap pauses are excluded from the
   stall detector's gaps so the two phases never double-count one
-  pause).  The first three sum to TTFT *exactly* and all six sum to
-  the request's total latency exactly — attribution is arithmetic
-  over recorded timestamps, never an estimate.
+  pause).  ``hops + ship + queue + prefill`` sum to TTFT *exactly*
+  and all seven sum to the request's total latency exactly —
+  attribution is arithmetic over recorded timestamps, never an
+  estimate.
 * **bounded retention** — sealed (retired or terminally rejected)
   entries live in a ring of ``capacity`` entries (the FlightRecorder
   idiom: a forgotten ledger cannot OOM), exported as strict JSONL via
@@ -154,7 +158,8 @@ def _new_hop(engine, t):
         "engine": engine,       # EngineStats.engine_label (unique)
         "replica": None,        # fleet replica index, when routed
         "via": "submit",        # submit|supervisor_restart|failover|
-        #                         hedge|refused
+        #                         hedge|refused|prefill|kv_ship|
+        #                         ship_fallback
         "t_submit": t,
         "queue_depth_at_enqueue": None,
         "t_admit": None,
@@ -406,15 +411,23 @@ class RequestLedger:
     # -- attribution -----------------------------------------------------
     @staticmethod
     def _phases(e, final=None) -> dict:
-        """Decompose one entry into the five phase components (module
-        docstring).  Exact by construction: hops + queue + prefill ==
-        TTFT and all five sum to t_retire - t_submit (stall is carved
-        OUT of decode, never added on top)."""
+        """Decompose one entry into the phase components (module
+        docstring).  Exact by construction: hops + ship + queue +
+        prefill == TTFT and all seven sum to t_retire - t_submit
+        (stall is carved OUT of decode and ship OUT of hops, never
+        added on top)."""
         if final is None:
             final = _final_hop(e)
         end = e["t_retire"] if e["t_retire"] is not None \
             else final["t_submit"]
         hops_s = max(final["t_submit"] - e["t_submit"], 0.0)
+        # a disaggregated admission's KV transfer: the fleet stamps
+        # the via=kv_ship hop with its measured ship_s (export ->
+        # validate -> scatter), which happened strictly BEFORE this
+        # hop's submit — carve it out of the hops span so the sums
+        # stay exact and a slow ship is named, not lumped into "hops"
+        ship_s = min(float(final.get("ship_s") or 0.0), hops_s)
+        hops_s -= ship_s
         t_admit = final.get("t_admit")
         t_first = final.get("t_first_token")
         if t_admit is not None:
@@ -462,6 +475,7 @@ class RequestLedger:
         stall_s = min(stall_s, decode_s - preempted_s)
         return {
             "hops": hops_s,
+            "ship": ship_s,
             "queue": queue_s,
             "prefill": prefill_s,
             "decode": decode_s - stall_s - preempted_s,
@@ -564,19 +578,20 @@ class RequestLedger:
         out["ttft_p99_s"] = p99
         pop = [e for e in completed if e["ttft_s"] >= p99]
         total = sum(e["ttft_s"] for e in pop)
-        sums = {"queue": 0.0, "prefill": 0.0, "hops": 0.0}
+        sums = {"queue": 0.0, "prefill": 0.0, "hops": 0.0,
+                "ship": 0.0}
         per_rep = {}
         for e in pop:
             ph = e["phases"] or self._phases(e)
             for k in sums:
-                sums[k] += ph[k]
+                sums[k] += ph.get(k, 0.0)
             rep = per_rep.setdefault(self._replica_key(e), {
                 "requests": 0, "ttft_s": 0.0, "queue": 0.0,
-                "prefill": 0.0, "hops": 0.0})
+                "prefill": 0.0, "hops": 0.0, "ship": 0.0})
             rep["requests"] += 1
             rep["ttft_s"] += e["ttft_s"]
-            for k in ("queue", "prefill", "hops"):
-                rep[k] += ph[k]
+            for k in ("queue", "prefill", "hops", "ship"):
+                rep[k] += ph.get(k, 0.0)
         out["ttft_p99_attribution"] = {
             k: {"s": v, "frac": (v / total if total > 0 else 0.0)}
             for k, v in sums.items()}

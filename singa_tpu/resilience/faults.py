@@ -48,6 +48,7 @@ SITES = (
     "serve.prefill_chunk",  # budgeted chunked-prefill chunk dispatch
     "serve.prefix_copy",   # prefix-cache pool<->slot block copies
     "serve.route",         # fleet router admission (ServeFleet.submit)
+    "serve.kv_ship",       # disaggregated KV ship (export + import)
     "io.binfile",          # BinFile record read/write
     "train.step",          # _GraphRunner step dispatch
 )

@@ -55,14 +55,29 @@ docs/SERVING.md "Tensor-parallel serving"::
 
     eng = model.serve(max_slots=8, tp=2,
                       paged=PagedConfig(block_size=16, num_blocks=256))
+
+Since the disaggregation round, ``roles=`` splits a fleet
+DistServe-style into prefill and decode specialists: long admissions
+build their canonical-KV prefix on a specialist and SHIP the blocks
+to a decode replica as a versioned host image (``serve.kvimage`` —
+the same format preemption swap uses), landing as a local warm hit;
+the radix prefix cache becomes a fleet-level resource
+(``FleetPrefixIndex``).  Streams stay byte-identical to the
+single-engine oracle.  See docs/SERVING.md "Disaggregated serving"::
+
+    fleet = model.serve_fleet(
+        replicas=4, roles=("prefill", "prefill", "decode", "decode"),
+        paged=PagedConfig(block_size=16, num_blocks=96),
+        prefix_cache=PrefixCacheConfig(block_size=16))
 """
 
 from .engine import InferenceEngine  # noqa: F401
 from .fleet import Router, ServeFleet  # noqa: F401
+from .kvimage import KVImage, KVImageError  # noqa: F401
 from .paged import PagedConfig, PagedKVArena  # noqa: F401
 from .tp import TPConfig, TPExecutor  # noqa: F401
-from .prefix import (PrefixCache, PrefixCacheConfig,  # noqa: F401
-                     SessionHandle)
+from .prefix import (FleetPrefixIndex, PrefixCache,  # noqa: F401
+                     PrefixCacheConfig, SessionHandle)
 from .request import (DeadlineExceededError, EngineFailedError,  # noqa: F401
                       FleetDownError, GenerationRequest,
                       GenerationResult, LoadShedError, QueueFullError,

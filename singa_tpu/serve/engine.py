@@ -660,6 +660,24 @@ class _Prefilling:
                  "seq")
 
 
+class _PrefixJob:
+    """Host-side state of one fleet-driven PREFILL-FOR-SHIP build (the
+    disaggregation round): the shippable canonical-KV prefix of a
+    prompt — its ``(plen - 1) // block_size`` full blocks, exactly
+    what a warm admission can consume — advanced across steps in
+    block-width ``_chunk_row`` windows against a private device row.
+    No slot is reserved, no token is sampled, and nothing streams:
+    the build is pure cache work, so a failed or abandoned build is
+    always replayable from scratch with byte-identical results.
+    ``engine`` pins the generation — a supervisor rebuild invalidates
+    the job (its row belongs to the dead engine's params) and the
+    fleet restarts the build."""
+
+    __slots__ = ("tokens", "plen", "n_goal", "ids_j", "kc_row",
+                 "vc_row", "off", "last_off", "nodes", "engine",
+                 "hit")
+
+
 class _Swapped:
     """A preempted request's complete host-side state: byte copies of
     its target cache lanes (and draft rows on a speculative engine),
@@ -671,7 +689,7 @@ class _Swapped:
 
     __slots__ = ("handle", "request", "emitted", "remaining",
                  "first_token_time", "admit_time", "admitted_step",
-                 "pos", "tok", "temp", "key", "kc_h", "vc_h", "dkc_h",
+                 "pos", "tok", "temp", "key", "image", "dkc_h",
                  "dvc_h", "n_data", "seq", "t_preempt", "j_lo")
 
     @property
@@ -1168,6 +1186,50 @@ class InferenceEngine:
                 "this automatically)", engine_step=self.step_count)
         if not isinstance(request, GenerationRequest):
             request = GenerationRequest(np.asarray(request))
+        self.validate_request(request)
+        if request.request_id in self._handles:
+            # an in-flight duplicate would orphan the earlier handle
+            # (the id is the engine's completion-routing key); finished
+            # requests are evicted at retire/reject, so an id may be
+            # REUSED once its predecessor resolved
+            raise ValueError(
+                f"request_id {request.request_id!r} is already "
+                f"in flight")
+        handle = RequestHandle(request)
+        t_sub = self._clock()
+        if _reqs._active:
+            # request-ledger hook: one flag read when tracing is off.
+            # Starts (or, on a supervisor/fleet requeue, CONTINUES)
+            # this request's timeline with a hop on this engine
+            _reqs._ledger.on_submit(
+                request.request_id, engine=self.stats.engine_label,
+                t=t_sub, prompt_len=len(request.prompt_ids),
+                max_new_tokens=request.max_new_tokens)
+        self.stats.on_submit()
+        try:
+            self.scheduler.enqueue(request)
+        except Exception:
+            self.stats.on_queue_full(request.request_id)
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=request.request_id,
+                         reason="queue_full")
+            if _reqs._active:
+                _reqs._ledger.on_reject(
+                    request.request_id, t=self._clock(),
+                    reason="queue_full",
+                    engine=self.stats.engine_label, started=False)
+            raise
+        handle._submit_time = t_sub
+        self._handles[request.request_id] = handle
+        return handle
+
+    def validate_request(self, request):
+        """Submit-time feasibility: raises ValueError for a request
+        that could NEVER fit this engine's arena (position space, or
+        paged worst-case blocks).  Shared by :meth:`submit` and the
+        fleet's disaggregated admission path, so a ship-parked
+        request fails the caller synchronously with the same typed
+        error a direct submit would."""
         need = len(request.prompt_ids) + request.max_new_tokens
         spec_pad = 0 if self.draft is None else self.spec_k - 1
         if need + spec_pad > self.max_len:
@@ -1219,41 +1281,6 @@ class InferenceEngine:
                     f"paged pool holds {self.paged_arena.num_blocks}; "
                     f"raise PagedConfig.num_blocks or lower "
                     f"max_new_tokens")
-        if request.request_id in self._handles:
-            # an in-flight duplicate would orphan the earlier handle
-            # (the id is the engine's completion-routing key); finished
-            # requests are evicted at retire/reject, so an id may be
-            # REUSED once its predecessor resolved
-            raise ValueError(
-                f"request_id {request.request_id!r} is already "
-                f"in flight")
-        handle = RequestHandle(request)
-        t_sub = self._clock()
-        if _reqs._active:
-            # request-ledger hook: one flag read when tracing is off.
-            # Starts (or, on a supervisor/fleet requeue, CONTINUES)
-            # this request's timeline with a hop on this engine
-            _reqs._ledger.on_submit(
-                request.request_id, engine=self.stats.engine_label,
-                t=t_sub, prompt_len=len(request.prompt_ids),
-                max_new_tokens=request.max_new_tokens)
-        self.stats.on_submit()
-        try:
-            self.scheduler.enqueue(request)
-        except Exception:
-            self.stats.on_queue_full(request.request_id)
-            _trace.event("serve/request_rejected", cat="serve",
-                         request=request.request_id,
-                         reason="queue_full")
-            if _reqs._active:
-                _reqs._ledger.on_reject(
-                    request.request_id, t=self._clock(),
-                    reason="queue_full",
-                    engine=self.stats.engine_label, started=False)
-            raise
-        handle._submit_time = t_sub
-        self._handles[request.request_id] = handle
-        return handle
 
     @property
     def pending(self) -> bool:
@@ -2012,8 +2039,10 @@ class InferenceEngine:
                         - sw.j_lo)
         sw.seq = next(self._swap_seq)
         sw.t_preempt = self._clock()
-        sw.kc_h, sw.vc_h = arena.swap_out(slot.blocks[sw.j_lo:],
-                                          sw.n_data)
+        # the swap image rides the shared versioned host format
+        # (serve/kvimage.py) — the same one KV shipping uses, so the
+        # two host-image paths cannot drift
+        sw.image = arena.swap_out(slot.blocks[sw.j_lo:], sw.n_data)
         sw.dkc_h = sw.dvc_h = None
         if self.draft is not None:
             dkc_row, dvc_row = _read_slot(self._dkc, self._dvc,
@@ -2066,7 +2095,7 @@ class InferenceEngine:
             if blocks is None:
                 return
             idx = free[0]
-            arena.swap_in(sw.kc_h, sw.vc_h, blocks[:sw.n_data])
+            arena.swap_in(sw.image, blocks[:sw.n_data])
             if self.draft is not None and sw.dkc_h is not None:
                 self._dkc, self._dvc = _write_slot(
                     self._dkc, self._dvc,
@@ -2904,3 +2933,232 @@ class InferenceEngine:
             key0, temp, self._top_p, top_k=self._statics["top_k"],
             use_top_p=self._statics["use_top_p"])
         return tok0, carry_key, kc_row, vc_row
+
+    # -- disaggregated prefill / KV shipping (the disagg round) ----------
+    # The fleet drives these from OUTSIDE the step loop: a prefill
+    # specialist builds the shippable canonical-KV prefix of a prompt
+    # (chunked — the PR-12 budget machinery's executable, so the
+    # shipped bytes ARE the canonical form warm admission consumes,
+    # dense and int8 alike), exports it as a versioned host image
+    # (serve/kvimage.py — the swap format), and a decode replica
+    # adopts the image's blocks into its OWN radix tree so the
+    # subsequent engine.submit lands as a local warm hit.  Parity is
+    # inherited, not re-proven: warm == cold is already pinned per
+    # engine, and the image is a byte copy of canonical chunk KV.
+
+    def _require_ship_support(self):
+        if self._closed:
+            raise RuntimeError(
+                "engine is closed; build a new one with model.serve()")
+        if self._failed:
+            raise EngineFailedError(
+                "engine has failed; rebuild it (EngineSupervisor does "
+                "this automatically)", engine_step=self.step_count)
+        if self.paged_arena is None or self.prefix_cache is None:
+            raise RuntimeError(
+                "KV shipping needs paged= AND prefix_cache= on every "
+                "replica: the ship format is the paged host image and "
+                "residency lives in the radix tree (docs/SERVING.md "
+                "'Disaggregated serving')")
+
+    def start_prefix_build(self, prompt_ids):
+        """Begin building the shippable prefix of ``prompt_ids``: its
+        ``(plen - 1) // block_size`` full blocks (the cap warm
+        admission applies — the final partial block is always
+        recomputed by the admitting engine to sample from).  Returns a
+        :class:`_PrefixJob`, or None when nothing is shippable (short
+        prompt).  A prefix already resident in THIS engine's tree
+        starts complete (``hit`` set — no recompute, the fleet's
+        shared-prefix-hit path); the matched path is ACQUIRED until
+        the job is exported or abandoned."""
+        self._require_ship_support()
+        arena, cache = self.paged_arena, self.prefix_cache
+        B = arena.block_size
+        toks = np.asarray(prompt_ids, np.int32).reshape(-1)
+        plen = len(toks)
+        n_goal = (plen - 1) // B
+        if n_goal < 1:
+            return None
+        job = _PrefixJob()
+        job.tokens = toks
+        job.plen = plen
+        job.n_goal = n_goal
+        job.engine = self
+        nodes = cache.lookup(toks)[:n_goal]
+        cache.acquire(nodes)
+        job.nodes = nodes
+        job.hit = len(nodes) == n_goal
+        job.off = len(nodes) * B
+        job.last_off = (n_goal - 1) * B
+        job.ids_j = None
+        job.kc_row = job.vc_row = None
+        if job.hit:
+            return job
+        try:
+            ids = np.zeros((1, self.max_len), np.int32)
+            ids[0, :plen] = toks
+            job.ids_j = jnp.asarray(ids)
+            if nodes:
+                job.kc_row, job.vc_row = cache.copy_into_row(nodes)
+            else:
+                # the fresh-zero chunk-from-scratch canonical form —
+                # the same row every cold chunked admission starts
+                # from
+                job.kc_row, job.vc_row = arena.gather_row([],
+                                                          n_used=0)
+        except Exception:
+            # the copies check fault sites (serve.prefix_copy /
+            # serve.paged_copy): a raise here happens before the job
+            # reaches the caller, so nothing would ever release the
+            # acquired path — release it ourselves or the refs pin
+            # those blocks unevictable forever (the same guard the
+            # warm-admission path keeps)
+            self.abandon_prefix_build(job)
+            raise
+        return job
+
+    def advance_prefix_build(self, job, max_tokens=None, rid=None):
+        """Spend up to ``max_tokens`` prefill tokens on the build's
+        chunk windows (None = finish it; the fleet passes the
+        specialist's ``prefill_token_budget`` so one giant document
+        never monopolizes a specialist's step).  Returns True when
+        the build is complete.  A raising chunk FAILS THE ENGINE
+        typed — the same contract as a raising admission prefill
+        inside ``step()`` — which is what makes 'kill a prefill
+        specialist mid-ship' a first-class chaos scenario."""
+        self._require_ship_support()
+        if job.engine is not self:
+            # a supervisor rebuild happened under the job: its rows /
+            # nodes belong to the dead engine's arena — advancing
+            # would adopt the wrong blocks.  The fleet restarts the
+            # build (nothing streamed; the replay is identical)
+            raise RuntimeError(
+                "stale prefix build: the engine was rebuilt under it;"
+                " restart the build")
+        B = self.paged_arena.block_size
+        left = (job.last_off - job.off + B if max_tokens is None
+                else int(max_tokens))
+        try:
+            while left >= B and job.off <= job.last_off:
+                if _faults._armed:
+                    _faults.check("serve.prefill_chunk")
+                off = job.off
+                _, job.kc_row, job.vc_row = self._x.chunk_row(
+                    self._params, job.ids_j, job.kc_row, job.vc_row,
+                    jnp.int32(off))
+                job.off += B
+                left -= B
+                if _reqs._active and rid is not None:
+                    _reqs._ledger.on_prefill_chunk(
+                        rid, engine=self.stats.engine_label,
+                        t=self._clock(), offset=off)
+        except Exception as e:
+            self.abandon_prefix_build(job)
+            raise self._fail(e) from e
+        return job.off > job.last_off
+
+    def abandon_prefix_build(self, job):
+        """Release a build's acquired prefix refs (ship fallback,
+        failover, a raising chunk).  Idempotent; a job whose engine
+        was rebuilt is a no-op (the old tree died with it)."""
+        if job.nodes and self.prefix_cache is not None \
+                and job.engine is self:
+            try:
+                self.prefix_cache.release(job.nodes)
+            except RuntimeError:
+                pass
+        job.nodes = []
+
+    def export_prefix_image(self, job):
+        """Finish the source half of a ship: DONATE the finished
+        chunk row's blocks into this engine's radix tree (residency —
+        the next request for this prefix exports without recompute,
+        fleet-wide) and pack the narrow versioned host image
+        (``serve.kv_ship`` fault site).  Under pool pressure the
+        donation is skipped (counted by the cache) and the image
+        ships straight from the row — shipping never fails on SOURCE
+        capacity.  Returns ``(image, resident)``: ``resident`` says
+        whether this engine's tree now holds the prefix (the fleet
+        records residency only when it is true — a skipped donation
+        must not plant a stale index entry).  Releases the job's
+        refs in all cases."""
+        self._require_ship_support()
+        if job.engine is not self:
+            raise RuntimeError(
+                "stale prefix build: the engine was rebuilt under it;"
+                " restart the build")
+        arena, cache = self.paged_arena, self.prefix_cache
+        n = job.n_goal
+        try:
+            if job.hit:
+                # resident: export straight from the tree's blocks
+                return arena.export_image(
+                    [nd.block for nd in job.nodes], n), True
+            k = len(job.nodes)
+            new = arena.alloc(n - k)
+            if new is None:
+                cache.on_donate_skipped(n - k)
+                return arena.export_row_image(job.kc_row, job.vc_row,
+                                              n), False
+            try:
+                arena.scatter_row(job.kc_row, job.vc_row,
+                                  {k + j: b for j, b in enumerate(new)})
+                blockmap = [nd.block for nd in job.nodes] + new
+                path = cache.adopt_blocks(job.tokens, blockmap, n)
+            except Exception:
+                arena.free(new)
+                raise
+            adopted = {nd.block for nd in path}
+            arena.free([b for b in new if b not in adopted])
+            return arena.export_image(
+                [nd.block for nd in path], n), True
+        finally:
+            self.abandon_prefix_build(job)
+
+    def admit_prefix_image(self, tokens, image):
+        """Destination half of a ship: validate the image TYPED
+        (:class:`~singa_tpu.serve.kvimage.KVImageError` — a truncated
+        or geometry-mismatched image never scatters), land its lanes
+        in this pool, and ADOPT them into the radix tree so the next
+        admission of ``tokens`` is a local warm hit.  Returns the
+        ACQUIRED node path (the caller releases it once the shipped
+        request resolves — the blocks must survive until admission),
+        or None when the pool has no capacity for the missing blocks
+        (cold fallback, counted by the fleet, never an error)."""
+        self._require_ship_support()
+        arena, cache = self.paged_arena, self.prefix_cache
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        n = int(image.n_data)
+        existing = cache.lookup(toks)[:n]
+        k = len(existing)
+        if k == n:
+            # already resident (an earlier ship, or a sibling's
+            # donation): nothing will scatter, so run the typed
+            # validation HERE (the scatter path's lives inside
+            # arena.import_image — exactly one validate either way)
+            image.validate(arena.block_size, arena.quant,
+                           pool_k=arena.pool_k)
+            cache.touch(existing)
+            cache.acquire(existing)
+            return existing
+        # pin the partial hit across the allocation: alloc's LRU
+        # eviction must not reclaim the very prefix we are extending
+        cache.acquire(existing)
+        new = arena.alloc(n - k)
+        if new is None:
+            cache.release(existing)
+            return None
+        try:
+            arena.import_image(image,
+                               {k + j: b for j, b in enumerate(new)})
+            blockmap = [nd.block for nd in existing] + new
+            path = cache.adopt_blocks(toks, blockmap, n)
+        except Exception:
+            arena.free(new)
+            cache.release(existing)
+            raise
+        adopted = {nd.block for nd in path}
+        arena.free([b for b in new if b not in adopted])
+        cache.release(existing)
+        cache.acquire(path)
+        return path

@@ -50,15 +50,39 @@ This module is that layer for the in-process engine:
   steps is re-dispatched to the least-loaded sibling; first completion
   wins (identical tokens either way — same seed), the loser's work is
   the hedge's cost.  Never hedges streaming (``on_token``) or session
-  requests.
+  requests;
+* **disaggregated prefill/decode** (``roles=`` — the disagg round,
+  DistServe/Splitwise-style): replicas become role-typed.  A long
+  admission routes to a **prefill specialist**, which builds the
+  prompt's canonical-KV block prefix with the chunked-prefill budget
+  machinery (never a decode lane stalled — specialists hold none),
+  then SHIPS the blocks to a **decode specialist** as a versioned
+  host image (serve/kvimage.py — the swap-out format): gather on the
+  source → validated image → scatter + radix-tree adoption on the
+  destination → ``engine.submit`` lands as a local WARM admission,
+  byte-identical to cold by the engine's warm==cold pin.  The radix
+  prefix cache becomes a FLEET resource: a host-side residency index
+  (:class:`~singa_tpu.serve.prefix.FleetPrefixIndex`, verified
+  against live trees at use) lets a hit on ANY replica seed a
+  targeted export instead of a cold re-prefill, and prefix-hash
+  sticky destination routing keeps each hot prefix's blocks on as
+  few replicas as possible.  Degenerate fleets fall back to mixed
+  roles (1 replica, all-decode, or a dead specialist side still
+  serves every request — cold, never refused), and every mid-ship
+  failure (``serve.kv_ship`` fault, destination capacity, a dying
+  specialist) requeues the request COLD-but-correct: nothing streams
+  during a ship, so a re-route is byte-identical.
 
 Metrics ride the process-wide observe registry as
-``serve.fleet.{replicas_healthy,failovers,requeues,routed,hedges}``
-labeled ``{fleet=,replica=}`` and surface in
+``serve.fleet.{replicas_healthy,failovers,requeues,routed,hedges,
+ships,ship_bytes,shared_prefix_hits,ship_fallbacks}`` labeled
+``{fleet=,replica=}`` (the ship family fleet-wide) and surface in
 ``health_report()["serve"]["fleet"]``; the ``serve.route`` fault site
-(singa_tpu.resilience) covers admission routing.  bench_chaos.py's
-``chaos_fleet`` scenario kills a replica mid-decode and CI gates on
-zero wedged/lost requests, survivor parity, and a pinned jit cache.
+(singa_tpu.resilience) covers admission routing and ``serve.kv_ship``
+covers both halves of a KV ship.  bench_chaos.py's ``chaos_fleet``
+scenario kills a replica mid-decode and ``chaos_disagg`` kills a
+prefill specialist mid-ship; CI gates on zero wedged/lost requests,
+survivor parity, zero leaked blocks, and a pinned jit cache.
 """
 
 from __future__ import annotations
@@ -66,6 +90,7 @@ from __future__ import annotations
 import itertools
 import time
 import weakref
+import zlib
 
 import numpy as np
 
@@ -75,6 +100,8 @@ from ..observe import trace as _trace
 from ..observe.registry import registry as _registry
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
+from .paged import PagedConfig
+from .prefix import FleetPrefixIndex
 from .request import (EngineFailedError, FleetDownError,
                       GenerationRequest, LoadShedError, QueueFullError,
                       RequestHandle, RestartBudgetExceededError)
@@ -105,16 +132,33 @@ class Router:
     than its healthiest sibling carries a 3x term; with no samples the
     term is 0) — plus a large penalty when the replica sits at/past
     ``SLO.queue_depth_max``.  ``rank`` returns candidate indices
-    best-first; ties break on replica index, which is deterministic
-    AND self-balancing because queue depth moves at submit time.
-    Subclass and override ``score`` for custom policies."""
+    best-first; ties break on LEAST-RECENTLY-ROUTED (the logical
+    route tick the fleet feeds through :meth:`note_routed`), then
+    replica index — deterministic, and cold traffic after a
+    fleet-wide drain spreads across equal-scored replicas instead of
+    piling onto replica 0.  Role-typed fleets price prefill
+    specialists SEPARATELY (:meth:`score_prefill`: build-queue depth
+    only — specialists hold no decode lanes, so TPOT and block
+    pressure never enter their score).  Subclass and override
+    ``score`` for custom policies."""
 
     def __init__(self, w_queue=1.0, w_occupancy=1.0, w_tpot=1.0,
-                 w_blocks=1.0):
+                 w_blocks=1.0, w_prefill=1.0):
         self.w_queue = float(w_queue)
         self.w_occupancy = float(w_occupancy)
         self.w_tpot = float(w_tpot)
         self.w_blocks = float(w_blocks)
+        self.w_prefill = float(w_prefill)
+        # least-recently-routed tie-break state: replica -> logical
+        # tick of its last admission (never wall time — deterministic)
+        self._routed_tick = {}
+        self._route_ticks = itertools.count(1)
+
+    def note_routed(self, idx):
+        """Record an admission to replica ``idx`` (the fleet calls
+        this on every successful route / ship destination): the
+        tie-break currency of :meth:`rank`."""
+        self._routed_tick[idx] = next(self._route_ticks)
 
     def score(self, view, tpot_base) -> float:
         s = (self.w_queue * view["queue_depth"]
@@ -133,13 +177,30 @@ class Router:
         return s
 
     def rank(self, views) -> list:
-        """Replica indices best-first."""
+        """Replica indices best-first (ties: least-recently-routed,
+        then index — see the class docstring)."""
         ewmas = [v["tpot_ewma"] for v in views
                  if v.get("tpot_ewma")]
         base = min(ewmas) if ewmas else None
         scored = sorted(
-            ((self.score(v, base), v["replica"]) for v in views))
-        return [idx for _, idx in scored]
+            ((self.score(v, base),
+              self._routed_tick.get(v["replica"], 0),
+              v["replica"]) for v in views))
+        return [t[-1] for t in scored]
+
+    def score_prefill(self, view) -> float:
+        """Prefill-specialist score: the depth of ship builds queued
+        on the replica — the only load a specialist carries."""
+        return self.w_prefill * view.get("prefill_depth", 0)
+
+    def rank_prefill(self, views) -> list:
+        """Prefill-specialist indices best-first, same tie-break
+        discipline as :meth:`rank`."""
+        scored = sorted(
+            ((self.score_prefill(v),
+              self._routed_tick.get(v["replica"], 0),
+              v["replica"]) for v in views))
+        return [t[-1] for t in scored]
 
 
 class _Replica:
@@ -159,15 +220,30 @@ class _Replica:
 class _Route:
     """One fleet request's routing state: the caller-facing handle and
     every dispatch attempt ``(replica_idx, supervisor_handle)`` made
-    for it (one normally; two when hedged or requeued)."""
+    for it (one normally; two when hedged or requeued).  A route with
+    NO attempts is mid-ship (queued or building on a prefill
+    specialist — the decode submission happens once the KV lands).
+    ``ship_release`` pins the shipped prefix in the destination's
+    radix tree until the request resolves."""
 
-    __slots__ = ("handle", "attempts", "submit_step", "hedged")
+    __slots__ = ("handle", "attempts", "submit_step", "hedged",
+                 "ship_release")
 
     def __init__(self, handle, step):
         self.handle = handle
         self.attempts = []
         self.submit_step = step
         self.hedged = False
+        self.ship_release = None
+
+
+class _ShipJob:
+    """One disaggregated admission's prefill-and-ship state: which
+    specialist is (re)building the prefix, the engine-side build, and
+    whether the prefix was already RESIDENT somewhere (the
+    shared-prefix-hit path — exported, never recomputed)."""
+
+    __slots__ = ("rid", "route", "request", "src", "job", "hit")
 
 
 class ServeFleet:
@@ -182,14 +258,51 @@ class ServeFleet:
     (``max_slots``, ``max_len``, ``slo``, ``prefix_cache``, ...);
     ``restart_budget``/``budget_reset_after_s``/``shed_on_slo_pressure``
     go to every supervisor.  Handles are fleet-owned: they resolve with
-    the final outcome across restarts AND failovers."""
+    the final outcome across restarts AND failovers.
+
+    ``roles``: one of ``"prefill"`` / ``"decode"`` / ``"mixed"`` per
+    replica (default: all mixed — the classic symmetric fleet).  Any
+    role-typed fleet requires ``paged=`` and ``prefix_cache=`` in the
+    engine kwargs (the ship format is the paged host image and
+    cross-replica residency lives in the radix tree); disaggregated
+    shipping activates when both a prefill and a decode-capable side
+    exist and falls back to classic routing otherwise:
+
+    >>> fleet = model.serve_fleet(
+    ...     replicas=4, roles=("prefill", "prefill", "decode",
+    ...                        "decode"),
+    ...     paged=PagedConfig(block_size=16, num_blocks=96),
+    ...     prefix_cache=PrefixCacheConfig(block_size=16))"""
 
     def __init__(self, model, replicas=2, router=None, restart_budget=2,
                  budget_reset_after_s=None, shed_on_slo_pressure=False,
                  hedge_after_steps=None, clock=time.monotonic,
-                 **engine_kw):
+                 roles=None, **engine_kw):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.roles = self._parse_roles(roles, replicas)
+        self._disagg = ("prefill" in self.roles
+                        and any(r != "prefill" for r in self.roles))
+        self._block_size = None
+        self._prefix_index = None
+        self._ship_jobs = []
+        if any(r != "mixed" for r in self.roles):
+            pc = engine_kw.get("paged")
+            cache_cfg = engine_kw.get("prefix_cache")
+            if pc is None or pc is False or cache_cfg is None \
+                    or cache_cfg is False:
+                raise ValueError(
+                    "role-typed fleets (roles=) require paged= AND "
+                    "prefix_cache= in the engine kwargs: the KV ship "
+                    "format is the paged host image and cross-replica "
+                    "residency lives in the radix tree (docs/"
+                    "SERVING.md 'Disaggregated serving')")
+            if pc is True:
+                pc = PagedConfig()
+            elif isinstance(pc, dict):
+                pc = PagedConfig(**pc)
+            self._block_size = pc.block_size
+            self._prefix_index = FleetPrefixIndex(pc.block_size)
         if hedge_after_steps is not None and hedge_after_steps < 1:
             raise ValueError(
                 f"hedge_after_steps must be >= 1 or None, got "
@@ -250,9 +363,31 @@ class ServeFleet:
                 "serve.fleet.hedges",
                 help="hedged re-dispatches admitted TO this replica",
                 **rl))
+        self._c_ships = reg.counter(
+            "serve.fleet.ships",
+            help="completed KV ships: a prefix built (or resident) on "
+                 "one replica landed warm in another replica's pool",
+            **lbl)
+        self._c_ship_bytes = reg.counter(
+            "serve.fleet.ship_bytes",
+            help="host bytes moved by completed KV ships", **lbl)
+        self._c_shared_hits = reg.counter(
+            "serve.fleet.shared_prefix_hits",
+            help="admissions served warm through the FLEET prefix "
+                 "index — a resident prefix exported without "
+                 "recompute, or routed to its resident decode replica "
+                 "— instead of a cold re-prefill", **lbl)
+        self._c_ship_fallbacks = reg.counter(
+            "serve.fleet.ship_fallbacks",
+            help="ships abandoned mid-flight (fault, capacity, "
+                 "failover): the request was requeued cold-but-"
+                 "correct, never lost", **lbl)
         self._registered = ([self._g_healthy] + self._c_routed
                             + self._c_failovers + self._c_requeues
-                            + self._c_hedges)
+                            + self._c_hedges
+                            + [self._c_ships, self._c_ship_bytes,
+                               self._c_shared_hits,
+                               self._c_ship_fallbacks])
         self._replicas = [
             _Replica(i, EngineSupervisor(model, **self._sup_kw,
                                          **self._replica_kw(i)))
@@ -268,8 +403,26 @@ class ServeFleet:
         self.step_count = 0
         self._closed = False
         self._log.info(
-            "fleet up: %d replicas x (slots=%d) [fleet=%s]", replicas,
-            self._replicas[0].sup.engine.max_slots, self.fleet_label)
+            "fleet up: %d replicas x (slots=%d) roles=%s [fleet=%s]",
+            replicas, self._replicas[0].sup.engine.max_slots,
+            ",".join(self.roles), self.fleet_label)
+
+    @staticmethod
+    def _parse_roles(roles, replicas):
+        if roles is None:
+            return ("mixed",) * replicas
+        roles = tuple(roles)
+        if len(roles) != replicas:
+            raise ValueError(
+                f"roles has {len(roles)} entries for {replicas} "
+                f"replicas — one role per replica")
+        bad = sorted({r for r in roles
+                      if r not in ("prefill", "decode", "mixed")})
+        if bad:
+            raise ValueError(
+                f"unknown role(s) {bad!r}: each replica is 'prefill',"
+                f" 'decode', or 'mixed'")
+        return roles
 
     def _replica_kw(self, idx):
         """Engine kwargs for replica ``idx``: the shared engine_kw,
@@ -319,11 +472,16 @@ class ServeFleet:
         return {
             "replicas": len(self._replicas),
             "replicas_healthy": self.healthy_replicas,
+            "roles": list(self.roles),
             "failovers": sum(c.value for c in self._c_failovers),
             "requeues": sum(c.value for c in self._c_requeues),
             "hedges": sum(c.value for c in self._c_hedges),
             "routed": {str(i): c.value
                        for i, c in enumerate(self._c_routed)},
+            "ships": self._c_ships.value,
+            "ship_bytes": self._c_ship_bytes.value,
+            "shared_prefix_hits": self._c_shared_hits.value,
+            "ship_fallbacks": self._c_ship_fallbacks.value,
             "engines": [rep.sup.engine.stats.snapshot()
                         for rep in self._replicas],
         }
@@ -352,8 +510,40 @@ class ServeFleet:
             _faults.check("serve.route")
         handle = RequestHandle(request)
         route = _Route(handle, self.step_count)
+        prefer = None
+        if self._ship_eligible(request):
+            # ONE warm-target scan decides the path: resident on a
+            # decode replica -> route there warm (no ship), else park
+            # a ship job (the scan walks live radix trees — never pay
+            # it twice on the admission hot path)
+            prefer = self._warm_decode_target(request)
+            if prefer is None:
+                # an infeasible request (position space, worst-case
+                # blocks) must fail the CALLER synchronously, exactly
+                # as a direct submit would — parking it on a ship job
+                # would wedge the fleet on a request no engine can
+                # ever accept.  Replicas share statics, so any
+                # healthy engine's feasibility check speaks for all
+                idx0 = next(r.idx for r in self._replicas
+                            if r.healthy)
+                self._replicas[idx0].sup.engine.validate_request(
+                    request)
+                # disaggregated admission: the request parks on a
+                # ship job (queued -> built on a prefill specialist
+                # -> KV shipped) and the decode submission happens in
+                # _drive_ships once the blocks land — nothing streams
+                # until then, so every ship failure mode replays cold
+                # with byte-identical output
+                self._routes[rid] = route
+                self._order.append(rid)
+                self._enqueue_ship(request, route)
+                return handle
+        elif self._disagg:
+            # not ship-eligible (short, sticky, queue-full, a side
+            # down) but the fleet cache may still warm-route it
+            prefer = self._warm_decode_target(request)
         try:
-            idx, inner = self._route(request)
+            idx, inner = self._route(request, prefer=prefer)
         except FleetDownError:
             _trace.event("serve/request_rejected", cat="serve",
                          request=rid, reason="fleet_down")
@@ -371,6 +561,13 @@ class ServeFleet:
             # engine.submit (inside the supervisor) opened the hop;
             # stamp WHICH replica the router chose on it
             _reqs._ledger.annotate_hop(rid, replica=idx)
+        if prefer is not None and idx == prefer:
+            # fleet-index warm routing: the replica's live tree holds
+            # the whole shippable prefix, so this admission lands
+            # warm WITHOUT a ship or a re-prefill
+            self._c_shared_hits.inc()
+            if _reqs._active:
+                _reqs._ledger.annotate_hop(rid, shared_prefix=True)
         route.attempts.append((idx, inner))
         self._routes[rid] = route
         self._order.append(rid)
@@ -379,15 +576,17 @@ class ServeFleet:
         self._drain_failovers()
         return handle
 
-    def _route(self, request, exclude=()):
+    def _route(self, request, exclude=(), prefer=None):
         """Admit ``request`` to the first candidate that takes it.
-        Tries sticky, then router-ranked healthy replicas; QueueFull /
-        LoadShed at one replica falls through to the next (which is
-        what makes shedding and back-pressure FLEET-wide decisions)."""
+        Tries sticky, then the ``prefer`` hint (fleet-index warm
+        routing), then router-ranked healthy decode-capable replicas;
+        QueueFull / LoadShed at one replica falls through to the next
+        (which is what makes shedding and back-pressure FLEET-wide
+        decisions)."""
         last_refusal = None   # QueueFull/LoadShed from a live replica
         last_death = None     # budget exhaustion surfacing at admission
         tried = 0
-        for idx in self._candidates(request, exclude):
+        for idx in self._candidates(request, exclude, prefer):
             rep = self._replicas[idx]
             tried += 1
             try:
@@ -403,6 +602,11 @@ class ServeFleet:
                 last_death = e
                 continue
             self._c_routed[idx].inc()
+            nr = getattr(self.router, "note_routed", None)
+            if nr is not None:
+                # least-recently-routed tie-break currency (custom
+                # routers without the hook simply keep index ties)
+                nr(idx)
             return idx, inner
         if tried == 0 or self.healthy_replicas == 0:
             raise FleetDownError(
@@ -416,9 +620,10 @@ class ServeFleet:
             raise last_refusal
         raise last_death
 
-    def _candidates(self, request, exclude=()):
+    def _candidates(self, request, exclude=(), prefer=None):
         """Candidate replica indices, best-first: the sticky session
-        target (healthy only) ahead of the router's ranking."""
+        target, then the warm-prefix ``prefer`` hint, then the
+        router's ranking of the decode-capable pool."""
         out = []
         sess = getattr(request, "session_of", None)
         if sess is not None:
@@ -426,10 +631,28 @@ class ServeFleet:
             if (idx is not None and idx not in exclude
                     and self._replicas[idx].healthy):
                 out.append(idx)
-        views = [self._view(rep) for rep in self._replicas
-                 if rep.healthy and rep.idx not in exclude
-                 and rep.idx not in out]
+        if (prefer is not None and prefer not in exclude
+                and prefer not in out
+                and self._replicas[prefer].healthy):
+            out.append(prefer)
+        views = [self._view(self._replicas[i])
+                 for i in self._decode_pool(exclude)
+                 if i not in out]
         out.extend(self.router.rank(views))
+        return out
+
+    def _decode_pool(self, exclude=()):
+        """Replica indices decode traffic may land on: healthy
+        non-prefill replicas — falling back to EVERY healthy replica
+        when none exists (the degenerate-fleet mixed-role fallback: a
+        1-replica, all-prefill, or dead-decode-side fleet still
+        serves every request, cold but correct)."""
+        out = [r.idx for r in self._replicas
+               if r.healthy and r.idx not in exclude
+               and self.roles[r.idx] != "prefill"]
+        if not out:
+            out = [r.idx for r in self._replicas
+                   if r.healthy and r.idx not in exclude]
         return out
 
     def _view(self, rep) -> dict:
@@ -442,10 +665,16 @@ class ServeFleet:
         arena = eng.paged_arena
         return {
             "replica": rep.idx,
+            "role": self.roles[rep.idx],
             "queue_depth": depth,
             "occupancy": eng.live_slots / eng.max_slots,
             "tpot_ewma": eng.stats.tpot_ewma,
             "queue_headroom": headroom,
+            # role-typed fleets: ship builds queued on this replica —
+            # the prefill side's load signal, priced separately from
+            # every decode signal above (Router.score_prefill)
+            "prefill_depth": sum(1 for s in self._ship_jobs
+                                 if s.src == rep.idx),
             # paged replicas: fraction of the KV pool in use (live
             # slots + cached blocks; swapped requests hold none but
             # will re-allocate on resume) — a replica whose pool is
@@ -475,6 +704,7 @@ class ServeFleet:
                 self._mark_down(rep, e)
         self._check_watchdog()
         self._drain_failovers()
+        self._drive_ships()
         if self.hedge_after_steps is not None:
             self._maybe_hedge()
         self._sync()
@@ -522,6 +752,11 @@ class ServeFleet:
         rep.healthy = False
         rep.needs_failover = True
         rep.down_error = error
+        if self._prefix_index is not None:
+            # the replica's tree dies with it: forget its residency
+            # records (stale hints would only cost a failed verify,
+            # but dropping them keeps holder scans tight)
+            self._prefix_index.drop_replica(rep.idx)
         self._c_failovers[rep.idx].inc()
         self._g_healthy.set(self.healthy_replicas)
         self._log.error(
@@ -643,6 +878,354 @@ class ServeFleet:
         _trace.event("serve/fleet_revive", cat="serve", replica=idx,
                      healthy=self.healthy_replicas)
 
+    # -- disaggregated prefill/decode: KV shipping -----------------------
+    def _ship_eligible(self, request) -> bool:
+        """True when this admission should run disaggregated: a
+        role-typed fleet with both sides healthy, a prompt with at
+        least one shippable full block, no sticky session target,
+        ship-queue headroom, and no decode replica already holding
+        the prefix (that routes warm directly — cheaper than any
+        ship)."""
+        if not self._disagg or self._prefix_index is None:
+            return False
+        sess = getattr(request, "session_of", None)
+        if sess is not None and self._sessions.get(sess) is not None:
+            return False
+        if (len(request.prompt_ids) - 1) // self._block_size < 1:
+            return False
+        if len(self._ship_jobs) >= self._ship_queue_max():
+            # the ship queue is NOT exempt from back-pressure: past
+            # the scheduler-depth bound, long admissions fall through
+            # to classic routing, where the decode replicas' own
+            # queue bounds and SLO shedding apply (a burst gets typed
+            # QueueFullError/LoadShedError, never unbounded host
+            # growth behind the specialists)
+            return False
+        if not any(r.healthy and self.roles[r.idx] == "prefill"
+                   for r in self._replicas):
+            return False
+        return any(r.healthy and self.roles[r.idx] != "prefill"
+                   for r in self._replicas)
+
+    def _ship_queue_max(self) -> int:
+        """Depth bound for parked ship builds: the replicas' own
+        scheduler back-pressure bound (they share engine_kw), so
+        disaggregated admission refuses at the same depth a direct
+        engine submit would."""
+        sched = self._replicas[0].sup.engine.scheduler
+        return int(getattr(sched, "max_queue_depth", 64) or 64)
+
+    def _verified_holder(self, tokens, n_goal, decode_only=False):
+        """First replica whose LIVE tree verifiably holds the first
+        ``n_goal`` blocks of ``tokens`` (fleet-index hint, checked
+        against the tree — the ONE place the verify/prune discipline
+        lives): stale hints are unregistered so later lookups stop
+        paying the verify.  ``decode_only`` restricts to
+        decode-capable replicas (warm routing); otherwise any role
+        qualifies (targeted export).  None when nothing verifies."""
+        if self._prefix_index is None or n_goal < 1:
+            return None
+        for idx in self._prefix_index.holders(tokens, n_goal):
+            rep = self._replicas[idx]
+            if not rep.healthy or (decode_only
+                                   and self.roles[idx] == "prefill"):
+                continue
+            eng = rep.sup.engine
+            if (not eng._closed and not eng._failed
+                    and eng.prefix_cache is not None
+                    and len(eng.prefix_cache.lookup(tokens)[:n_goal])
+                    == n_goal):
+                return idx
+            # the replica's LRU evicted it since registration: the
+            # hint is dead — prune it
+            self._prefix_index.unregister(tokens, n_goal, idx)
+        return None
+
+    def _warm_decode_target(self, request):
+        """A healthy decode-capable replica whose LIVE tree already
+        holds the request's whole shippable prefix: routing there
+        serves warm locally with no ship and no re-prefill."""
+        if self._prefix_index is None:
+            return None
+        n_goal = (len(request.prompt_ids) - 1) // self._block_size
+        return self._verified_holder(request.prompt_ids, n_goal,
+                                     decode_only=True)
+
+    def _pick_ship_src(self, request) -> int:
+        """The replica a ship sources from: any healthy replica whose
+        live tree already holds the whole prefix (targeted export —
+        zero recompute, whatever its role), else the prefill
+        specialist with the shallowest build queue."""
+        n_goal = (len(request.prompt_ids) - 1) // self._block_size
+        idx = self._verified_holder(request.prompt_ids, n_goal)
+        if idx is not None:
+            return idx
+        views = [self._view(r) for r in self._replicas
+                 if r.healthy and self.roles[r.idx] == "prefill"]
+        return self.router.rank_prefill(views)[0]
+
+    def _enqueue_ship(self, request, route):
+        sjob = _ShipJob()
+        sjob.rid = request.request_id
+        sjob.route = route
+        sjob.request = request
+        sjob.src = self._pick_ship_src(request)
+        sjob.job = None
+        sjob.hit = False
+        self._ship_jobs.append(sjob)
+        if _reqs._active:
+            # the request's timeline opens HERE with a hop on the
+            # prefill specialist: no engine.submit happens there, but
+            # exact ship/prefill attribution needs the hop (this one
+            # via=prefill, then the decode hop via=kv_ship)
+            eng = self._replicas[sjob.src].sup.engine
+            _reqs._ledger.on_submit(
+                sjob.rid, engine=eng.stats.engine_label,
+                t=self._clock(),
+                prompt_len=len(request.prompt_ids),
+                max_new_tokens=request.max_new_tokens)
+            _reqs._ledger.annotate_hop(sjob.rid, replica=sjob.src,
+                                       via="prefill")
+        _trace.event("serve/kv_ship_queued", cat="serve",
+                     request=sjob.rid, src=sjob.src)
+
+    def _drive_ships(self):
+        """Advance every queued ship one scheduling quantum: per
+        healthy source, chunk its HEAD build by the specialist's own
+        ``prefill_token_budget`` (None = finish this step); completed
+        builds export → validate → scatter + adopt on the chosen
+        decode replica, and the request submits there (warm by
+        construction).  Every failure mode — an injected
+        ``serve.kv_ship`` fault, a malformed image, destination
+        capacity, a dying specialist — falls back to a COLD route:
+        later, never wrong (nothing streamed during the ship)."""
+        if not self._ship_jobs:
+            return
+        busy = set()
+        remaining = []
+        for sjob in self._ship_jobs:
+            if sjob.route.handle.done():
+                self._abandon_build(sjob)
+                continue
+            rep = self._replicas[sjob.src]
+            if not rep.healthy:
+                self._reassign_or_fallback(sjob, remaining)
+                continue
+            if sjob.src in busy:
+                remaining.append(sjob)
+                continue
+            busy.add(sjob.src)
+            try:
+                if sjob.job is None \
+                        or sjob.job.engine is not rep.sup.engine:
+                    sjob.job = rep.sup.start_prefix_build(
+                        sjob.request.prompt_ids)
+                    sjob.hit = bool(sjob.job is not None
+                                    and sjob.job.hit)
+                if sjob.job is None:
+                    self._ship_fallback(sjob, "nothing_shippable")
+                    continue
+                done = rep.sup.advance_prefix_build(
+                    sjob.job, rep.sup.engine._budget, rid=sjob.rid)
+                if done is None:
+                    # the specialist died mid-chunk and was rebuilt:
+                    # restart the build on the fresh engine next step
+                    # (nothing streamed — the replay is identical)
+                    sjob.job = None
+                    remaining.append(sjob)
+                    continue
+                if not done:
+                    remaining.append(sjob)
+                    continue
+                self._complete_ship(sjob, rep)
+            except RestartBudgetExceededError as e:
+                self._mark_down(rep, e)
+                self._reassign_or_fallback(sjob, remaining)
+            except Exception as e:
+                # mid-ship failure (injected serve.kv_ship fault, a
+                # malformed/truncated image, a raising copy): the
+                # engine helpers already unwound their local state —
+                # requeue the request cold-but-correct
+                self._log.warning(
+                    "ship for %s failed (%r); serving cold", sjob.rid,
+                    e)
+                self._ship_fallback(sjob, type(e).__name__)
+        self._ship_jobs = remaining
+        if any(r.needs_failover for r in self._replicas):
+            self._drain_failovers()
+
+    def _ship_dsts(self, request) -> list:
+        """Ship destination candidates, best-first: the PREFIX-HASH
+        STICKY target (a deterministic crc32 of the shipped block
+        prefix over the healthy decode pool, so each hot prefix's
+        blocks concentrate on as few replicas as possible), then the
+        router's ranking of the rest."""
+        pool = self._decode_pool()
+        if not pool:
+            return []
+        n_goal = (len(request.prompt_ids) - 1) // self._block_size
+        toks = np.asarray(request.prompt_ids, np.int32).reshape(-1)
+        key = toks[:n_goal * self._block_size].tobytes()
+        sticky = sorted(pool)[zlib.crc32(key) % len(pool)]
+        out = [sticky]
+        views = [self._view(self._replicas[i]) for i in pool
+                 if i != sticky]
+        out.extend(self.router.rank(views))
+        return out
+
+    def _complete_ship(self, sjob, src_rep):
+        """Transfer a finished build: export the image from the
+        source, land it on the first destination with capacity, and
+        submit the request there (the admission finds the prefix in
+        its OWN radix tree — a local warm hit)."""
+        req = sjob.request
+        t0 = self._clock()
+        image, src_resident = src_rep.sup.export_prefix_image(
+            sjob.job)
+        sjob.job = None
+        n = image.n_data
+        if src_resident:
+            # only a REAL donation/residency is worth indexing — a
+            # pool-pressure export-from-row never entered the tree
+            self._prefix_index.register(req.prompt_ids, n,
+                                        src_rep.idx)
+        path = dst_rep = None
+        for idx in self._ship_dsts(req):
+            cand = self._replicas[idx]
+            try:
+                path = cand.sup.admit_prefix_image(req.prompt_ids,
+                                                   image)
+            except RestartBudgetExceededError as e:
+                self._mark_down(cand, e)
+                continue
+            if path is not None:
+                dst_rep = cand
+                break
+        if path is None:
+            self._ship_fallback(sjob, "dst_capacity")
+            return
+        t1 = self._clock()
+        dst = dst_rep.idx
+        cache = dst_rep.sup.engine.prefix_cache
+        try:
+            inner = dst_rep.sup.submit(req)
+        except (QueueFullError, LoadShedError, ValueError,
+                RestartBudgetExceededError) as e:
+            # refused AFTER the blocks landed: they stay CACHED on
+            # the destination (soft free space, not a leak) — unpin
+            # and serve cold wherever the router finds room
+            try:
+                cache.release(path)
+            except RuntimeError:
+                pass
+            if isinstance(e, RestartBudgetExceededError):
+                self._mark_down(dst_rep, e)
+            self._ship_fallback(sjob, "dst_refused")
+            return
+        sjob.route.ship_release = (cache, path)
+        sjob.route.attempts.append((dst, inner))
+        self._c_routed[dst].inc()
+        nr = getattr(self.router, "note_routed", None)
+        if nr is not None:
+            nr(dst)
+        self._c_ships.inc()
+        self._c_ship_bytes.inc(image.nbytes)
+        if sjob.hit:
+            # the prefix was RESIDENT on the source (an earlier
+            # build, another request's donation): this ship recomputed
+            # nothing — the fleet-level cache did its job
+            self._c_shared_hits.inc()
+        self._prefix_index.register(req.prompt_ids, n, dst)
+        if _reqs._active:
+            _reqs._ledger.annotate_hop(
+                sjob.rid, replica=dst, via="kv_ship",
+                src_replica=src_rep.idx, ship_s=t1 - t0,
+                ship_bytes=image.nbytes, ship_blocks=n)
+        _trace.event("serve/kv_ship", cat="serve", request=sjob.rid,
+                     src=src_rep.idx, dst=dst, blocks=n,
+                     bytes=image.nbytes)
+        self._log.info("shipped %d KV blocks for %s: replica %d -> %d"
+                       " (%d bytes)", n, sjob.rid, src_rep.idx, dst,
+                       image.nbytes)
+
+    def _ship_fallback(self, sjob, reason):
+        """Serve a failed ship COLD: nothing streamed during the
+        ship, so a plain re-route is byte-identical — later, never
+        wrong.  Unplaceable requests reject typed (the failover
+        contract), never silently dropped."""
+        self._abandon_build(sjob)
+        self._c_ship_fallbacks.inc()
+        rid = sjob.rid
+        _trace.event("serve/kv_ship_fallback", cat="serve",
+                     request=rid, reason=reason)
+        try:
+            idx, inner = self._route(sjob.request)
+        except (EngineFailedError, QueueFullError, LoadShedError,
+                ValueError) as e:
+            # ValueError: submit-time infeasibility surfacing on the
+            # cold path (belt and braces — ship eligibility already
+            # pre-validated, but the route must NEVER let an escape
+            # wedge the drive loop with the job gone)
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=rid, reason="ship_unplaceable")
+            if _reqs._active:
+                _reqs._ledger.on_reject(
+                    rid, t=self._clock(),
+                    reason=f"ship_unplaceable:{type(e).__name__}",
+                    started=False)
+            sjob.route.handle._reject(e)
+            return
+        if _reqs._active:
+            _reqs._ledger.annotate_hop(rid, replica=idx,
+                                       via="ship_fallback",
+                                       reason=reason)
+        sjob.route.attempts.append((idx, inner))
+
+    def _reassign_or_fallback(self, sjob, remaining):
+        """The build's source replica died: restart it on another
+        healthy prefill specialist (nothing streamed — a rebuilt
+        prefix is byte-identical), else serve cold."""
+        self._abandon_build(sjob)
+        have_prefill = any(
+            r.healthy and self.roles[r.idx] == "prefill"
+            for r in self._replicas)
+        have_decode = any(
+            r.healthy and self.roles[r.idx] != "prefill"
+            for r in self._replicas)
+        if have_prefill and have_decode:
+            sjob.src = self._pick_ship_src(sjob.request)
+            sjob.job = None
+            if _reqs._active:
+                eng = self._replicas[sjob.src].sup.engine
+                _reqs._ledger.on_submit(sjob.rid,
+                                        engine=eng.stats.engine_label,
+                                        t=self._clock())
+                _reqs._ledger.annotate_hop(sjob.rid, replica=sjob.src,
+                                           via="prefill")
+            _trace.event("serve/kv_ship_requeued", cat="serve",
+                         request=sjob.rid, src=sjob.src)
+            remaining.append(sjob)
+        else:
+            self._ship_fallback(sjob, "specialist_lost")
+
+    def _abandon_build(self, sjob):
+        """Release a job's engine-side refs (idempotent; a rebuilt
+        engine makes it a no-op — the old tree died with it)."""
+        if sjob.job is not None:
+            rep = self._replicas[sjob.src]
+            if sjob.job.engine is rep.sup.engine:
+                rep.sup.abandon_prefix_build(sjob.job)
+            sjob.job = None
+
+    def _release_ship_pin(self, route):
+        if route.ship_release is not None:
+            cache, path = route.ship_release
+            route.ship_release = None
+            try:
+                cache.release(path)
+            except RuntimeError:
+                pass  # the destination engine was rebuilt: stale path
+
     # -- hedging ---------------------------------------------------------
     def _maybe_hedge(self):
         """Re-dispatch requests stuck un-started behind one replica's
@@ -725,7 +1308,11 @@ class ServeFleet:
                 done.append(rid)
         if done:
             for rid in done:
-                self._routes.pop(rid, None)
+                route = self._routes.pop(rid, None)
+                if route is not None:
+                    # a shipped request's prefix pin lives exactly as
+                    # long as the request: release it with the route
+                    self._release_ship_pin(route)
             live = set(self._routes)
             self._order = [r for r in self._order if r in live]
 

@@ -1,0 +1,203 @@
+"""Versioned host-side KV block images — ONE wire format for
+preemption swap and fleet KV shipping (the disaggregation round).
+
+Two paths copy paged KV between device pools and host memory:
+
+* **preemption swap** (serve/paged.py ``swap_out``/``swap_in``) — a
+  preempted request's blocks round-trip through host RAM and resume
+  byte-exactly;
+* **KV shipping** (serve/fleet.py disaggregated serving) — a prefill
+  specialist's canonical prompt blocks travel to a decode specialist's
+  pool, seeding its radix prefix cache so the admission lands warm.
+
+Before this module the swap image was a bare ``(kc_host, vc_host)``
+numpy-pytree pair with no self-description: nothing stopped a drifted
+producer (or a truncated transfer) from scattering garbage into a
+live pool.  A :class:`KVImage` carries a VERSION, the block geometry,
+the quantization flag, and a per-leaf dtype/shape header captured at
+pack time; :meth:`KVImage.validate` re-derives the signature from the
+arrays and cross-checks it against both the header (truncation /
+mutation fails typed) and the consuming arena's geometry (a dense
+image cannot scatter into an int8 pool, a block-size-16 image cannot
+land in a block-size-32 pool).  Both swap and ship consume images
+through the same checks, so the two paths cannot drift.
+
+Leaf layout (the cache-row convention every fixed-shape copy in
+serve/paged.py uses): dense pools are one ``(L, 1, H_kv, W, D)``
+array per K/V; int8 pools are ``(values, scales)`` tuples whose
+scales leaf drops the trailing ``D`` axis.  ``W`` is the image's lane
+width — a FULL row for swap (the historical shape, one executable per
+engine geometry) or the narrow ``n_data * block_size`` slice for
+shipping (ship bytes track the prompt, not ``max_len``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["KVIMAGE_VERSION", "KVImage", "KVImageError", "pack_image"]
+
+#: bump when the leaf layout or header schema changes; ``validate``
+#: refuses images from a different version rather than guessing
+KVIMAGE_VERSION = 1
+
+
+class KVImageError(ValueError):
+    """A KV image failed validation (version / geometry / dtype /
+    header mismatch, or arrays inconsistent with their pack-time
+    header).  Raised BEFORE any scatter touches a pool — a bad image
+    degrades to a cold prefill, never to corrupted cache state."""
+
+
+def _leaf_list(tree):
+    """Flatten a host cache pytree (array, or (values, scales) tuple,
+    possibly nested under tuples/lists) into a leaf list in
+    deterministic order."""
+    if isinstance(tree, (tuple, list)):
+        out = []
+        for t in tree:
+            out.extend(_leaf_list(t))
+        return out
+    return [tree]
+
+
+def _signature(kc, vc):
+    """Per-leaf (shape, dtype) header, K leaves then V leaves."""
+    return tuple((tuple(a.shape), str(a.dtype))
+                 for a in _leaf_list(kc) + _leaf_list(vc))
+
+
+class KVImage:
+    """One request's (or prefix's) KV blocks as a self-describing host
+    image.  Construct through :func:`pack_image` — the header is
+    captured from the arrays at pack time, which is what makes
+    later truncation detectable."""
+
+    __slots__ = ("version", "block_size", "n_data", "quant", "header",
+                 "kc", "vc")
+
+    def __init__(self, version, block_size, n_data, quant, header,
+                 kc, vc):
+        self.version = int(version)
+        self.block_size = int(block_size)
+        self.n_data = int(n_data)
+        self.quant = bool(quant)
+        self.header = tuple(header)
+        self.kc = kc
+        self.vc = vc
+
+    @property
+    def width(self) -> int:
+        """Lane width of the image rows (positions per leaf)."""
+        return int(_leaf_list(self.kc)[0].shape[3])
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes the image's arrays occupy — the fleet's
+        ``serve.fleet.ship_bytes`` accounting."""
+        return int(sum(a.nbytes
+                       for a in _leaf_list(self.kc)
+                       + _leaf_list(self.vc)))
+
+    def validate(self, block_size, quant, pool_k=None):
+        """Typed validation before any scatter: version supported,
+        geometry matches the consuming arena (``block_size``,
+        ``quant``), arrays consistent with the pack-time header
+        (truncated or mutated images fail HERE), lane width a block
+        multiple covering ``n_data`` blocks, and — when the consuming
+        pool's K leaves are handed in — per-leaf dtype and
+        (L, H, tail) compatibility with the pool.  Raises
+        :class:`KVImageError`; returns None."""
+        if self.version != KVIMAGE_VERSION:
+            raise KVImageError(
+                f"KV image version {self.version} != supported "
+                f"{KVIMAGE_VERSION}: refuse rather than guess at the "
+                f"leaf layout")
+        if self.block_size != block_size:
+            raise KVImageError(
+                f"KV image block_size ({self.block_size}) != pool "
+                f"block_size ({block_size}): lanes would not tile "
+                f"the target blocks")
+        if self.quant != bool(quant):
+            raise KVImageError(
+                f"KV image quant={self.quant} vs pool quant="
+                f"{bool(quant)}: dense and int8 (values, scales) "
+                f"layouts are not interchangeable")
+        sig = _signature(self.kc, self.vc)
+        if sig != self.header:
+            raise KVImageError(
+                "KV image arrays do not match their pack-time header "
+                "(truncated or mutated in transit): "
+                f"header={self.header} got={sig}")
+        k_leaves = _leaf_list(self.kc)
+        v_leaves = _leaf_list(self.vc)
+        if len(k_leaves) != len(v_leaves):
+            raise KVImageError(
+                f"KV image K/V leaf-count mismatch "
+                f"({len(k_leaves)} vs {len(v_leaves)})")
+        W = self.width
+        if W % self.block_size != 0:
+            raise KVImageError(
+                f"KV image lane width ({W}) is not a multiple of "
+                f"block_size ({self.block_size})")
+        if self.n_data < 0 or self.n_data * self.block_size > W:
+            raise KVImageError(
+                f"KV image n_data ({self.n_data} blocks) exceeds its "
+                f"own lane width ({W} positions): a length-lying "
+                f"image must never scatter")
+        for a in k_leaves + v_leaves:
+            if a.ndim < 4 or a.shape[1] != 1 or a.shape[3] != W:
+                raise KVImageError(
+                    f"KV image leaf shape {tuple(a.shape)} is not a "
+                    f"(L, 1, H, {W}[, D]) cache row")
+        if pool_k is not None:
+            pool_leaves = _leaf_list(pool_k)
+            if len(pool_leaves) != len(k_leaves):
+                raise KVImageError(
+                    f"KV image has {len(k_leaves)} K leaves but the "
+                    f"pool has {len(pool_leaves)} (dense vs int8 "
+                    f"layout drift)")
+            for img, pool in zip(k_leaves, pool_leaves):
+                # pool: (L, N+1, H, B, ...) vs image: (L, 1, H, W, ...)
+                if (img.shape[0] != pool.shape[0]
+                        or img.shape[2] != pool.shape[2]
+                        or img.shape[4:] != pool.shape[4:]
+                        or str(img.dtype) != str(pool.dtype)):
+                    raise KVImageError(
+                        f"KV image leaf {tuple(img.shape)}/{img.dtype}"
+                        f" incompatible with pool leaf "
+                        f"{tuple(pool.shape)}/{pool.dtype} (layer/"
+                        f"head/head-dim/dtype must match)")
+
+    def narrowed(self, n_data=None) -> "KVImage":
+        """A copy of this image sliced to ``n_data`` blocks' lanes
+        (default: ``self.n_data``) — the ship-path form, where bytes
+        on the wire track the shipped prefix, not ``max_len``.  The
+        header is re-captured from the sliced arrays (a narrowed
+        image is a NEW image, not a mutation of this one)."""
+        n = self.n_data if n_data is None else int(n_data)
+        if n > self.n_data:
+            raise KVImageError(
+                f"narrowed({n}) beyond the image's n_data "
+                f"({self.n_data})")
+        w = max(n, 1) * self.block_size
+
+        def cut(tree):
+            if isinstance(tree, tuple):
+                return tuple(cut(t) for t in tree)
+            if isinstance(tree, list):
+                return [cut(t) for t in tree]
+            return np.ascontiguousarray(tree[:, :, :, :w])
+
+        kc, vc = cut(self.kc), cut(self.vc)
+        return KVImage(self.version, self.block_size, n, self.quant,
+                       _signature(kc, vc), kc, vc)
+
+
+def pack_image(kc_host, vc_host, block_size, n_data, quant) -> KVImage:
+    """Seal host cache-row pytrees into a :class:`KVImage`.  The
+    per-leaf header is captured HERE, so any later divergence between
+    the arrays and what was packed (a truncated transfer, an in-place
+    mutation) fails :meth:`KVImage.validate` typed."""
+    return KVImage(KVIMAGE_VERSION, block_size, n_data, quant,
+                   _signature(kc_host, vc_host), kc_host, vc_host)

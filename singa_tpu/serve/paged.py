@@ -773,26 +773,94 @@ class PagedKVArena:
             jnp.asarray(np.asarray(sel, np.int32)), jnp.asarray(idx),
             block=self.block_size)
 
-    # -- swap ------------------------------------------------------------
-    def swap_out(self, blocks, n_data):
+    # -- swap / ship images ----------------------------------------------
+    # Both host-image paths — preemption swap AND fleet KV shipping —
+    # produce/consume the SAME versioned serve/kvimage.py format, so
+    # the two cannot drift and a truncated or geometry-mismatched
+    # image fails typed before any scatter touches the pool.
+
+    def swap_out(self, blocks, n_data) -> "KVImage":
         """Copy ``blocks``' first ``n_data`` lanes to HOST memory (one
-        gather + device sync) — the preemption path.  Returns
-        (kc_host, vc_host) numpy pytrees shaped like a cache row."""
+        gather + device sync) — the preemption path.  Returns a
+        full-row-width :class:`~singa_tpu.serve.kvimage.KVImage` (one
+        gather executable per engine geometry, the historical swap
+        shape)."""
+        from .kvimage import pack_image
+
         kc_row, vc_row = self.gather_row(blocks, n_used=n_data)
         self._c_swap_out.inc()
-        return (jax.tree.map(np.asarray, kc_row),
-                jax.tree.map(np.asarray, vc_row))
+        return pack_image(jax.tree.map(np.asarray, kc_row),
+                          jax.tree.map(np.asarray, vc_row),
+                          block_size=self.block_size, n_data=n_data,
+                          quant=self.quant)
 
-    def swap_in(self, kc_host, vc_host, blocks):
-        """Restore a swapped-out row's lanes into freshly allocated
+    def swap_in(self, image, blocks):
+        """Restore a swapped-out image's lanes into freshly allocated
         ``blocks`` (one scatter — ``scatter_row`` carries the
         ``serve.paged_copy`` fault check, so one logical restore is
-        one policy tick).  Byte-exact: the resumed request's cache
-        state is exactly what swap_out saved."""
+        one policy tick).  The image validates against THIS pool's
+        geometry first (:class:`~singa_tpu.serve.kvimage.KVImageError`
+        on any mismatch — never scatters garbage).  Byte-exact: the
+        resumed request's cache state is exactly what swap_out
+        saved."""
+        image.validate(self.block_size, self.quant,
+                       pool_k=self.pool_k)
         self._c_swap_in.inc()
-        self.scatter_row(jax.tree.map(jnp.asarray, kc_host),
-                         jax.tree.map(jnp.asarray, vc_host),
+        self.scatter_row(jax.tree.map(jnp.asarray, image.kc),
+                         jax.tree.map(jnp.asarray, image.vc),
                          {j: b for j, b in enumerate(blocks)})
+
+    def export_image(self, blocks, n_data) -> "KVImage":
+        """Gather ``blocks``' first ``n_data`` lanes into a NARROW
+        host image (``n_data * block_size`` lanes — ship bytes track
+        the shipped prefix, not ``max_len``): the KV-shipping source
+        path.  Packs directly (NOT via :meth:`swap_out` — the
+        ``serve.paged.swap_out`` counter means preemption pressure
+        and must not absorb ship traffic).  Checks the
+        ``serve.kv_ship`` fault site — an injected mid-ship failure
+        raises typed and the fleet requeues the request
+        cold-but-correct."""
+        from .kvimage import pack_image
+
+        if _faults._armed:
+            _faults.check("serve.kv_ship")
+        kc_row, vc_row = self.gather_row(blocks, n_used=n_data)
+        img = pack_image(jax.tree.map(np.asarray, kc_row),
+                         jax.tree.map(np.asarray, vc_row),
+                         block_size=self.block_size, n_data=n_data,
+                         quant=self.quant)
+        return img.narrowed()
+
+    def export_row_image(self, kc_row, vc_row, n_data) -> "KVImage":
+        """Build a narrow ship image straight from a device cache ROW
+        (the prefill-specialist path when pool pressure skipped the
+        donation: the chunked row is the only copy).  Same fault site
+        and format as :meth:`export_image`."""
+        from .kvimage import pack_image
+
+        if _faults._armed:
+            _faults.check("serve.kv_ship")
+        img = pack_image(jax.tree.map(np.asarray, kc_row),
+                         jax.tree.map(np.asarray, vc_row),
+                         block_size=self.block_size, n_data=n_data,
+                         quant=self.quant)
+        return img.narrowed()
+
+    def import_image(self, image, lanes):
+        """Scatter a validated ship image's lanes into pool blocks:
+        ``lanes`` maps lane index -> block id (lanes below a local
+        prefix hit are simply absent — their bytes never move).  The
+        ``serve.kv_ship`` fault site covers the destination half of a
+        ship; validation runs BEFORE the fault check so a malformed
+        image is always the typed :class:`KVImageError`, never a
+        chaos artifact."""
+        image.validate(self.block_size, self.quant,
+                       pool_k=self.pool_k)
+        if _faults._armed:
+            _faults.check("serve.kv_ship")
+        self.scatter_row(jax.tree.map(jnp.asarray, image.kc),
+                         jax.tree.map(jnp.asarray, image.vc),
+                         dict(lanes))
 
     def on_preempt(self):
         self._c_preempt.inc()

@@ -309,6 +309,53 @@ class EngineSupervisor:
                 _reqs._ledger.annotate_hop(rid, via="supervisor_restart",
                                            restart=self.restarts)
 
+    # -- disaggregated prefill / KV shipping (fleet-driven) --------------
+    # Thin supervised wrappers over the engine's ship APIs: the fleet
+    # never reaches a dead or failed engine through them.  A chunk
+    # fault mid-build fails the ENGINE typed (the engine's contract);
+    # the wrapper rebuilds it — restart budget enforced — and reports
+    # the build dead by returning None, so the fleet restarts it from
+    # scratch (nothing streamed: a replayed build is byte-identical).
+
+    def _ship_guard(self):
+        if self._dead:
+            raise RestartBudgetExceededError(
+                f"supervisor is dead: restart budget "
+                f"({self.restart_budget}) exhausted")
+        if self.engine._failed:
+            self._recover()
+
+    def start_prefix_build(self, prompt_ids):
+        self._ship_guard()
+        return self.engine.start_prefix_build(prompt_ids)
+
+    def advance_prefix_build(self, job, max_tokens=None, rid=None):
+        """True when complete, False when budget ran out first, None
+        when the engine died mid-chunk and was rebuilt (the job is
+        invalid — restart the build).  Raises
+        :class:`RestartBudgetExceededError` once the budget is
+        spent."""
+        self._ship_guard()
+        try:
+            return self.engine.advance_prefix_build(
+                job, max_tokens, rid=rid)
+        except EngineFailedError:
+            self._recover()
+            self._sync()
+            return None
+
+    def export_prefix_image(self, job):
+        self._ship_guard()
+        return self.engine.export_prefix_image(job)
+
+    def admit_prefix_image(self, tokens, image):
+        self._ship_guard()
+        return self.engine.admit_prefix_image(tokens, image)
+
+    def abandon_prefix_build(self, job):
+        if not self.engine._closed:
+            self.engine.abandon_prefix_build(job)
+
     def abandon(self, reason="fleet failover"):
         """Fleet failover entry point: mark this supervisor dead WITHOUT
         driving the (possibly wedged) engine, and reject every

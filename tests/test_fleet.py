@@ -3,9 +3,11 @@ cross-replica failover with requeue parity, fleet-wide load shedding,
 hedged re-dispatch, and the fleet observability surface.
 
 Deterministic on CPU: faults come from the seeded injection registry,
-routing ties break on replica index, and every parity check compares
-against the single-prompt ``generate`` oracle (requeued/hedged requests
-re-derive the SAME private sampling chain from their seed)."""
+routing ties break on least-recently-routed logical ticks (replica
+index on a fresh router — tests/test_serve_disagg.py pins the
+tie-break), and every parity check compares against the single-prompt
+``generate`` oracle (requeued/hedged requests re-derive the SAME
+private sampling chain from their seed)."""
 
 import numpy as np
 import pytest
@@ -404,8 +406,10 @@ def test_fleet_metrics_health_report_and_unregister(model):
     assert "fleet_failovers" in rep["resilience"]
     assert "fleet_requeues" in rep["resilience"]
     snap = fleet.snapshot()
-    assert set(snap) == {"replicas", "replicas_healthy", "failovers",
-                         "requeues", "hedges", "routed", "engines"}
+    assert set(snap) == {"replicas", "replicas_healthy", "roles",
+                         "failovers", "requeues", "hedges", "routed",
+                         "ships", "ship_bytes", "shared_prefix_hits",
+                         "ship_fallbacks", "engines"}
     assert len(snap["engines"]) == 2
     fleet.close()
     gkey = "serve.fleet.replicas_healthy{fleet=%s}" % lbl

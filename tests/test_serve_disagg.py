@@ -1,0 +1,539 @@
+"""Disaggregated prefill/decode serving (the disagg round): role-typed
+fleets, KV shipping through the versioned host image, the fleet-level
+prefix index, and the router's least-recently-routed tie-break.
+
+The parity chain under test: a prefill specialist's build is the
+chunked-prefill CANONICAL form (the exact executable warm admission
+rides), the ship image is a byte copy of those blocks, and the decode
+replica's admission is a local warm hit — so a disaggregated stream
+must be byte-identical to the same request served by one engine
+(greedy AND seeded sampling, dense AND int8 pools).  Every failure
+mode (mid-ship fault, specialist death, destination capacity) must
+requeue cold-but-correct with zero leaked blocks on BOTH replicas.
+
+Named to sort after test_monitor (the paged AOT compiles register
+cost tables — same collection-order hazard test_serve_longctx
+documents)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.observe import requests as reqtrace
+from singa_tpu.resilience import FailOnce, faults
+from singa_tpu.serve import (GenerationRequest, KVImage, KVImageError,
+                             PagedConfig, PrefixCacheConfig, Router,
+                             ServeFleet)
+from singa_tpu.serve.kvimage import KVIMAGE_VERSION, pack_image
+from singa_tpu.serve.prefix import FleetPrefixIndex
+
+BLOCK = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+def _disagg_kw(num_blocks=48, **extra):
+    return dict(paged=PagedConfig(block_size=BLOCK,
+                                  num_blocks=num_blocks),
+                prefix_cache=PrefixCacheConfig(block_size=BLOCK),
+                **extra)
+
+
+def _long(seed, n=40):
+    return np.random.RandomState(seed).randint(
+        0, 256, n).astype(np.int32)
+
+
+def _chats(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, 256, rng.randint(3, 7)).astype(np.int32),
+             int(rng.randint(2, 5))) for _ in range(n)]
+
+
+def _leaks(fleet):
+    """Blocks unaccounted for on each replica after a drain: used
+    minus tree-cached must be zero (live slots are empty)."""
+    out = []
+    for i in range(fleet.replicas):
+        eng = fleet.supervisor(i).engine
+        if eng._closed:
+            continue
+        out.append(eng.paged_arena.blocks_used
+                   - eng.prefix_cache.cached_blocks)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# kvimage: the shared versioned host format
+# ---------------------------------------------------------------------------
+
+def _fake_rows(width=16, quant=False):
+    if quant:
+        kc = (np.zeros((2, 1, 4, width, 8), np.int8),
+              np.zeros((2, 1, 4, width), np.float32))
+    else:
+        kc = np.zeros((2, 1, 4, width, 8), np.float32)
+    vc = (tuple(np.copy(a) for a in kc) if quant
+          else np.copy(kc))
+    return kc, vc
+
+
+def test_kvimage_pack_validate_roundtrip():
+    kc, vc = _fake_rows()
+    img = pack_image(kc, vc, block_size=8, n_data=2, quant=False)
+    assert img.version == KVIMAGE_VERSION
+    assert img.width == 16 and img.nbytes > 0
+    img.validate(8, False)                      # clean
+    nar = img.narrowed(1)
+    assert nar.width == 8 and nar.n_data == 1
+    nar.validate(8, False)
+
+
+def test_kvimage_mismatches_fail_typed():
+    kc, vc = _fake_rows()
+    img = pack_image(kc, vc, block_size=8, n_data=2, quant=False)
+    with pytest.raises(KVImageError):           # wrong block size
+        img.validate(16, False)
+    with pytest.raises(KVImageError):           # dense into int8 pool
+        img.validate(8, True)
+    bad = KVImage(KVIMAGE_VERSION + 1, 8, 2, False, img.header,
+                  img.kc, img.vc)
+    with pytest.raises(KVImageError):           # unknown version
+        bad.validate(8, False)
+    lies = KVImage(KVIMAGE_VERSION, 8, 3, False, img.header,
+                   img.kc, img.vc)
+    with pytest.raises(KVImageError):           # n_data beyond width
+        lies.validate(8, False)
+
+
+def test_kvimage_truncation_detected_by_header():
+    """A truncated transfer (arrays no longer match the pack-time
+    header) fails typed — it can never scatter garbage."""
+    kc, vc = _fake_rows()
+    img = pack_image(kc, vc, block_size=8, n_data=2, quant=False)
+    img.kc = img.kc[:, :, :, :8]                # 'truncated in transit'
+    with pytest.raises(KVImageError):
+        img.validate(8, False)
+
+
+def test_swap_roundtrips_through_image_and_rejects_mismatch(model):
+    """Preemption swap rides the same versioned format: out -> in is
+    byte-exact, and an image from an alien geometry refuses before
+    touching the pool."""
+    eng = model.serve(max_slots=2, **_disagg_kw())
+    arena = eng.paged_arena
+    blocks = arena.alloc(2)
+    img = arena.swap_out(blocks, 2)
+    before_k, _ = arena.gather_row(blocks, n_used=2)
+    dst = arena.alloc(2)
+    arena.swap_in(img, dst)
+    after_k, _ = arena.gather_row(dst, n_used=2)
+    np.testing.assert_array_equal(np.asarray(before_k),
+                                  np.asarray(after_k))
+    alien = pack_image(img.kc, img.vc, block_size=BLOCK * 2,
+                       n_data=1, quant=False)
+    with pytest.raises(KVImageError):
+        arena.swap_in(alien, dst)
+    arena.free(blocks)
+    arena.free(dst)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# router: least-recently-routed tie-break + prefill scoring
+# ---------------------------------------------------------------------------
+
+def test_router_tiebreak_least_recently_routed():
+    """Equal scores no longer bias onto replica 0: the tie goes to
+    the replica routed to least recently (deterministic logical
+    ticks — a fresh router still falls back to index order)."""
+    r = Router()
+    views = [{"replica": i, "queue_depth": 0, "occupancy": 0.0,
+              "tpot_ewma": None, "queue_headroom": None}
+             for i in range(3)]
+    assert r.rank(views) == [0, 1, 2]           # fresh: index order
+    r.note_routed(0)
+    assert r.rank(views) == [1, 2, 0]
+    r.note_routed(1)
+    assert r.rank(views) == [2, 0, 1]
+    r.note_routed(2)
+    r.note_routed(1)
+    assert r.rank(views) == [0, 2, 1]
+    # real load still dominates the tie-break
+    views[0]["queue_depth"] = 3
+    assert r.rank(views)[-1] == 0
+
+
+def test_router_prefill_scoring_by_build_depth():
+    r = Router()
+    views = [{"replica": 0, "prefill_depth": 2},
+             {"replica": 1, "prefill_depth": 0}]
+    assert r.rank_prefill(views) == [1, 0]
+
+
+def test_fleet_prefix_index_register_lookup_drop():
+    idx = FleetPrefixIndex(4)
+    toks = np.arange(12, dtype=np.int32)
+    idx.register(toks, 3, replica=0)
+    idx.register(toks, 2, replica=1)
+    assert idx.holders(toks, 3) == [0]
+    assert idx.holders(toks, 2) == [0, 1]
+    assert idx.holders(np.arange(1, 13, dtype=np.int32), 2) == []
+    idx.unregister(toks, 3, replica=0)      # stale-hint pruning
+    assert idx.holders(toks, 3) == []
+    assert idx.holders(toks, 2) == [1]      # replica 1's record kept
+    idx.drop_replica(1)
+    assert idx.holders(toks, 2) == []
+    assert idx.snapshot()["indexed_blocks"] == 0
+
+
+def test_fleet_prefix_index_bounded():
+    """The residency trie never grows past max_blocks: the stalest
+    root subtree is evicted first, the freshest registration always
+    survives its own insert."""
+    idx = FleetPrefixIndex(4, max_blocks=6)
+    prompts = [np.arange(i * 100, i * 100 + 12, dtype=np.int32)
+               for i in range(4)]
+    for p in prompts:
+        idx.register(p, 3, replica=0)
+        assert idx.snapshot()["indexed_blocks"] <= 6
+    assert idx.holders(prompts[-1], 3) == [0]   # freshest survives
+    assert idx.holders(prompts[0], 3) == []     # stalest evicted
+
+
+# ---------------------------------------------------------------------------
+# role validation
+# ---------------------------------------------------------------------------
+
+def test_roles_validation(model):
+    with pytest.raises(ValueError, match="one role per replica"):
+        ServeFleet(model, replicas=2, roles=("prefill",),
+                   max_slots=2, **_disagg_kw())
+    with pytest.raises(ValueError, match="unknown role"):
+        ServeFleet(model, replicas=2, roles=("prefill", "verifier"),
+                   max_slots=2, **_disagg_kw())
+    with pytest.raises(ValueError, match="paged= AND prefix_cache="):
+        ServeFleet(model, replicas=2, roles=("prefill", "decode"),
+                   max_slots=2)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated parity + ships
+# ---------------------------------------------------------------------------
+
+def test_disagg_greedy_parity_ships_and_no_leaks(model):
+    """The service-level pin: every stream of a 1-prefill/1-decode
+    fleet — long documents shipped, short chats routed direct — is
+    byte-identical to single-prompt generate; ships happened; the
+    prefill specialist carried NO decode traffic; zero leaked blocks
+    on both replicas."""
+    docs = [(_long(3), 4), (_long(4), 3)]
+    chats = _chats(2)
+    work = docs + chats
+    base = [np.asarray(model.generate(p, max_new_tokens=n,
+                                      temperature=0.0))
+            for p, n in work]
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        hs = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0)) for p, n in work]
+        fleet.run_until_complete(max_steps=800)
+        for h, want in zip(hs, base):
+            np.testing.assert_array_equal(h.result().tokens, want)
+        snap = fleet.snapshot()
+        assert snap["ships"] >= 2, snap
+        assert snap["ship_bytes"] > 0
+        assert snap["ship_fallbacks"] == 0
+        assert snap["routed"]["0"] == 0         # specialist: no decode
+        assert snap["routed"]["1"] == len(work)
+        # the decode replica served the shipped admissions WARM
+        dec = fleet.supervisor(1).engine.stats.snapshot()["prefix"]
+        assert dec["hits"] >= 2
+        assert all(l == 0 for l in _leaks(fleet)), _leaks(fleet)
+
+
+def test_disagg_seeded_sampling_parity(model):
+    p = _long(7, n=37)
+    want = model.generate(p, max_new_tokens=6, temperature=0.8,
+                          rng=np.random.RandomState(21))
+    seed = int(np.random.RandomState(21).randint(0, 2 ** 31 - 1))
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        h = fleet.submit(GenerationRequest(
+            p, max_new_tokens=6, temperature=0.8, seed=seed))
+        fleet.run_until_complete(max_steps=400)
+        np.testing.assert_array_equal(h.result().tokens, want)
+        assert fleet.snapshot()["ships"] == 1
+
+
+def test_disagg_int8_parity(model):
+    """int8 pools ship their (values, scales) image: the
+    disaggregated stream equals a single int8+cache engine's (the
+    chunked-quantized canonical form both sides share)."""
+    p = _long(9, n=33)
+    eng = model.serve(max_slots=2, cache_dtype="int8", **_disagg_kw())
+    h0 = eng.submit(GenerationRequest(p, max_new_tokens=5,
+                                      temperature=0.0))
+    eng.run_until_complete(max_steps=300)
+    want = h0.result().tokens
+    eng.close()
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, cache_dtype="int8",
+                           **_disagg_kw()) as fleet:
+        h = fleet.submit(GenerationRequest(p, max_new_tokens=5,
+                                           temperature=0.0))
+        fleet.run_until_complete(max_steps=400)
+        np.testing.assert_array_equal(h.result().tokens, want)
+        assert fleet.snapshot()["ships"] == 1
+        assert all(l == 0 for l in _leaks(fleet))
+
+
+def test_warm_via_ship_equals_local_warm(model):
+    """The three admission paths agree byte-for-byte: cold single
+    engine, locally-warm single engine (prefix cache hit), and
+    warm-via-ship on a disaggregated fleet."""
+    p = _long(11, n=41)
+    cold = np.asarray(model.generate(p, max_new_tokens=5,
+                                     temperature=0.0))
+    eng = model.serve(max_slots=2, **_disagg_kw())
+    ha = eng.submit(GenerationRequest(p, max_new_tokens=5,
+                                      temperature=0.0))
+    eng.run_until_complete(max_steps=300)
+    hb = eng.submit(GenerationRequest(p, max_new_tokens=5,
+                                      temperature=0.0))   # local warm
+    eng.run_until_complete(max_steps=300)
+    assert eng.stats.snapshot()["prefix"]["hits"] >= 1
+    local_warm = hb.result().tokens
+    eng.close()
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        hc = fleet.submit(GenerationRequest(p, max_new_tokens=5,
+                                            temperature=0.0))
+        fleet.run_until_complete(max_steps=400)
+        shipped = hc.result().tokens
+    np.testing.assert_array_equal(ha.result().tokens, cold)
+    np.testing.assert_array_equal(local_warm, cold)
+    np.testing.assert_array_equal(shipped, cold)
+
+
+def test_shared_prefix_hits_across_replicas(model):
+    """The fleet-level cache: a prompt prefilled once on the
+    specialist warms LATER requests without any re-prefill — the
+    second admission either routes to the resident decode replica
+    (warm locally) or exports the resident blocks (no recompute).
+    Either way shared_prefix_hits counts it and the specialist built
+    the prefix exactly once."""
+    p = _long(13, n=40)
+    want = np.asarray(model.generate(p, max_new_tokens=4,
+                                     temperature=0.0))
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        h1 = fleet.submit(GenerationRequest(p, max_new_tokens=4,
+                                            temperature=0.0))
+        fleet.run_until_complete(max_steps=400)
+        h2 = fleet.submit(GenerationRequest(p, max_new_tokens=4,
+                                            temperature=0.0))
+        fleet.run_until_complete(max_steps=400)
+        np.testing.assert_array_equal(h1.result().tokens, want)
+        np.testing.assert_array_equal(h2.result().tokens, want)
+        snap = fleet.snapshot()
+        assert snap["shared_prefix_hits"] >= 1, snap
+        # residency did the work the second time: either the ship
+        # count stayed at 1 (warm decode routing) or the second ship
+        # exported without recompute (counted as the shared hit)
+        assert snap["ships"] <= 2
+
+
+def test_ship_queue_backpressure_falls_through_to_classic(model):
+    """The ship queue is not exempt from back-pressure: past the
+    scheduler-depth bound, long admissions route CLASSIC (the decode
+    side's own queue bounds apply) instead of parking unboundedly
+    behind the specialists — still byte-correct, just not shipped."""
+    docs = [(_long(31), 3), (_long(32), 3)]
+    base = [np.asarray(model.generate(p, max_new_tokens=n,
+                                      temperature=0.0))
+            for p, n in docs]
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        fleet._ship_queue_max = lambda: 1
+        hs = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0)) for p, n in docs]
+        assert len(fleet._ship_jobs) == 1      # second refused a park
+        fleet.run_until_complete(max_steps=500)
+        for h, want in zip(hs, base):
+            np.testing.assert_array_equal(h.result().tokens, want)
+        snap = fleet.snapshot()
+        assert snap["ships"] == 1
+        assert snap["routed"]["1"] == 2        # both decoded on dst
+
+
+def test_short_prompt_routes_direct(model):
+    """Nothing shippable (< 2 full blocks): classic routing to the
+    decode side, zero ships."""
+    p = np.arange(6, dtype=np.int32)
+    want = np.asarray(model.generate(p, max_new_tokens=4,
+                                     temperature=0.0))
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        h = fleet.submit(GenerationRequest(p, max_new_tokens=4,
+                                           temperature=0.0))
+        fleet.run_until_complete(max_steps=200)
+        np.testing.assert_array_equal(h.result().tokens, want)
+        snap = fleet.snapshot()
+        assert snap["ships"] == 0
+        assert snap["routed"]["1"] == 1 and snap["routed"]["0"] == 0
+
+
+def test_degenerate_fleet_mixed_fallback(model):
+    """A role-typed fleet with no decode side still serves every
+    request (cold, never refused) — the mixed-role fallback."""
+    work = _chats(3, seed=5) + [(_long(15), 3)]
+    base = [np.asarray(model.generate(p, max_new_tokens=n,
+                                      temperature=0.0))
+            for p, n in work]
+    with ServeFleet(model, replicas=1, roles=("prefill",),
+                    max_slots=2, **_disagg_kw()) as fleet:
+        hs = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0)) for p, n in work]
+        fleet.run_until_complete(max_steps=600)
+        for h, want in zip(hs, base):
+            np.testing.assert_array_equal(h.result().tokens, want)
+        assert fleet.snapshot()["ships"] == 0
+
+
+def test_session_sticky_skips_ship(model):
+    """A pinned session's continuation routes STICKY to the replica
+    whose tree holds its blocks — never through a ship."""
+    p = _long(17, n=40)
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        h = fleet.submit(GenerationRequest(
+            p, max_new_tokens=4, temperature=0.0, pin_session=True))
+        fleet.run_until_complete(max_steps=400)
+        sess = h.result().session
+        assert sess is not None
+        req2 = sess.request(np.arange(4, dtype=np.int32),
+                            max_new_tokens=4, temperature=0.0)
+        h2 = fleet.submit(req2)
+        fleet.run_until_complete(max_steps=400)
+        want = np.asarray(model.generate(
+            req2.prompt_ids, max_new_tokens=4, temperature=0.0))
+        np.testing.assert_array_equal(h2.result().tokens, want)
+        assert fleet.snapshot()["ships"] == 1   # only the first turn
+        sess.release()
+
+
+# ---------------------------------------------------------------------------
+# failure modes: mid-ship fault + specialist death
+# ---------------------------------------------------------------------------
+
+def test_ship_fault_requeues_cold_with_parity(model):
+    """An injected serve.kv_ship fault mid-transfer: the request is
+    requeued COLD (byte-identical — nothing streamed during a ship),
+    the fallback is counted, and neither replica leaks a block."""
+    p = _long(19, n=40)
+    want = np.asarray(model.generate(p, max_new_tokens=4,
+                                     temperature=0.0))
+    with model.serve_fleet(replicas=2, roles=("prefill", "decode"),
+                           max_slots=2, **_disagg_kw()) as fleet:
+        pol = faults.inject("serve.kv_ship", FailOnce())
+        h = fleet.submit(GenerationRequest(p, max_new_tokens=4,
+                                           temperature=0.0))
+        fleet.run_until_complete(max_steps=400)
+        faults.clear()
+        assert pol.fired == 1
+        np.testing.assert_array_equal(h.result().tokens, want)
+        snap = fleet.snapshot()
+        assert snap["ships"] == 0
+        assert snap["ship_fallbacks"] == 1
+        assert snap["replicas_healthy"] == 2    # a ship fault is not
+        #                                         an engine death
+        assert all(l == 0 for l in _leaks(fleet)), _leaks(fleet)
+
+
+def test_prefill_specialist_killed_mid_ship(model):
+    """chaos: a chunk fault with a zero restart budget KILLS the
+    prefill specialist mid-build.  The fleet fails it over, serves
+    the mid-ship request (and everything else) cold on the decode
+    replica with parity — zero wedged, zero lost, zero leaked on
+    both the dead arena and the survivor."""
+    work = [(_long(23), 3)] + _chats(3, seed=7)
+    base = [np.asarray(model.generate(p, max_new_tokens=n,
+                                      temperature=0.0))
+            for p, n in work]
+    with ServeFleet(model, replicas=2, roles=("prefill", "decode"),
+                    max_slots=2, restart_budget=0,
+                    **_disagg_kw()) as fleet:
+        arena0 = fleet.supervisor(0).engine.paged_arena
+        pol = faults.inject("serve.prefill_chunk", FailOnce())
+        hs = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0)) for p, n in work]
+        fleet.run_until_complete(max_steps=600)
+        faults.clear()
+        assert pol.fired == 1
+        for h, want in zip(hs, base):
+            assert h.done()
+            np.testing.assert_array_equal(h.result().tokens, want)
+        snap = fleet.snapshot()
+        assert snap["replicas_healthy"] == 1
+        assert snap["failovers"] == 1
+        assert snap["ships"] == 0
+        assert snap["ship_fallbacks"] == 1
+        # the dead specialist's pool leaked nothing behind the
+        # partial build, and the survivor is clean
+        assert arena0.blocks_used == 0, arena0.blocks_used
+        eng1 = fleet.supervisor(1).engine
+        assert eng1.paged_arena.blocks_used \
+            == eng1.prefix_cache.cached_blocks
+
+
+# ---------------------------------------------------------------------------
+# ledger: via=kv_ship hop + exact ship-phase attribution
+# ---------------------------------------------------------------------------
+
+def test_ledger_kv_ship_hop_and_ship_phase(model):
+    p = _long(29, n=40)
+    reqtrace.enable(capacity=64)
+    try:
+        with model.serve_fleet(replicas=2,
+                               roles=("prefill", "decode"),
+                               max_slots=2, **_disagg_kw()) as fleet:
+            h = fleet.submit(GenerationRequest(
+                p, max_new_tokens=4, temperature=0.0,
+                request_id="shipped"))
+            fleet.run_until_complete(max_steps=400)
+            h.result()
+        led = reqtrace.ledger()
+        e = led.entry("shipped")
+        vias = [hop["via"] for hop in e["hops"]]
+        assert vias == ["prefill", "kv_ship"], vias
+        final = e["hops"][e["final_hop"]]
+        assert final["via"] == "kv_ship"
+        assert final["src_replica"] == 0 and final["replica"] == 1
+        assert final["ship_bytes"] > 0 and final["ship_blocks"] >= 1
+        assert final["admit_kind"] == "warm"    # the ship's point
+        ph = e["phases"]
+        assert ph["ship"] > 0
+        # exact arithmetic: hops + ship + queue + prefill == TTFT,
+        # and all seven phases sum to total latency
+        assert ph["hops"] + ph["ship"] + ph["queue"] + ph["prefill"] \
+            == pytest.approx(e["ttft_s"], abs=1e-9)
+        assert sum(ph.values()) == pytest.approx(
+            e["t_retire"] - e["t_submit"], abs=1e-9)
+    finally:
+        reqtrace.disable()
