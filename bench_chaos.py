@@ -575,6 +575,187 @@ def chaos_tp(report):
         f"restarts ({restarts}) != injected TP faults ({injected})"
 
 
+def chaos_ep(report):
+    """A fault at the ``serve.ep_dispatch`` site (every sharded-twin
+    dispatch of an expert-parallel MoE engine checks it) fires
+    mid-decode: the sharded engine fails TYPED — never wedges — and
+    the supervisor rebuilds it on the SAME (ep, tp) device group
+    (twin-cache hit, fresh sharded pool).  Requeued never-started
+    streams keep byte parity with the uninterrupted single-device MoE
+    run; started requests fail typed; the rebuilt engine's paged pool
+    drains to ZERO used blocks.  Zero wedged/lost/leaked, restarts ==
+    injected."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest, PagedConfig)
+
+    cfg = GPT2Config.tiny(dropout=0.0, moe_every=2, moe_experts=4)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(13)
+    workload = [(rng.randint(0, 256, rng.randint(4, 12))
+                 .astype(np.int32), int(rng.randint(4, 10)))
+                for _ in range(10)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = leaked = 0
+    expert_tokens_after = 0
+    for fail_after in (4, 9):
+        sup = EngineSupervisor(
+            m, max_slots=2, restart_budget=2, ep=2,
+            paged=PagedConfig(block_size=8, num_blocks=32))
+        exec0 = sup.engine.ep_exec
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.ep_dispatch",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=4000)
+        faults.clear()
+        injected += pol.fired
+        if pol.fired:
+            assert sup.engine.ep_exec is not exec0, \
+                "rebuilt engine carried the failed EP executor"
+            assert sup.engine.ep_exec.ep == 2
+        if pol.fired:
+            # the rebuilt engine kept routing: expert load flowed
+            # after the restart (an imbalanced-router signal that
+            # survives chaos is a working signal) — counted only for
+            # iterations whose fault actually fired, so a
+            # never-restarted run cannot mask a dead-router rebuild
+            expert_tokens_after += sum(
+                sup.engine.ep_exec.expert_tokens)
+        leaked += sup.engine.paged_arena.blocks_used
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "EP token stream diverged after restart"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve_ep"] = {
+        "requests": 2 * len(workload),
+        "expert_shards": 2,
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "blocks_leaked": int(leaked),
+        "dispatch_faults_injected": injected,
+        "engine_restarts": restarts,
+        "expert_tokens_after_restart": int(expert_tokens_after),
+    }
+    assert wedged == 0, f"{wedged} EP requests wedged/lost"
+    assert leaked == 0, f"{leaked} EP pool blocks leaked"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0 and typed_failed > 0
+    assert expert_tokens_after > 0
+    assert restarts == injected > 0, \
+        f"restarts ({restarts}) != injected EP faults ({injected})"
+
+
+def chaos_pp(report):
+    """A fault at the ``serve.pp_boundary`` site (every sharded
+    dispatch of a pipeline-parallel engine checks it — a raising
+    stage-boundary hop) fires mid-decode: the pipelined engine fails
+    TYPED — never wedges — and the supervisor rebuilds it on the SAME
+    stage group (twin-cache hit, fresh stage-sliced pool).  Requeued
+    never-started streams keep byte parity with the uninterrupted
+    single-device paged run; started requests fail typed; the rebuilt
+    pool drains to ZERO used blocks.  Zero wedged/lost/leaked,
+    restarts == injected."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, faults
+    from singa_tpu.serve import (EngineFailedError, EngineSupervisor,
+                                 GenerationRequest, PagedConfig)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(17)
+    workload = [(rng.randint(0, 256, rng.randint(4, 12))
+                 .astype(np.int32), int(rng.randint(4, 10)))
+                for _ in range(10)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    injected = 0
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    completed = wedged = typed_failed = leaked = 0
+    for fail_after in (4, 9):
+        sup = EngineSupervisor(
+            m, max_slots=2, restart_budget=2, pp=2,
+            paged=PagedConfig(block_size=8, num_blocks=32))
+        exec0 = sup.engine.pp_exec
+        handles = [sup.submit(GenerationRequest(
+            p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+        pol = faults.inject("serve.pp_boundary",
+                            FailAfterN(fail_after, times=1))
+        sup.run_until_complete(max_steps=4000)
+        faults.clear()
+        injected += pol.fired
+        if pol.fired:
+            assert sup.engine.pp_exec is not exec0, \
+                "rebuilt engine carried the failed PP executor"
+            assert sup.engine.pp_exec.stages == 2
+        leaked += sup.engine.paged_arena.blocks_used
+        for (p, n), h, want in zip(workload, handles, base):
+            if not h.done():
+                wedged += 1
+                continue
+            try:
+                got = h.result().tokens
+                assert np.array_equal(got, want), \
+                    "PP token stream diverged after restart"
+                completed += 1
+            except EngineFailedError:
+                typed_failed += 1
+        sup.close()
+
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+    report["serve_pp"] = {
+        "requests": 2 * len(workload),
+        "stages": 2,
+        "completed_with_parity": completed,
+        "typed_failures": typed_failed,
+        "wedged_or_lost": wedged,
+        "blocks_leaked": int(leaked),
+        "boundary_faults_injected": injected,
+        "engine_restarts": restarts,
+    }
+    assert wedged == 0, f"{wedged} PP requests wedged/lost"
+    assert leaked == 0, f"{leaked} PP pool blocks leaked"
+    assert completed + typed_failed == 2 * len(workload)
+    assert completed > 0 and typed_failed > 0
+    assert restarts == injected > 0, \
+        f"restarts ({restarts}) != injected PP faults ({injected})"
+
+
 def chaos_longctx(report):
     """A fault BETWEEN budgeted prefill chunks (the
     ``serve.prefill_chunk`` site, armed while a 72-token admission is
@@ -900,6 +1081,8 @@ def main():
     chaos_paged(report)
     chaos_longctx(report)
     chaos_tp(report)
+    chaos_ep(report)
+    chaos_pp(report)
     chaos_fleet(report)
     chaos_disagg(report)
 

@@ -213,7 +213,15 @@ def _serve_jit_cache_size():
     twins = T._twin_cache_size()
     if twins is None:
         return None
-    return total + G._compile_cache_size() + twins
+    from singa_tpu.serve import ep as EPM
+    from singa_tpu.serve import pp as PPM
+
+    ep_twins = EPM._twin_cache_size()
+    pp_twins = PPM._twin_cache_size()
+    if ep_twins is None or pp_twins is None:
+        return None
+    return (total + G._compile_cache_size() + twins + ep_twins
+            + pp_twins)
 
 
 def run_prefix_mix(max_slots):
@@ -860,6 +868,182 @@ def run_tp(m, workload, engine_outs, tp, engine_section,
     }
 
 
+#: the dense-layer tp width the --ep bench composes with (shared with
+#: main()'s virtual-mesh provisioning so the two cannot drift)
+_EP_BENCH_TP = 2
+
+
+def run_ep(ep, tp=_EP_BENCH_TP, max_slots=8):
+    """The --ep measurement: a ragged workload through an
+    EXPERT-PARALLEL paged MoE engine (serve/ep.py: experts sharded
+    over the ep axis, dense layers Megatron over an orthogonal tp
+    axis, capacity-bounded GShard dispatch inside the pool steps)
+    against a single-device MoE engine oracle (itself verified
+    against offline generate here), with per-expert routed-token
+    occupancy, the dropped-token counter (0 at the drop-free default
+    capacity), and the jit+twin cache pinned across the timed run.
+    ``vs_single_device_tokens_per_s`` carries the same honest CPU
+    caveat as --tp: the gated claims are parity / recompiles / load
+    accounting — the knob exists for expert banks bigger than one
+    REAL device (chip-pending, ROADMAP item 5)."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.serve import EPConfig, GenerationRequest, PagedConfig
+
+    cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=192,
+                     n_layer=4, n_head=4, n_inner=384, dropout=0.0,
+                     attn_impl="fused", moe_every=2, moe_experts=4)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    workload = make_workload(n_positions=cfg.n_positions)
+    pcfg = PagedConfig(block_size=16, num_blocks=48)
+
+    def drive(kw):
+        eng = m.serve(max_slots=max_slots, paged=pcfg, **kw)
+        handles = []
+        pending = list(workload)
+        peak_blocks = 0
+        t0 = time.perf_counter()
+        while pending or eng.pending:
+            while pending and pending[0]["arrival_step"] <= eng.step_count:
+                w = pending.pop(0)
+                handles.append(eng.submit(GenerationRequest(
+                    w["prompt"], max_new_tokens=w["n_new"])))
+            eng.step()
+            peak_blocks = max(peak_blocks,
+                              eng.paged_arena.blocks_used)
+        wall = time.perf_counter() - t0
+        outs = [h.result() for h in handles]
+        snap = eng.stats.snapshot()
+        eng.close()
+        return wall, outs, snap, peak_blocks
+
+    ep_kw = dict(ep=EPConfig(ep=ep, tp=tp))
+    drive({})           # warmup: single-device MoE executables
+    drive(ep_kw)        # warmup: the (ep, tp) sharded twins
+    base_wall, base_outs, _, _ = drive({})
+    jit_before = _serve_jit_cache_size()
+    wall, outs, snap, peak_blocks = drive(ep_kw)
+    jit_after = _serve_jit_cache_size()
+
+    # the single-device MoE engine is oracle-verified against offline
+    # generate; EP parity against it is transitively offline parity
+    oracle = all(
+        np.array_equal(r.tokens,
+                       m.generate(w["prompt"],
+                                  max_new_tokens=w["n_new"],
+                                  temperature=0))
+        for w, r in zip(workload, base_outs))
+    parity = oracle and all(
+        np.array_equal(a.tokens, b.tokens)
+        for a, b in zip(outs, base_outs))
+    useful = sum(w["n_new"] for w in workload)
+    ep_snap = snap["ep"]
+    total_toks = sum(ep_snap["expert_tokens"]) or 1
+    return {
+        "expert_shards": ep_snap["shards"],
+        "dense_tp": ep_snap["dense_tp"],
+        "experts": ep_snap["experts"],
+        "capacity_factor": ep_snap["capacity_factor"],
+        "devices": ep_snap["devices"],
+        "paged_pool": {"block_size": pcfg.block_size,
+                       "num_blocks": pcfg.num_blocks},
+        "wall_s": wall,
+        "tokens_per_s": useful / wall,
+        **_lat(snap),
+        "vs_single_device_tokens_per_s": (
+            (useful / wall) / (useful / base_wall)),
+        "sharded_dispatches": ep_snap["sharded_dispatches"],
+        "per_expert": {
+            "tokens": ep_snap["expert_tokens"],
+            "occupancy": [t / total_toks
+                          for t in ep_snap["expert_tokens"]],
+            "load_imbalance": ep_snap["load_imbalance"],
+        },
+        "dropped_tokens": ep_snap["dropped_tokens"],
+        "kv_bytes_per_shard": ep_snap["kv_bytes_per_shard"],
+        "blocks_peak": peak_blocks,
+        "blocks_leaked": snap["paged"]["blocks_used"],
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": bool(parity),
+        "chip_pending": True,  # CPU numbers; see docs/SERVING.md
+    }
+
+
+def run_pp(m, workload, engine_outs, stages, engine_section,
+           max_slots=8):
+    """The --pp measurement: the standard ragged workload through a
+    PIPELINE-PARALLEL paged engine (serve/pp.py: layers partitioned
+    into stages, each owning its layer slice of the block pool,
+    GPipe-microbatched decode) with per-stream parity against the
+    (oracle-verified) single-device engine run, per-stage pool
+    occupancy, stage-boundary hop counts, and the jit+twin cache
+    pinned across the timed run.  Same honest CPU caveat as --tp:
+    gated claims are parity / recompiles / occupancy — the knob
+    exists for models DEEPER than one real device (chip-pending)."""
+    from singa_tpu.serve import GenerationRequest, PagedConfig, PPConfig
+
+    pcfg = PagedConfig(block_size=16, num_blocks=48)
+    kw = dict(pp=PPConfig(stages=stages), paged=pcfg)
+
+    def drive():
+        eng = m.serve(max_slots=max_slots, **kw)
+        handles = []
+        pending = list(workload)
+        peak_blocks = 0
+        t0 = time.perf_counter()
+        while pending or eng.pending:
+            while pending and pending[0]["arrival_step"] <= eng.step_count:
+                w = pending.pop(0)
+                handles.append(eng.submit(GenerationRequest(
+                    w["prompt"], max_new_tokens=w["n_new"])))
+            eng.step()
+            peak_blocks = max(peak_blocks,
+                              eng.paged_arena.blocks_used)
+        wall = time.perf_counter() - t0
+        outs = [h.result() for h in handles]
+        snap = eng.stats.snapshot()
+        eng.close()
+        return wall, outs, snap, peak_blocks
+
+    drive()  # warmup (compiles the stage twins)
+    jit_before = _serve_jit_cache_size()
+    wall, outs, snap, peak_blocks = drive()
+    jit_after = _serve_jit_cache_size()
+
+    parity = all(np.array_equal(a.tokens, b.tokens)
+                 for a, b in zip(outs, engine_outs))
+    useful = sum(w["n_new"] for w in workload)
+    pp_snap = snap["pp"]
+    return {
+        "stages": pp_snap["stages"],
+        "layers_per_stage": pp_snap["layers_per_stage"],
+        "microbatches": pp_snap["microbatches"],
+        "devices": pp_snap["devices"],
+        "paged_pool": {"block_size": pcfg.block_size,
+                       "num_blocks": pcfg.num_blocks},
+        "wall_s": wall,
+        "tokens_per_s": useful / wall,
+        **_lat(snap),
+        "vs_single_device_tokens_per_s": (
+            (useful / wall) / engine_section["tokens_per_s"]),
+        "sharded_dispatches": pp_snap["sharded_dispatches"],
+        "boundary_hops": pp_snap["boundary_hops"],
+        "per_stage": {
+            "kv_bytes": pp_snap["kv_bytes_per_stage"],
+            "blocks_peak": peak_blocks,
+            "occupancy_peak": peak_blocks / pcfg.num_blocks,
+        },
+        "blocks_leaked": snap["paged"]["blocks_used"],
+        "recompiles": (None if jit_before is None
+                       else jit_after - jit_before),
+        "parity": bool(parity),
+        "chip_pending": True,  # CPU numbers; see docs/SERVING.md
+    }
+
+
 def _longctx_mix(rng, vocab, n_chat=10, long_len=384, n_long=2):
     """Document-analysis serve mix: short chat traffic arriving every
     step, two LONG admissions (a ``long_len``-token document each)
@@ -1359,17 +1543,35 @@ def main():
                          "(serve/tp.py) with per-stream parity "
                          "against the single-device run, per-shard "
                          "occupancy, recompile pin (the tp section)")
+    ap.add_argument("--ep", type=int, default=None, metavar="K",
+                    help="also run a ragged MoE workload through a "
+                         "K-expert-shard EXPERT-PARALLEL paged engine "
+                         "(serve/ep.py, dense layers tp=2) with "
+                         "parity against the single-device MoE "
+                         "oracle, per-expert routed-token occupancy, "
+                         "dropped-token count, recompile pin (the ep "
+                         "section)")
+    ap.add_argument("--pp", type=int, default=None, metavar="K",
+                    help="also run the standard workload through a "
+                         "K-stage PIPELINE-PARALLEL paged engine "
+                         "(serve/pp.py, GPipe-microbatched decode) "
+                         "with per-stream parity against the "
+                         "single-device run, per-stage occupancy, "
+                         "boundary-hop counts, recompile pin (the pp "
+                         "section)")
     args = ap.parse_args()
 
     # --tp needs a >=K-device mesh BEFORE jax initializes its backend;
     # the flag only affects the CPU platform (a real slice already has
     # its chips), mirroring tests/conftest.py's virtual topology
-    if args.tp:
+    if args.tp or args.ep or args.pp:
+        need = max(8, args.tp or 0, _EP_BENCH_TP * (args.ep or 0),
+                   args.pp or 0)
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count="
-                f"{max(8, args.tp)}").strip()
+                f"{need}").strip()
 
     import jax
 
@@ -1524,6 +1726,17 @@ def main():
             engine_snapshots=[snap], include_registry=False)
     if args.tp:
         report["tp"] = run_tp(m, workload, outs_e, args.tp,
+                              report["engine"], max_slots=max_slots)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.ep:
+        report["ep"] = run_ep(args.ep, max_slots=max_slots)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
+    if args.pp:
+        report["pp"] = run_pp(m, workload, outs_e, args.pp,
                               report["engine"], max_slots=max_slots)
         report["registry"] = observe.registry().snapshot()
         report["health"] = observe.health_report(

@@ -395,9 +395,20 @@ class GPT2LMHead(model.Model):
         sliding-window models (``GPT2Config(attn_window=)``) serve
         in paged mode holding O(window) blocks per slot; and
         ``TPConfig(ring_prefill=True)`` prefills cold long prompts
-        sequence-sharded over the tp mesh.  See docs/SERVING.md
-        "Fast decode", "Paged KV and preemption", "Tensor-parallel
-        serving", and "Long-context serving"."""
+        sequence-sharded over the tp mesh.  ``ep=EPConfig(ep=, tp=)``
+        — expert-parallel MoE serving (serve/ep.py): experts shard
+        over an ``ep`` mesh axis with capacity-bounded GShard
+        dispatch inside the jitted pool steps, dense layers keep the
+        Megatron layout on an orthogonal ``tp`` axis, and streams
+        stay token-identical to the single-device MoE engine.
+        ``pp=PPConfig(stages=, microbatches=)`` — pipeline-parallel
+        serving (serve/pp.py): the layer stack partitions into
+        stages, each owning its layer slice of the paged KV pool,
+        with microbatched decode so pipeline bubbles amortize across
+        the continuous batch (requires ``paged=``).  See
+        docs/SERVING.md "Fast decode", "Paged KV and preemption",
+        "Tensor-parallel serving", "Long-context serving", and
+        "Expert-parallel and pipeline serving"."""
         from ..serve import InferenceEngine
 
         return InferenceEngine(self, **kw)
@@ -415,8 +426,11 @@ class GPT2LMHead(model.Model):
         engine).  ``tp=k`` builds a fleet of TENSOR-PARALLEL replicas:
         the device mesh partitions into ``replicas`` disjoint k-wide
         groups (tp inside each replica, data parallelism across them;
-        ``tp x replicas`` must fit the mesh).  See docs/SERVING.md
-        "Fleet serving" and "Tensor-parallel serving"."""
+        ``tp x replicas`` must fit the mesh).  ``ep=``/``pp=`` do the
+        same for expert-parallel MoE and pipeline-parallel replicas —
+        (ep x tp)-wide or stage-wide disjoint groups respectively.
+        See docs/SERVING.md "Fleet serving", "Tensor-parallel
+        serving", and "Expert-parallel and pipeline serving"."""
         from ..serve import ServeFleet
 
         return ServeFleet(self, replicas=replicas, **kw)

@@ -291,7 +291,7 @@ def _attn_full(q, k, v, n_head, start=None, window=None, tp_world=1):
 
 
 def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2,
-                   window=None, tp_axis=None, tp_world=1):
+                   window=None, tp_axis=None, tp_world=1, ep=None):
     h = _ln(x, p["ln1_s"], p["ln1_b"], eps)
     q = h @ p["wq"] + p["bq"]
     k = h @ p["wk"] + p["bk"]
@@ -300,12 +300,14 @@ def _block_prefill(x, p, n_head, eps, start=None, moe_top_k=2,
                    tp_world=tp_world)
     x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world,
+                 ep=ep)
     return x, k, v
 
 
 def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
-                  moe_top_k=2, window=None, tp_axis=None, tp_world=1):
+                  moe_top_k=2, window=None, tp_axis=None, tp_world=1,
+                  ep=None):
     """x: (B, 1, E); k/v_cache: (B, H_kv, ctx, D) with this step's K/V
     already written at ``pos``.  Attends to positions <= pos (and
     >= ``start`` per row for left-padded batches).
@@ -393,7 +395,8 @@ def _block_decode(x, p, k_cache, v_cache, pos, n_head, eps, start=None,
     a = a.reshape(b, 1, e // tp_world)
     x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world,
+                 ep=ep)
     return x, k_cache, v_cache
 
 
@@ -436,17 +439,130 @@ def _moe_ffn(h, p, top_k):
     return y
 
 
-def _mlp(h, p, moe_top_k, tp_axis=None, tp_world=1):
+# -- expert-parallel MoE FFN (serve/ep.py) -----------------------------------
+# The serve EP backend runs every dispatch under a shard_map over a
+# 2-D (ep, tp) mesh with the stacked expert weights sharded on their
+# leading axis.  The FFN below is the GShard formulation restated for
+# replicated decode activations: routing + capacity run identically on
+# every rank (probs are replicated), each rank computes only its
+# RESIDENT experts' contributions through the capacity-shaped
+# dispatch/combine one-hots (parallel/moe.py's — the training layer's
+# routing math, reused verbatim), and ONE psum over the ep axis sums
+# each token's top-k expert outputs — the degenerate all-to-all for
+# replicated tokens (the dispatch half is free because every rank
+# already holds every token; only the combine reduces).
+#
+# Exactness: with ``cap_factor=None`` the capacity is the token count —
+# nothing ever drops, and per-token outputs equal `_moe_ffn`'s exactly
+# up to float summation order (the ep psum — the same near-tie caveat
+# as the TP psum).  A FINITE cap_factor is the GShard capacity mode:
+# per-dispatch token groups bound each expert's buffer, over-capacity
+# assignments are DROPPED — their combine weight is zero, so the
+# block's residual path carries the token (never a zeroed hidden
+# state) — and the drop pattern couples tokens within a dispatch
+# (which is why the engine refuses a finite cap_factor next to the
+# prefix cache: chunked and full prefill route different groups, so
+# chunk KV would stop being canonical).  Pad lanes of a prefill
+# dispatch route like real tokens and consume capacity — deterministic
+# but part of the group, documented in docs/SERVING.md.
+#
+# Observability rides a TRACE-TIME collector: while an ep.py twin body
+# is being traced, every `_moe_ffn_ep` application appends its
+# (tokens-per-expert, dropped) arrays, and the twin wrapper folds them
+# into two extra replicated outputs (`serve.ep.expert_tokens{expert=}`
+# / the dropped-token counter).  One thread-local stack — the wrapper
+# consumes the tracers inside the same trace that made them.
+
+_EP_COLLECT = __import__("threading").local()
+
+
+class _ep_collecting:
+    """Context manager arming the EP-stats collector for one row/body
+    trace; yields the list `_moe_ffn_ep` appends (counts, dropped)
+    tracer pairs to."""
+
+    def __enter__(self):
+        stack = getattr(_EP_COLLECT, "stack", None)
+        if stack is None:
+            stack = _EP_COLLECT.stack = []
+        self._rec = []
+        stack.append(self._rec)
+        return self._rec
+
+    def __exit__(self, *exc):
+        _EP_COLLECT.stack.pop()
+        return False
+
+
+def _ep_record(counts, dropped):
+    stack = getattr(_EP_COLLECT, "stack", None)
+    if stack:
+        stack[-1].append((counts, dropped))
+
+
+def _moe_ffn_ep(h, p, top_k, ep):
+    """Expert-parallel MoE FFN: ``ep = (axis, world, cap_factor)`` —
+    the mesh axis the stacked expert weights shard over, its size, and
+    the GShard capacity factor (None = capacity == tokens, drop-free).
+    ``p['moe_w1']``&co arrive as this rank's (E/world, ...) slices
+    under shard_map; ``moe_wg`` is replicated."""
+    from ..parallel import moe as _moe
+
+    axis, world, cap_factor = ep
+    b, s, dm = h.shape
+    n = b * s
+    e = p["moe_wg"].shape[-1]
+    probs = jax.nn.softmax(
+        (h @ p["moe_wg"].astype(h.dtype)).astype(jnp.float32),
+        axis=-1).reshape(n, e)
+    cap = (n if cap_factor is None
+           else max(1, int(math.ceil(top_k * n / e * cap_factor))))
+    if top_k == 2:
+        dispatch, combine, _ = _moe._top2_dispatch(probs, cap)
+    elif top_k == 1:
+        dispatch, combine, _ = _moe._top1_dispatch(probs, cap)
+    else:
+        raise ValueError("moe_top_k must be 1 (Switch) or 2 (GShard), "
+                         f"got {top_k}")
+    _ep_record(*_moe.dispatch_load(dispatch, top_k))
+    rank = jax.lax.axis_index(axis)
+    e_loc = e // world
+    d_l = jax.lax.dynamic_slice_in_dim(
+        dispatch, rank * e_loc, e_loc, axis=1).astype(h.dtype)
+    c_l = jax.lax.dynamic_slice_in_dim(
+        combine, rank * e_loc, e_loc, axis=1).astype(h.dtype)
+    ht = h.reshape(n, dm)
+    # dispatch: tokens -> this rank's (E_loc, C, D) expert buffers
+    xin = jnp.einsum("nec,nd->ecd", d_l, ht)
+    hh = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, p["moe_w1"])
+                     + p["moe_b1"][:, None, :])
+    out = jnp.einsum("ecf,efd->ecd", hh, p["moe_w2"]) \
+        + p["moe_b2"][:, None, :]
+    # combine locally (non-resident experts weight zero on this rank),
+    # then ONE psum over ep sums each token's top-k contributions —
+    # recorded through the communicator hook like the TP psums
+    y = jnp.einsum("nec,ecd->nd", c_l, out)
+    return _tp_psum(y, axis, world).reshape(b, s, dm)
+
+
+def _mlp(h, p, moe_top_k, tp_axis=None, tp_world=1, ep=None):
     """The block's feed-forward: dense two-layer gelu MLP, or the
     expert-routed MoE when the block carries ``moe_*`` weights.  Under
     ``tp_axis`` the dense path is column-fc1 / row-fc2 with ONE psum
-    (Megatron); MoE blocks are expert-parallel, not tensor-parallel —
-    the serve TP backend rejects them at construction."""
+    (Megatron); MoE blocks shard over the EXPERT axis instead —
+    ``ep = (axis, world, cap_factor)`` threads the serve EP backend's
+    mesh through (singa_tpu/serve/ep.py), and an MoE block under
+    ``tp_axis`` WITHOUT an ep axis is rejected with a pointer at the
+    ``serve(ep=)`` path."""
     if "moe_wg" in p:
+        if ep is not None:
+            return _moe_ffn_ep(h, p, moe_top_k, ep)
         if tp_axis is not None:
             raise NotImplementedError(
-                "MoE blocks are not tensor-parallel in the serve TP "
-                "backend (expert weights shard over the expert axis)")
+                "MoE blocks are not tensor-parallel: expert weights "
+                "shard over the expert axis — serve this model with "
+                "model.serve(ep=EPConfig(ep=, tp=)) "
+                "(singa_tpu/serve/ep.py)")
         return _moe_ffn(h, p, moe_top_k)
     return _tp_psum(jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"],
                     tp_axis, tp_world) + p["b2"]
@@ -461,7 +577,7 @@ def _logits(x, params):
 
 def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
             quant_cache=False, window=None, prompt_end=None,
-            rolling=True, tp_axis=None, tp_world=1):
+            rolling=True, tp_axis=None, tp_world=1, ep=None):
     """ids: (B, Sp) int32 (padded prompt).  Returns (hidden, k_caches,
     v_caches): hidden is the final-LN (B, Sp, E) — the caller picks the
     rows it needs BEFORE the vocab matmul (materializing (Sp, V) logits
@@ -504,7 +620,8 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
     for p in params["blocks"]:
         x, k, v = _block_prefill(x, p, n_head, eps, start=start,
                                  moe_top_k=moe_top_k, window=window,
-                                 tp_axis=tp_axis, tp_world=tp_world)
+                                 tp_axis=tp_axis, tp_world=tp_world,
+                                 ep=ep)
         e = x.shape[-1]
         d = e // n_head
         n_kv = k.shape[-1] // d  # GQA caches hold n_kv_head heads
@@ -522,7 +639,8 @@ def prefill(params, ids, n_head, eps, start=None, moe_top_k=2,
 
 
 def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
-                 moe_top_k=2, window=None, tp_axis=None, tp_world=1):
+                 moe_top_k=2, window=None, tp_axis=None, tp_world=1,
+                 ep=None):
     """Advance one decode step through every block: x (B, 1, E) at
     position ``pos`` against caches (L, B, H, ctx, D).  Returns
     ((B, V) logits, new kc, new vc).  Shared by sampling
@@ -534,7 +652,7 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
                                   _cache_layer(vc, li), pos, n_head,
                                   eps, start=start, moe_top_k=moe_top_k,
                                   window=window, tp_axis=tp_axis,
-                                  tp_world=tp_world)
+                                  tp_world=tp_world, ep=ep)
         new_kc.append(kl)
         new_vc.append(vl)
     kc = _cache_stack(new_kc)
@@ -544,7 +662,8 @@ def _advance_one(params, x, kc, vc, pos, n_head, eps, start=None,
 
 
 def decode_step(params, x, kc, vc, pos, n_head, eps, *, start=None,
-                moe_top_k=2, window=None, tp_axis=None, tp_world=1):
+                moe_top_k=2, window=None, tp_axis=None, tp_world=1,
+                ep=None):
     """PUBLIC single-step decode core with an EXTERNALIZED cache carry
     (the serve engine's contract; round 6).  The generation loops in
     this module own their KV cache inside a ``lax.scan`` carry; an
@@ -566,11 +685,12 @@ def decode_step(params, x, kc, vc, pos, n_head, eps, *, start=None,
     math bit-identical."""
     return _advance_one(params, x, kc, vc, pos, n_head, eps,
                         start=start, moe_top_k=moe_top_k, window=window,
-                        tp_axis=tp_axis, tp_world=tp_world)
+                        tp_axis=tp_axis, tp_world=tp_world, ep=ep)
 
 
 def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
-                 moe_top_k=2, window=None, tp_axis=None, tp_world=1):
+                 moe_top_k=2, window=None, tp_axis=None, tp_world=1,
+                 ep=None):
     """Chunked cache advance: x (B, K, E) are K consecutive tokens at
     positions pos..pos+K-1.  Writes all K K/V rows in one contiguous
     dynamic_update_slice and attends the K queries against the cache
@@ -631,12 +751,13 @@ def _block_chunk(x, p, k_cache, v_cache, pos, n_head, eps,
     a = a.transpose(0, 3, 1, 2, 4).reshape(b, klen, e // tp_world)
     x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world,
+                 ep=ep)
     return x, k_cache, v_cache
 
 
 def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2,
-                  window=None, tp_axis=None, tp_world=1):
+                  window=None, tp_axis=None, tp_world=1, ep=None):
     """PUBLIC offset-prefill entry (the prefix cache's contract;
     serve.prefix round).  Advance every layer by a K-token chunk —
     ``x``: (B, K, E) embedded inputs at positions ``pos..pos+K-1``
@@ -669,7 +790,8 @@ def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2,
                                  _cache_layer(vc, li), pos, n_head,
                                  eps, moe_top_k=moe_top_k,
                                  window=window,
-                                 tp_axis=tp_axis, tp_world=tp_world)
+                                 tp_axis=tp_axis, tp_world=tp_world,
+                                 ep=ep)
         new_kc.append(kl)
         new_vc.append(vl)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
@@ -677,14 +799,14 @@ def prefill_chunk(params, x, kc, vc, pos, n_head, eps, *, moe_top_k=2,
 
 
 def _advance_chunk(params, x, kc, vc, pos, n_head, eps, moe_top_k=2,
-                   tp_axis=None, tp_world=1):
+                   tp_axis=None, tp_world=1, ep=None):
     """Advance every block by a K-token chunk (x: (B, K, E) embedded
     inputs at positions pos..pos+K-1).  Returns ((B, K, V) logits,
     new kc, new vc).  The speculative verify step — routed through
     :func:`prefill_chunk` so the chunked cache math exists once."""
     x, kc, vc = prefill_chunk(params, x, kc, vc, pos, n_head, eps,
                               moe_top_k=moe_top_k, tp_axis=tp_axis,
-                              tp_world=tp_world)
+                              tp_world=tp_world, ep=ep)
     return _logits(x, params), kc, vc
 
 
@@ -820,7 +942,7 @@ def _paged_qkv(x, p, n_head, eps):
 def _block_decode_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
                         n_head, eps, block, trash, moe_top_k=2,
                         window=None, blk_lo=None, tp_axis=None,
-                        tp_world=1):
+                        tp_world=1, ep=None):
     """One layer's block-native decode step: x (1, 1, E) at position
     ``pos``, one layer's pool leaves ((N+1, H_kv, B, D) dense or
     (values, scales)), ``tbl`` the slot's trash-padded block table.
@@ -846,7 +968,8 @@ def _block_decode_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
         1, 1, e // tp_world)
     x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world,
+                 ep=ep)
     off = pos % block
     cur = tbl[pos // block]
 
@@ -866,7 +989,7 @@ def _block_decode_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
 def _block_chunk_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
                        n_head, eps, block, trash, moe_top_k=2,
                        window=None, blk_lo=None, tp_axis=None,
-                       tp_world=1):
+                       tp_world=1, ep=None):
     """The chunk-query variant (speculative verify): x (1, K, E) at
     positions ``pos..pos+K-1``.  Pool lanes < ``pos`` are visible to
     every query; the chunk's own keys are causal within the chunk —
@@ -896,7 +1019,8 @@ def _block_chunk_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
         1, klen, e // tp_world)
     x = x + (_tp_psum(a @ p["wo"], tp_axis, tp_world) + p["bo"])
     h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+    x = x + _mlp(h, p, moe_top_k, tp_axis=tp_axis, tp_world=tp_world,
+                 ep=ep)
     b0 = pos // block
     b1 = (pos + klen - 1) // block
     off = pos % block
@@ -920,7 +1044,7 @@ def _block_chunk_paged(x, p, pool_k_l, pool_v_l, tbl, pos, n_blk,
 def decode_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
                       n_head, eps, *, block, trash, moe_top_k=2,
                       window=None, blk_lo=None, tp_axis=None,
-                      tp_world=1):
+                      tp_world=1, ep=None):
     """PUBLIC block-native single-step decode (the paged serve
     engine's hot path; serve/paged.py ``_paged_decode_kernel``).
     ``x``: (1, 1, E) embedded input at ``pos``; ``pool_k/v``: the full
@@ -936,7 +1060,7 @@ def decode_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
             x, p, _cache_layer(pool_k, li), _cache_layer(pool_v, li),
             tbl, pos, n_blk, n_head, eps, block, trash,
             moe_top_k=moe_top_k, window=window, blk_lo=blk_lo,
-            tp_axis=tp_axis, tp_world=tp_world)
+            tp_axis=tp_axis, tp_world=tp_world, ep=ep)
         kbs.append(kb)
         vbs.append(vb)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)
@@ -947,7 +1071,7 @@ def decode_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
 def chunk_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
                      n_head, eps, *, block, trash, moe_top_k=2,
                      window=None, blk_lo=None, tp_axis=None,
-                     tp_world=1):
+                     tp_world=1, ep=None):
     """PUBLIC block-native chunk advance (speculative verify against
     the pool; serve/paged.py ``_paged_spec_kernel``).  ``x``:
     (1, K, E) embedded chunk at ``pos..pos+K-1``.  Returns
@@ -960,7 +1084,7 @@ def chunk_step_paged(params, x, pool_k, pool_v, tbl, pos, n_blk,
             x, p, _cache_layer(pool_k, li), _cache_layer(pool_v, li),
             tbl, pos, n_blk, n_head, eps, block, trash,
             moe_top_k=moe_top_k, window=window, blk_lo=blk_lo,
-            tp_axis=tp_axis, tp_world=tp_world)
+            tp_axis=tp_axis, tp_world=tp_world, ep=ep)
         kds.append(kd)
         vds.append(vd)
     x = _ln(x, params["lnf_s"], params["lnf_b"], eps)

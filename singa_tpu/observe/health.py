@@ -175,6 +175,74 @@ def _tp_section(snap: dict) -> dict:
     }
 
 
+def _ep_section(snap: dict) -> dict:
+    """The ``serve.ep`` health section: expert-parallel MoE serving
+    (serve/ep.py) — expert shard width, per-expert routed-token load,
+    dropped assignments, and a max/mean load-imbalance ratio (an
+    imbalanced router is the MoE why_slow: collapsed routing shows up
+    here before it shows up as expert-shard latency).  Zeros when no
+    EP engine ever ran — always present so dashboards can alert
+    unconditionally.  ``shards`` is the widest live engine's expert
+    mesh (max, like the tp section); token/drop counters sum across
+    engines, and expert_tokens sums per expert INDEX across engines
+    (same-geometry replicas add up; the imbalance ratio is computed
+    over the summed loads)."""
+    counters, gauges = snap["counters"], snap["gauges"]
+    widths = [v for k, v in gauges.items()
+              if k == "serve.ep.shards"
+              or k.startswith("serve.ep.shards{")]
+    per_expert: dict = {}
+    for k, v in counters.items():
+        if k == "serve.ep.expert_tokens" \
+                or k.startswith("serve.ep.expert_tokens{"):
+            e = "0"
+            if "expert=" in k:
+                e = k.split("expert=")[1].split("}")[0].split(",")[0]
+            per_expert[e] = per_expert.get(e, 0) + v
+    loads = [per_expert[k] for k in sorted(per_expert, key=int)] \
+        if per_expert else []
+    total = sum(loads)
+    imb = (max(loads) / (total / len(loads))
+           if total and loads else None)
+    return {
+        "shards": max(widths) if widths else 0,
+        "kv_bytes_per_shard": _sum_metric(
+            gauges, "serve.ep.kv_bytes_per_shard"),
+        "sharded_dispatches": _sum_metric(
+            counters, "serve.ep.sharded_dispatches"),
+        "expert_tokens": loads,
+        "dropped_tokens": _sum_metric(
+            counters, "serve.ep.dropped_tokens"),
+        "load_imbalance": imb,
+    }
+
+
+def _pp_section(snap: dict) -> dict:
+    """The ``serve.pp`` health section: pipeline-parallel serving
+    (serve/pp.py) — stage depth, microbatch width, per-stage KV
+    bytes, and stage-boundary hop counts (zeros when no PP engine
+    ever ran — always present so dashboards can alert
+    unconditionally).  ``stages`` is the deepest live engine's
+    pipeline (max); bytes/dispatches/hops sum across engines."""
+    counters, gauges = snap["counters"], snap["gauges"]
+    depths = [v for k, v in gauges.items()
+              if k == "serve.pp.stages"
+              or k.startswith("serve.pp.stages{")]
+    mbs = [v for k, v in gauges.items()
+           if k == "serve.pp.microbatches"
+           or k.startswith("serve.pp.microbatches{")]
+    return {
+        "stages": max(depths) if depths else 0,
+        "microbatches": max(mbs) if mbs else 0,
+        "kv_bytes_per_stage": _sum_metric(
+            gauges, "serve.pp.kv_bytes_per_stage"),
+        "sharded_dispatches": _sum_metric(
+            counters, "serve.pp.sharded_dispatches"),
+        "boundary_hops": _sum_metric(
+            counters, "serve.pp.boundary_hops"),
+    }
+
+
 def _fleet_section(snap: dict) -> dict:
     """The ``serve.fleet`` health section: replicated-serve routing and
     failover counters summed across fleets (zeros when no fleet ever
@@ -312,6 +380,8 @@ def health_report(reg=None, engine_snapshots=(),
             "paged": _paged_section(snap),
             "spec": _spec_section(snap),
             "tp": _tp_section(snap),
+            "ep": _ep_section(snap),
+            "pp": _pp_section(snap),
             "fleet": _fleet_section(snap),
             # tail-latency attribution from the request ledger
             # (observe.requests): always present; {"enabled": False}

@@ -43,7 +43,26 @@ from ..tensor import Tensor
 from . import sharding
 from .sharding import EXPERT, P, ShardingPlan
 
-__all__ = ["MoEFFN"]
+__all__ = ["MoEFFN", "dispatch_load"]
+
+
+def dispatch_load(dispatch, top_k):
+    """Expert-load observability from a dispatch one-hot (the serve
+    expert-parallel twins' hook — singa_tpu/serve/ep.py feeds
+    ``serve.ep.expert_tokens{engine=,expert=}`` and the dropped-token
+    counter from exactly this): ``dispatch`` is the (N, E, C) 0/1
+    tensor :func:`_top1_dispatch`/:func:`_top2_dispatch` return.
+    Returns ``(tokens_per_expert (E,) int32, dropped int32)`` where
+    ``dropped`` counts the top-k assignments capacity bounded away
+    (every token makes exactly ``top_k`` assignments; an assignment
+    that did not survive is a drop whose output rides the residual
+    path).  An imbalanced router shows up here before it shows up as
+    latency — the MoE why_slow."""
+    kept = jnp.sum(dispatch, axis=(0, 2))                   # (E,)
+    n = dispatch.shape[0]
+    dropped = top_k * n - jnp.sum(kept)
+    return (jnp.round(kept).astype(jnp.int32),
+            jnp.round(dropped).astype(jnp.int32))
 
 
 def _top2_dispatch(probs, capacity):

@@ -44,9 +44,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import autograd
 
 __all__ = [
-    "DATA", "MODEL", "SEQ", "PIPE", "EXPERT", "TP", "AXES",
-    "create_mesh", "create_tp_mesh", "ShardingPlan", "constrain",
-    "plan_active",
+    "DATA", "MODEL", "SEQ", "PIPE", "EXPERT", "TP", "EP", "PP", "AXES",
+    "create_mesh", "create_tp_mesh", "create_ep_mesh", "create_pp_mesh",
+    "ShardingPlan", "constrain", "plan_active",
 ]
 
 DATA = "data"
@@ -63,6 +63,18 @@ AXES = (DATA, MODEL, SEQ, PIPE, EXPERT)
 #: distinct means a Chrome trace can tell a TP-serve psum from a
 #: training ``model``-axis collective at a glance.
 TP = "tp"
+
+#: the SERVE-side expert-parallel axis (singa_tpu/serve/ep.py): the
+#: leading axis of a 2-D ``(ep, tp)`` decode mesh over which an MoE
+#: engine's stacked expert weights shard.  Distinct from the training
+#: ``expert`` axis for the same trace-attribution reason as :data:`TP`.
+EP = "ep"
+
+#: the SERVE-side pipeline-stage axis (singa_tpu/serve/pp.py): a 1-D
+#: mesh over which an engine's LAYERS (and the layer axis of its paged
+#: KV pool) partition into stages.  Distinct from the training
+#: ``pipe`` axis, like :data:`TP`/:data:`EP`.
+PP = "pp"
 
 # True while a graph-mode step is being traced under a ShardingPlan;
 # constrain() is the identity otherwise (eager compile-time dummy
@@ -131,6 +143,44 @@ def create_tp_mesh(tp, devices=None) -> Mesh:
             f"XLA_FLAGS=--xla_force_host_platform_device_count={tp} "
             f"(tests/conftest.py) or lower tp")
     return Mesh(np.asarray(devices[:tp]), (TP,))
+
+
+def create_ep_mesh(ep, tp=1, devices=None) -> Mesh:
+    """2-D serve-side ``(ep, tp)`` mesh over the first ``ep * tp``
+    devices: experts shard over :data:`EP` (the outer axis), the dense
+    layers' Megatron layout rides :data:`TP` (the inner axis, adjacent
+    devices — the heavier per-layer collective).  ``tp=1`` keeps the
+    axis (size-1 sharding is a no-op) so one spec set serves every EP
+    geometry."""
+    if ep < 1 or tp < 1:
+        raise ValueError(f"ep and tp must be >= 1, got ep={ep} tp={tp}")
+    n = ep * tp
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"ep x tp = {ep} x {tp} = {n} needs {n} devices, have "
+            f"{len(devices)} — provision a virtual CPU mesh via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            f"(tests/conftest.py) or shrink the mesh")
+    return Mesh(np.asarray(devices[:n]).reshape(ep, tp), (EP, TP))
+
+
+def create_pp_mesh(stages, devices=None) -> Mesh:
+    """1-D serve-side pipeline mesh over the first ``stages`` devices
+    (axis name :data:`PP`): each rank owns one stage's layer slice of
+    the decode weights and of the paged KV pool."""
+    if stages < 1:
+        raise ValueError(f"stages must be >= 1, got {stages}")
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < stages:
+        raise ValueError(
+            f"stages={stages} needs {stages} devices, have "
+            f"{len(devices)} — provision a virtual CPU mesh via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{stages} (tests/conftest.py) or lower stages")
+    return Mesh(np.asarray(devices[:stages]), (PP,))
 
 
 class ShardingPlan:

@@ -65,21 +65,31 @@ _DECODE_COL_B = ("bq", "bk", "bv", "b1")
 _DECODE_ROW_W = ("wo", "w2")
 
 
-def decode_param_specs(params, axis=MODEL):
+#: MoE expert-weight keys: stacked (E, ...) arrays whose LEADING
+#: expert axis shards over the serve ``ep`` axis (serve/ep.py); the
+#: router ``moe_wg`` stays replicated (tiny, and every rank routes).
+_DECODE_EXPERT_W = ("moe_w1", "moe_b1", "moe_w2", "moe_b2")
+
+
+def decode_param_specs(params, axis=MODEL, ep_axis=None):
     """PartitionSpec pytree (same structure as ``params``) laying an
     ``extract_params`` decode pytree out Megatron-style over ``axis``:
     attention heads + MLP columns partitioned, out-proj/fc2 row-
-    partitioned, embeddings/norms/head replicated.  MoE blocks are
-    expert-parallel, not tensor-parallel — they are rejected here so
-    the failure is a typed construction error, not a shape mismatch
-    deep inside a shard_map trace."""
+    partitioned, embeddings/norms/head replicated.  MoE blocks shard
+    their stacked expert weights over ``ep_axis`` (the serve
+    expert-parallel backend, singa_tpu/serve/ep.py) — without one they
+    are rejected here so the failure is a typed construction error
+    naming the ``serve(ep=)`` path, not a shape mismatch deep inside a
+    shard_map trace."""
     blocks = []
     for li, blk in enumerate(params["blocks"]):
-        if "moe_wg" in blk:
+        if "moe_wg" in blk and ep_axis is None:
             raise NotImplementedError(
                 f"block {li} is an MoE block: expert weights shard "
                 f"over the expert axis, not the tensor-parallel axis "
-                f"(serve TP supports dense/GQA models only)")
+                f"— serve this model with model.serve(ep=EPConfig("
+                f"ep=, tp=)) (singa_tpu/serve/ep.py: expert-parallel "
+                f"decode; tp= covers dense/GQA models only)")
         spec = {}
         for k in blk:
             if k in _DECODE_COL_W:
@@ -88,6 +98,8 @@ def decode_param_specs(params, axis=MODEL):
                 spec[k] = P(axis)
             elif k in _DECODE_ROW_W:
                 spec[k] = P(axis, None)
+            elif k in _DECODE_EXPERT_W:
+                spec[k] = P(ep_axis)
             else:
                 spec[k] = P()
         blocks.append(spec)

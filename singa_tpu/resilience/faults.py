@@ -45,6 +45,8 @@ SITES = (
     "checkpoint.read",     # model load path + Snapshot.read
     "comm.collective",     # host-side collective dispatch
     "serve.decode_step",   # the engine's pool decode (and prefill)
+    "serve.ep_dispatch",   # expert-parallel sharded-twin dispatch
+    "serve.pp_boundary",   # pipeline stage-boundary sharded dispatch
     "serve.prefill_chunk",  # budgeted chunked-prefill chunk dispatch
     "serve.prefix_copy",   # prefix-cache pool<->slot block copies
     "serve.route",         # fleet router admission (ServeFleet.submit)

@@ -56,6 +56,22 @@ docs/SERVING.md "Tensor-parallel serving"::
     eng = model.serve(max_slots=8, tp=2,
                       paged=PagedConfig(block_size=16, num_blocks=256))
 
+Since the EP/PP round the serve stack covers every architecture the
+training side builds: ``ep=EPConfig(ep=, tp=)`` serves MoE models
+expert-parallel (experts sharded over an ``ep`` mesh axis,
+capacity-bounded GShard dispatch inside the jitted pool steps, dense
+layers Megatron over an orthogonal ``tp`` axis — serve/ep.py), and
+``pp=PPConfig(stages=, microbatches=)`` serves models DEEPER than one
+device's memory pipeline-parallel (layers partitioned into stages,
+each stage owning its layer slice of the paged KV pool, microbatched
+decode so bubbles amortize across the continuous batch — serve/pp.py).
+See docs/SERVING.md "Expert-parallel and pipeline serving"::
+
+    eng = moe_model.serve(max_slots=8, ep=EPConfig(ep=2, tp=2),
+                          paged=PagedConfig(block_size=16))
+    eng = deep_model.serve(max_slots=8, pp=PPConfig(stages=2),
+                           paged=PagedConfig(block_size=16))
+
 Since the disaggregation round, ``roles=`` splits a fleet
 DistServe-style into prefill and decode specialists: long admissions
 build their canonical-KV prefix on a specialist and SHIP the blocks
@@ -76,6 +92,8 @@ from .fleet import Router, ServeFleet  # noqa: F401
 from .kvimage import KVImage, KVImageError  # noqa: F401
 from .paged import PagedConfig, PagedKVArena  # noqa: F401
 from .tp import TPConfig, TPExecutor  # noqa: F401
+from .ep import EPConfig, EPExecutor  # noqa: F401
+from .pp import PPConfig, PPExecutor  # noqa: F401
 from .prefix import (FleetPrefixIndex, PrefixCache,  # noqa: F401
                      PrefixCacheConfig, SessionHandle)
 from .request import (DeadlineExceededError, EngineFailedError,  # noqa: F401

@@ -144,7 +144,7 @@ def _select_sample(logit, key, temp, top_k, top_p, use_top_p):
 
 def _decode_row(params, kc_r, vc_r, tok, pos_r, live_r, key, temp,
                 top_p, n_head, eps, moe_top_k, top_k, use_top_p,
-                tp_axis=None, tp_world=1):
+                tp_axis=None, tp_world=1, ep=None):
     """ONE slot's decode-step math — kc_r/vc_r: (L, H_kv, max_len, D)
     cache rows (int8 arenas are (values, scales) pytrees, so the
     batch-axis insert/strip is tree-mapped rather than indexed).
@@ -159,7 +159,8 @@ def _decode_row(params, kc_r, vc_r, tok, pos_r, live_r, key, temp,
     logits, kc2, vc2 = decode_step(
         params, x, jax.tree.map(lambda a: a[:, None], kc_r),
         jax.tree.map(lambda a: a[:, None], vc_r), p_c, n_head, eps,
-        moe_top_k=moe_top_k, tp_axis=tp_axis, tp_world=tp_world)
+        moe_top_k=moe_top_k, tp_axis=tp_axis, tp_world=tp_world,
+        ep=ep)
     ks = jax.random.split(key)
     nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
                          use_top_p)
@@ -199,7 +200,7 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
                           "tp_world"))
 def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
                  eps, moe_top_k, top_k, use_top_p, quant=False,
-                 window=None, tp_axis=None, tp_world=1):
+                 window=None, tp_axis=None, tp_world=1, ep=None):
     """Admission prefill for ONE request: ids (1, max_len)
     right-padded.  Returns (first token, carried key, kc_row, vc_row)
     with cache rows (L, 1, H_kv, max_len, D) ready to write into the
@@ -213,7 +214,8 @@ def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
     hidden, kc, vc = prefill(params, ids, n_head, eps,
                              moe_top_k=moe_top_k, quant_cache=quant,
                              window=window, rolling=False,
-                             tp_axis=tp_axis, tp_world=tp_world)
+                             tp_axis=tp_axis, tp_world=tp_world,
+                             ep=ep)
     last_h = jax.lax.dynamic_index_in_dim(
         hidden, prompt_len - 1, axis=1, keepdims=False)      # (1, E)
     logit0 = _logits(last_h[:, None, :], params)[0, 0]       # (V,)
@@ -228,7 +230,7 @@ def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
                           "tp_world"))
 def _prefill_batch(params, ids, plens, seeds, temps, top_p, n_head,
                    eps, moe_top_k, top_k, use_top_p, quant=False,
-                   window=None, tp_axis=None, tp_world=1):
+                   window=None, tp_axis=None, tp_world=1, ep=None):
     """BATCHED cold admission (the gather-tax round): R requests'
     prefills in ONE dispatch — ids (R, W) right-padded at the pass's
     shared narrow width, plens/seeds/temps (R,).  vmaps the exact
@@ -247,7 +249,7 @@ def _prefill_batch(params, ids, plens, seeds, temps, top_p, n_head,
         return _prefill_one.__wrapped__(
             params, ids_r[None], plen, key0, temp, top_p, n_head,
             eps, moe_top_k, top_k, use_top_p, quant=quant,
-            window=window, tp_axis=tp_axis, tp_world=tp_world)
+            window=window, tp_axis=tp_axis, tp_world=tp_world, ep=ep)
 
     tok0, keys, kc, vc = jax.vmap(row, in_axes=(0, 0, 0, 0),
                                   out_axes=(0, 0, 1, 1))(
@@ -275,7 +277,7 @@ def _prefill_rows(params, ids, n_head, eps, moe_top_k, quant=False):
          donate_argnums=(2, 3))
 def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
                moe_top_k, chunk, window=None, tp_axis=None,
-               tp_world=1):
+               tp_world=1, ep=None):
     """Offset prefill of ONE block-width window: embed tokens at
     positions [off, off+chunk) of the padded ``ids`` row and advance
     them through ``gpt2_decode.prefill_chunk`` against a cache row
@@ -289,7 +291,7 @@ def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
         jnp.take(params["wpe"], pos, axis=0)[None]
     return prefill_chunk(params, x, kc_row, vc_row, off, n_head, eps,
                          moe_top_k=moe_top_k, window=window,
-                         tp_axis=tp_axis, tp_world=tp_world)
+                         tp_axis=tp_axis, tp_world=tp_world, ep=ep)
 
 
 @partial(jax.jit, static_argnames=("top_k", "use_top_p"))
@@ -355,7 +357,7 @@ def _draft_propose(d_params, dkc_r, dvc_r, t_c, p_c, k_draft, temp,
 
 def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
               live_r, key, temp, top_p, spec_k, tn, te, tm, dn, de, dm,
-              top_k, use_top_p, tp_axis=None, tp_world=1):
+              top_k, use_top_p, tp_axis=None, tp_world=1, ep=None):
     """ONE slot's speculative-chunk math: the shared draft proposal
     scan (:func:`_draft_propose`), then ONE target chunk advance
     (``_advance_chunk`` — a single cache read serves all ``spec_k``
@@ -385,7 +387,7 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
     lg, kc2, vc2 = _advance_chunk(t_params, xs, _batch1(kc_r),
                                   _batch1(vc_r), p_c, tn, te,
                                   moe_top_k=tm, tp_axis=tp_axis,
-                                  tp_world=tp_world)
+                                  tp_world=tp_world, ep=ep)
     out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
                                temp, top_p, top_k, use_top_p)
     return (out, a_draft, _unbatch1(kc2), _unbatch1(vc2),
@@ -395,7 +397,7 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
 def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
                       key, temp, top_p, n_blk, block, trash, n_head,
                       eps, moe_top_k, top_k, use_top_p, window=None,
-                      blk_lo=None, tp_axis=None, tp_world=1):
+                      blk_lo=None, tp_axis=None, tp_world=1, ep=None):
     """ONE slot's BLOCK-NATIVE decode-step math (the gather-tax
     round): same embed / sample chain as :func:`_decode_row`, but the
     attention runs directly over the block pool through
@@ -415,7 +417,7 @@ def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
         params, x, pool_k, pool_v, tbl, p_c, n_blk, n_head, eps,
         block=block, trash=trash, moe_top_k=moe_top_k,
         window=window, blk_lo=blk_lo,
-        tp_axis=tp_axis, tp_world=tp_world)
+        tp_axis=tp_axis, tp_world=tp_world, ep=ep)
     ks = jax.random.split(key)
     nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
                          use_top_p)
@@ -426,7 +428,7 @@ def _spec_row_paged(t_params, d_params, pool_k, pool_v, dkc_r, dvc_r,
                     tbl, tok, pos_r, live_r, key, temp, top_p, n_blk,
                     spec_k, block, trash, tn, te, tm, dn, de, dm,
                     top_k, use_top_p, window=None, blk_lo=None,
-                    tp_axis=None, tp_world=1):
+                    tp_axis=None, tp_world=1, ep=None):
     """ONE slot's BLOCK-NATIVE speculative chunk: the SAME draft
     proposal scan and the SAME ``spec_verify`` as :func:`_spec_row`
     (shared helpers — the accept logic cannot drift), with the target
@@ -451,7 +453,7 @@ def _spec_row_paged(t_params, d_params, pool_k, pool_v, dkc_r, dvc_r,
     lg, kdbl, vdbl = chunk_step_paged(
         t_params, xs, pool_k, pool_v, tbl, p_c, n_blk, tn, te,
         block=block, trash=trash, moe_top_k=tm, window=window,
-        blk_lo=blk_lo, tp_axis=tp_axis, tp_world=tp_world)
+        blk_lo=blk_lo, tp_axis=tp_axis, tp_world=tp_world, ep=ep)
     out, a_draft = spec_verify(lg[0], d_probs, props, k_verify,
                                temp, top_p, top_k, use_top_p)
     return (out, a_draft, kdbl, vdbl,
@@ -748,7 +750,7 @@ class InferenceEngine:
                  scheduler=None, top_k=0, top_p=None,
                  clock=time.monotonic, slo=None, prefix_cache=None,
                  draft_model=None, spec_k=None, cache_dtype=None,
-                 paged=None, tp=None):
+                 paged=None, tp=None, ep=None, pp=None):
         cfg = model.cfg
         # sliding-window models serve in PAGED mode only (the
         # long-context round): block tables are position-indexed, so
@@ -882,6 +884,55 @@ class InferenceEngine:
                         "with the offline oracle, which ring "
                         "reduction reordering cannot keep through "
                         "quantization bins; serve int8 without ring")
+        # -- expert-parallel / pipeline-parallel backends (serve/ep.py
+        # and serve/pp.py): the FULL refusal matrix runs HERE, before
+        # EngineStats (or any executor) registers a single metric — a
+        # refused construction must leak nothing (the PR-12 leaked-
+        # gauge hazard, audited for every ep/pp combination)
+        self._ep_cfg = self._pp_cfg = None
+        if ep is not None and ep is not False:
+            from .ep import as_ep_config
+            ep = as_ep_config(ep)
+            if ep.ep * ep.tp > 1:
+                self._ep_cfg = ep
+        if pp is not None and pp is not False:
+            from .pp import as_pp_config
+            pp = as_pp_config(pp)
+            if pp.stages > 1:
+                self._pp_cfg = pp
+        # conflicts test ACTIVE backends, not knobs-passed: explicit
+        # "off" values (tp=1, pp=1, ep=1) next to an active backend
+        # are legal no-ops, matching each knob's own "1 = off"
+        # contract (tp was coerced to a TPConfig up top when set)
+        _tp_on = (tp is not None and tp is not False and tp.tp > 1)
+        if self._ep_cfg is not None:
+            if _tp_on:
+                raise ValueError(
+                    "ep= together with tp=: EPConfig carries the "
+                    "dense layers' tensor-parallel width itself — "
+                    "pass ep=EPConfig(ep=, tp=) and drop the bare "
+                    "tp= knob")
+            if self._pp_cfg is not None:
+                raise ValueError(
+                    "ep= together with pp=: one sharded executor "
+                    "per engine — serve expert-parallel (ep=) or "
+                    "pipeline-parallel (pp=), not both")
+            from .ep import check_ep
+            check_ep(self._ep_cfg, cfg,
+                     model_plan=getattr(model, "plan", None),
+                     prefix_cache=prefix_cache)
+        if self._pp_cfg is not None:
+            if _tp_on:
+                raise ValueError(
+                    "pp= together with tp=: one sharded executor "
+                    "per engine — interleaving tensor parallelism "
+                    "inside a stage is the documented next "
+                    "extension, not a supported composition")
+            from .pp import check_pp
+            check_pp(self._pp_cfg, cfg,
+                     model_plan=getattr(model, "plan", None),
+                     paged=paged, draft_model=draft_model,
+                     window=self._window)
         self._clock = clock
         # string schedulers construct PER ENGINE — an object instance
         # forwarded through supervisor/fleet engine_kw would be SHARED
@@ -940,7 +991,37 @@ class InferenceEngine:
                                else None)
                 self._params = self.tp_exec.place_params(self._params)
                 self.stats.tp_source = self.tp_exec.snapshot
-        self._x = (self.tp_exec if self.tp_exec is not None
+        # -- expert-parallel / pipeline-parallel executors: same seam,
+        # different mesh.  Validation already ran up top (before any
+        # registration); the executors re-check defensively before
+        # registering their own metrics.
+        self.ep_exec = self.pp_exec = None
+        if self._ep_cfg is not None:
+            from .ep import EPExecutor
+            self.ep_exec = EPExecutor(
+                self._ep_cfg, cfg, statics=self._statics,
+                quant=self._quant,
+                model_plan=getattr(model, "plan", None),
+                engine_label=self.stats.engine_label,
+                reg=self.stats.registry, prefix_cache=prefix_cache)
+            self.ep_exec.set_window(self._window)
+            self._params = self.ep_exec.place_params(self._params)
+            self.stats.ep_source = self.ep_exec.snapshot
+        if self._pp_cfg is not None:
+            from .pp import PPExecutor
+            self.pp_exec = PPExecutor(
+                self._pp_cfg, cfg, statics=self._statics,
+                quant=self._quant,
+                model_plan=getattr(model, "plan", None),
+                engine_label=self.stats.engine_label,
+                reg=self.stats.registry)
+            self._params = self.pp_exec.place_params(self._params)
+            self.stats.pp_source = self.pp_exec.snapshot
+        #: the ONE sharded executor (tp | ep | pp | None) — placement
+        #: and late-statics calls below go through this seam so the
+        #: host-side step loop never knows which mesh it runs over
+        self._shard = (self.tp_exec or self.ep_exec or self.pp_exec)
+        self._x = (self._shard if self._shard is not None
                    else _LocalExec(self))
         # fixed-shape KV arena keyed on (max_slots, max_len): L layers,
         # H_kv heads (GQA keeps the narrow cache), compute dtype —
@@ -958,13 +1039,13 @@ class InferenceEngine:
                      jnp.zeros((L_, S, H_, W), jnp.float32))
             else:
                 z = jnp.zeros((L_, S, H_, W, D_), cdt)
-            if self.tp_exec is None:
+            if self._shard is None:
                 return z
             # target arenas shard on the H_kv axis; the DRAFT arena
             # (shard=False) replicates — every shard runs the full
             # draft, which is what keeps any draft geometry legal
-            return (self.tp_exec.place_cache(z) if shard
-                    else self.tp_exec.place_replicated(z))
+            return (self._shard.place_cache(z) if shard
+                    else self._shard.place_replicated(z))
 
         # -- paged KV mode (serve/paged.py): ONE block pool replaces
         # the per-slot worst-case arena; capacity becomes "blocks
@@ -999,7 +1080,7 @@ class InferenceEngine:
                 paged, L, H_kv, D, cdt, row_width=W,
                 quant=self._quant,
                 engine_label=self.stats.engine_label,
-                reg=self.stats.registry, tp=self.tp_exec)
+                reg=self.stats.registry, tp=self._shard)
             self.stats.paged_source = self.paged_arena.snapshot
             self._kc = self._vc = None
         else:
@@ -1020,20 +1101,20 @@ class InferenceEngine:
                                dcfg.n_embd // dcfg.n_head, shard=False)
             self._dvc = _arena(dcfg.n_layer, dcfg.n_kv_head,
                                dcfg.n_embd // dcfg.n_head, shard=False)
-            if self.tp_exec is not None:
-                self._d_params = self.tp_exec.place_replicated(
+            if self._shard is not None:
+                self._d_params = self._shard.place_replicated(
                     self._d_params)
-                self.tp_exec.set_spec(self.spec_k, self._d_statics)
+                self._shard.set_spec(self.spec_k, self._d_statics)
         # per-slot host state + device sampling keys
         self._slots = [None] * S            # _Slot or None
         self._toks = np.zeros(S, np.int32)  # last emitted token
         self._pos = np.zeros(S, np.int32)
         self._temps = np.zeros(S, np.float32)
         self._keys = jnp.zeros((S, 2), jnp.uint32)
-        if self.tp_exec is not None:
+        if self._shard is not None:
             # committed replicated so the sharded twins never pay a
             # per-dispatch broadcast for the key table
-            self._keys = self.tp_exec.place_replicated(self._keys)
+            self._keys = self._shard.place_replicated(self._keys)
         self._handles = {}
         self._swapped = []                  # paged mode: _Swapped list
         # batched-admission deferral (the gather-tax round): one
@@ -1097,7 +1178,7 @@ class InferenceEngine:
                 prefix_cache, L, H_kv, D, cdt,
                 engine_label=self.stats.engine_label,
                 reg=self.stats.registry, quant=self._quant,
-                arena=self.paged_arena, tp=self.tp_exec)
+                arena=self.paged_arena, tp=self._shard)
             self.prefix_cache.attach_row_geometry(W)
             if self.paged_arena is not None:
                 # cached-but-unreferenced blocks are soft free space:
@@ -1108,8 +1189,8 @@ class InferenceEngine:
                 n_head=cfg.n_head, eps=float(cfg.layer_norm_eps),
                 moe_top_k=self._statics["moe_top_k"],
                 chunk=prefix_cache.block_size, window=self._window)
-            if self.tp_exec is not None:
-                self.tp_exec.set_chunk(self._chunk_statics)
+            if self._shard is not None:
+                self._shard.set_chunk(self._chunk_statics)
             self.stats.prefix_source = self.prefix_cache.snapshot
             # prefill-interleave pricing: warm admissions that
             # recompute at most one chunk don't consume the cold
@@ -1141,8 +1222,8 @@ class InferenceEngine:
                     moe_top_k=self._statics["moe_top_k"],
                     chunk=self.paged_arena.block_size,
                     window=self._window)
-                if self.tp_exec is not None:
-                    self.tp_exec.set_chunk(self._chunk_statics)
+                if self._shard is not None:
+                    self._shard.set_chunk(self._chunk_statics)
             self._c_budget_chunks = self.stats.registry.counter(
                 "serve.prefill.budget_chunks",
                 help="block-width chunk dispatches the chunked-"
@@ -1170,7 +1251,17 @@ class InferenceEngine:
             f"{self.paged_arena.num_blocks}x"
             f"{self.paged_arena.block_size}",
             "off" if self.tp_exec is None
-            else f"{self.tp_exec.tp} shards")
+            else f"{self.tp_exec.tp} shards",
+        )
+        if self.ep_exec is not None:
+            self._log.info(
+                "engine ep backend: %d expert shards x %d tp "
+                "(capacity_factor=%s)", self.ep_exec.ep,
+                self.ep_exec.tp, self.ep_exec.config.capacity_factor)
+        if self.pp_exec is not None:
+            self._log.info(
+                "engine pp backend: %d stages x %d microbatches",
+                self.pp_exec.stages, self.pp_exec.microbatches)
 
     # -- submission ------------------------------------------------------
     def submit(self, request) -> RequestHandle:
@@ -1318,6 +1409,10 @@ class InferenceEngine:
             self.paged_arena.unregister()
         if self.tp_exec is not None:
             self.tp_exec.unregister()
+        if self.ep_exec is not None:
+            self.ep_exec.unregister()
+        if self.pp_exec is not None:
+            self.pp_exec.unregister()
         self.stats.registry.remove(*self._own_metrics)
         self._own_metrics = []
         self._kc = self._vc = None

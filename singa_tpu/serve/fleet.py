@@ -325,10 +325,25 @@ class ServeFleet:
         # a supervisor rebuild or revive() lands on the SAME device
         # group and reuses the same compiled twins.
         self._tp_cfgs = None
+        self._par_key = "tp"
         if engine_kw.get("tp") not in (None, False):
             from .tp import fleet_tp_configs
 
             self._tp_cfgs = fleet_tp_configs(engine_kw["tp"], replicas)
+        elif engine_kw.get("ep") not in (None, False):
+            # expert-parallel replicas: the mesh partitions into
+            # (ep x tp)-wide groups, one per replica (serve/ep.py)
+            from .ep import fleet_ep_configs
+
+            self._par_key = "ep"
+            self._tp_cfgs = fleet_ep_configs(engine_kw["ep"], replicas)
+        elif engine_kw.get("pp") not in (None, False):
+            # pipeline-parallel replicas: stage-wide groups
+            # (serve/pp.py)
+            from .pp import fleet_pp_configs
+
+            self._par_key = "pp"
+            self._tp_cfgs = fleet_pp_configs(engine_kw["pp"], replicas)
         self._sup_kw = dict(
             restart_budget=restart_budget,
             budget_reset_after_s=budget_reset_after_s,
@@ -426,12 +441,14 @@ class ServeFleet:
 
     def _replica_kw(self, idx):
         """Engine kwargs for replica ``idx``: the shared engine_kw,
-        with ``tp`` swapped for the replica's pinned device-group
-        TPConfig on a tensor-parallel fleet."""
+        with the sharded-backend knob (``tp``/``ep``/``pp``) swapped
+        for the replica's pinned device-group config so a supervisor
+        rebuild or revive() lands on the SAME group and reuses the
+        same compiled twins."""
         if self._tp_cfgs is None:
             return self._engine_kw
         kw = dict(self._engine_kw)
-        kw["tp"] = self._tp_cfgs[idx]
+        kw[self._par_key] = self._tp_cfgs[idx]
         return kw
 
     # -- introspection ---------------------------------------------------

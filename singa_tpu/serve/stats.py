@@ -147,6 +147,14 @@ class EngineStats:
         # set by the engine in tensor-parallel mode: the TPExecutor's
         # snapshot (shard count, per-shard KV bytes, dispatch counts)
         self.tp_source = None
+        # set by the engine in expert-parallel mode (serve/ep.py): the
+        # EPExecutor's snapshot (expert shard count, per-expert routed
+        # token load, dropped-token count, load imbalance)
+        self.ep_source = None
+        # set by the engine in pipeline-parallel mode (serve/pp.py):
+        # the PPExecutor's snapshot (stage count, microbatches,
+        # per-stage KV bytes, dispatch counts)
+        self.pp_source = None
         # speculative engines only: acceptance accounting (``spec`` is
         # set by the engine when a draft model is attached; a plain
         # engine registers nothing and snapshots spec: None)
@@ -374,6 +382,13 @@ class EngineStats:
             # for tensor-parallel ones (serve/tp.py)
             "tp": (self.tp_source()
                    if self.tp_source is not None else None),
+            # add-only schema extensions (EP/PP-serve round): None
+            # unless the engine runs the expert-parallel or
+            # pipeline-parallel executor (serve/ep.py, serve/pp.py)
+            "ep": (self.ep_source()
+                   if self.ep_source is not None else None),
+            "pp": (self.pp_source()
+                   if self.pp_source is not None else None),
             # add-only schema extension (speculative round): None for
             # plain engines.  tokens_per_chunk = accepted proposals +
             # the chunk's bonus/correction token, per verify chunk —

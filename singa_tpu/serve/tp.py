@@ -216,8 +216,11 @@ class TPExecutor:
         if getattr(cfg, "moe_every", None) is not None:
             raise NotImplementedError(
                 "tp= on an MoE model: expert weights shard over the "
-                "expert axis, not the tensor-parallel axis (serve TP "
-                "supports dense/GQA models)")
+                "expert axis, not the tensor-parallel axis — serve "
+                "this model with model.serve(ep=EPConfig(ep=, tp=)) "
+                "(singa_tpu/serve/ep.py: expert-parallel decode with "
+                "the dense layers on an orthogonal tp axis); bare "
+                "tp= covers dense/GQA models")
         tp = int(config.tp)
         # mesh first: "tp wider than the machine" is the clearer error
         # when both it and a divisibility check would fire

@@ -200,13 +200,15 @@ def test_stats_schema_stable():
     snap = eng.stats.snapshot()
     assert set(snap) == {"requests", "throughput", "latency", "queue",
                          "slots", "slo", "prefix", "spec", "paged",
-                         "tp"}
-    # no prefix cache / draft model / paged arena / tp mesh
+                         "tp", "ep", "pp"}
+    # no prefix cache / draft model / paged arena / tp-ep-pp mesh
     # configured: present but None
     assert snap["prefix"] is None
     assert snap["spec"] is None
     assert snap["paged"] is None
     assert snap["tp"] is None
+    assert snap["ep"] is None
+    assert snap["pp"] is None
     assert set(snap["requests"]) == {
         "submitted", "completed", "rejected_deadline",
         "rejected_queue_full"}

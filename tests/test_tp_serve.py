@@ -294,11 +294,25 @@ def test_twin_cache_keyed_on_model_structure(model, draft):
 
 def test_moe_model_refused():
     """MoE blocks shard over the expert axis, not tp: typed refusal
-    at construction."""
+    at construction, and the message is the CONTRACT — it must name
+    the ``serve(ep=)`` path that does serve this model (the EP/PP
+    round's rewritten refusal; serve/ep.py)."""
     m = _build(GPT2Config.tiny(dropout=0.0, moe_every=2,
                                moe_experts=2))
-    with pytest.raises(NotImplementedError, match="MoE"):
+    from singa_tpu.observe.registry import registry
+
+    def tp_gauges():
+        return {k for k in registry().snapshot()["gauges"]
+                if k.startswith("serve.tp.")}
+
+    before = tp_gauges()
+    with pytest.raises(NotImplementedError,
+                       match=r"serve\(ep=EPConfig"):
         m.serve(max_slots=2, tp=2)
+    # the refusal fired BEFORE the executor registered anything: a
+    # failed construction must leak no serve.tp gauges (the PR-12
+    # leaked-gauge hazard, audited for the rewritten refusal)
+    assert tp_gauges() == before
 
 
 def test_metrics_and_health_surface(model):
