@@ -1054,6 +1054,120 @@ def chaos_disagg(report):
     assert sd["blocks_leaked"] == 0, sd["blocks_leaked"]
 
 
+def chaos_autoscale(report):
+    """Fault the ``serve.autoscale`` site mid-scale-up (the autoscale
+    round): the scaling DECISION aborts typed — ledger records
+    ``scale_up_failed``, no half-registered replica exists (replica
+    count and fleet counter families unchanged), the fleet keeps
+    serving on its existing replica — and the next check simply
+    retries and succeeds.  After the burst drains, the autoscaler
+    drains the spare replica back down and the retired engine's
+    ``serve.*{engine=n}`` series leave the registry (the scale-down
+    leaked-gauge audit, same hazard class as the EP/PP refusal
+    audits)."""
+    from singa_tpu import observe, tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.resilience import FailOnce, faults
+    from singa_tpu.serve import (AutoscaleConfig, Autoscaler,
+                                 GenerationRequest, ServeFleet)
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(21)
+    workload = [(rng.randint(0, 256, rng.randint(3, 12)).astype(np.int32),
+                 int(rng.randint(3, 7))) for _ in range(12)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n, temperature=0.0))
+            for p, n in workload]
+
+    T = [0.0]
+    fleet = ServeFleet(m, replicas=1, max_slots=2,
+                       clock=lambda: T[0])
+    sc = Autoscaler(fleet, AutoscaleConfig(
+        min_replicas=1, max_replicas=2, scale_up_cooldown_s=1.0,
+        scale_down_cooldown_s=2.0, queue_high=2.0, queue_low=0.5,
+        occupancy_high=0.95, occupancy_low=0.45),
+        clock=lambda: T[0])
+    handles = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0)) for p, n in workload]
+
+    def fleet_counter_sets():
+        snap = observe.registry().snapshot()
+        return sorted(
+            k for k in snap["counters"]
+            if k.startswith("serve.fleet.routed{")
+            and f"fleet={fleet.fleet_label}" in k)
+
+    counters_before = fleet_counter_sets()
+    pol = faults.inject("serve.autoscale", FailOnce())
+    ev1 = sc.check()
+    assert ev1 is not None and ev1["action"] == "scale_up_failed", ev1
+    assert pol.fired == 1
+    # no half-registered replica: same replica count, same fleet
+    # counter families, the lone replica still serving
+    assert fleet.replicas == 1
+    assert fleet_counter_sets() == counters_before
+    for _ in range(3):
+        fleet.step()
+    T[0] += 0.5
+    ev2 = sc.check()  # the retry: no cooldown was spent on the abort
+    assert ev2 is not None and ev2["action"] == "scale_up", ev2
+    faults.clear()
+    assert fleet.replicas == 2
+
+    while fleet.pending:
+        fleet.step()
+        T[0] += 0.5
+        sc.check()
+    completed = sum(
+        bool(np.array_equal(h.result().tokens, want))
+        for h, want in zip(handles, base))
+    wedged = sum(1 for h in handles if not h.done())
+
+    # all-quiet: the spare replica drains and retires (the decision
+    # ledger is the evidence — the drain may already have completed
+    # during the serving loop's checks)
+    for _ in range(16):
+        if any(e["action"] == "drain_done"
+               for e in sc.scaling_events):
+            break
+        T[0] += 1.0
+        sc.check()
+    assert any(e["action"] == "drain_done"
+               for e in sc.scaling_events), \
+        [e["action"] for e in sc.scaling_events]
+    retired = [r for r in fleet._replicas if r.retired]
+    assert len(retired) == 1
+    # leaked-gauge audit: the retired engine's label series must be
+    # GONE from the registry, not frozen at their last values
+    lbl = f"engine={retired[0].sup.engine.stats.engine_label}"
+    snap = observe.registry().snapshot()
+    leaked = [k for sec in snap.values() for k in sec if lbl in k]
+    assert not leaked, leaked
+    actions = [e["action"] for e in sc.scaling_events]
+    sc.close()
+    fleet.close()
+
+    report["serve_autoscale"] = {
+        "requests": len(workload),
+        "completed_with_parity": completed,
+        "wedged_or_lost": wedged,
+        "autoscale_faults_injected": pol.fired,
+        "decisions_failed": 1,
+        "scale_ups": actions.count("scale_up"),
+        "scale_downs": actions.count("drain_done"),
+        "actions": actions,
+        "leaked_series": len(leaked),
+    }
+    sa = report["serve_autoscale"]
+    assert sa["wedged_or_lost"] == 0, sa
+    assert sa["completed_with_parity"] == len(workload), sa
+    assert sa["autoscale_faults_injected"] == 1
+    assert sa["scale_ups"] == 1 and sa["scale_downs"] == 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="CHAOS.json", metavar="PATH",
@@ -1085,6 +1199,7 @@ def main():
     chaos_pp(report)
     chaos_fleet(report)
     chaos_disagg(report)
+    chaos_autoscale(report)
 
     health = observe.health_report(include_registry=False)
     report["health"] = health

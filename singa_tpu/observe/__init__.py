@@ -52,5 +52,9 @@ from .trace import (clear, disable, drain, dropped,  # noqa: F401
 from . import monitor  # noqa: F401  (imports trace/registry only)
 from . import requests  # noqa: F401  (per-request lifecycle ledger)
 from .requests import RequestLedger  # noqa: F401
+from . import timeseries  # noqa: F401  (windowed telemetry rings)
+from .timeseries import WindowedFamily, WindowRing  # noqa: F401
+from . import slo  # noqa: F401  (multi-window burn-rate alerting)
+from .slo import BurnRule, SLOPolicy  # noqa: F401
 from . import health  # noqa: F401
 from .health import SLO, health_report  # noqa: F401

@@ -40,7 +40,9 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import re
+import time as _time
 
 from . import trace as _trace
 from .registry import Counter, Histogram, registry as _registry
@@ -225,6 +227,32 @@ def write_chrome_trace(path, events=None, metadata=None,
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 _PREFIX = "singa_tpu_"
 
+# process start (module import — the observe layer loads with the
+# package), the singa_tpu_process_uptime_seconds zero point
+_T0 = _time.monotonic()
+
+
+def _build_info_labels():
+    """(key, value) pairs for the ``singa_tpu_build_info`` gauge:
+    package version, jax version, and the active backend.  Standard
+    scrape-target hygiene — a dashboard joining on build_info can
+    split any regression by deploy.  Backend resolution never
+    INITIALIZES a backend (reads the platform env/config only), so
+    scraping cannot allocate a TPU."""
+    try:
+        from .. import __version__ as ver
+    except Exception:
+        ver = "unknown"
+    try:
+        import jax
+        jver = jax.__version__
+        backend = (os.environ.get("JAX_PLATFORMS")
+                   or os.environ.get("JAX_PLATFORM_NAME") or "auto")
+    except Exception:
+        jver, backend = "absent", "none"
+    return [("version", str(ver)), ("jax", jver),
+            ("backend", backend)]
+
 
 def _prom_name(name: str) -> str:
     n = _NAME_OK.sub("_", name)
@@ -313,7 +341,71 @@ def prometheus_text(reg=None) -> str:
                         pname + "_quantile"
                         + _prom_labels(m.labels, [("quantile", q)])
                         + " " + _prom_num(m.series.percentile(q * 100)))
+    lines.extend(_windowed_lines(reg))
+    # scrape-target hygiene: build identity + process uptime, so any
+    # dashboard can join a regression onto a deploy and rate() the
+    # target's restarts
+    lines.append("# HELP singa_tpu_build_info build identity "
+                 "(version/jax/backend); always 1")
+    lines.append("# TYPE singa_tpu_build_info gauge")
+    lines.append("singa_tpu_build_info"
+                 + _prom_labels(_build_info_labels()) + " 1")
+    lines.append("# HELP singa_tpu_process_uptime_seconds seconds "
+                 "since the observe layer loaded in this process")
+    lines.append("# TYPE singa_tpu_process_uptime_seconds gauge")
+    lines.append("singa_tpu_process_uptime_seconds "
+                 + _prom_num(_time.monotonic() - _T0))
     return "\n".join(lines) + "\n"
+
+
+def _windowed_lines(reg) -> list:
+    """Sibling-gauge exposition for every windowed family
+    (observe.timeseries): ``<name>_rate_60s``-style names, one sample
+    per label set per window, each family with its own HELP/TYPE
+    block.  The all-time families above are untouched — windowed
+    truth rides NEXT TO them, never instead of them."""
+    from .timeseries import _wlabel
+
+    lines = []
+    fams = reg.windowed_families()
+    for name in sorted(fams):
+        wf = fams[name]
+        pname = _prom_name(name)
+        if wf.kind == "histogram":
+            cols = (("rate", "rate", "in-window events per second"),
+                    ("p50", "q50", "nearest-rank p50 over the window"),
+                    ("p99", "q99", "nearest-rank p99 over the window"))
+        elif wf.kind == "gauge":
+            cols = (("mean", "mean", "mean written level over the "
+                                     "window"),)
+        else:
+            cols = (("rate", "rate", "counter growth per second over "
+                                     "the window"),)
+        now = wf.clock()
+        rings = dict(wf.rings)  # scale-ups attach concurrently
+        for col, _, help_ in cols:
+            for w in wf.windows:
+                # _wlabel of a fractional window carries a dot, which
+                # is illegal in a metric NAME (fine in label values) —
+                # sanitize or one bad window poisons the whole scrape
+                fam = _NAME_OK.sub("_",
+                                   f"{pname}_{col}_{_wlabel(w)}s")
+                lines.append(
+                    f"# HELP {fam} windowed sibling of {pname}: "
+                    f"{help_} ({_wlabel(w)}s window)")
+                lines.append(f"# TYPE {fam} gauge")
+                for labels in sorted(rings):
+                    ring = rings[labels]
+                    if col == "rate":
+                        v = ring.rate(w, now)
+                    elif col == "mean":
+                        v = ring.mean(w, now)
+                    else:
+                        v = ring.quantile(
+                            0.5 if col == "p50" else 0.99, w, now)
+                    lines.append(fam + _prom_labels(labels) + " "
+                                 + _prom_num(v))
+    return lines
 
 
 def write_prometheus(path, reg=None) -> str:

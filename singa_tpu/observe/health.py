@@ -32,6 +32,7 @@ from dataclasses import asdict, dataclass
 
 from . import monitor as _monitor
 from . import requests as _requests
+from . import slo as _slo
 from . import trace as _trace
 from .registry import registry as _registry
 
@@ -252,6 +253,10 @@ def _fleet_section(snap: dict) -> dict:
     return {
         "replicas_healthy": _sum_metric(
             gauges, "serve.fleet.replicas_healthy"),
+        # add-only (autoscale round): healthy minus draining/retired —
+        # the set the router admits NEW work to
+        "replicas_routable": _sum_metric(
+            gauges, "serve.fleet.replicas_routable"),
         "failovers": _sum_metric(counters, "serve.fleet.failovers"),
         "requeues": _sum_metric(counters, "serve.fleet.requeues"),
         "hedges": _sum_metric(counters, "serve.fleet.hedges"),
@@ -298,6 +303,48 @@ def _resilience_section(snap_counters: dict) -> dict:
                                       "serve.fleet.requeues"),
         "shed_requests": _by_label(snap_counters,
                                    "serve.shed_requests", "reason"),
+    }
+
+
+def _windowed_section(reg) -> dict:
+    """The top-level ``windowed`` section: every windowed family's
+    per-window aggregates (observe.timeseries).  Always present;
+    ``{"enabled": False}`` until the first
+    ``registry.windowed(name, ...)`` registration — the same
+    unconditional-assert shape as ``why_slow``."""
+    fams = reg.windowed_families()
+    if not fams:
+        return {"enabled": False}
+    return {"enabled": True,
+            "families": {name: fams[name].section()
+                         for name in sorted(fams)}}
+
+
+def _autoscale_section(snap: dict) -> dict:
+    """The ``serve.autoscale`` health section, derived from the
+    ``serve.autoscale.*`` registry family (pure string work, like
+    every serve section — observe never imports the serve layer).
+    ``{"enabled": False}`` until an Autoscaler registers its gauges."""
+    counters, gauges = snap["counters"], snap["gauges"]
+    enabled = any(k == "serve.autoscale.replicas"
+                  or k.startswith("serve.autoscale.replicas{")
+                  for k in gauges)
+    if not enabled:
+        return {"enabled": False}
+    return {
+        "enabled": True,
+        "replicas": _sum_metric(gauges, "serve.autoscale.replicas"),
+        "min_replicas": _sum_metric(gauges,
+                                    "serve.autoscale.min_replicas"),
+        "max_replicas": _sum_metric(gauges,
+                                    "serve.autoscale.max_replicas"),
+        "draining": _sum_metric(gauges, "serve.autoscale.draining"),
+        "scale_ups": _sum_metric(counters,
+                                 "serve.autoscale.scale_ups"),
+        "scale_downs": _sum_metric(counters,
+                                   "serve.autoscale.scale_downs"),
+        "decisions_failed": _sum_metric(
+            counters, "serve.autoscale.decisions_failed"),
     }
 
 
@@ -390,7 +437,19 @@ def health_report(reg=None, engine_snapshots=(),
             # slowest requests into queue/prefill/decode/stall/hop
             # phase components — the "WHY did p99 regress" answer
             "why_slow": _requests.why_slow_section(),
+            # multi-window burn-rate alerting (observe.slo): always
+            # present; {"enabled": False} until an SLOPolicy installs
+            "slo_alerts": _slo.alerts_section(),
+            # signal-driven fleet autoscaling (serve/autoscale.py):
+            # always present; {"enabled": False} until an Autoscaler
+            # registers — derived from the serve.autoscale.* family
+            "autoscale": _autoscale_section(snap),
         },
+        # windowed telemetry (observe.timeseries): rate/quantile over
+        # the last N seconds next to the all-time registry truth —
+        # always present, {"enabled": False} until the first
+        # registry.windowed() registration
+        "windowed": _windowed_section(reg),
         "resilience": _resilience_section(snap["counters"]),
         "watchdog": (
             {"active": True, **wd.summary()} if wd is not None

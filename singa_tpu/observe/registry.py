@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import bisect as _bisect
 import threading
+import time as _time
 
 from ..utils.metrics import LatencySeries
 
@@ -45,7 +46,7 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 
 
 class _Metric:
-    __slots__ = ("name", "labels", "help", "_lock")
+    __slots__ = ("name", "labels", "help", "_lock", "_rings")
 
     KIND = "metric"
 
@@ -57,6 +58,12 @@ class _Metric:
         # bytecodes, and the observe layer promises concurrent use
         # (async-checkpoint writer thread + main loop)
         self._lock = threading.Lock()
+        # windowed-telemetry rings (observe.timeseries), attached by
+        # ``MetricsRegistry.windowed``: every value write appends the
+        # new value.  Empty tuple when no window is registered — the
+        # hot-path cost of the feature being off is one truthiness
+        # check.
+        self._rings = ()
 
     @property
     def key(self):
@@ -80,6 +87,13 @@ class Counter(_Metric):
                              f"(inc({n})); use a Gauge")
         with self._lock:
             self.value += n
+            # inside the lock: two concurrent incs must append their
+            # cumulative samples in value order, or a ring's newest
+            # sample can sit BELOW the true cumulative value and
+            # under-report the window's growth
+            if self._rings:
+                for r in self._rings:
+                    r.append(self.value)
         return self
 
 
@@ -96,16 +110,25 @@ class Gauge(_Metric):
 
     def set(self, v):
         self.value = v
+        if self._rings:
+            for r in self._rings:
+                r.append(v)
         return self
 
     def inc(self, n=1):
         with self._lock:
             self.value += n
+            if self._rings:  # in value order — see Counter.inc
+                for r in self._rings:
+                    r.append(self.value)
         return self
 
     def dec(self, n=1):
         with self._lock:
             self.value -= n
+            if self._rings:  # in value order — see Counter.inc
+                for r in self._rings:
+                    r.append(self.value)
         return self
 
 
@@ -115,7 +138,7 @@ class Histogram(_Metric):
     for the Prometheus ``_bucket{le=...}`` exposition (+Inf is
     implicit); defaults to :data:`DEFAULT_BUCKETS`."""
 
-    __slots__ = ("series", "buckets", "_bins", "_bin_idx")
+    __slots__ = ("series", "buckets", "_bins")
 
     KIND = "histogram"
 
@@ -132,13 +155,23 @@ class Histogram(_Metric):
                     f"buckets must be non-empty, strictly increasing, "
                     f"got {buckets}")
             self.buckets = b
-        # per-ladder-bin counts, filled INCREMENTALLY on the read side
-        # (bucket_counts): adopters record into the series directly
+        # per-ladder-bin counts, filled AT RECORD TIME through the
+        # series' hook seam: adopters record into the series directly
         # (EngineStats), so observe() cannot be the binning point, and
-        # re-binning the whole history per scrape would make scrape
-        # cost grow with uptime
+        # the series' retained-value ring is BOUNDED (values age out),
+        # so a read-side catch-up could miss evicted values.  A
+        # record-time hook is O(log buckets) per event and keeps the
+        # cumulative bins exact over all time — the Prometheus
+        # histogram contract — regardless of the retained window.
         self._bins = [0] * len(self.buckets)
-        self._bin_idx = 0
+        for v in self.series.values:  # adopt pre-existing samples
+            self._bin(v)
+        self.series.add_hook(self._bin)
+
+    def _bin(self, v):
+        i = _bisect.bisect_left(self.buckets, v)
+        if i < len(self._bins):
+            self._bins[i] += 1
 
     def observe(self, v):
         self.series.record(v)
@@ -150,19 +183,12 @@ class Histogram(_Metric):
 
     def bucket_counts(self) -> list:
         """Cumulative ``(le, count)`` pairs, ending with ``(inf,
-        count)``.  Each call bins only the values APPENDED since the
-        last call (O(new * log buckets), so a scrape's cost does not
-        grow with process uptime), keeping the bins cumulative over
-        all time — the Prometheus histogram contract — even if the
-        retained value window is ever bounded.  The +Inf bucket uses
+        count)``.  Bins are maintained at record time (O(log buckets)
+        per event), so a scrape's cost does not grow with process
+        uptime and the bins stay cumulative over all time even though
+        the retained value window is bounded.  The +Inf bucket uses
         the series' RUNNING count (same source as ``_count``), so
         ``x_bucket{le="+Inf"} == x_count`` always holds."""
-        vals = self.series.values
-        while self._bin_idx < len(vals):
-            i = _bisect.bisect_left(self.buckets, vals[self._bin_idx])
-            if i < len(self._bins):
-                self._bins[i] += 1
-            self._bin_idx += 1
         out, c = [], 0
         for le, n in zip(self.buckets, self._bins):
             c += n
@@ -184,6 +210,7 @@ class MetricsRegistry:
     def __init__(self):
         self._metrics = {}
         self._kinds = {}  # name -> metric class (one kind per name)
+        self._windowed = {}  # name -> timeseries.WindowedFamily
         self._lock = threading.Lock()
 
     def _get_or_create(self, cls, name, labels, help, **kw):
@@ -204,6 +231,12 @@ class MetricsRegistry:
                 m = cls(name, key[1], help=help, **kw)
                 self._metrics[key] = m
                 self._kinds[name] = cls
+                wf = self._windowed.get(name)
+                if wf is not None:
+                    # the family pre-dates this label set (a fleet
+                    # scale-up registering a new engine's counters):
+                    # windowing follows the name, not the moment
+                    wf._attach(m)
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {name!r}{dict(key[1])} already registered "
@@ -226,6 +259,62 @@ class MetricsRegistry:
         return self._get_or_create(Histogram, name, labels, help,
                                    series=series, buckets=buckets)
 
+    def windowed(self, name, windows=None, capacity=None,
+                 clock=None) -> "WindowedFamily":
+        """Attach windowed telemetry (observe.timeseries) to every
+        metric named ``name`` — current AND future label sets — and
+        return the :class:`~singa_tpu.observe.timeseries
+        .WindowedFamily` (get-or-create: asking again for the same
+        name returns the SAME family; windows/capacity/clock are
+        first-registration-wins, like histogram buckets).
+
+        >>> wf = registry().windowed("serve.completed", windows=(60,))
+        >>> wf.rate(60)      # completions/s over the last minute
+
+        The family's values ride ``export.prometheus_text`` as sibling
+        ``<name>_rate_60s``-style gauges and
+        ``health_report()["windowed"]``; the all-time family is
+        untouched.  Memory: one bounded ring per label set, O(ring)
+        forever."""
+        from .timeseries import (DEFAULT_RING_CAPACITY,
+                                 DEFAULT_WINDOWS, WindowedFamily)
+
+        with self._lock:
+            wf = self._windowed.get(name)
+            if wf is None:
+                kind = self._kinds.get(name)
+                wf = WindowedFamily(
+                    name,
+                    kind.KIND if kind is not None else None,
+                    windows=(windows if windows is not None
+                             else DEFAULT_WINDOWS),
+                    capacity=(capacity if capacity is not None
+                              else DEFAULT_RING_CAPACITY),
+                    clock=clock if clock is not None else _time.monotonic)
+                self._windowed[name] = wf
+                for (n, _), m in self._metrics.items():
+                    if n == name:
+                        wf._attach(m)
+            return wf
+
+    def windowed_families(self) -> dict:
+        """``{name: WindowedFamily}`` of every windowed registration
+        (the health report's ``windowed`` section source)."""
+        with self._lock:
+            return dict(self._windowed)
+
+    def unwindow(self, name):
+        """Drop a windowed family (tests, policy teardown).  The
+        attached counter/gauge rings stop being read and are dropped;
+        histogram series hooks are detached."""
+        with self._lock:
+            wf = self._windowed.pop(name, None)
+            if wf is None:
+                return
+            for (n, _), m in self._metrics.items():
+                if n == name:
+                    wf._detach_metric(m)
+
     def metrics(self) -> list:
         """All registered metrics, in stable (name, labels) order."""
         with self._lock:
@@ -236,10 +325,17 @@ class MetricsRegistry:
         ``serve.*`` set — see ``EngineStats.unregister``) so a
         process-lifetime registry doesn't pin dead subsystems'
         histograms forever.  Unknown metrics are ignored.  A name
-        whose last metric is removed frees its kind reservation too."""
+        whose last metric is removed frees its kind reservation too.
+        Windowed rings attached to the removed metrics are detached
+        with them — a retired engine's windowed series disappears
+        instead of freezing at its last value (the scale-down
+        leaked-gauge contract)."""
         with self._lock:
             for m in metrics:
                 self._metrics.pop(m.key, None)
+                wf = self._windowed.get(m.name)
+                if wf is not None:
+                    wf._detach_metric(m)
             names = {name for name, _ in self._metrics}
             for name in [n for n in self._kinds if n not in names]:
                 del self._kinds[name]
@@ -248,6 +344,7 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
             self._kinds.clear()
+            self._windowed.clear()
 
     def snapshot(self) -> dict:
         """JSON-able view: ``{"counters": {...}, "gauges": {...},

@@ -51,6 +51,11 @@ SITES = (
     "serve.prefix_copy",   # prefix-cache pool<->slot block copies
     "serve.route",         # fleet router admission (ServeFleet.submit)
     "serve.kv_ship",       # disaggregated KV ship (export + import)
+    "serve.autoscale",     # autoscaler scale-up/retire actions
+    #                        (serve/autoscale.py — checked BEFORE any
+    #                        replica construction or registration, so
+    #                        a fired fault abandons the DECISION typed
+    #                        and the fleet keeps serving)
     "io.binfile",          # BinFile record read/write
     "train.step",          # _GraphRunner step dispatch
 )

@@ -204,10 +204,17 @@ class Router:
 
 
 class _Replica:
-    """Fleet-side bookkeeping for one supervised engine replica."""
+    """Fleet-side bookkeeping for one supervised engine replica.
+
+    ``draining``: excluded from NEW routing but still driven every
+    step (it finishes its live work — the scale-down half-state).
+    ``retired``: drained and closed by ``retire_replica`` — its
+    engine's ``serve.*{engine=n}`` metrics are unregistered (the
+    frozen-gauge fix) and it is skipped by health/snapshot until
+    ``revive()`` reuses the slot."""
 
     __slots__ = ("idx", "sup", "healthy", "needs_failover",
-                 "down_error")
+                 "down_error", "draining", "retired")
 
     def __init__(self, idx, sup):
         self.idx = idx
@@ -215,6 +222,8 @@ class _Replica:
         self.healthy = True
         self.needs_failover = False
         self.down_error = None
+        self.draining = False
+        self.retired = False
 
 
 class _Route:
@@ -358,7 +367,12 @@ class ServeFleet:
         lbl = dict(fleet=self.fleet_label)
         self._g_healthy = reg.gauge(
             "serve.fleet.replicas_healthy",
-            help="replicas the router currently admits to", **lbl)
+            help="replicas currently healthy (draining included — "
+                 "they still serve their live work)", **lbl)
+        self._g_routable = reg.gauge(
+            "serve.fleet.replicas_routable",
+            help="replicas the router currently admits NEW work to "
+                 "(healthy minus draining/retired)", **lbl)
         self._c_routed, self._c_failovers = [], []
         self._c_requeues, self._c_hedges = [], []
         for i in range(replicas):
@@ -397,7 +411,8 @@ class ServeFleet:
             help="ships abandoned mid-flight (fault, capacity, "
                  "failover): the request was requeued cold-but-"
                  "correct, never lost", **lbl)
-        self._registered = ([self._g_healthy] + self._c_routed
+        self._registered = ([self._g_healthy, self._g_routable]
+                            + self._c_routed
                             + self._c_failovers + self._c_requeues
                             + self._c_hedges
                             + [self._c_ships, self._c_ship_bytes,
@@ -407,7 +422,7 @@ class ServeFleet:
             _Replica(i, EngineSupervisor(model, **self._sup_kw,
                                          **self._replica_kw(i)))
             for i in range(replicas)]
-        self._g_healthy.set(replicas)
+        self._refresh_gauges()
         # fleet-owned completion routing (the supervisor pattern, one
         # level up: routes resolve across restarts AND failovers)
         self._routes = {}        # request_id -> _Route
@@ -454,11 +469,27 @@ class ServeFleet:
     # -- introspection ---------------------------------------------------
     @property
     def replicas(self) -> int:
+        """Replica slots (retired ones included — a retired slot can
+        be revived, so it still counts as capacity)."""
         return len(self._replicas)
 
     @property
     def healthy_replicas(self) -> int:
         return sum(r.healthy for r in self._replicas)
+
+    @staticmethod
+    def _routable(rep) -> bool:
+        """True when the router may send NEW work here: healthy, not
+        retired, not draining toward a scale-down."""
+        return rep.healthy and not rep.draining and not rep.retired
+
+    @property
+    def routable_replicas(self) -> int:
+        return sum(self._routable(r) for r in self._replicas)
+
+    def _refresh_gauges(self):
+        self._g_healthy.set(self.healthy_replicas)
+        self._g_routable.set(self.routable_replicas)
 
     @property
     def pending(self) -> bool:
@@ -470,12 +501,18 @@ class ServeFleet:
         return self._replicas[idx].sup
 
     def health(self) -> dict:
-        """Per-replica health view: the router's input plus status."""
+        """Per-replica health view: the router's input plus status.
+        Retired replicas are DROPPED (their engines are closed and
+        their metrics unregistered — a scale-down must not leave a
+        frozen per-replica row behind)."""
         out = {}
         for rep in self._replicas:
+            if rep.retired:
+                continue
             eng = rep.sup.engine
             out[rep.idx] = {
                 "healthy": rep.healthy,
+                "draining": rep.draining,
                 "restarts": rep.sup.restarts,
                 "queue_depth": (eng.scheduler.queue_depth
                                 if not eng._closed else 0),
@@ -484,11 +521,27 @@ class ServeFleet:
             }
         return out
 
+    def load_views(self) -> list:
+        """The router-signal views the fleet itself routes on (queue
+        depth, occupancy, tpot_ewma, blocks_used_frac, draining flag),
+        one per non-retired healthy replica — the autoscaler's input
+        surface (serve/autoscale.py)."""
+        return [self._view(r) for r in self._replicas
+                if r.healthy and not r.retired]
+
     def snapshot(self) -> dict:
-        """Fleet-level stats (bench_serve's ``fleet`` section)."""
+        """Fleet-level stats (bench_serve's ``fleet`` section).
+        Retired replicas keep their lifetime ``routed`` counts (the
+        fleet-labeled counters are fleet-lifetime) but contribute no
+        ``engines`` entry — their engine metrics are unregistered."""
         return {
-            "replicas": len(self._replicas),
+            "replicas": sum(not r.retired for r in self._replicas),
             "replicas_healthy": self.healthy_replicas,
+            # add-only (autoscale round): scale-state visibility
+            "replicas_routable": self.routable_replicas,
+            "replicas_draining": sum(r.draining
+                                     for r in self._replicas),
+            "replicas_retired": sum(r.retired for r in self._replicas),
             "roles": list(self.roles),
             "failovers": sum(c.value for c in self._c_failovers),
             "requeues": sum(c.value for c in self._c_requeues),
@@ -500,7 +553,7 @@ class ServeFleet:
             "shared_prefix_hits": self._c_shared_hits.value,
             "ship_fallbacks": self._c_ship_fallbacks.value,
             "engines": [rep.sup.engine.stats.snapshot()
-                        for rep in self._replicas],
+                        for rep in self._replicas if not rep.retired],
         }
 
     # -- admission -------------------------------------------------------
@@ -646,11 +699,11 @@ class ServeFleet:
         if sess is not None:
             idx = self._sessions.get(sess)
             if (idx is not None and idx not in exclude
-                    and self._replicas[idx].healthy):
+                    and self._routable(self._replicas[idx])):
                 out.append(idx)
         if (prefer is not None and prefer not in exclude
                 and prefer not in out
-                and self._replicas[prefer].healthy):
+                and self._routable(self._replicas[prefer])):
             out.append(prefer)
         views = [self._view(self._replicas[i])
                  for i in self._decode_pool(exclude)
@@ -665,11 +718,16 @@ class ServeFleet:
         1-replica, all-prefill, or dead-decode-side fleet still
         serves every request, cold but correct)."""
         out = [r.idx for r in self._replicas
-               if r.healthy and r.idx not in exclude
+               if self._routable(r) and r.idx not in exclude
                and self.roles[r.idx] != "prefill"]
         if not out:
+            # degenerate-fleet fallback: a draining replica still
+            # beats refusing traffic (drain is a preference, not a
+            # correctness rule), but a retired one is CLOSED — never
+            # a candidate
             out = [r.idx for r in self._replicas
-                   if r.healthy and r.idx not in exclude]
+                   if r.healthy and not r.retired
+                   and r.idx not in exclude]
         return out
 
     def _view(self, rep) -> dict:
@@ -683,6 +741,9 @@ class ServeFleet:
         return {
             "replica": rep.idx,
             "role": self.roles[rep.idx],
+            # scale-down half-state: still serving its live work but
+            # closed to new routing (the autoscaler reads this)
+            "draining": rep.draining,
             "queue_depth": depth,
             "occupancy": eng.live_slots / eng.max_slots,
             "tpot_ewma": eng.stats.tpot_ewma,
@@ -769,13 +830,15 @@ class ServeFleet:
         rep.healthy = False
         rep.needs_failover = True
         rep.down_error = error
+        rep.draining = False  # a dying drain is a failover, not a
+        #                       scale-down — the autoscaler re-derives
         if self._prefix_index is not None:
             # the replica's tree dies with it: forget its residency
             # records (stale hints would only cost a failed verify,
             # but dropping them keeps holder scans tight)
             self._prefix_index.drop_replica(rep.idx)
         self._c_failovers[rep.idx].inc()
-        self._g_healthy.set(self.healthy_replicas)
+        self._refresh_gauges()
         self._log.error(
             "replica %d failed out of the fleet (%r); %d/%d healthy",
             rep.idx, error, self.healthy_replicas, len(self._replicas))
@@ -874,11 +937,12 @@ class ServeFleet:
             "healthy siblings", rep.idx)
 
     def revive(self, idx):
-        """Bring a failed replica back: release the dead engine, build
-        a fresh supervisor (fresh restart budget, empty prefix cache —
-        cold but correct; same compiled shapes, so reviving costs an
-        arena allocation, not a recompile), and re-enter the routing
-        set."""
+        """Bring a failed OR retired replica back: release the dead
+        engine, build a fresh supervisor (fresh restart budget, empty
+        prefix cache — cold but correct; same compiled shapes, so
+        reviving costs an arena allocation, not a recompile), and
+        re-enter the routing set.  The autoscaler's scale-up reuses
+        retired slots through exactly this path."""
         rep = self._replicas[idx]
         if rep.healthy:
             raise ValueError(f"replica {idx} is healthy")
@@ -889,11 +953,151 @@ class ServeFleet:
         rep.healthy = True
         rep.needs_failover = False
         rep.down_error = None
-        self._g_healthy.set(self.healthy_replicas)
+        rep.draining = False
+        rep.retired = False
+        self._refresh_gauges()
         self._log.info("replica %d revived; %d/%d healthy", idx,
                        self.healthy_replicas, len(self._replicas))
         _trace.event("serve/fleet_revive", cat="serve", replica=idx,
                      healthy=self.healthy_replicas)
+
+    # -- elastic capacity (serve/autoscale.py drives these) --------------
+    def add_replica(self, role="mixed") -> int:
+        """Scale-up: append a brand-new supervised replica and admit
+        it to the routing set; returns its index.  Identical statics
+        mean the spawn is a COMPILE-CACHE HIT (module-wide twin/jit
+        caches — the bench_serve recompile pin covers it); the cost is
+        an arena allocation.  Sharded fleets (tp/ep/pp) pin their
+        device groups at construction and cannot grow — scale those by
+        reviving retired slots only."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        if self._tp_cfgs is not None:
+            raise ValueError(
+                f"cannot add a replica to a {self._par_key}-sharded "
+                f"fleet: device groups were partitioned at "
+                f"construction; size it max_replicas-wide up front and "
+                f"scale by drain/revive (docs/SERVING.md "
+                f"'Autoscaling')")
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"unknown role {role!r}: 'prefill', 'decode' or "
+                f"'mixed'")
+        if role != "mixed" and not self._disagg \
+                and all(r == "mixed" for r in self.roles):
+            raise ValueError(
+                f"role={role!r} on a symmetric fleet: role-typed "
+                f"replicas need the fleet built with roles= (the ship "
+                f"machinery is wired at construction)")
+        idx = len(self._replicas)
+        # build the supervisor BEFORE registering anything fleet-side:
+        # a raising constructor must not leave half a replica behind
+        # (the engine's own metrics unwind through its failure paths;
+        # the fleet counters below are get-or-create and cannot raise)
+        sup = EngineSupervisor(self._model, **self._sup_kw,
+                               **self._replica_kw(idx))
+        reg = self._reg
+        rl = dict(fleet=self.fleet_label, replica=str(idx))
+        new_counters = [
+            reg.counter("serve.fleet.routed",
+                        help="requests admitted to this replica",
+                        **rl),
+            reg.counter("serve.fleet.failovers",
+                        help="times this replica was failed out of "
+                             "the routing set", **rl),
+            reg.counter("serve.fleet.requeues",
+                        help="never-started requests moved OFF this "
+                             "replica onto healthy siblings", **rl),
+            reg.counter("serve.fleet.hedges",
+                        help="hedged re-dispatches admitted TO this "
+                             "replica", **rl),
+        ]
+        self._c_routed.append(new_counters[0])
+        self._c_failovers.append(new_counters[1])
+        self._c_requeues.append(new_counters[2])
+        self._c_hedges.append(new_counters[3])
+        self._registered.extend(new_counters)
+        self.roles = self.roles + (role,)
+        self._replicas.append(_Replica(idx, sup))
+        self._refresh_gauges()
+        self._log.info("replica %d added (%s); %d/%d healthy", idx,
+                       role, self.healthy_replicas,
+                       len(self._replicas))
+        _trace.event("serve/fleet_add_replica", cat="serve",
+                     replica=idx, role=role,
+                     healthy=self.healthy_replicas)
+        return idx
+
+    def start_drain(self, idx):
+        """Scale-down, phase 1: stop routing NEW work to the replica.
+        It keeps stepping until its live requests finish
+        (:meth:`drained`), then :meth:`retire_replica` closes it.
+        Sticky sessions fall back to normal routing (cold but
+        correct)."""
+        rep = self._replicas[idx]
+        if not rep.healthy or rep.retired:
+            raise ValueError(f"replica {idx} is not serving")
+        if rep.draining:
+            return
+        rep.draining = True
+        self._refresh_gauges()
+        self._log.info("replica %d draining (routable %d/%d)", idx,
+                       self.routable_replicas, len(self._replicas))
+        _trace.event("serve/fleet_drain_begin", cat="serve",
+                     replica=idx, routable=self.routable_replicas)
+
+    def cancel_drain(self, idx):
+        """Abort a drain (load came back before the replica emptied):
+        the replica re-enters the routing set with its state intact —
+        the cheapest possible scale-up."""
+        rep = self._replicas[idx]
+        if not rep.draining:
+            raise ValueError(f"replica {idx} is not draining")
+        rep.draining = False
+        self._refresh_gauges()
+        _trace.event("serve/fleet_drain_cancel", cat="serve",
+                     replica=idx, routable=self.routable_replicas)
+
+    def drained(self, idx) -> bool:
+        """True when a draining replica holds no work: no queued or
+        live requests, and no ship build sourcing from it."""
+        rep = self._replicas[idx]
+        return (not rep.sup.pending
+                and all(s.src != idx for s in self._ship_jobs))
+
+    def retire_replica(self, idx):
+        """Scale-down, phase 2: close a drained replica.  The close
+        routes through ``EngineStats.unregister()`` (engine.close →
+        _release_everything), so every ``serve.*{engine=n}`` series —
+        gauges included — leaves the registry with the replica instead
+        of freezing at its last value, and the health report's
+        per-replica sections drop it (the leaked-gauge audit in
+        tests/test_autoscale.py pins this).  The slot stays in
+        ``_replicas`` so a later scale-up can ``revive()`` it on the
+        same pinned config."""
+        rep = self._replicas[idx]
+        if rep.retired:
+            return
+        if not rep.draining:
+            raise ValueError(
+                f"replica {idx} is not draining; start_drain() first "
+                f"(retire without drain would abandon live requests)")
+        if not self.drained(idx):
+            raise RuntimeError(
+                f"replica {idx} still holds work (queue="
+                f"{rep.sup.engine.scheduler.queue_depth}, live="
+                f"{rep.sup.engine.live_slots}); wait for drained()")
+        rep.sup.close()  # drained: the non-force close asserts it
+        rep.retired = True
+        rep.healthy = False
+        rep.draining = False
+        if self._prefix_index is not None:
+            self._prefix_index.drop_replica(idx)
+        self._refresh_gauges()
+        self._log.info("replica %d retired; %d/%d serving", idx,
+                       self.routable_replicas, len(self._replicas))
+        _trace.event("serve/fleet_retire", cat="serve", replica=idx,
+                     routable=self.routable_replicas)
 
     # -- disaggregated prefill/decode: KV shipping -----------------------
     def _ship_eligible(self, request) -> bool:
@@ -918,10 +1122,10 @@ class ServeFleet:
             # QueueFullError/LoadShedError, never unbounded host
             # growth behind the specialists)
             return False
-        if not any(r.healthy and self.roles[r.idx] == "prefill"
+        if not any(self._routable(r) and self.roles[r.idx] == "prefill"
                    for r in self._replicas):
             return False
-        return any(r.healthy and self.roles[r.idx] != "prefill"
+        return any(self._routable(r) and self.roles[r.idx] != "prefill"
                    for r in self._replicas)
 
     def _ship_queue_max(self) -> int:
@@ -978,7 +1182,8 @@ class ServeFleet:
         if idx is not None:
             return idx
         views = [self._view(r) for r in self._replicas
-                 if r.healthy and self.roles[r.idx] == "prefill"]
+                 if self._routable(r)
+                 and self.roles[r.idx] == "prefill"]
         return self.router.rank_prefill(views)[0]
 
     def _enqueue_ship(self, request, route):
@@ -1204,10 +1409,10 @@ class ServeFleet:
         prefix is byte-identical), else serve cold."""
         self._abandon_build(sjob)
         have_prefill = any(
-            r.healthy and self.roles[r.idx] == "prefill"
+            self._routable(r) and self.roles[r.idx] == "prefill"
             for r in self._replicas)
         have_decode = any(
-            r.healthy and self.roles[r.idx] != "prefill"
+            self._routable(r) and self.roles[r.idx] != "prefill"
             for r in self._replicas)
         if have_prefill and have_decode:
             sjob.src = self._pick_ship_src(sjob.request)

@@ -4,6 +4,7 @@ computes these inline in example scripts)."""
 
 from __future__ import annotations
 
+import collections as _collections
 import time
 
 
@@ -74,27 +75,72 @@ def percentile(values, p) -> float:
     return float(vals[min(len(vals), max(1, rank)) - 1])
 
 
+#: default bound on retained raw samples per series.  ~8k float
+#: samples keep RSS flat over multi-hour soaks (the previous unbounded
+#: list grew linearly with uptime) while a nearest-rank p99 over the
+#: retained ring still rests on ~80 tail observations.
+DEFAULT_MAX_SAMPLES = 8192
+
+
 class LatencySeries:
     """Accumulates per-event latencies (seconds) and summarizes them in
     the schema serving metrics report everywhere: count/mean/p50/p99/
     max.  Used by serve/stats.py for TTFT and TPOT; generic enough for
-    any per-event timing."""
+    any per-event timing.
 
-    def __init__(self):
-        self.values = []
+    MEMORY BOUND: raw samples are retained in a RING of the newest
+    ``max_samples`` (default :data:`DEFAULT_MAX_SAMPLES`) so a
+    process-lifetime series cannot grow RSS with uptime.  The running
+    ``total_sum``/``count`` pair stays EXACT over every value ever
+    recorded (the Prometheus ``_sum``/``_count`` contract), and the
+    observe registry's Histogram bins each value into its cumulative
+    bucket ladder AT RECORD TIME (via :meth:`add_hook`), so exported
+    bucket counts stay exact running totals too.  Only the summary
+    percentiles/mean/max degrade once the ring wraps: they describe
+    the retained window — the newest ~8k events — which is the honest
+    approximation for an all-time p99 nobody can store (documented in
+    docs/OBSERVABILITY.md; WINDOWED quantiles come from the observe
+    timeseries rings, which carry timestamps).
+    """
+
+    def __init__(self, max_samples=DEFAULT_MAX_SAMPLES):
+        if max_samples is not None and max_samples < 1:
+            raise ValueError(
+                f"max_samples must be >= 1 or None, got {max_samples}")
+        self.max_samples = max_samples
+        self.values = _collections.deque(maxlen=max_samples)
         # running totals over EVERY recorded value, maintained
-        # separately from ``values`` so that if the retained window is
-        # ever bounded/evicted, the Prometheus ``_sum``/``_count`` pair
-        # (export.prometheus_text) stays mutually consistent instead of
-        # pairing an all-time count with a windowed sum
+        # separately from ``values`` so the bounded retained window
+        # never makes the Prometheus ``_sum``/``_count`` pair
+        # (export.prometheus_text) pair an all-time count with a
+        # windowed sum
         self.total_sum = 0.0
         self._total_count = 0
+        # record-time observers (observe.registry.Histogram bucket
+        # binning, observe.timeseries window rings): called with each
+        # recorded float AFTER the totals update.  A tuple, not a
+        # list: the hot path's ``for h in self._hooks`` over an empty
+        # tuple is the whole disabled cost.
+        self._hooks = ()
+
+    def add_hook(self, fn):
+        """Register ``fn(value: float)`` to observe every future
+        ``record`` (the seam the registry Histogram and the windowed
+        timeseries rings attach through — adopters of a series record
+        into it directly, so ``record`` is the only point that sees
+        every value exactly once)."""
+        self._hooks = self._hooks + (fn,)
+
+    def remove_hook(self, fn):
+        self._hooks = tuple(h for h in self._hooks if h is not fn)
 
     def record(self, seconds: float):
         v = float(seconds)
         self.values.append(v)
         self.total_sum += v
         self._total_count += 1
+        for h in self._hooks:
+            h(v)
 
     @property
     def count(self) -> int:
@@ -108,7 +154,9 @@ class LatencySeries:
         return percentile(self.values, p)
 
     def summary(self) -> dict:
-        """Stable-schema dict (tests assert the exact key set)."""
+        """Stable-schema dict (tests assert the exact key set).
+        ``count`` is the exact all-time total; mean/percentiles/max
+        describe the retained ring (see class docstring)."""
         return {
             "count": self.count,
             "mean": self.mean(),

@@ -1,0 +1,422 @@
+"""Fleet autoscaling (serve/autoscale.py + the ServeFleet elastic
+surface): the decision table threadless under a fake clock and fake
+fleet, plus live-fleet integration (spawn = compile-cache reuse,
+drain/retire, the scale-down leaked-gauge audit, the serve.autoscale
+fault site)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.observe import health_report
+from singa_tpu.observe.registry import MetricsRegistry, registry
+from singa_tpu.resilience import FailOnce, faults
+from singa_tpu.serve import (AutoscaleConfig, Autoscaler,
+                             GenerationRequest, ServeFleet)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _FakeReplica:
+    def __init__(self, idx):
+        self.idx = idx
+        self.healthy = True
+        self.draining = False
+        self.retired = False
+
+
+class _FakePolicy:
+    """Just the .alerts surface the autoscaler reads."""
+
+    def __init__(self):
+        self.alerts = {"page": {"firing": False}}
+
+    def fire(self, on=True):
+        self.alerts["page"]["firing"] = on
+
+
+class FakeFleet:
+    """Duck-typed ServeFleet surface the Autoscaler consumes — the
+    decision table runs with zero engines."""
+
+    def __init__(self, n=1, load=None):
+        self.fleet_label = "t"
+        self._clock = None
+        self._replicas = [_FakeReplica(i) for i in range(n)]
+        self.load = load if load is not None else {}
+        self.log = []
+        self.drained_set = set()
+
+    @property
+    def replicas(self):
+        return len(self._replicas)
+
+    def load_views(self):
+        out = []
+        for r in self._replicas:
+            if not (r.healthy and not r.retired):
+                continue
+            v = {"replica": r.idx, "role": "mixed",
+                 "draining": r.draining, "queue_depth": 0,
+                 "occupancy": 0.0, "tpot_ewma": None,
+                 "queue_headroom": None, "blocks_used_frac": None}
+            v.update(self.load.get(r.idx, {}))
+            out.append(v)
+        return out
+
+    def add_replica(self, role="mixed"):
+        idx = len(self._replicas)
+        self._replicas.append(_FakeReplica(idx))
+        self.log.append(("add", idx))
+        return idx
+
+    def revive(self, idx):
+        r = self._replicas[idx]
+        r.healthy, r.retired, r.draining = True, False, False
+        self.log.append(("revive", idx))
+
+    def start_drain(self, idx):
+        self._replicas[idx].draining = True
+        self.log.append(("drain", idx))
+
+    def cancel_drain(self, idx):
+        self._replicas[idx].draining = False
+        self.log.append(("cancel", idx))
+
+    def drained(self, idx):
+        return idx in self.drained_set
+
+    def retire_replica(self, idx):
+        r = self._replicas[idx]
+        r.retired, r.healthy, r.draining = True, False, False
+        self.log.append(("retire", idx))
+
+
+def _scaler(fleet, clk, reg=None, policy=None, **kw):
+    cfg = dict(min_replicas=1, max_replicas=3,
+               scale_up_cooldown_s=10.0, scale_down_cooldown_s=30.0,
+               queue_high=4.0, queue_low=0.5, occupancy_high=0.85,
+               occupancy_low=0.35, blocks_high=0.85)
+    cfg.update(kw)
+    return Autoscaler(fleet, AutoscaleConfig(**cfg),
+                      slo_policy=policy, clock=clk,
+                      reg=reg if reg is not None else MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# decision table (threadless, fake fleet)
+# ---------------------------------------------------------------------------
+
+def test_scale_up_on_burn_alert():
+    clk, pol = FakeClock(), _FakePolicy()
+    fleet = FakeFleet(1)
+    sc = _scaler(fleet, clk, policy=pol)
+    assert sc.check() is None           # quiet + at min: hold
+    pol.fire()
+    ev = sc.check()
+    assert ev["action"] == "scale_up"
+    assert ev["reason"].startswith("slo_burn:page")
+    assert fleet.log == [("add", 1)]
+    # the ledger carries the signal snapshot that justified it
+    assert ev["signals"]["alerts_firing"] == ["page"]
+
+
+def test_scale_up_on_load_signals_and_cooldown_no_flap():
+    clk = FakeClock()
+    fleet = FakeFleet(1, load={0: {"queue_depth": 9}})
+    sc = _scaler(fleet, clk)
+    assert sc.check()["action"] == "scale_up"
+    fleet.load[1] = {"queue_depth": 9}
+    # still hot, but inside the up-cooldown: no flapping
+    clk.advance(5.0)
+    assert sc.check() is None
+    clk.advance(5.0)
+    assert sc.check()["action"] == "scale_up"
+    # at max_replicas: never scales past the ceiling
+    fleet.load[2] = {"queue_depth": 9}
+    clk.advance(20.0)
+    assert sc.check() is None
+    assert fleet.replicas == 3
+
+
+def test_scale_up_prefers_reviving_a_retired_slot():
+    clk = FakeClock()
+    fleet = FakeFleet(2)
+    fleet._replicas[1].retired = True
+    fleet._replicas[1].healthy = False
+    fleet.load = {0: {"queue_depth": 9}}
+    sc = _scaler(fleet, clk)
+    ev = sc.check()
+    assert ev["action"] == "scale_up" and "via=revive" in ev["reason"]
+    assert fleet.log == [("revive", 1)]
+
+
+def test_scale_up_on_kv_block_pressure():
+    clk = FakeClock()
+    fleet = FakeFleet(1, load={0: {"blocks_used_frac": 0.95}})
+    sc = _scaler(fleet, clk)
+    ev = sc.check()
+    assert ev["action"] == "scale_up" and "kv_blocks" in ev["reason"]
+
+
+def test_scale_down_only_when_quiet_and_drained():
+    clk, pol = FakeClock(), _FakePolicy()
+    fleet = FakeFleet(2)
+    sc = _scaler(fleet, clk, policy=pol, max_replicas=2)
+    # a firing alert blocks scale-down (and at max_replicas there is
+    # no up to take either — the fleet holds)
+    pol.fire()
+    assert sc.check() is None
+    pol.fire(False)
+    ev = sc.check()
+    assert ev["action"] == "drain_begin"
+    idx = ev["replica"]
+    assert fleet._replicas[idx].draining
+    # NOT retired until the replica actually drains
+    assert sc.check() is None
+    assert not any(r.retired for r in fleet._replicas)
+    fleet.drained_set.add(idx)
+    ev = sc.check()
+    assert ev["action"] == "drain_done"
+    assert fleet._replicas[idx].retired
+    # one drain at a time + down-cooldown: the second replica holds
+    assert sc.check() is None
+
+
+def test_scale_down_blocked_by_cooldowns_and_min():
+    clk = FakeClock()
+    fleet = FakeFleet(2, load={0: {"queue_depth": 9}})
+    sc = _scaler(fleet, clk, min_replicas=2)
+    sc.check()  # scale_up at t=0 -> 3 serving
+    fleet.load = {}
+    # quiet immediately after a scale-up: the down-embargo holds
+    clk.advance(10.0)
+    assert sc.check() is None
+    clk.advance(30.0)
+    ev = sc.check()
+    assert ev is not None and ev["action"] == "drain_begin"
+    fleet.drained_set.add(ev["replica"])
+    sc.check()  # drain_done -> back to 2 serving
+    # min_replicas floor: 2 serving == min, no further drain however
+    # long it stays quiet
+    clk.advance(100.0)
+    assert sc.check() is None
+    assert sum(1 for r in fleet._replicas
+               if r.healthy and not r.retired) == 2  # 3 - 1 retired
+
+
+def test_burst_during_drain_cancels_it():
+    clk = FakeClock()
+    fleet = FakeFleet(2)
+    sc = _scaler(fleet, clk)
+    ev = sc.check()
+    assert ev["action"] == "drain_begin"
+    idx = ev["replica"]
+    fleet.load = {i: {"queue_depth": 9} for i in (0, 1)}
+    ev = sc.check()
+    assert ev["action"] == "drain_cancelled" and ev["replica"] == idx
+    assert not fleet._replicas[idx].draining
+    # the cancel counted as the scale-up (cooldown armed)
+    assert sc.check() is None
+
+
+def test_autoscale_fault_site_abandons_decision_typed():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    fleet = FakeFleet(1, load={0: {"queue_depth": 9}})
+    sc = _scaler(fleet, clk, reg=reg)
+    faults.inject("serve.autoscale", FailOnce())
+    ev = sc.check()
+    assert ev["action"] == "scale_up_failed" and "error" in ev
+    assert fleet.replicas == 1 and fleet.log == []
+    assert reg.counter("serve.autoscale.decisions_failed",
+                       fleet="t").value == 1
+    # no cooldown was spent: the next check retries and succeeds
+    ev = sc.check()
+    assert ev["action"] == "scale_up"
+    assert fleet.replicas == 2
+
+
+def test_config_validation():
+    fleet = FakeFleet(1)
+    with pytest.raises(ValueError):
+        _scaler(fleet, FakeClock(), min_replicas=0)
+    with pytest.raises(ValueError):
+        _scaler(fleet, FakeClock(), min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        _scaler(fleet, FakeClock(), queue_low=5.0, queue_high=4.0)
+    with pytest.raises(ValueError):
+        _scaler(fleet, FakeClock(), blocks_high=0.0)
+    with pytest.raises(ValueError):
+        _scaler(fleet, FakeClock(), scale_up_cooldown_s=-1.0)
+    with pytest.raises(ValueError):
+        # fleet narrower than the floor
+        _scaler(FakeFleet(1), FakeClock(), min_replicas=2,
+                max_replicas=3)
+
+
+# ---------------------------------------------------------------------------
+# live fleet integration
+# ---------------------------------------------------------------------------
+
+def _work(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, 256, rng.randint(3, 10)).astype(np.int32),
+             int(rng.randint(2, 6))) for _ in range(n)]
+
+
+def test_live_scale_up_serves_with_parity_and_drains_down(model):
+    """The full loop on a real fleet: queue pressure spawns a replica
+    (token parity held), all-quiet drains it back, the retired
+    engine's metrics leave the registry (the leaked-gauge audit) and
+    the health report drops its per-replica row."""
+    work = _work(12, seed=3)
+    base = [np.asarray(model.generate(p, max_new_tokens=n,
+                                      temperature=0.0))
+            for p, n in work]
+    clk = FakeClock()
+    fleet = ServeFleet(model, replicas=1, max_slots=2,
+                       clock=clk)
+    sc = Autoscaler(fleet, AutoscaleConfig(
+        min_replicas=1, max_replicas=2, scale_up_cooldown_s=1.0,
+        scale_down_cooldown_s=2.0, queue_high=2.0, queue_low=0.5,
+        occupancy_high=1.5, occupancy_low=0.6), clock=clk)
+    hs = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0)) for p, n in work]
+    ev = sc.check()
+    assert ev is not None and ev["action"] == "scale_up"
+    assert fleet.replicas == 2
+    while fleet.pending:
+        fleet.step()
+        clk.advance(0.5)
+        sc.check()
+    for h, want in zip(hs, base):
+        assert np.array_equal(h.result().tokens, want)
+    # all-quiet: drain + retire
+    for _ in range(12):
+        if any(e["action"] == "drain_done"
+               for e in sc.scaling_events):
+            break
+        clk.advance(1.0)
+        sc.check()
+    assert any(e["action"] == "drain_done"
+               for e in sc.scaling_events)
+    retired = [r for r in fleet._replicas if r.retired]
+    assert len(retired) == 1
+    # leaked-gauge audit: nothing keyed to the retired engine's label
+    lbl = f"engine={retired[0].sup.engine.stats.engine_label}"
+    snap = registry().snapshot()
+    leaked = [k for sec in snap.values() for k in sec if lbl in k]
+    assert leaked == [], leaked
+    # health: the per-replica row is gone, the autoscale section live
+    assert retired[0].idx not in fleet.health()
+    rep = health_report(include_registry=False)
+    assert rep["serve"]["autoscale"]["enabled"] is True
+    assert rep["serve"]["autoscale"]["scale_ups"] >= 1
+    assert rep["serve"]["autoscale"]["scale_downs"] >= 1
+    snap_f = fleet.snapshot()
+    assert snap_f["replicas"] == 1 and snap_f["replicas_retired"] == 1
+    sc.close()
+    fleet.close()
+    # close released the autoscale gauges too
+    assert health_report(include_registry=False)["serve"][
+        "autoscale"] == {"enabled": False}
+
+
+def test_live_draining_replica_finishes_then_retires(model):
+    """start_drain stops NEW routing but the replica completes its
+    live work first; retire_replica refuses while work remains."""
+    clk = FakeClock()
+    fleet = ServeFleet(model, replicas=2, max_slots=2, clock=clk)
+    work = _work(6, seed=4)
+    hs = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0)) for p, n in work]
+    busy = next(i for i in range(2)
+                if fleet.supervisor(i).pending)
+    fleet.start_drain(busy)
+    with pytest.raises(RuntimeError):
+        fleet.retire_replica(busy)
+    # new submissions route AWAY from the draining replica
+    before = fleet.snapshot()["routed"][str(busy)]
+    extra = _work(3, seed=5)
+    hs += [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0)) for p, n in extra]
+    assert fleet.snapshot()["routed"][str(busy)] == before
+    fleet.run_until_complete(max_steps=500)
+    for h in hs:
+        h.result()
+    assert fleet.drained(busy)
+    fleet.retire_replica(busy)
+    assert fleet.snapshot()["replicas"] == 1
+    # retire without drain refuses typed
+    with pytest.raises(ValueError):
+        fleet.retire_replica(1 - busy)
+    fleet.close()
+
+
+def test_live_revive_reuses_retired_slot_and_add_replica_grows(model):
+    clk = FakeClock()
+    fleet = ServeFleet(model, replicas=1, max_slots=2, clock=clk)
+    idx = fleet.add_replica()
+    assert idx == 1 and fleet.replicas == 2
+    fleet.start_drain(idx)
+    assert fleet.drained(idx)
+    fleet.retire_replica(idx)
+    assert fleet.routable_replicas == 1
+    fleet.revive(idx)
+    assert fleet.routable_replicas == 2
+    work = _work(4, seed=6)
+    hs = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0)) for p, n in work]
+    fleet.run_until_complete(max_steps=500)
+    for h in hs:
+        h.result()
+    # a symmetric fleet refuses role-typed growth
+    with pytest.raises(ValueError):
+        fleet.add_replica(role="prefill")
+    with pytest.raises(ValueError):
+        fleet.add_replica(role="nonsense")
+    fleet.close()
+    with pytest.raises(RuntimeError):
+        fleet.add_replica()
+
+
+def test_live_sharded_fleet_refuses_add_replica(model):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a 4-device mesh")
+    from singa_tpu.serve import PagedConfig
+
+    fleet = ServeFleet(model, replicas=2, max_slots=2, tp=2,
+                       paged=PagedConfig(block_size=8, num_blocks=32))
+    with pytest.raises(ValueError, match="sharded"):
+        fleet.add_replica()
+    fleet.run_until_complete(max_steps=50)
+    fleet.close()

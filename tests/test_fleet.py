@@ -406,11 +406,18 @@ def test_fleet_metrics_health_report_and_unregister(model):
     assert "fleet_failovers" in rep["resilience"]
     assert "fleet_requeues" in rep["resilience"]
     snap = fleet.snapshot()
-    assert set(snap) == {"replicas", "replicas_healthy", "roles",
+    assert set(snap) == {"replicas", "replicas_healthy",
+                         "replicas_routable", "replicas_draining",
+                         "replicas_retired", "roles",
                          "failovers", "requeues", "hedges", "routed",
                          "ships", "ship_bytes", "shared_prefix_hits",
                          "ship_fallbacks", "engines"}
     assert len(snap["engines"]) == 2
+    # add-only autoscale-round keys: nothing draining or retired in a
+    # static fleet, every replica routable
+    assert snap["replicas_routable"] == 2
+    assert snap["replicas_draining"] == 0
+    assert snap["replicas_retired"] == 0
     fleet.close()
     gkey = "serve.fleet.replicas_healthy{fleet=%s}" % lbl
     assert gkey not in registry().snapshot()["gauges"]

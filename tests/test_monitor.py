@@ -581,7 +581,14 @@ def test_health_report_schema_and_sections():
         report = health_report()
         assert set(report) == {
             "schema", "host", "train", "step_time", "serve",
-            "resilience", "watchdog", "flight_recorder", "registry"}
+            "windowed", "resilience", "watchdog", "flight_recorder",
+            "registry"}
+        # always-present feature sections: {"enabled": False} until
+        # their layers install (windowed rings, burn-rate policy,
+        # autoscaler)
+        assert report["windowed"] == {"enabled": False}
+        assert report["serve"]["slo_alerts"] == {"enabled": False}
+        assert report["serve"]["autoscale"] == {"enabled": False}
         # the resilience section is always present, zeroed when the
         # layer never armed
         assert report["resilience"]["engine_restarts"] >= 0

@@ -302,11 +302,14 @@ def test_prometheus_bucket_override_and_inf_invariant():
 def test_prometheus_sum_count_stay_consistent_under_windowing():
     """_sum comes from the series' RUNNING total, not the retained
     values window — evicting values must not desync the pair."""
+    from singa_tpu.utils.metrics import LatencySeries
+
     reg = MetricsRegistry()
-    h = reg.histogram("serve.ttft", engine="0")
+    h = reg.histogram("serve.ttft", engine="0",
+                      series=LatencySeries(max_samples=2))
     for v in (0.1, 0.2, 0.3):
-        h.observe(v)
-    h.series.values.pop(0)  # simulate a bounded window evicting
+        h.observe(v)  # the bounded ring evicts 0.1
+    assert list(h.series.values) == [0.2, 0.3]
     lines = export.prometheus_text(reg).splitlines()
     assert 'singa_tpu_serve_ttft_sum{engine="0"} 0.6000000000000001' \
         in lines

@@ -109,18 +109,16 @@ def test_percentile_empty_and_clamped():
 
 def test_latency_series_running_totals_survive_window_eviction():
     """total_sum/count are maintained independently of the retained
-    ``values`` window, so the Prometheus _sum/_count pair stays
-    consistent if/when the window is ever bounded."""
-    s = LatencySeries()
+    ``values`` ring, so the Prometheus _sum/_count pair stays
+    consistent now that the window IS bounded (the soak-memory
+    satellite: default ~8k, overridable)."""
+    s = LatencySeries(max_samples=3)
     for v in (1.0, 2.0, 3.0):
         s.record(v)
     assert s.total_sum == 6.0 and s.count == 3
-    # simulate a window eviction (a future bounded series would do
-    # this internally): the running totals must NOT move
-    s.values.pop(0)
-    assert s.total_sum == 6.0 and s.count == 3
-    s.record(4.0)
-    assert s.total_sum == 10.0 and s.count == 4
+    s.record(4.0)  # evicts 1.0 from the ring
+    assert list(s.values) == [2.0, 3.0, 4.0]
+    assert s.total_sum == 10.0 and s.count == 4  # totals exact
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,419 @@
+"""Windowed telemetry (observe.timeseries + registry.windowed) and
+multi-window SLO burn-rate alerting (observe.slo).
+
+Everything here is THREADLESS and fake-clocked: ring arithmetic,
+fire/clear hysteresis, and the export/health surfaces are all
+deterministic functions of (samples, clock)."""
+
+import json
+import math
+
+import pytest
+
+from singa_tpu.observe import health_report
+from singa_tpu.observe.export import prometheus_text
+from singa_tpu.observe.registry import MetricsRegistry
+from singa_tpu.observe.slo import BurnRule, SLOPolicy, alerts_section
+from singa_tpu.observe.timeseries import WindowRing
+from singa_tpu.utils.metrics import LatencySeries
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# WindowRing arithmetic
+# ---------------------------------------------------------------------------
+
+def test_counter_ring_rate_basic_and_empty_window():
+    clk = FakeClock()
+    r = WindowRing("counter", clock=clk, baseline=0.0)
+    # empty window: no samples, no growth — 0.0, never nan/raise
+    assert r.rate(60.0) == 0.0
+    v = 0
+    for _ in range(6):
+        clk.advance(10.0)
+        v += 2
+        r.append(v)
+    # all 6 samples in the last 60s against a baseline of 0
+    assert r.rate(60.0) == pytest.approx(12 / 60.0)
+    # a 30s window sees only the growth of the last 30s (2 samples
+    # strictly inside + the boundary one): baseline = value at the
+    # last sample at/before the cutoff
+    assert r.rate(30.0) == pytest.approx((12 - 6) / 30.0)
+    # idle counter: window slides past every sample -> rate decays to 0
+    clk.advance(120.0)
+    assert r.rate(60.0) == 0.0
+
+
+def test_counter_ring_single_sample_and_attach_baseline():
+    clk = FakeClock()
+    # attached to a counter already at 100: history is NOT credited
+    r = WindowRing("counter", clock=clk, baseline=100.0)
+    clk.advance(5.0)
+    r.append(103)
+    assert r.rate(60.0) == pytest.approx(3 / 60.0)
+
+
+def test_counter_ring_wraparound_keeps_floor_baseline():
+    clk = FakeClock()
+    r = WindowRing("counter", capacity=4, clock=clk, baseline=0.0)
+    for v in (1, 2, 3, 4, 5, 6):  # evicts samples 1, 2
+        clk.advance(1.0)
+        r.append(v)
+    assert len(r) == 4
+    # window covering everything: baseline is the FLOOR (last evicted
+    # value), not zero — growth since the oldest retained knowledge
+    assert r.rate(100.0) == pytest.approx((6 - 2) / 100.0)
+
+
+def test_ring_clock_going_backwards_is_safe():
+    clk = FakeClock()
+    r = WindowRing("event", clock=clk)
+    r.append(1.0)
+    clk.advance(-50.0)  # clock steps BACK
+    r.append(2.0)
+    # reads never raise; the future-stamped sample counts in-window
+    vals = r.values(10.0)
+    assert 2.0 in vals
+    assert r.rate(10.0) >= 0.0
+    r2 = WindowRing("counter", clock=clk, baseline=0.0)
+    r2.append(5)
+    clk.advance(-50.0)
+    r2.append(3)  # counter "reset" under a backwards clock
+    assert r2.rate(10.0) >= 0.0  # clamped, never negative
+
+
+def test_event_ring_quantile_and_mean():
+    clk = FakeClock()
+    r = WindowRing("event", clock=clk)
+    assert math.isnan(r.quantile(0.5, 60.0))  # empty -> nan
+    r.append(0.3)
+    assert r.quantile(0.99, 60.0) == 0.3  # single sample
+    for v in (0.1, 0.2, 0.4):
+        clk.advance(1.0)
+        r.append(v)
+    assert r.quantile(0.5, 60.0) == 0.2
+    assert r.mean(60.0) == pytest.approx(0.25)
+    assert r.rate(60.0) == pytest.approx(4 / 60.0)
+    clk.advance(60.0)  # ages out all but the last sample
+    assert r.quantile(0.5, 60.0) == 0.4
+    with pytest.raises(ValueError):
+        r.quantile(1.5, 60.0)
+    with pytest.raises(ValueError):
+        r.rate(0.0)
+
+
+# ---------------------------------------------------------------------------
+# registry.windowed plumbing
+# ---------------------------------------------------------------------------
+
+def test_registry_windowed_counter_attaches_current_and_future():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    c0 = reg.counter("x.total", engine="0")
+    wf = reg.windowed("x.total", windows=(60,), clock=clk)
+    c1 = reg.counter("x.total", engine="1")  # created AFTER windowing
+    clk.advance(10.0)
+    c0.inc(6)
+    c1.inc(12)
+    assert wf.rate(60) == pytest.approx(18 / 60.0)
+    # label filter
+    assert wf.rate(60, match={"engine": "1"}) == pytest.approx(
+        12 / 60.0)
+    # get-or-create: same family back
+    assert reg.windowed("x.total") is wf
+
+
+def test_registry_windowed_histogram_sees_direct_series_records():
+    """EngineStats records into the adopted LatencySeries directly,
+    bypassing Histogram.observe — the ring must still see it."""
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    h = reg.histogram("lat.s", engine="0")
+    wf = reg.windowed("lat.s", windows=(60,), clock=clk)
+    h.series.record(0.5)  # the EngineStats idiom
+    h.observe(0.1)
+    assert sorted(wf.values(60)) == [0.1, 0.5]
+    assert wf.quantile(0.99, 60) == 0.5
+
+
+def test_registry_remove_detaches_windowed_ring():
+    """A retired engine's windowed series must disappear with its
+    all-time series, not freeze at its last value."""
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    wf = reg.windowed("x.total", windows=(60,), clock=clk)
+    c0 = reg.counter("x.total", engine="0")
+    c1 = reg.counter("x.total", engine="1")
+    c0.inc(5)
+    c1.inc(7)
+    assert len(wf.rings) == 2
+    reg.remove(c1)
+    assert len(wf.rings) == 1
+    assert wf.rate(60) == pytest.approx(5 / 60.0)
+    # further writes to the removed metric no longer reach a ring
+    assert c1._rings == ()
+
+
+def test_registry_remove_detaches_histogram_series_hook():
+    """The histogram path detaches by the EXACT hook object (a fresh
+    ``ring.append`` bound method would never match): after removal
+    the series stops feeding the ring and drops the hook."""
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    h = reg.histogram("lat.s", engine="0")
+    wf = reg.windowed("lat.s", windows=(60,), clock=clk)
+    h.series.record(0.5)
+    assert wf.values(60) == [0.5]
+    hooks_with_ring = len(h.series._hooks)
+    reg.remove(h)
+    assert len(h.series._hooks) == hooks_with_ring - 1
+    assert wf.rings == {} and wf._series_hooks == {}
+    h.series.record(0.7)  # no ring left to receive it
+    assert wf.values(60) == []
+
+
+def test_registry_windowed_gauge_mean_and_section():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    g = reg.gauge("depth", engine="0")
+    wf = reg.windowed("depth", windows=(60,), clock=clk)
+    g.set(4)
+    g.inc(2)
+    assert wf.kind == "gauge"
+    assert wf.mean(60) == pytest.approx(5.0)
+    sec = wf.section()
+    assert sec["windows"]["60"]["mean"] == pytest.approx(5.0)
+    reg.unwindow("depth")
+    assert reg.windowed_families() == {}
+    assert g._rings == ()
+
+
+# ---------------------------------------------------------------------------
+# bounded LatencySeries (satellite: flat RSS over multi-hour soaks)
+# ---------------------------------------------------------------------------
+
+def test_latency_series_ring_bounds_samples_keeps_totals_exact():
+    s = LatencySeries(max_samples=4)
+    for i in range(10):
+        s.record(float(i))
+    assert len(s.values) == 4            # ring: newest 4 retained
+    assert s.count == 10                 # exact all-time count
+    assert s.total_sum == pytest.approx(45.0)  # exact all-time sum
+    # percentiles describe the retained window (documented
+    # approximation) — still real observed values
+    assert s.percentile(50) in (6.0, 7.0, 8.0, 9.0)
+    with pytest.raises(ValueError):
+        LatencySeries(max_samples=0)
+
+
+def test_histogram_buckets_stay_exact_after_series_wrap():
+    """Record-time binning: cumulative bucket counts cover EVERY
+    recorded value even after the retained ring evicted most of
+    them, and le=+Inf always equals _count."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat.s", buckets=(0.1, 1.0),
+                      series=LatencySeries(max_samples=3))
+    for _ in range(50):
+        h.observe(0.05)   # below 0.1
+    for _ in range(5):
+        h.observe(0.5)    # in (0.1, 1.0]
+    counts = dict(h.bucket_counts())
+    assert counts[0.1] == 50
+    assert counts[1.0] == 55
+    assert counts[float("inf")] == h.count == 55
+    assert len(h.series.values) == 3
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate policy
+# ---------------------------------------------------------------------------
+
+def _policy(reg, clk, threshold=3.0, clear_ratio=0.5,
+            budget=0.1, **kw):
+    return SLOPolicy(
+        None, budget_frac=budget, kinds=("ttft",),
+        rules=(BurnRule("page", long_s=10.0, short_s=3.0,
+                        threshold=threshold,
+                        clear_ratio=clear_ratio),),
+        reg=reg, clock=clk, install=False, **kw)
+
+
+def test_burn_requires_both_windows(monkeypatch):
+    """A short blip exceeds the SHORT window's burn but not the long
+    one — no page (the multi-window point)."""
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    pol = _policy(reg, clk)
+    viol = reg.counter("serve.slo_violations", engine="0", kind="ttft")
+    done = reg.counter("serve.completed", engine="0")
+    # 8s of clean traffic, then 2s of pure violations: short window
+    # (3s) burns hot, long window (10s) stays below threshold
+    for _ in range(16):
+        clk.advance(0.5)
+        done.inc()
+    for _ in range(4):
+        clk.advance(0.5)
+        done.inc()
+        viol.inc()
+    pol.poll()
+    st = pol.alerts["page"]
+    assert st["burn_short"] >= 3.0
+    assert st["burn_long"] < 3.0
+    assert not pol.firing()
+
+
+def test_burn_fires_and_clears_hysteretically_with_callback():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    transitions = []
+    pol = _policy(reg, clk,
+                  on_alert=lambda name, firing, info:
+                  transitions.append((name, firing)))
+    viol = reg.counter("serve.slo_violations", engine="0", kind="ttft")
+    done = reg.counter("serve.completed", engine="0")
+    # sustained 100% violation ratio across BOTH windows -> fire
+    for _ in range(24):
+        clk.advance(0.5)
+        done.inc()
+        viol.inc()
+        pol.poll()
+    assert pol.firing("page")
+    assert pol.alerts["page"]["fired"] == 1
+    assert transitions == [("page", True)]
+    g = reg.gauge("serve.slo.alert_firing", rule="page")
+    assert g.value == 1
+    # hovering JUST below threshold but above the clear line: the
+    # alert holds (hysteresis) — 25% violations at budget 0.1 is
+    # burn 2.5, between clear (1.5) and threshold (3.0)
+    for i in range(40):
+        clk.advance(0.5)
+        done.inc()
+        if i % 4 == 0:
+            viol.inc()
+        pol.poll()
+    assert pol.firing("page"), pol.alerts["page"]
+    # clean traffic: both windows fall below threshold*clear_ratio
+    for _ in range(30):
+        clk.advance(0.5)
+        done.inc()
+        pol.poll()
+    assert not pol.firing("page")
+    assert pol.alerts["page"]["cleared"] == 1
+    assert transitions == [("page", True), ("page", False)]
+    assert reg.counter("serve.slo.alerts_cleared", rule="page").value \
+        == 1
+
+
+def test_burn_zero_traffic_and_violations_without_completions():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    pol = _policy(reg, clk)
+    assert pol.burn_rate(3.0) == 0.0  # silence is not a burn
+    viol = reg.counter("serve.slo_violations", engine="0", kind="ttft")
+    clk.advance(1.0)
+    viol.inc()
+    assert pol.burn_rate(3.0) == float("inf")  # burning, not idle
+    # the queue kind is excluded by default (different denominator)
+    q = reg.counter("serve.slo_violations", engine="0", kind="queue")
+    q.inc(100)
+    done = reg.counter("serve.completed", engine="0")
+    for _ in range(10):
+        clk.advance(0.2)
+        done.inc()
+    assert pol.burn_rate(3.0) < float("inf")
+
+
+def test_policy_validates_config():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        SLOPolicy(None, budget_frac=0.0, reg=reg, install=False)
+    with pytest.raises(ValueError):
+        SLOPolicy(None, rules=(), reg=reg, install=False)
+    with pytest.raises(ValueError):
+        SLOPolicy(None, rules=(
+            BurnRule("x", long_s=1.0, short_s=2.0, threshold=1.0),),
+            reg=reg, install=False)
+    with pytest.raises(ValueError):
+        SLOPolicy(None, rules=(
+            BurnRule("x", long_s=2.0, short_s=1.0, threshold=0.0),),
+            reg=reg, install=False)
+    with pytest.raises(ValueError):
+        SLOPolicy(None, rules=(
+            BurnRule("x", long_s=2.0, short_s=1.0, threshold=1.0,
+                     clear_ratio=0.0),),
+            reg=reg, install=False)
+    with pytest.raises(ValueError):
+        SLOPolicy(None, rules=(
+            BurnRule("a", long_s=2.0, short_s=1.0, threshold=1.0),
+            BurnRule("a", long_s=4.0, short_s=2.0, threshold=1.0),),
+            reg=reg, install=False)
+
+
+def test_install_uninstall_and_health_section():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    assert alerts_section() == {"enabled": False}
+    pol = _policy(reg, clk)
+    try:
+        from singa_tpu.observe import slo as slo_mod
+        slo_mod.install(pol)
+        sec = alerts_section()
+        assert sec["enabled"] is True
+        assert "page" in sec["rules"]
+        # the health report carries it (and the windowed section)
+        rep = health_report(reg=reg, include_registry=False)
+        assert rep["serve"]["slo_alerts"]["enabled"] is True
+        assert rep["windowed"]["enabled"] is True
+        assert rep["serve"]["autoscale"] == {"enabled": False}
+        json.dumps(rep, default=str)
+    finally:
+        pol.close()
+    assert alerts_section() == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# export surface
+# ---------------------------------------------------------------------------
+
+def test_prometheus_windowed_siblings_build_info_uptime():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    c = reg.counter("serve.tokens_out", engine="0",
+                    help="tokens emitted")
+    reg.windowed("serve.tokens_out", windows=(60,), clock=clk)
+    h = reg.histogram("serve.ttft", engine="0")
+    reg.windowed("serve.ttft", windows=(60,), clock=clk)
+    clk.advance(30.0)
+    c.inc(60)
+    h.observe(0.2)
+    txt = prometheus_text(reg)
+    lines = txt.splitlines()
+    # windowed sibling gauges, each family with HELP + TYPE
+    assert any(ln.startswith(
+        "singa_tpu_serve_tokens_out_rate_60s{engine=\"0\"} 1")
+        for ln in lines), txt
+    assert "# HELP singa_tpu_serve_tokens_out_rate_60s" in txt
+    assert "# TYPE singa_tpu_serve_tokens_out_rate_60s gauge" in txt
+    assert "singa_tpu_serve_ttft_p99_60s" in txt
+    # the all-time families are still there, unchanged
+    assert "singa_tpu_serve_tokens_out_total" in txt
+    assert "singa_tpu_serve_ttft_bucket" in txt
+    # scrape-target hygiene
+    assert "# TYPE singa_tpu_build_info gauge" in txt
+    bi = next(ln for ln in lines
+              if ln.startswith("singa_tpu_build_info"))
+    assert 'version="' in bi and 'jax="' in bi and 'backend="' in bi
+    up = next(ln for ln in lines
+              if ln.startswith("singa_tpu_process_uptime_seconds "))
+    assert float(up.split()[-1]) >= 0.0
