@@ -1054,6 +1054,177 @@ def chaos_disagg(report):
     assert sd["blocks_leaked"] == 0, sd["blocks_leaked"]
 
 
+def _dist_model_spec():
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.serve import gpt2_spec
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m, gpt2_spec(m)
+
+
+def _dist_leaks(fleet):
+    """Wire-level leak count: the step reply mirrors blocks_used AND
+    cached_blocks parent-side, so the invariant is checkable without
+    reaching into worker engines."""
+    total = 0
+    for i in range(fleet.replicas):
+        eng = fleet.supervisor(i).engine
+        if eng._closed or eng.paged_arena is None:
+            continue
+        total += (eng.paged_arena.blocks_used
+                  - eng.prefix_cache.cached_blocks)
+    return total
+
+
+def chaos_dist_partition(report):
+    """A PARTITIONED peer mid-decode (the dist round): the injected
+    ``serve.dist.rpc`` fault fires on a step RPC exactly where a real
+    network split would — the peer is marked down through the same
+    PeerGone -> failover path, never-started work requeues onto the
+    survivor with byte parity (nothing had streamed), and the
+    role-aware autoscaler's ``replace_dead`` heals the fleet back to
+    width with a FRESH worker that then serves traffic.  Workers run
+    as threads here (same wire protocol and fault sites as processes;
+    the chaos matrix stays bounded-time)."""
+    from singa_tpu.resilience import FailOnce, faults
+    from singa_tpu.serve import DistFleet, GenerationRequest
+    from singa_tpu.serve.autoscale import AutoscaleConfig, Autoscaler
+
+    m, spec = _dist_model_spec()
+    rng = np.random.RandomState(21)
+    workload = [(rng.randint(0, 256, rng.randint(3, 7)).astype(np.int32),
+                 int(rng.randint(2, 5))) for _ in range(5)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    fleet = DistFleet(spec, replicas=2, spawn="thread", max_slots=2)
+    pol = faults.inject("serve.dist.rpc", FailOnce())
+    handles = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0))
+        for p, n in workload]
+    fleet.run_until_complete(max_steps=800)
+    faults.clear()
+    completed = wedged = 0
+    for h, want in zip(handles, base):
+        if not h.done():
+            wedged += 1
+            continue
+        assert np.array_equal(h.result().tokens, want), \
+            "dist stream diverged across the partition"
+        completed += 1
+    snap = fleet.snapshot()
+    assert snap["replicas_healthy"] == 1, snap["replicas_healthy"]
+    assert snap["failovers"] >= 1
+
+    # the autoscaler replaces the dead peer on its next check, and
+    # the fresh worker serves
+    sc = Autoscaler(fleet, AutoscaleConfig(
+        min_replicas=2, max_replicas=2,
+        scale_up_cooldown_s=0.0, scale_down_cooldown_s=0.0))
+    ev = sc.check()
+    assert ev is not None and ev["action"] == "replace_dead", ev
+    assert fleet.healthy_replicas == 2
+    post = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0))
+        for p, n in workload[:3]]
+    fleet.run_until_complete(max_steps=400)
+    post_done = sum(
+        1 for h, want in zip(post, base)
+        if h.done() and np.array_equal(h.result().tokens, want))
+    fleet.close()
+
+    report["serve_dist_partition"] = {
+        "replicas": 2,
+        "requests": len(workload),
+        "completed_with_parity": completed,
+        "wedged_or_lost": wedged,
+        "rpc_faults_injected": pol.fired,
+        "failovers": snap["failovers"],
+        "requeues": snap["requeues"],
+        "replaced_dead": 1,
+        "replicas_healthy_after": 2,
+        "post_heal_requests": len(post),
+        "post_heal_completed": post_done,
+    }
+    d = report["serve_dist_partition"]
+    assert d["wedged_or_lost"] == 0, d
+    assert d["completed_with_parity"] == d["requests"], d
+    assert d["rpc_faults_injected"] == 1, d
+    assert d["post_heal_completed"] == d["post_heal_requests"], d
+
+
+def chaos_dist_halfship(report):
+    """A HALF-SHIPPED image (the dist round): the transport dies
+    between layers of a streamed cross-host ship — the injected
+    ``serve.dist.frame`` fault fires mid-relay, the destination's
+    staging buffer is aborted (typed, never admitted), the request
+    falls back to a cold serve with byte parity, neither peer is
+    condemned, and a LATER ship on the same fleet still streams
+    clean.  Zero leaked blocks on both sides."""
+    from singa_tpu.resilience import FailOnce, faults
+    from singa_tpu.serve import (DistFleet, GenerationRequest,
+                                 PagedConfig, PrefixCacheConfig)
+
+    m, spec = _dist_model_spec()
+    rng = np.random.RandomState(22)
+    workload = [(rng.randint(0, 256, 48).astype(np.int32), 3),
+                (rng.randint(0, 256, 48).astype(np.int32), 3)] + \
+        [(rng.randint(0, 256, rng.randint(3, 7)).astype(np.int32),
+          int(rng.randint(2, 5))) for _ in range(2)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    fleet = DistFleet(
+        spec, replicas=2, spawn="thread",
+        roles=("prefill", "decode"), max_slots=2,
+        paged=PagedConfig(block_size=8, num_blocks=48),
+        prefix_cache=PrefixCacheConfig(block_size=8))
+    pol = faults.inject("serve.dist.frame", FailOnce())
+    handles = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0))
+        for p, n in workload]
+    fleet.run_until_complete(max_steps=800)
+    faults.clear()
+    completed = wedged = 0
+    for h, want in zip(handles, base):
+        if not h.done():
+            wedged += 1
+            continue
+        assert np.array_equal(h.result().tokens, want), \
+            "dist stream diverged across the half-ship"
+        completed += 1
+    snap = fleet.snapshot()
+    leaked = _dist_leaks(fleet)
+    fleet.close()
+
+    report["serve_dist_halfship"] = {
+        "replicas": 2,
+        "requests": len(workload),
+        "completed_with_parity": completed,
+        "wedged_or_lost": wedged,
+        "frame_faults_injected": pol.fired,
+        "ship_fallbacks": snap["ship_fallbacks"],
+        "frames_relayed": snap["dist"]["frames"],
+        "replicas_healthy_after": snap["replicas_healthy"],
+        "blocks_leaked": leaked,
+    }
+    d = report["serve_dist_halfship"]
+    assert d["wedged_or_lost"] == 0, d
+    assert d["completed_with_parity"] == d["requests"], d
+    assert d["frame_faults_injected"] == 1, d
+    assert d["ship_fallbacks"] >= 1, d
+    assert d["replicas_healthy_after"] == 2, d
+    assert d["frames_relayed"] > 0, \
+        "the post-fault ship never streamed — the fleet stayed cold"
+    assert d["blocks_leaked"] == 0, d
+
+
 def chaos_autoscale(report):
     """Fault the ``serve.autoscale`` site mid-scale-up (the autoscale
     round): the scaling DECISION aborts typed — ledger records
@@ -1199,6 +1370,8 @@ def main():
     chaos_pp(report)
     chaos_fleet(report)
     chaos_disagg(report)
+    chaos_dist_partition(report)
+    chaos_dist_halfship(report)
     chaos_autoscale(report)
 
     health = observe.health_report(include_registry=False)

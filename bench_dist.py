@@ -606,6 +606,151 @@ def _flagship_projection(W):
     return out
 
 
+def _fleet_smoke(args):
+    """``--fleet``: the multi-host serving smoke (the dist round) —
+    a 2-PROCESS local DistFleet on CPU proving the wire is invisible:
+    (1) byte parity with the in-process ServeFleet through the
+    unmodified router, (2) one streamed cross-host KV ship with the
+    warm repeat's TTFT beating the cold prefill, (3) one worker kill
+    with every in-flight request requeued to parity.  Bounded-time:
+    this is the tier-1 CI gate next to soak/chaos, not a benchmark —
+    wall time rides the JSON so the gate's budget is visible."""
+    import jax
+
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.serve import (DistFleet, GenerationRequest,
+                                 PagedConfig, PrefixCacheConfig,
+                                 ServeFleet, gpt2_spec)
+
+    t_wall = time.time()
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    spec = gpt2_spec(m)
+    result = {"bench": "dist_fleet_smoke",
+              "schema": "singa_tpu.dist/1",
+              "backend": jax.devices()[0].platform,
+              "spawn": "process", "replicas": 2}
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 256, rng.randint(4, 9)).astype(np.int32)
+               for _ in range(6)]
+
+    def run(fleet, plist, prefix="q"):
+        hs = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=5, request_id=f"{prefix}{i}"))
+            for i, p in enumerate(plist)]
+        fleet.run_until_complete(max_steps=800)
+        return [[int(t) for t in h.result().tokens] for h in hs]
+
+    def leaks(fleet):
+        total = 0
+        for i in range(fleet.replicas):
+            eng = fleet.supervisor(i).engine
+            if eng._closed or eng.paged_arena is None:
+                continue
+            total += (eng.paged_arena.blocks_used
+                      - eng.prefix_cache.cached_blocks)
+        return total
+
+    leaked = 0
+
+    # 1. parity across the process boundary ---------------------------
+    with ServeFleet(m, replicas=2, max_slots=2) as f:
+        want = run(f, prompts)
+    with DistFleet(spec, replicas=2, spawn="process",
+                   max_slots=2) as f:
+        got = run(f, prompts)
+        pids = [f.supervisor(i).pid for i in range(2)]
+        snap = f.snapshot()
+    result["parity"] = {
+        "requests": len(prompts),
+        "byte_identical": got == want,
+        "worker_pids": pids,
+        "worker_pids_distinct": all(p and p != os.getpid()
+                                    for p in pids),
+        "rpcs": snap["dist"]["rpcs"],
+        "rpc_errors": snap["dist"]["rpc_errors"],
+    }
+    assert result["parity"]["byte_identical"], "wire parity broken"
+    assert result["parity"]["worker_pids_distinct"], pids
+
+    # 2. one streamed ship + warm-vs-cold cross-host TTFT --------------
+    doc = rng.randint(0, 256, 96).astype(np.int32)
+    kw = dict(roles=("prefill", "decode"), max_slots=2,
+              paged=PagedConfig(block_size=8, num_blocks=64),
+              prefix_cache=PrefixCacheConfig(block_size=8))
+    with DistFleet(spec, replicas=2, spawn="process", **kw) as f:
+        h1 = f.submit(GenerationRequest(doc, max_new_tokens=4,
+                                        request_id="cold"))
+        f.run_until_complete(max_steps=800)
+        cold = h1.result()
+        h2 = f.submit(GenerationRequest(doc, max_new_tokens=4,
+                                        request_id="warm"))
+        f.run_until_complete(max_steps=800)
+        warm = h2.result()
+        snap = f.snapshot()
+        leaked += leaks(f)
+    result["ship"] = {
+        "doc_tokens": int(len(doc)),
+        "ships": snap["ships"],
+        "ship_fallbacks": snap["ship_fallbacks"],
+        "frames": snap["dist"]["frames"],
+        "frame_bytes": snap["dist"]["frame_bytes"],
+        "ship_s_mean": snap["dist"]["ship_s_mean"],
+        "cold_ttft_s": round(cold.ttft, 4),
+        "warm_ttft_s": round(warm.ttft, 4),
+        "warm_beats_cold": bool(warm.ttft < cold.ttft),
+        "tokens_identical": ([int(t) for t in warm.tokens]
+                             == [int(t) for t in cold.tokens]),
+    }
+    assert snap["ships"] >= 1 and snap["dist"]["frames"] > 0, snap
+    assert result["ship"]["tokens_identical"]
+    assert result["ship"]["warm_beats_cold"], \
+        (cold.ttft, warm.ttft)
+
+    # 3. one kill: a worker severed mid-flight -------------------------
+    with DistFleet(spec, replicas=2, spawn="process",
+                   max_slots=2) as f:
+        hs = [f.submit(GenerationRequest(
+            p, max_new_tokens=5, request_id=f"k{i}"))
+            for i, p in enumerate(prompts[:4])]
+        f.step()
+        f.kill_worker(0)
+        f.run_until_complete(max_steps=800)
+        wedged = sum(0 if h.done() else 1 for h in hs)
+        got_k = [[int(t) for t in h.result().tokens]
+                 for h in hs if h.done()]
+        snap = f.snapshot()
+        healthy = f.healthy_replicas
+    result["kill"] = {
+        "requests": 4,
+        "wedged_or_lost": wedged,
+        "completed_with_parity": sum(
+            g == w for g, w in zip(got_k, want[:4])),
+        "failovers": snap["failovers"],
+        "requeues": snap["requeues"],
+        "replicas_healthy_after": healthy,
+    }
+    assert wedged == 0, f"{wedged} requests wedged after kill"
+    assert result["kill"]["completed_with_parity"] == 4
+    assert snap["failovers"] >= 1 and healthy == 1
+
+    result["blocks_leaked"] = leaked
+    assert leaked == 0, f"{leaked} blocks leaked"
+    result["wall_s"] = round(time.time() - t_wall, 2)
+    result["passed"] = True
+
+    out = args.out if args.out != "SCALING.json" \
+        else "MULTICHIP_r06.json"
+    with open(os.path.join(_REPO, out), "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(result))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--world", type=int, default=8)
@@ -614,7 +759,14 @@ def main():
     ap.add_argument("--model", default="cnn",
                     choices=["cnn", "resnet18"])
     ap.add_argument("--out", default="SCALING.json")
+    ap.add_argument("--fleet", action="store_true",
+                    help="multi-host serving smoke: 2-process "
+                         "DistFleet parity + one streamed ship + one "
+                         "kill (writes MULTICHIP_r06.json by default)")
     args = ap.parse_args()
+
+    if args.fleet:
+        return _fleet_smoke(args)
 
     _provision_or_reexec(args.world)
 

@@ -56,6 +56,15 @@ SITES = (
     #                        replica construction or registration, so
     #                        a fired fault abandons the DECISION typed
     #                        and the fleet keeps serving)
+    "serve.dist.rpc",      # dist-fleet control RPC to a worker peer
+    #                        (serve/dist/fleet.py — a fired fault is a
+    #                        PARTITION: the peer is marked gone and
+    #                        the fleet fails over, exactly as if the
+    #                        host dropped off the network)
+    "serve.dist.frame",    # streamed KV ship frame relay to the
+    #                        destination peer (a fired fault is a
+    #                        HALF-SHIPPED image: staged frames are
+    #                        aborted and the request replays cold)
     "io.binfile",          # BinFile record read/write
     "train.step",          # _GraphRunner step dispatch
 )

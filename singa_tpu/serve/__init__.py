@@ -89,6 +89,7 @@ single-engine oracle.  See docs/SERVING.md "Disaggregated serving"::
 
 from .engine import InferenceEngine  # noqa: F401
 from .fleet import Router, ServeFleet  # noqa: F401
+from .dist import DistFleet, ModelSpec, gpt2_spec  # noqa: F401
 from .autoscale import AutoscaleConfig, Autoscaler  # noqa: F401
 from .kvimage import KVImage, KVImageError  # noqa: F401
 from .paged import PagedConfig, PagedKVArena  # noqa: F401

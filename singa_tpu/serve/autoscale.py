@@ -62,6 +62,11 @@ class AutoscaleConfig:
     * ``occupancy_high``/``occupancy_low``: mean live-slot occupancy;
     * ``blocks_high``: max paged-pool used fraction (None or unpaged
       engines skip the signal);
+    * ``prefill_queue_high``/``prefill_queue_low``: mean SHIP-BUILD
+      queue depth per routable prefill specialist (the disagg round's
+      separate load signal — specialists hold no decode lanes, so
+      queue/occupancy/TPOT never see their pressure); role-less fleets
+      have no prefill views and skip the signal;
     * ``scale_up_cooldown_s``/``scale_down_cooldown_s``: minimum
       spacing between same-direction actions; a scale-down is also
       embargoed for ``scale_down_cooldown_s`` after any scale-up
@@ -77,6 +82,8 @@ class AutoscaleConfig:
     occupancy_high: float = 0.85
     occupancy_low: float = 0.35
     blocks_high: float = 0.85
+    prefill_queue_high: float = 2.0
+    prefill_queue_low: float = 0.5
 
     def validate(self):
         if self.min_replicas < 1:
@@ -91,6 +98,8 @@ class AutoscaleConfig:
             raise ValueError("cooldowns must be >= 0")
         for low, high, name in (
                 (self.queue_low, self.queue_high, "queue"),
+                (self.prefill_queue_low, self.prefill_queue_high,
+                 "prefill_queue"),
                 (self.occupancy_low, self.occupancy_high,
                  "occupancy")):
             if low < 0 or high <= low:
@@ -187,8 +196,15 @@ class Autoscaler:
     def signals(self, now=None) -> dict:
         """One JSON-able snapshot of everything the decision reads:
         per-replica router views aggregated + burn-rate state."""
-        views = [v for v in self.fleet.load_views()
-                 if not v["draining"]]
+        all_views = [v for v in self.fleet.load_views()
+                     if not v["draining"]]
+        # prefill specialists carry NO decode load (their queue depth
+        # and occupancy are structurally 0) — folding them into the
+        # decode means would dilute real pressure, so the roles see
+        # separate aggregates (role-less fleets: pviews is empty and
+        # nothing changes)
+        pviews = [v for v in all_views if v.get("role") == "prefill"]
+        views = [v for v in all_views if v.get("role") != "prefill"]
         n = len(views)
         q = [v["queue_depth"] for v in views]
         occ = [v["occupancy"] for v in views]
@@ -196,9 +212,10 @@ class Autoscaler:
                   if v.get("blocks_used_frac") is not None]
         ewmas = [v["tpot_ewma"] for v in views
                  if v.get("tpot_ewma") is not None]
+        pq = [v.get("prefill_depth", 0) for v in pviews]
         pol = self.slo_policy
         return {
-            "routable": n,
+            "routable": len(all_views),
             "draining": self._draining_idx,
             "queue_depth_mean": (sum(q) / n) if n else 0.0,
             "queue_depth_max": max(q) if q else 0,
@@ -206,6 +223,9 @@ class Autoscaler:
             "occupancy_max": max(occ) if occ else 0.0,
             "blocks_used_frac_max": max(blocks) if blocks else None,
             "tpot_ewma_max_s": max(ewmas) if ewmas else None,
+            "prefill_routable": len(pviews),
+            "prefill_depth_mean": (sum(pq) / len(pq)) if pq else 0.0,
+            "prefill_depth_max": max(pq) if pq else 0,
             "alerts_firing": ([name for name, st in pol.alerts.items()
                                if st["firing"]]
                               if pol is not None else []),
@@ -224,6 +244,10 @@ class Autoscaler:
         event = None
         self._sync_drain_state()
         sig = self.signals(now)
+        event = self._replace_dead(now, sig)
+        if event is not None:
+            self._refresh_gauges()
+            return event
         up_reasons = self._up_reasons(sig)
         if up_reasons:
             # pressure is evaluated BEFORE a finished drain retires:
@@ -262,6 +286,12 @@ class Autoscaler:
                 and sig["blocks_used_frac_max"] is not None
                 and sig["blocks_used_frac_max"] > cfg.blocks_high):
             reasons.append("kv_blocks")
+        if (sig["prefill_routable"] > 0
+                and sig["prefill_depth_mean"] > cfg.prefill_queue_high):
+            # build-queue pressure on the prefill side: a separate
+            # signal with a separate remedy (a prefill specialist, not
+            # a decode replica — _scale_role picks it)
+            reasons.append("prefill_queue")
         return reasons
 
     def _can_scale_up(self, sig, now) -> bool:
@@ -284,6 +314,9 @@ class Autoscaler:
         if sig["queue_depth_mean"] > cfg.queue_low \
                 or sig["occupancy_mean"] > cfg.occupancy_low:
             return False
+        if sig["prefill_routable"] > 0 \
+                and sig["prefill_depth_mean"] > cfg.prefill_queue_low:
+            return False  # ship builds still queued: not all-quiet
         if self._last_down_t is not None \
                 and now - self._last_down_t < cfg.scale_down_cooldown_s:
             return False
@@ -294,22 +327,47 @@ class Autoscaler:
         return True
 
     # -- actions ---------------------------------------------------------
-    def _record(self, now, action, replica, reason, sig, error=None):
+    def _record(self, now, action, replica, reason, sig, error=None,
+                **extra):
         entry = {"t": now, "action": action, "replica": replica,
                  "reason": reason, "signals": sig}
         if error is not None:
             entry["error"] = error
+        entry.update(extra)
         self.scaling_events.append(entry)
         _trace.event("serve/autoscale", cat="serve", action=action,
                      replica=replica, reason=reason)
         return entry
 
+    def _scale_role(self, reasons) -> str:
+        """Which ROLE the pressure calls for: prefill-only pressure
+        wants a prefill specialist, anything decode-side on a
+        disaggregated fleet wants a decode replica, and symmetric
+        fleets always grow mixed (the only role add_replica accepts
+        there)."""
+        if not getattr(self.fleet, "_disagg", False):
+            return "mixed"
+        if reasons == ["prefill_queue"]:
+            return "prefill"
+        return "decode"
+
     def _scale_up(self, now, sig, reasons):
         reason = "+".join(reasons)
         fleet = self.fleet
+        role = self._scale_role(reasons)
         # a drain in flight IS spare capacity: cancelling it is
-        # cheaper than any spawn, and it cannot fail
-        if self._draining_idx is not None:
+        # cheaper than any spawn, and it cannot fail — but a drain is
+        # always decode-side capacity, so prefill-only pressure skips
+        # the cancel and buys an actual specialist (unless the spawn
+        # gates — ceiling or cooldown — block it, where the cancel is
+        # still strictly better than holding)
+        prefill_can_spawn = (
+            role == "prefill"
+            and sig["routable"] < self.config.max_replicas
+            and (self._last_up_t is None
+                 or now - self._last_up_t
+                 >= self.config.scale_up_cooldown_s))
+        if self._draining_idx is not None and not prefill_can_spawn:
             idx = self._draining_idx
             fleet.cancel_drain(idx)
             self._draining_idx = None
@@ -318,33 +376,86 @@ class Autoscaler:
             self._log.info("autoscale: drain of replica %d cancelled "
                            "(%s)", idx, reason)
             return self._record(now, "drain_cancelled", idx, reason,
-                                sig)
+                                sig, role=role)
         try:
             # the fault site guards the WHOLE action: fired here,
             # nothing was constructed or registered — the decision
             # aborts typed and a later check retries it
             if _faults._armed:
                 _faults.check("serve.autoscale")
+            roles = getattr(fleet, "roles", None)
             retired = [r.idx for r in fleet._replicas if r.retired]
-            if retired:
+            # prefer a retired slot whose pinned role MATCHES the
+            # pressure (reviving a decode slot does nothing for a
+            # backed-up prefill side), then any retired slot for
+            # mixed growth, then a fresh spawn with the right role
+            match = [i for i in retired
+                     if roles is None or roles[i] == role]
+            if match:
+                idx = match[0]
+                fleet.revive(idx)
+                how = "revive"
+            elif retired and role == "mixed":
                 idx = retired[0]
                 fleet.revive(idx)
                 how = "revive"
             else:
-                idx = fleet.add_replica()
+                idx = fleet.add_replica(role=role)
                 how = "spawn"
         except Exception as e:
             self._c_failed.inc()
             self._log.warning("autoscale: scale-up abandoned (%r); "
                               "will retry", e)
             return self._record(now, "scale_up_failed", None, reason,
-                                sig, error=repr(e))
+                                sig, error=repr(e), role=role)
         self._last_up_t = now
         self._c_ups.inc()
-        self._log.info("autoscale: scale-up via %s -> replica %d (%s)",
-                       how, idx, reason)
+        self._log.info("autoscale: scale-up via %s -> %s replica %d "
+                       "(%s)", how, role, idx, reason)
         return self._record(now, "scale_up", idx,
-                            f"{reason} via={how}", sig)
+                            f"{reason} via={how}", sig, role=role)
+
+    def _replace_dead(self, now, sig):
+        """Replace a FAILED (not retired — those are deliberate
+        scale-downs) replica: revive it on its pinned config so the
+        fleet heals back to its pre-failure width without waiting for
+        load pressure.  Runs before the pressure evaluation — a dead
+        replica is lost capacity whatever the signals say — but
+        respects the scale-up cooldown so a crash-looping replica
+        cannot drive a revive storm."""
+        fleet = self.fleet
+        cfg = self.config
+        if sig["routable"] >= cfg.max_replicas:
+            return None
+        if self._last_up_t is not None \
+                and now - self._last_up_t < cfg.scale_up_cooldown_s:
+            return None
+        dead = [r for r in fleet._replicas
+                if not r.healthy and not r.retired
+                and not getattr(r, "needs_failover", False)]
+        if not dead:
+            return None
+        rep = dead[0]
+        roles = getattr(fleet, "roles", None)
+        role = roles[rep.idx] if roles is not None else "mixed"
+        try:
+            if _faults._armed:
+                _faults.check("serve.autoscale")
+            fleet.revive(rep.idx)
+        except Exception as e:
+            self._c_failed.inc()
+            self._log.warning(
+                "autoscale: dead-replica replacement abandoned (%r); "
+                "will retry", e)
+            return self._record(now, "replace_failed", rep.idx,
+                                "replica_dead", sig, error=repr(e),
+                                role=role)
+        self._last_up_t = now
+        self._c_ups.inc()
+        self._log.info("autoscale: dead %s replica %d replaced", role,
+                       rep.idx)
+        return self._record(now, "replace_dead", rep.idx,
+                            "replica_dead", sig, role=role)
 
     def _begin_drain(self, now, sig):
         fleet = self.fleet

@@ -1,0 +1,27 @@
+"""Multi-host serving: the fleet across the process boundary.
+
+* :mod:`~singa_tpu.serve.dist.transport` — framed socket transport
+  (length-prefixed, versioned, crc-checked; typed timeout/retry;
+  piggybacked heartbeats);
+* :mod:`~singa_tpu.serve.dist.worker` — the replica worker loop: one
+  supervised engine behind the RPC dispatch, built from a picklable
+  :class:`ModelSpec`;
+* :mod:`~singa_tpu.serve.dist.fleet` — :class:`DistFleet`, a
+  :class:`~singa_tpu.serve.fleet.ServeFleet` whose replicas are worker
+  processes (or threads), with wire KV shipping — bulk images and
+  layer-wise streamed frames — and the cross-host residency directory.
+
+See docs/SERVING.md "Multi-host serving".
+"""
+
+from .fleet import DistFleet, DistSession, RemoteSupervisor
+from .transport import (PROTO_VERSION, Conn, Listener, PeerGoneError,
+                        PeerTimeoutError, TransportError)
+from .worker import ModelSpec, gpt2_spec, worker_main
+
+__all__ = [
+    "DistFleet", "DistSession", "RemoteSupervisor",
+    "ModelSpec", "gpt2_spec", "worker_main",
+    "PROTO_VERSION", "Conn", "Listener", "PeerGoneError",
+    "PeerTimeoutError", "TransportError",
+]

@@ -1,0 +1,313 @@
+"""Framed socket transport for the distributed serve fleet.
+
+One wire format for everything the fleet says to a worker peer:
+length-prefixed, versioned, crc-checked frames over a plain TCP
+socket.  Three message kinds ride it —
+
+* ``CALL``/``REPLY`` — the synchronous control RPC the fleet drives a
+  replica with (submit/step/build/export/...).  Every call carries a
+  sequence number, a typed timeout, and an optional bounded
+  retry-with-backoff for idempotent operations;
+* ``ONEWAY`` — fire-and-forget messages that must not stall the
+  sender: streamed KV ship frames (dist/fleet.py relays them to the
+  destination while the source is still prefilling) and best-effort
+  aborts/shutdowns;
+* ``HELLO`` — the connect-time handshake: a worker proves it belongs
+  to THIS fleet (shared token) and says which replica index it is.
+
+Frame layout (all integers network byte order)::
+
+    | magic 'STPU' | u8 proto | u8 kind | u32 crc32(payload) |
+    | u64 length   | payload (pickle)                        |
+
+A frame that fails the magic, version, crc, or length checks raises
+:class:`TransportError` — the stream is unusable after that (framing
+lost), so callers escalate to peer loss.  Socket-level failures map to
+the PEER-LOSS family: :class:`PeerGoneError` subclasses
+``RestartBudgetExceededError`` ON PURPOSE — to the fleet, a worker
+that dropped off the network and a supervisor that spent its restart
+budget are the same event ("this replica cannot serve; fail over"),
+so every existing fleet path (admission, step, ship driving) handles a
+partition with zero new code.  :class:`PeerTimeoutError` narrows it
+for calls that exceeded their deadline after retries.
+
+Heartbeats are PIGGYBACKED: every received frame refreshes the
+connection's ``last_rx`` clock, so a busy peer never pays a separate
+ping, and ``Conn.age()`` tells the fleet's watchdog how stale a quiet
+peer is (it pings only those — serve/dist/fleet.py
+``_check_watchdog``).
+
+The ``serve.dist.rpc`` fault site is checked on the CALLER side of
+every RPC (when armed): a fired fault is a modeled network partition —
+the peer process is still alive, but this side treats it as gone,
+which is exactly what a partition looks like from one end.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import time
+import zlib
+
+from ...resilience import faults as _faults
+from ...utils.logging import get_channel
+from ..request import RestartBudgetExceededError
+
+__all__ = ["PROTO_VERSION", "TransportError", "PeerGoneError",
+           "PeerTimeoutError", "Conn", "Listener", "MSG_CALL",
+           "MSG_REPLY", "MSG_ONEWAY", "MSG_HELLO"]
+
+#: bump when the frame layout or the RPC envelope changes; a peer on a
+#: different proto version fails the handshake typed instead of
+#: misparsing frames
+PROTO_VERSION = 1
+
+MSG_CALL = 1
+MSG_REPLY = 2
+MSG_ONEWAY = 3
+MSG_HELLO = 4
+
+_MAGIC = b"STPU"
+_HEAD = struct.Struct("!4sBBIQ")
+#: refuse absurd frame lengths before allocating: the largest honest
+#: payload is a KV image of a test/bench pool (MBs); 1 GiB means a
+#: corrupted length field, not a message
+_MAX_FRAME = 1 << 30
+
+
+class TransportError(RuntimeError):
+    """The byte stream itself is broken: bad magic, proto-version
+    skew, a crc mismatch, or a length-lying frame.  Framing is lost
+    after this — the connection cannot be trusted for another
+    message, so callers escalate to peer loss."""
+
+
+class PeerGoneError(RestartBudgetExceededError):
+    """The worker peer is unreachable (connection reset, EOF,
+    injected partition, or timeouts past the retry budget).
+
+    Subclasses :class:`RestartBudgetExceededError` deliberately: the
+    fleet's existing failure handling — mark the replica down, reject
+    its outstanding work typed, requeue the never-started part onto
+    healthy siblings — is EXACTLY the right response to a partitioned
+    host, and inheriting the type means every ``except
+    RestartBudgetExceededError`` site in serve/fleet.py handles
+    partitions with no dist-specific code."""
+
+
+class PeerTimeoutError(PeerGoneError):
+    """A call exceeded its deadline (after any retries).  Still peer
+    loss — a peer that cannot answer within the budget is
+    indistinguishable from a dead one, and waiting longer would stall
+    the whole fleet's step loop."""
+
+
+def _recv_exact(sock, n):
+    """Read exactly ``n`` bytes or raise on EOF mid-read (the
+    mid-stream-EOF case: a peer that died between frames raises
+    PeerGone at the next read; one that died MID-frame raises here)."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise PeerGoneError(
+                f"peer closed the stream mid-frame ({len(buf)} of {n} "
+                f"bytes read)", started=None)
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class Conn:
+    """One framed connection to a peer.  Single-threaded by design —
+    the fleet drives every replica from its own loop, and the worker
+    loop is strictly serial — so there is no locking, only framing.
+
+    ``label`` is used in error messages and logs ("r2", "listener").
+    """
+
+    def __init__(self, sock, label=""):
+        self.sock = sock
+        self.label = label
+        self.last_rx = time.monotonic()
+        self._seq = 0
+        self._log = get_channel("serve")
+        # TCP_NODELAY: RPCs are small request/response frames; Nagle
+        # would add 40ms floors to every fleet step
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+    # -- framing ---------------------------------------------------------
+    def send(self, kind, obj):
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        head = _HEAD.pack(_MAGIC, PROTO_VERSION, kind,
+                          zlib.crc32(payload) & 0xFFFFFFFF,
+                          len(payload))
+        try:
+            self.sock.sendall(head + payload)
+        except (OSError, ValueError) as e:
+            raise PeerGoneError(
+                f"send to peer {self.label or '?'} failed: {e!r}",
+                started=None) from e
+
+    def recv(self, timeout=None):
+        """One ``(kind, obj)`` frame.  ``timeout`` None blocks
+        forever (the worker loop's idle state); a number raises
+        :class:`PeerTimeoutError` on expiry."""
+        try:
+            self.sock.settimeout(timeout)
+            head = _recv_exact(self.sock, _HEAD.size)
+        except socket.timeout as e:
+            raise PeerTimeoutError(
+                f"no frame from peer {self.label or '?'} within "
+                f"{timeout}s", started=None) from e
+        except OSError as e:
+            raise PeerGoneError(
+                f"recv from peer {self.label or '?'} failed: {e!r}",
+                started=None) from e
+        magic, proto, kind, crc, length = _HEAD.unpack(head)
+        if magic != _MAGIC:
+            raise TransportError(
+                f"bad frame magic {magic!r} from peer "
+                f"{self.label or '?'}: stream framing lost")
+        if proto != PROTO_VERSION:
+            raise TransportError(
+                f"peer {self.label or '?'} speaks proto {proto}, this "
+                f"side {PROTO_VERSION}: refuse rather than misparse")
+        if length > _MAX_FRAME:
+            raise TransportError(
+                f"frame length {length} exceeds the {_MAX_FRAME} "
+                f"bound: corrupted length field")
+        try:
+            payload = _recv_exact(self.sock, length)
+        except socket.timeout as e:
+            raise PeerTimeoutError(
+                f"frame body from peer {self.label or '?'} stalled",
+                started=None) from e
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise TransportError(
+                f"frame crc mismatch from peer {self.label or '?'}: "
+                f"payload corrupted in transit")
+        self.last_rx = time.monotonic()
+        return kind, pickle.loads(payload)
+
+    def age(self) -> float:
+        """Seconds since the last successfully received frame — the
+        piggybacked heartbeat the fleet's watchdog reads."""
+        return time.monotonic() - self.last_rx
+
+    # -- RPC (caller side) -----------------------------------------------
+    def call(self, op, payload=None, timeout=60.0, retries=0,
+             backoff=0.05):
+        """Synchronous RPC: send ``CALL {seq, op, ...}``, wait for the
+        matching ``REPLY``.  ``retries`` re-sends on TIMEOUT only
+        (with exponential backoff) and must only be used for
+        idempotent ops — a retried ``submit`` could double-admit.
+        Checks the ``serve.dist.rpc`` fault site first: a fired fault
+        is a modeled partition and surfaces as :class:`PeerGoneError`.
+        """
+        if _faults._armed:
+            try:
+                _faults.check("serve.dist.rpc")
+            except Exception as e:
+                raise PeerGoneError(
+                    f"partition injected on RPC {op!r} to peer "
+                    f"{self.label or '?'} ({e!r})", started=None) from e
+        attempt = 0
+        while True:
+            self._seq += 1
+            seq = self._seq
+            self.send(MSG_CALL, {"seq": seq, "op": op,
+                                 "payload": payload})
+            try:
+                while True:
+                    kind, msg = self.recv(timeout)
+                    if kind != MSG_REPLY:
+                        # a stray one-way (late ship abort ack etc.)
+                        # is not an error; skip it
+                        continue
+                    if msg.get("seq") != seq:
+                        raise TransportError(
+                            f"out-of-sequence reply from peer "
+                            f"{self.label or '?'}: got "
+                            f"{msg.get('seq')}, want {seq}")
+                    return msg
+            except PeerTimeoutError:
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                self._log.warning(
+                    "RPC %s to peer %s timed out; retry %d/%d", op,
+                    self.label or "?", attempt, retries)
+                time.sleep(backoff * (2 ** (attempt - 1)))
+
+    def send_oneway(self, op, payload=None):
+        """Fire-and-forget (ship frames, aborts): no reply, no seq."""
+        self.send(MSG_ONEWAY, {"op": op, "payload": payload})
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Listener:
+    """The fleet's accept side: workers dial back here and prove
+    membership with the shared ``token`` in their HELLO frame."""
+
+    def __init__(self, host="127.0.0.1", port=0, token=b""):
+        self.token = token
+        self._log = get_channel("serve")
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(64)
+        self.host, self.port = self.sock.getsockname()
+
+    def accept_worker(self, timeout=120.0):
+        """Accept one worker connection and run its HELLO handshake.
+        Returns ``(replica_idx, Conn)``.  The generous default timeout
+        covers a spawned process importing jax from cold."""
+        self.sock.settimeout(timeout)
+        try:
+            sock, addr = self.sock.accept()
+        except socket.timeout as e:
+            raise PeerTimeoutError(
+                f"no worker connected within {timeout}s",
+                started=None) from e
+        conn = Conn(sock)
+        kind, hello = conn.recv(timeout=timeout)
+        if kind != MSG_HELLO:
+            conn.close()
+            raise TransportError(
+                f"first frame from {addr} was kind {kind}, not HELLO")
+        if hello.get("token") != self.token \
+                or hello.get("proto") != PROTO_VERSION:
+            conn.close()
+            raise TransportError(
+                f"worker handshake from {addr} refused (token or "
+                f"proto mismatch: proto={hello.get('proto')})")
+        idx = int(hello["idx"])
+        conn.label = f"r{idx}"
+        self._log.info("worker r%d connected from %s", idx, addr)
+        return idx, conn
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect_worker(host, port, token, idx, timeout=60.0) -> Conn:
+    """Worker side of the handshake: dial the fleet's listener and
+    introduce this replica."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    conn = Conn(sock, label="fleet")
+    conn.send(MSG_HELLO, {"token": token, "idx": int(idx),
+                          "proto": PROTO_VERSION})
+    return conn

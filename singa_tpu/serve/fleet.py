@@ -418,10 +418,8 @@ class ServeFleet:
                             + [self._c_ships, self._c_ship_bytes,
                                self._c_shared_hits,
                                self._c_ship_fallbacks])
-        self._replicas = [
-            _Replica(i, EngineSupervisor(model, **self._sup_kw,
-                                         **self._replica_kw(i)))
-            for i in range(replicas)]
+        self._replicas = [_Replica(i, self._new_supervisor(i))
+                          for i in range(replicas)]
         self._refresh_gauges()
         # fleet-owned completion routing (the supervisor pattern, one
         # level up: routes resolve across restarts AND failovers)
@@ -453,6 +451,16 @@ class ServeFleet:
                 f"unknown role(s) {bad!r}: each replica is 'prefill',"
                 f" 'decode', or 'mixed'")
         return roles
+
+    def _new_supervisor(self, idx) -> EngineSupervisor:
+        """Build replica ``idx``'s supervisor — THE construction seam.
+        Every path that creates replica capacity (__init__, revive(),
+        add_replica()) routes through here, so a subclass that hosts
+        replicas elsewhere (serve/dist/fleet.py spawns a worker
+        process and returns an RPC proxy) changes exactly one
+        method."""
+        return EngineSupervisor(self._model, **self._sup_kw,
+                                **self._replica_kw(idx))
 
     def _replica_kw(self, idx):
         """Engine kwargs for replica ``idx``: the shared engine_kw,
@@ -773,13 +781,7 @@ class ServeFleet:
             raise RuntimeError(
                 "fleet is closed; build a new one with "
                 "model.serve_fleet()")
-        for rep in self._replicas:
-            if not rep.healthy or not rep.sup.pending:
-                continue
-            try:
-                rep.sup.step()
-            except RestartBudgetExceededError as e:
-                self._mark_down(rep, e)
+        self._step_replicas()
         self._check_watchdog()
         self._drain_failovers()
         self._drive_ships()
@@ -803,6 +805,20 @@ class ServeFleet:
                     f"fleet did not drain within {max_steps} steps "
                     f"(routes={len(self._routes)}, healthy="
                     f"{self.healthy_replicas}/{len(self._replicas)})")
+
+    def _step_replicas(self):
+        """Drive every healthy pending replica one engine step,
+        marking down those whose restart budget surfaced.  A seam:
+        serve/dist/fleet.py overrides it to issue every replica's
+        step RPC before collecting any reply, so remote replicas
+        decode concurrently instead of serializing on round trips."""
+        for rep in self._replicas:
+            if not rep.healthy or not rep.sup.pending:
+                continue
+            try:
+                rep.sup.step()
+            except RestartBudgetExceededError as e:
+                self._mark_down(rep, e)
 
     # -- health / failover -----------------------------------------------
     def _check_watchdog(self):
@@ -948,8 +964,7 @@ class ServeFleet:
             raise ValueError(f"replica {idx} is healthy")
         if not rep.sup.engine._closed:
             rep.sup.close(force=True)
-        rep.sup = EngineSupervisor(self._model, **self._sup_kw,
-                                   **self._replica_kw(idx))
+        rep.sup = self._new_supervisor(idx)
         rep.healthy = True
         rep.needs_failover = False
         rep.down_error = None
@@ -994,8 +1009,7 @@ class ServeFleet:
         # a raising constructor must not leave half a replica behind
         # (the engine's own metrics unwind through its failure paths;
         # the fleet counters below are get-or-create and cannot raise)
-        sup = EngineSupervisor(self._model, **self._sup_kw,
-                               **self._replica_kw(idx))
+        sup = self._new_supervisor(idx)
         reg = self._reg
         rl = dict(fleet=self.fleet_label, replica=str(idx))
         new_counters = [
@@ -1247,6 +1261,7 @@ class ServeFleet:
                 if sjob.job is None:
                     self._ship_fallback(sjob, "nothing_shippable")
                     continue
+                self._before_build_advance(sjob)
                 done = rep.sup.advance_prefix_build(
                     sjob.job, rep.sup.engine._budget, rid=sjob.rid)
                 if done is None:
@@ -1295,6 +1310,13 @@ class ServeFleet:
         out.extend(self.router.rank(views))
         return out
 
+    def _before_build_advance(self, sjob):
+        """Hook called just before each ship build's advance quantum.
+        A no-op here; serve/dist/fleet.py uses it to open the
+        layer-wise STREAMED ship (pick the destination, start its
+        staging, attach the frame sink) so KV lanes ship while the
+        source is still prefilling later chunks."""
+
     def _complete_ship(self, sjob, src_rep):
         """Transfer a finished build: export the image from the
         source, land it on the first destination with capacity, and
@@ -1326,6 +1348,16 @@ class ServeFleet:
         if path is None:
             self._ship_fallback(sjob, "dst_capacity")
             return
+        self._land_shipped(sjob, src_rep, dst_rep, path, n,
+                           image.nbytes, t0)
+
+    def _land_shipped(self, sjob, src_rep, dst_rep, path, n, nbytes,
+                      t0):
+        """Final leg of any completed ship (bulk image OR streamed
+        frames): submit the request on the destination — where the
+        admission is a local warm hit — pin the shipped prefix for the
+        request's lifetime, and account the ship."""
+        req = sjob.request
         t1 = self._clock()
         dst = dst_rep.idx
         cache = dst_rep.sup.engine.prefix_cache
@@ -1351,7 +1383,7 @@ class ServeFleet:
         if nr is not None:
             nr(dst)
         self._c_ships.inc()
-        self._c_ship_bytes.inc(image.nbytes)
+        self._c_ship_bytes.inc(nbytes)
         if sjob.hit:
             # the prefix was RESIDENT on the source (an earlier
             # build, another request's donation): this ship recomputed
@@ -1362,13 +1394,13 @@ class ServeFleet:
             _reqs._ledger.annotate_hop(
                 sjob.rid, replica=dst, via="kv_ship",
                 src_replica=src_rep.idx, ship_s=t1 - t0,
-                ship_bytes=image.nbytes, ship_blocks=n)
+                ship_bytes=nbytes, ship_blocks=n)
         _trace.event("serve/kv_ship", cat="serve", request=sjob.rid,
                      src=src_rep.idx, dst=dst, blocks=n,
-                     bytes=image.nbytes)
+                     bytes=nbytes)
         self._log.info("shipped %d KV blocks for %s: replica %d -> %d"
                        " (%d bytes)", n, sjob.rid, src_rep.idx, dst,
-                       image.nbytes)
+                       nbytes)
 
     def _ship_fallback(self, sjob, reason):
         """Serve a failed ship COLD: nothing streamed during the

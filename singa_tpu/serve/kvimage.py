@@ -29,17 +29,39 @@ scales leaf drops the trailing ``D`` axis.  ``W`` is the image's lane
 width — a FULL row for swap (the historical shape, one executable per
 engine geometry) or the narrow ``n_data * block_size`` slice for
 shipping (ship bytes track the prompt, not ``max_len``).
+
+Since the multi-host round the image is also the WIRE format: every
+image carries a crc32 ``checksum`` over its leaf bytes (captured at
+pack time, re-derived in :meth:`KVImage.validate` — a bit-flip that
+preserves shape and dtype fails typed, which the header check alone
+cannot catch), and :meth:`KVImage.to_bytes` /
+:meth:`KVImage.from_bytes` frame it for a socket: magic + version +
+pickled metadata + raw leaf bytes + the checksum.  ``from_bytes``
+rejects truncation (mid-stream EOF), corruption (checksum mismatch)
+and version skew with :class:`KVImageError` BEFORE any array is
+handed to a pool.
 """
 
 from __future__ import annotations
 
+import pickle
+import struct
+import zlib
+
 import numpy as np
 
-__all__ = ["KVIMAGE_VERSION", "KVImage", "KVImageError", "pack_image"]
+__all__ = ["KVIMAGE_VERSION", "KVImage", "KVImageError", "pack_image",
+           "leaf_list"]
 
 #: bump when the leaf layout or header schema changes; ``validate``
 #: refuses images from a different version rather than guessing
 KVIMAGE_VERSION = 1
+
+#: wire framing for ``to_bytes``/``from_bytes``: magic, u16 version,
+#: u8 quant, u32 metadata length (then metadata, leaf bytes, crc32)
+_WIRE_MAGIC = b"KVIM"
+_WIRE_HEAD = struct.Struct("!4sHBI")
+_WIRE_CRC = struct.Struct("!I")
 
 
 class KVImageError(ValueError):
@@ -67,6 +89,23 @@ def _signature(kc, vc):
                  for a in _leaf_list(kc) + _leaf_list(vc))
 
 
+def leaf_list(tree):
+    """Public alias of the leaf flattening (K leaves then V leaves is
+    ``leaf_list(kc) + leaf_list(vc)``) — the dist ship path frames
+    images leaf-by-leaf and must slice in the exact order the
+    checksum covers."""
+    return _leaf_list(tree)
+
+
+def _checksum(kc, vc) -> int:
+    """crc32 over every leaf's raw bytes, K leaves then V leaves —
+    the content integrity the shape/dtype header cannot see."""
+    crc = 0
+    for a in _leaf_list(kc) + _leaf_list(vc):
+        crc = zlib.crc32(np.ascontiguousarray(a).data, crc)
+    return crc & 0xFFFFFFFF
+
+
 class KVImage:
     """One request's (or prefix's) KV blocks as a self-describing host
     image.  Construct through :func:`pack_image` — the header is
@@ -74,10 +113,10 @@ class KVImage:
     later truncation detectable."""
 
     __slots__ = ("version", "block_size", "n_data", "quant", "header",
-                 "kc", "vc")
+                 "kc", "vc", "checksum")
 
     def __init__(self, version, block_size, n_data, quant, header,
-                 kc, vc):
+                 kc, vc, checksum=None):
         self.version = int(version)
         self.block_size = int(block_size)
         self.n_data = int(n_data)
@@ -85,6 +124,11 @@ class KVImage:
         self.header = tuple(header)
         self.kc = kc
         self.vc = vc
+        # crc32 over the leaf bytes (None on images packed by callers
+        # predating the wire round: validate then skips the content
+        # check and keeps the header/geometry checks)
+        self.checksum = (None if checksum is None
+                         else int(checksum) & 0xFFFFFFFF)
 
     @property
     def width(self) -> int:
@@ -129,6 +173,14 @@ class KVImage:
                 "KV image arrays do not match their pack-time header "
                 "(truncated or mutated in transit): "
                 f"header={self.header} got={sig}")
+        if self.checksum is not None:
+            crc = _checksum(self.kc, self.vc)
+            if crc != self.checksum:
+                raise KVImageError(
+                    f"KV image payload corrupted in transit: crc32 "
+                    f"{crc:#010x} != packed {self.checksum:#010x} — "
+                    f"a shape-preserving bit-flip the header check "
+                    f"cannot see; refuse before any scatter")
         k_leaves = _leaf_list(self.kc)
         v_leaves = _leaf_list(self.vc)
         if len(k_leaves) != len(v_leaves):
@@ -191,13 +243,117 @@ class KVImage:
 
         kc, vc = cut(self.kc), cut(self.vc)
         return KVImage(self.version, self.block_size, n, self.quant,
-                       _signature(kc, vc), kc, vc)
+                       _signature(kc, vc), kc, vc,
+                       checksum=_checksum(kc, vc))
+
+    # -- wire codec (the dist transport's KV payload) --------------------
+    def to_bytes(self) -> bytes:
+        """Frame the image for a socket: magic + version + quant +
+        length-prefixed metadata (geometry + per-leaf header), the raw
+        leaf bytes in header order, and a trailing crc32 over the leaf
+        bytes.  Decode with :meth:`from_bytes`."""
+        leaves = [np.ascontiguousarray(a)
+                  for a in _leaf_list(self.kc) + _leaf_list(self.vc)]
+        meta = pickle.dumps(
+            {"block_size": self.block_size, "n_data": self.n_data,
+             "header": self.header,
+             "k_leaves": len(_leaf_list(self.kc))},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        crc = 0
+        chunks = [_WIRE_HEAD.pack(_WIRE_MAGIC, self.version,
+                                  int(self.quant), len(meta)), meta]
+        for a in leaves:
+            crc = zlib.crc32(a.data, crc)
+            chunks.append(a.tobytes())
+        chunks.append(_WIRE_CRC.pack(crc & 0xFFFFFFFF))
+        return b"".join(chunks)
+
+    @classmethod
+    def from_bytes(cls, buf) -> "KVImage":
+        """Decode a :meth:`to_bytes` frame.  Every malformed input is
+        a typed :class:`KVImageError`: short buffers (mid-stream EOF),
+        bad magic, version skew, and payload whose crc32 disagrees
+        with the trailer (bit-flip in transit).  The returned image
+        still goes through :meth:`validate` at the consuming pool —
+        this decoder checks the WIRE, validate checks the POOL."""
+        buf = memoryview(bytes(buf))
+        if len(buf) < _WIRE_HEAD.size + _WIRE_CRC.size:
+            raise KVImageError(
+                f"KV image wire frame truncated: {len(buf)} bytes is "
+                f"shorter than the fixed framing "
+                f"({_WIRE_HEAD.size + _WIRE_CRC.size})")
+        magic, version, quant, meta_len = _WIRE_HEAD.unpack_from(buf, 0)
+        if magic != _WIRE_MAGIC:
+            raise KVImageError(
+                f"KV image wire frame has bad magic {bytes(magic)!r} "
+                f"(expected {_WIRE_MAGIC!r}): not a KV image")
+        if version != KVIMAGE_VERSION:
+            raise KVImageError(
+                f"KV image wire version {version} != supported "
+                f"{KVIMAGE_VERSION}: refuse rather than guess at the "
+                f"leaf layout")
+        off = _WIRE_HEAD.size
+        if len(buf) < off + meta_len + _WIRE_CRC.size:
+            raise KVImageError(
+                f"KV image wire frame truncated inside metadata "
+                f"({len(buf)} bytes, metadata needs "
+                f"{off + meta_len + _WIRE_CRC.size})")
+        try:
+            meta = pickle.loads(bytes(buf[off:off + meta_len]))
+            header = tuple(tuple(h) for h in meta["header"])
+            k_leaves = int(meta["k_leaves"])
+            block_size, n_data = meta["block_size"], meta["n_data"]
+        except Exception as e:
+            raise KVImageError(
+                f"KV image wire metadata undecodable ({e!r})") from e
+        off += meta_len
+        leaves = []
+        for shape, dtype in header:
+            n = int(np.prod(shape, dtype=np.int64)) \
+                * np.dtype(dtype).itemsize
+            if len(buf) < off + n + _WIRE_CRC.size:
+                raise KVImageError(
+                    f"KV image wire frame truncated mid-leaf: leaf "
+                    f"{len(leaves)} needs {n} bytes, "
+                    f"{len(buf) - off - _WIRE_CRC.size} remain "
+                    f"(mid-stream EOF)")
+            leaves.append(np.frombuffer(
+                buf[off:off + n], dtype=dtype).reshape(shape))
+            off += n
+        if len(buf) != off + _WIRE_CRC.size:
+            raise KVImageError(
+                f"KV image wire frame has {len(buf) - off - _WIRE_CRC.size}"
+                f" trailing bytes beyond its header's leaves (length-"
+                f"lying frame)")
+        (want,) = _WIRE_CRC.unpack_from(buf, off)
+        crc = 0
+        for a in leaves:
+            crc = zlib.crc32(a.data, crc)
+        crc &= 0xFFFFFFFF
+        if crc != want:
+            raise KVImageError(
+                f"KV image wire payload corrupted: crc32 {crc:#010x} "
+                f"!= trailer {want:#010x} (bit-flip in transit)")
+        if not 0 < k_leaves < len(leaves) or k_leaves * 2 != len(leaves):
+            raise KVImageError(
+                f"KV image wire metadata claims {k_leaves} K leaves "
+                f"of {len(leaves)} total — K/V must split evenly")
+
+        def tree(ls):
+            return ls[0] if len(ls) == 1 else tuple(ls)
+
+        return cls(version, block_size, n_data, bool(quant), header,
+                   tree(leaves[:k_leaves]), tree(leaves[k_leaves:]),
+                   checksum=want)
 
 
 def pack_image(kc_host, vc_host, block_size, n_data, quant) -> KVImage:
     """Seal host cache-row pytrees into a :class:`KVImage`.  The
     per-leaf header is captured HERE, so any later divergence between
     the arrays and what was packed (a truncated transfer, an in-place
-    mutation) fails :meth:`KVImage.validate` typed."""
+    mutation) fails :meth:`KVImage.validate` typed.  Since the wire
+    round the content crc32 is captured too — shape-preserving
+    corruption fails the same way."""
     return KVImage(KVIMAGE_VERSION, block_size, n_data, quant,
-                   _signature(kc_host, vc_host), kc_host, vc_host)
+                   _signature(kc_host, vc_host), kc_host, vc_host,
+                   checksum=_checksum(kc_host, vc_host))

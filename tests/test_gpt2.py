@@ -456,8 +456,12 @@ def test_int8_cache_decode_matches_dense_on_trained_model():
     are int8."""
     import jax.numpy as jnp
 
+    from singa_tpu import device as device_module
     from singa_tpu.models import gpt2_decode
 
+    # seed the init: with urandom weights the trained logit margins are
+    # occasionally thin enough for int8 noise to flip a greedy argmax
+    device_module.get_default_device().SetRandSeed(0)
     cfg = _cfg()
     m = GPT2LMHead(cfg)
     m.set_optimizer(opt.Adam(lr=1e-3))

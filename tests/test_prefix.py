@@ -368,3 +368,71 @@ def test_prefix_metrics_flow_into_health_and_prometheus():
     snap = observe.registry().snapshot()["counters"]
     lbl = "{engine=" + eng.stats.engine_label + "}"
     assert ("serve.prefix.hits" + lbl) not in snap
+
+
+# ---------------------------------------------------------------------------
+# FleetPrefixIndex staleness: the residency directory vs the live tree
+# ---------------------------------------------------------------------------
+
+def test_fleet_index_stale_after_live_eviction():
+    """The cross-host residency lifecycle at unit level: a hint is
+    registered while the blocks are cached, per-replica LRU eviction
+    silently invalidates it, the verify-against-the-live-tree step
+    (what the fleet's _verified_holder does over the wire) detects
+    the shortfall, and ``unregister`` prunes the lie — the next
+    lookup reports no holder, so the request serves cold."""
+    from singa_tpu.serve.prefix import FleetPrefixIndex
+
+    m = _model()
+    idx = FleetPrefixIndex(BS)
+    eng = m.serve(max_slots=1, **_cache_kw(num_blocks=8))
+    rng = np.random.RandomState(5)
+    warm = rng.randint(0, 256, 3 * BS).astype(np.int32)
+    h = eng.submit(GenerationRequest(warm, max_new_tokens=2))
+    eng.run_until_complete(max_steps=100)
+    h.result()
+    n_cached = len(eng.prefix_cache.lookup(warm))
+    assert n_cached >= 2
+    idx.register(warm, n_cached, replica=0)
+    assert idx.holders(warm, n_cached) == [0]
+
+    # unrelated traffic floods the 8-block pool: the hinted path is
+    # LRU-evicted from the LIVE tree while the directory still lies
+    for i in range(4):
+        p = rng.randint(0, 256, 3 * BS).astype(np.int32)
+        eng.submit(GenerationRequest(p, max_new_tokens=2))
+        eng.run_until_complete(max_steps=100)
+    live = len(eng.prefix_cache.lookup(warm))
+    assert live < n_cached                        # the hint went stale
+    assert idx.holders(warm, n_cached) == [0]     # ...and still lies
+
+    idx.unregister(warm, n_cached, replica=0)     # the verify verdict
+    assert idx.holders(warm, n_cached) == []
+    assert idx.snapshot()["indexed_blocks"] == 0
+    eng.close()
+
+
+def test_fleet_index_dead_host_drop_is_exhaustive():
+    """drop_replica forgets a dead host EVERYWHERE — full spans,
+    partial overlaps with a surviving host, and the node accounting —
+    so a revived replica's empty tree never inherits stale claims."""
+    from singa_tpu.serve.prefix import FleetPrefixIndex
+
+    idx = FleetPrefixIndex(BS)
+    rng = np.random.RandomState(9)
+    a = rng.randint(0, 256, 3 * BS).astype(np.int32)
+    b = rng.randint(0, 256, 2 * BS).astype(np.int32)
+    idx.register(a, 3, replica=0)
+    idx.register(a, 2, replica=1)                 # shared partial span
+    idx.register(b, 2, replica=0)                 # replica-0 exclusive
+    assert idx.holders(a, 3) == [0]
+    assert idx.holders(a, 2) == [0, 1]
+
+    idx.drop_replica(0)
+    assert idx.holders(a, 3) == []                # dead host's span gone
+    assert idx.holders(a, 2) == [1]               # survivor's claim kept
+    assert idx.holders(b, 2) == []                # exclusive path pruned
+    # only replica 1's two shared blocks remain indexed
+    assert idx.snapshot()["indexed_blocks"] == 2
+    idx.drop_replica(1)
+    assert idx.snapshot()["indexed_blocks"] == 0
