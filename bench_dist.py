@@ -606,6 +606,136 @@ def _flagship_projection(W):
     return out
 
 
+def _pull_worker_jit(f):
+    """Sum the WORKER-side jit-cache censuses over the telemetry op
+    (None if any worker's jax build can't count)."""
+    total = 0
+    for i in range(f.replicas):
+        v = f.supervisor(i)._conn.call(
+            "telemetry", {"jit": True}, timeout=10.0,
+            fault_site="serve.dist.telemetry")["value"].get("jit_cache")
+        if v is None:
+            return None
+        total += v
+    return total
+
+
+def _federation_evidence(f, args, jit_cold, jit_warm):
+    """Phase-2 federation measurement over the live 2-process fleet:
+    kill the telemetry channel (one lost pull -> typed ``stale``, the
+    next pull recovers), take the final federated pull, then write and
+    strictly re-parse the three merged artifacts, checking the gate's
+    invariants — >=2 host pids + a cross-host flow arrow in the trace,
+    ``+Inf`` bucket == ``_count`` for every federated histogram
+    ladder, why_slow latency fractions summing to 1 with the exact
+    ``ship`` phase, and zero warm recompiles with federation on."""
+    from singa_tpu.observe import health_report
+    from singa_tpu.resilience import FailOnce, faults
+
+    # telemetry-channel death: serving untouched, typed degradation
+    faults.inject("serve.dist.telemetry", FailOnce())
+    f._maybe_pull_telemetry(force=True)
+    stale_seen = health_report()["serve"]["dist"]["stale_hosts"]
+    f._maybe_pull_telemetry(force=True)       # recovery + fresh pull
+    ds = health_report()["serve"]["dist"]
+    # federation observes, never compiles: the clock probes + pulls
+    # above must leave every worker jit cache exactly where the warm
+    # repeat left it
+    jit_end = _pull_worker_jit(f)
+
+    ws = ds["why_slow"]
+    lat = ws["latency_p99_attribution"]
+    frac_sum = sum(p["frac"] for p in lat.values())
+
+    # the federated histogram contract, per host series
+    fams, inf_ok, pick = 0, True, None
+    for _host, hh in f.telemetry.hosts.items():
+        if hh.registry is None:
+            continue
+        for mtr in hh.registry["metrics"]:
+            if mtr["kind"] != "histogram":
+                continue
+            fams += 1
+            inf_ok &= (mtr["buckets"][-1][1] == mtr["count"])
+            if pick is None and mtr["count"]:
+                pick = mtr["name"]
+    mh = f.telemetry.merged_histogram(pick) if pick else None
+
+    # artifacts: merged Chrome trace, host-labeled exposition, fleet
+    # request log — re-parsed STRICTLY after writing (a NaN/Inf is a
+    # write-time error here, not a viewer surprise later)
+    tpath = os.path.join(_REPO, args.trace_out)
+    ppath = os.path.join(_REPO, args.prom_out)
+    rpath = os.path.join(_REPO, args.request_log)
+    n_ev = f.telemetry.write_chrome_trace(tpath)
+    prom = f.telemetry.prometheus_text()
+    with open(ppath, "w") as fh:
+        fh.write(prom)
+    n_req = f.telemetry.write_request_log(rpath)
+
+    def _no_const(s):
+        raise ValueError(f"non-strict JSON constant: {s}")
+
+    with open(tpath) as fh:
+        doc = json.load(fh, parse_constant=_no_const)
+    with open(rpath) as fh:
+        for line in fh:
+            json.loads(line, parse_constant=_no_const)
+    pids = sorted({e["pid"] for e in doc["traceEvents"]})
+    host_pids = [p for p in pids if p >= 10]
+    flows = doc["otherData"]["cross_host_flows"]
+
+    fed = {
+        "hosts": sorted(ds["hosts"]),
+        "worker_pids": {h: d["pid"] for h, d in ds["hosts"].items()},
+        "clock": {h: d["clock"] for h, d in ds["hosts"].items()},
+        "pulls": {h: d["pulls"] for h, d in ds["hosts"].items()},
+        "stale_seen": stale_seen,
+        "stale_after_recovery": ds["stale_hosts"],
+        "why_slow": {
+            "latency_frac_sum": round(frac_sum, 6),
+            "ttft_phases": sorted(ws["ttft_p99_attribution"]),
+            "straggler_host": ws["straggler_host"],
+        },
+        "trace": {"events": n_ev, "pids": pids,
+                  "host_pids": host_pids,
+                  "cross_host_flows": flows},
+        "prometheus": {
+            "bytes": len(prom),
+            "host_labeled_series": prom.count('host="'),
+            "histogram_families": fams,
+            "inf_bucket_equals_count": bool(inf_ok),
+        },
+        "fleet_histogram": (None if mh is None else {
+            "name": mh["name"], "count": mh["count"],
+            "per_host_counts": mh["per_host_counts"],
+            "p50": mh["p50"], "p99": mh["p99"]}),
+        "request_log_entries": n_req,
+        "jit_cache_before_warm_repeat": jit_cold,
+        "jit_cache_after_warm_repeat": jit_warm,
+        "recompiles_warm": (None if jit_cold is None
+                            or jit_warm is None
+                            else jit_warm - jit_cold),
+        "recompiles_federation": (
+            None if jit_warm is None or jit_end is None
+            else jit_end - jit_warm),
+        "artifacts": {"trace": args.trace_out,
+                      "prom": args.prom_out,
+                      "request_log": args.request_log},
+    }
+    assert stale_seen == ["w0"], stale_seen
+    assert ds["stale_hosts"] == [], ds
+    assert abs(frac_sum - 1.0) < 1e-6, lat
+    assert "ship" in ws["ttft_p99_attribution"], ws
+    assert len(host_pids) >= 2, pids
+    assert flows >= 1, doc["otherData"]
+    assert fams > 0 and inf_ok, (fams, inf_ok)
+    assert fed["recompiles_warm"] in (0, None), fed
+    assert fed["recompiles_federation"] in (0, None), fed
+    assert n_req >= 2 and n_ev > 0, (n_req, n_ev)
+    return fed
+
+
 def _fleet_smoke(args):
     """``--fleet``: the multi-host serving smoke (the dist round) —
     a 2-PROCESS local DistFleet on CPU proving the wire is invisible:
@@ -614,11 +744,24 @@ def _fleet_smoke(args):
     warm repeat's TTFT beating the cold prefill, (3) one worker kill
     with every in-flight request requeued to parity.  Bounded-time:
     this is the tier-1 CI gate next to soak/chaos, not a benchmark —
-    wall time rides the JSON so the gate's budget is visible."""
+    wall time rides the JSON so the gate's budget is visible.
+
+    Since the federation round the smoke also proves the fleet can be
+    SEEN across the process boundary: phase 2 runs with the request
+    ledger + tracing federated over the wire, writes the merged
+    2-process Chrome trace (one pid per host, a cross-host flow arrow
+    on the KV ship), the host-labeled Prometheus exposition, and the
+    fleet-wide request log (``--trace-out`` / ``--prom-out`` /
+    ``--request-log``), kills the telemetry channel mid-run to show
+    the typed ``stale`` degradation + recovery, and pins the worker
+    jit caches across the warm repeat (federation observes, never
+    recompiles)."""
     import jax
 
-    from singa_tpu import tensor
+    from singa_tpu import observe, tensor
     from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe import health_report
+    from singa_tpu.resilience import FailOnce, faults
     from singa_tpu.serve import (DistFleet, GenerationRequest,
                                  PagedConfig, PrefixCacheConfig,
                                  ServeFleet, gpt2_spec)
@@ -658,8 +801,16 @@ def _fleet_smoke(args):
     leaked = 0
 
     # 1. parity across the process boundary ---------------------------
+    # (the in-process reference runs UNOBSERVED so the ledger and the
+    # merged artifacts below carry only cross-process traffic)
     with ServeFleet(m, replicas=2, max_slots=2) as f:
         want = run(f, prompts)
+
+    observe.clear()
+    observe.enable()
+    led = observe.requests.enable(capacity=4096)
+    faults.clear()
+
     with DistFleet(spec, replicas=2, spawn="process",
                    max_slots=2) as f:
         got = run(f, prompts)
@@ -691,8 +842,22 @@ def _fleet_smoke(args):
                                         request_id="warm"))
         f.run_until_complete(max_steps=800)
         warm = h2.result()
+        # steady-state recompile pin: the FIRST warm repeat may compile
+        # the warm-admission executables once; the second identical
+        # repeat must compile NOTHING (worker-side census over the
+        # telemetry op — this is the cross-process bench_serve pin)
+        jit_cold = _pull_worker_jit(f)
+        h3 = f.submit(GenerationRequest(doc, max_new_tokens=4,
+                                        request_id="warm2"))
+        f.run_until_complete(max_steps=800)
+        warm2 = h3.result()
+        jit_warm = _pull_worker_jit(f)
+        assert [int(t) for t in warm2.tokens] \
+            == [int(t) for t in warm.tokens]
         snap = f.snapshot()
         leaked += leaks(f)
+        result["federation"] = _federation_evidence(f, args, jit_cold,
+                                                    jit_warm)
     result["ship"] = {
         "doc_tokens": int(len(doc)),
         "ships": snap["ships"],
@@ -725,6 +890,12 @@ def _fleet_smoke(args):
                  for h in hs if h.done()]
         snap = f.snapshot()
         healthy = f.healthy_replicas
+    # the kill is OBSERVABLE: every peer-loss lands in the controller
+    # ledger as a typed reject hop (requeue continuity keeps the same
+    # request id through to its final parity-checked completion)
+    peer_lost = sum(
+        1 for e in led.entries() for h in e["hops"]
+        if (h.get("reject") or {}).get("reason") == "peer_lost")
     result["kill"] = {
         "requests": 4,
         "wedged_or_lost": wedged,
@@ -733,10 +904,12 @@ def _fleet_smoke(args):
         "failovers": snap["failovers"],
         "requeues": snap["requeues"],
         "replicas_healthy_after": healthy,
+        "peer_lost_hops_recorded": peer_lost,
     }
     assert wedged == 0, f"{wedged} requests wedged after kill"
     assert result["kill"]["completed_with_parity"] == 4
     assert snap["failovers"] >= 1 and healthy == 1
+    assert peer_lost >= 1, "kill left no typed reject in the ledger"
 
     result["blocks_leaked"] = leaked
     assert leaked == 0, f"{leaked} blocks leaked"
@@ -763,6 +936,16 @@ def main():
                     help="multi-host serving smoke: 2-process "
                          "DistFleet parity + one streamed ship + one "
                          "kill (writes MULTICHIP_r06.json by default)")
+    ap.add_argument("--trace-out", default="MULTICHIP_trace.json",
+                    help="--fleet: merged 2-process Chrome trace "
+                         "(one pid per host, cross-host flow arrows)")
+    ap.add_argument("--prom-out", default="MULTICHIP_metrics.prom",
+                    help="--fleet: federated Prometheus exposition "
+                         "(every worker series host= labeled)")
+    ap.add_argument("--request-log",
+                    default="MULTICHIP_requests.jsonl",
+                    help="--fleet: fleet-wide merged request log "
+                         "(sealed ledger entries, JSONL)")
     args = ap.parse_args()
 
     if args.fleet:

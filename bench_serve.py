@@ -186,42 +186,14 @@ def run_prefix_engine(m, workload, max_slots, prefix_cfg=None,
 
 
 def _serve_jit_cache_size():
-    """Total jit-cache entries across every executable the engine,
-    prefix cache, and paged arena dispatch — pinned across the timed
-    runs to prove the warm path introduces ZERO runtime recompiles.
-    The paged pool steps dispatch through their own AOT compile cache
-    (cost-table capture) and the TP backend through its sharded-twin
-    cache, so both entry counts ride the same pin."""
-    from singa_tpu.serve import engine as E
-    from singa_tpu.serve import paged as G
-    from singa_tpu.serve import prefix as P
-    from singa_tpu.serve import tp as T
+    """Total jit-cache entries across every executable the serve stack
+    dispatches — pinned across the timed runs to prove the warm path
+    introduces ZERO runtime recompiles.  The census itself lives in
+    :mod:`singa_tpu.serve.jitpin` since the federation round (DistFleet
+    workers report it over the telemetry op); this is the same count."""
+    from singa_tpu.serve.jitpin import jit_cache_size
 
-    total = 0
-    for f in (E._pool_decode_step, E._pool_spec_step, E._prefill_one,
-              E._prefill_batch, E._prefill_rows, E._write_slot,
-              E._chunk_row,
-              E._first_from_hidden, P._blocks_to_row,
-              P._row_to_blocks, P._read_slot, G._paged_decode_step,
-              G._paged_spec_step, G._paged_decode_kernel,
-              G._paged_spec_kernel, G._pool_to_row, G._row_to_pool,
-              G._rows_to_pool):
-        try:
-            total += f._cache_size()
-        except Exception:
-            return None  # jax without _cache_size: report honestly
-    twins = T._twin_cache_size()
-    if twins is None:
-        return None
-    from singa_tpu.serve import ep as EPM
-    from singa_tpu.serve import pp as PPM
-
-    ep_twins = EPM._twin_cache_size()
-    pp_twins = PPM._twin_cache_size()
-    if ep_twins is None or pp_twins is None:
-        return None
-    return (total + G._compile_cache_size() + twins + ep_twins
-            + pp_twins)
+    return jit_cache_size()
 
 
 def run_prefix_mix(max_slots):

@@ -56,5 +56,8 @@ from . import timeseries  # noqa: F401  (windowed telemetry rings)
 from .timeseries import WindowedFamily, WindowRing  # noqa: F401
 from . import slo  # noqa: F401  (multi-window burn-rate alerting)
 from .slo import BurnRule, SLOPolicy  # noqa: F401
+from . import federate  # noqa: F401  (cross-host merge: clocks,
+#                                      traces, metrics, why_slow)
+from .federate import ClockSync, FleetTelemetry  # noqa: F401
 from . import health  # noqa: F401
 from .health import SLO, health_report  # noqa: F401

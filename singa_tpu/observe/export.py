@@ -124,7 +124,8 @@ def request_trace_events(entries, pid=1) -> list:
                 hop_end = h["t_submit"]
             base = {"request": rid, "hop": j,
                     "engine": h.get("engine"),
-                    "replica": h.get("replica"), "via": h.get("via")}
+                    "replica": h.get("replica"),
+                    "host": h.get("host"), "via": h.get("via")}
 
             def span(name, t0, t1, **extra):
                 if t0 is None or t1 is None or t1 < t0:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 from dataclasses import asdict, dataclass
 
+from . import federate as _federate
 from . import monitor as _monitor
 from . import requests as _requests
 from . import slo as _slo
@@ -437,6 +438,13 @@ def health_report(reg=None, engine_snapshots=(),
             # slowest requests into queue/prefill/decode/stall/hop
             # phase components — the "WHY did p99 regress" answer
             "why_slow": _requests.why_slow_section(),
+            # cross-host federation (observe.federate): always
+            # present; {"enabled": False} until a federated DistFleet
+            # installs its FleetTelemetry.  When live it carries
+            # per-host clock/staleness status and the FLEET-wide
+            # why_slow (worker hop detail merged in controller time,
+            # straggler host named)
+            "dist": _federate.dist_section(),
             # multi-window burn-rate alerting (observe.slo): always
             # present; {"enabled": False} until an SLOPolicy installs
             "slo_alerts": _slo.alerts_section(),

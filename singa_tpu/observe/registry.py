@@ -346,6 +346,32 @@ class MetricsRegistry:
             self._kinds.clear()
             self._windowed.clear()
 
+    def dump(self) -> dict:
+        """Wire-serializable full dump (the federation telemetry
+        schema, ``observe.federate``): one dict per metric with
+        name/kind/labels/help, plus value (counter/gauge) or the full
+        cumulative bucket ladder + running sum/count + exact
+        nearest-rank p50/p99 (histogram).  Unlike :meth:`snapshot`
+        this ships the BUCKETS, so a controller can re-expose a
+        worker's histograms as real TYPE-histogram families that
+        ``histogram_quantile`` aggregates across hosts."""
+        out = []
+        for m in self.metrics():
+            d = {"name": m.name, "kind": m.KIND,
+                 "labels": [list(kv) for kv in m.labels],
+                 "help": m.help}
+            if isinstance(m, Histogram):
+                d["buckets"] = [[le, c] for le, c in
+                                m.bucket_counts()]
+                d["sum"] = m.series.total_sum
+                d["count"] = m.series.count
+                d["p50"] = m.series.percentile(50)
+                d["p99"] = m.series.percentile(99)
+            else:
+                d["value"] = m.value
+            out.append(d)
+        return {"schema": "singa_tpu.telemetry/1", "metrics": out}
+
     def snapshot(self) -> dict:
         """JSON-able view: ``{"counters": {...}, "gauges": {...},
         "histograms": {...}}`` keyed ``name{k=v,...}`` (labels sorted,

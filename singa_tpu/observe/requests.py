@@ -75,7 +75,8 @@ import json
 from ..utils.metrics import percentile
 
 __all__ = ["RequestLedger", "enable", "disable", "active", "ledger",
-           "why_slow_section", "write_request_log"]
+           "why_slow_section", "write_request_log",
+           "set_host_namer"]
 
 # Module-global fast path, mirroring trace._active: `if not
 # requests._active: <skip>` is the ENTIRE disabled cost of a hook
@@ -85,6 +86,20 @@ _ledger = None
 
 #: outcomes that mean "completed normally" (engine finish reasons)
 _COMPLETED = ("length", "stop")
+
+# replica index -> host id, installed by a DistFleet (observe.federate)
+# so hop records carry WHERE a hop ran across the process boundary;
+# None (the default) leaves hosts unset — in-process fleets group
+# under "local" in the per-host attribution
+_host_namer = None
+
+
+def set_host_namer(fn):
+    """Install (or clear, with None) the replica->host-id mapping the
+    ledger stamps onto hops as ``replica`` annotations arrive.  The
+    dist fleet owns this: ``w<idx>`` per worker peer."""
+    global _host_namer
+    _host_namer = fn
 
 
 def enable(capacity=1024, record_steps=True) -> "RequestLedger":
@@ -157,6 +172,8 @@ def _new_hop(engine, t):
     return {
         "engine": engine,       # EngineStats.engine_label (unique)
         "replica": None,        # fleet replica index, when routed
+        "host": None,           # host id, when served across the
+        #                         process boundary (observe.federate)
         "via": "submit",        # submit|supervisor_restart|failover|
         #                         hedge|refused|prefill|kv_ship|
         #                         ship_fallback
@@ -277,6 +294,9 @@ class RequestLedger:
         _, hop = self._hop(rid, engine)
         if hop is not None:
             hop.update(attrs)
+            if _host_namer is not None and hop.get("host") is None \
+                    and hop.get("replica") is not None:
+                hop["host"] = _host_namer(hop["replica"])
 
     def on_admit(self, rid, engine, t, slot=None, step=None):
         """Admission started: the request left the queue for a pool
@@ -541,6 +561,16 @@ class RequestLedger:
             return str(final["replica"])
         return f'engine:{final.get("engine")}'
 
+    @staticmethod
+    def _host_key(e) -> str:
+        """Grouping key for per-host attribution: the final hop's host
+        id when served across the process boundary (observe.federate
+        stamps it), else "local" — an in-process fleet is one host."""
+        idx = e.get("final_hop")
+        final = (e["hops"][idx] if idx is not None
+                 else _final_hop(e))
+        return final.get("host") or "local"
+
     def why_slow(self, top_k=5) -> dict:
         """Tail-latency attribution over the sealed ring.
 
@@ -566,7 +596,10 @@ class RequestLedger:
             "dropped": self.dropped,
             "ttft_p99_s": None,
             "ttft_p99_attribution": {},
+            "latency_p99_attribution": {},
             "per_replica": {},
+            "per_host": {},
+            "straggler_host": None,
             "tpot_p99_s": None,
             "tpot_p99_attribution": {},
             "slowest": [],
@@ -580,11 +613,21 @@ class RequestLedger:
         total = sum(e["ttft_s"] for e in pop)
         sums = {"queue": 0.0, "prefill": 0.0, "hops": 0.0,
                 "ship": 0.0}
-        per_rep = {}
+        # the full end-to-end decomposition over the same population:
+        # all SEVEN phases sum to t_retire - t_submit per entry
+        # (_phases is exact by construction), so these fractions sum
+        # to 1 — the fleet-level "where did the whole latency go"
+        lat_total = sum(e["t_retire"] - e["t_submit"] for e in pop)
+        lat_sums = {"queue": 0.0, "prefill": 0.0, "ship": 0.0,
+                    "decode": 0.0, "stall": 0.0, "preempted": 0.0,
+                    "hops": 0.0}
+        per_rep, per_host = {}, {}
         for e in pop:
             ph = e["phases"] or self._phases(e)
             for k in sums:
                 sums[k] += ph.get(k, 0.0)
+            for k in lat_sums:
+                lat_sums[k] += ph.get(k, 0.0)
             rep = per_rep.setdefault(self._replica_key(e), {
                 "requests": 0, "ttft_s": 0.0, "queue": 0.0,
                 "prefill": 0.0, "hops": 0.0, "ship": 0.0})
@@ -592,10 +635,26 @@ class RequestLedger:
             rep["ttft_s"] += e["ttft_s"]
             for k in ("queue", "prefill", "hops", "ship"):
                 rep[k] += ph.get(k, 0.0)
+            hst = per_host.setdefault(self._host_key(e), {
+                "requests": 0, "ttft_s": 0.0, "total_s": 0.0})
+            hst["requests"] += 1
+            hst["ttft_s"] += e["ttft_s"]
+            hst["total_s"] += e["t_retire"] - e["t_submit"]
         out["ttft_p99_attribution"] = {
             k: {"s": v, "frac": (v / total if total > 0 else 0.0)}
             for k, v in sums.items()}
+        out["latency_p99_attribution"] = {
+            k: {"s": v,
+                "frac": (v / lat_total if lat_total > 0 else 0.0)}
+            for k, v in lat_sums.items()}
         out["per_replica"] = per_rep
+        out["per_host"] = per_host
+        # the straggler: the host contributing the most tail TTFT —
+        # same max-by idiom as health's step-time straggler
+        worst = max(per_host, key=lambda h: per_host[h]["ttft_s"])
+        out["straggler_host"] = {
+            "host": worst, "ttft_s": per_host[worst]["ttft_s"],
+            "requests": per_host[worst]["requests"]}
         tpots = [e["tpot_s"] for e in completed
                  if e["tpot_s"] is not None]
         if tpots:
@@ -633,6 +692,7 @@ class RequestLedger:
                 "dominant_phase": max(ph, key=ph.get),
                 "hops": [{"engine": h.get("engine"),
                           "replica": h.get("replica"),
+                          "host": h.get("host"),
                           "via": h.get("via")} for h in e["hops"]],
             })
         return out

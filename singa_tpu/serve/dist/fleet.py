@@ -67,7 +67,10 @@ import time
 
 import numpy as np
 
+from ...observe import federate as _federate
 from ...observe import requests as _reqs
+from ...observe import trace as _trace
+from ...observe.federate import ClockSync, FleetTelemetry
 from ...observe.timeseries import WindowRing
 from ...resilience import faults as _faults
 from ..fleet import ServeFleet
@@ -297,6 +300,13 @@ class RemoteSupervisor:
                 continue
             if "err" in out:
                 h._reject(load_exc(out["err"]))
+                if self._fleet._spawn_mode == "process":
+                    # thread mode: the worker engine's own reject site
+                    # already emitted the instant into the SHARED trace
+                    _trace.event(
+                        "serve/request_rejected", cat="serve",
+                        request=rid, reason=type(h._error).__name__,
+                        replica=self._idx)
                 if _reqs._active \
                         and self._fleet._spawn_mode == "process":
                     _reqs._ledger.on_reject(
@@ -441,6 +451,17 @@ class RemoteSupervisor:
             h._reject(EngineFailedError(
                 f"{rid}: worker r{self._idx} lost ({reason})",
                 request_id=rid, started=started))
+            # the worker is UNREACHABLE: nothing on its side can
+            # record this rejection — the controller is the authority
+            # on the delivery-started verdict, so it lands here
+            _trace.event("serve/request_rejected", cat="serve",
+                         request=rid, reason="peer_lost",
+                         replica=self._idx, started=started)
+            if _reqs._active:
+                _reqs._ledger.on_reject(
+                    rid, t=self._clock(), reason="peer_lost",
+                    engine=self.engine.stats.engine_label,
+                    started=started)
         self._order = []
 
     # -- ship API (the fleet's _drive_ships speaks this) -----------------
@@ -474,6 +495,7 @@ class RemoteSupervisor:
         RuntimeError so the drive loop requeues the request cold
         WITHOUT condemning the healthy source."""
         dst_sup, ship_id = stream
+        t0 = self._clock()
         try:
             for (li, layer, lo, hi, data) in frames:
                 if _faults._armed:
@@ -483,6 +505,12 @@ class RemoteSupervisor:
                     "lo": lo, "hi": hi, "bytes": data})
                 dst_sup._c_frames.inc()
                 dst_sup._c_frame_bytes.inc(len(data))
+            # wire time spent HERE is overlapped with the source's
+            # next prefill chunk — the hidden half of the ship
+            fleet = self._fleet
+            fleet._ship_hidden[rid] = (
+                fleet._ship_hidden.get(rid, 0.0)
+                + (self._clock() - t0))
         except PeerGoneError as e:
             dst_sup._c_rpc_errors.inc()
             fleet = self._fleet
@@ -610,7 +638,8 @@ class DistFleet(ServeFleet):
 
     def __init__(self, spec, replicas=2, spawn="thread",
                  stream_ships=True, rpc_timeout=60.0,
-                 heartbeat_timeout=30.0, **kw):
+                 heartbeat_timeout=30.0, federate=True,
+                 telemetry_interval_s=2.0, **kw):
         if not isinstance(spec, ModelSpec):
             raise TypeError(
                 f"DistFleet needs a ModelSpec (the worker's model "
@@ -640,7 +669,36 @@ class DistFleet(ServeFleet):
         #: evidence surface: snapshot()["dist"]["ship_s_*"])
         self.ship_window = WindowRing(
             kind="event", clock=kw.get("clock", time.monotonic))
+        # -- federation state (must exist BEFORE super().__init__:
+        # supervisors spawn in there and register their hosts) -------
+        self._federate = bool(federate)
+        self._telemetry_interval = float(telemetry_interval_s)
+        self._t_last_pull = None
+        self._ship_hidden = {}    # rid -> wire s overlapped w/ prefill
+        self._peer_metrics = {}   # idx -> [Conn transport metrics]
+        #: controller-side merge of every worker's telemetry: clocks,
+        #: registries, ledgers, traces (observe.federate)
+        self.telemetry = FleetTelemetry(
+            clock=kw.get("clock", time.monotonic))
+        if self._federate:
+            # hop records gain a host id so cross-host why_slow and
+            # flow arrows can name hosts; module-level install makes
+            # health_report()["serve"]["dist"] see THIS fleet
+            _reqs.set_host_namer(lambda i: f"w{i}")
+            _federate.install(self.telemetry)
         super().__init__(spec, replicas=replicas, **kw)
+        self.telemetry.fleet = self.fleet_label
+        lblf = dict(fleet=self.fleet_label)
+        self._c_ship_hidden = self._reg.counter(
+            "serve.dist.ship_wire_hidden_s",
+            help="streamed-ship wire seconds overlapped with source "
+                 "prefill compute (the hidden half)", **lblf)
+        self._c_ship_exposed = self._reg.counter(
+            "serve.dist.ship_wire_exposed_s",
+            help="ship completion wall seconds on the request's "
+                 "critical path (export+commit+land)", **lblf)
+        self._dist_registered += [self._c_ship_hidden,
+                                  self._c_ship_exposed]
 
     # -- replica construction / teardown ---------------------------------
     def _new_supervisor(self, idx):
@@ -655,14 +713,52 @@ class DistFleet(ServeFleet):
         sup_kw = {k: v for k, v in self._sup_kw.items()
                   if k != "clock"}  # callables don't ship; the worker
         #                             keeps its own monotonic clock
-        ack = conn.call("init", {
-            "spec": self._spec, "sup_kw": sup_kw,
-            "engine_kw": self._replica_kw(idx)},
-            timeout=self._init_timeout())
+        init = {"spec": self._spec, "sup_kw": sup_kw,
+                "engine_kw": self._replica_kw(idx)}
+        if self._federate and self._spawn_mode == "process":
+            # the worker process records its OWN ledger + trace and
+            # ships them on telemetry pulls; thread mode must NOT —
+            # its observe globals are the controller's (shared)
+            init["federate"] = {"ledger": True, "trace": True,
+                                "capacity": 4096}
+        ack = conn.call("init", init, timeout=self._init_timeout())
         if not ack["ok"]:
             conn.close()
             raise load_exc(ack["err"])
-        return RemoteSupervisor(self, idx, conn, proc, ack["value"])
+        sup = RemoteSupervisor(self, idx, conn, proc, ack["value"])
+        self._register_host(idx, sup)
+        return sup
+
+    def _register_host(self, idx, sup):
+        """Federation side of a (re)spawned worker: fresh per-peer
+        transport metrics (a replaced peer's series leave the registry
+        first — replace_dead must not resurrect the dead conn's
+        counts), a fresh NTP-style clock estimate (process mode: new
+        process, new clock base), and a fresh telemetry host slot."""
+        old = self._peer_metrics.pop(idx, None)
+        if old:
+            self._reg.remove(*old)
+            self._dist_registered = [
+                m for m in self._dist_registered if m not in old]
+        ms = sup._conn.attach_metrics(self._reg, peer=f"w{idx}")
+        self._peer_metrics[idx] = ms
+        self._dist_registered += ms
+        if not self._federate:
+            return
+        cs = None
+        if self._spawn_mode == "process":
+            cs = ClockSync(clock=self._clock)
+            try:
+                cs.sample(lambda: sup._conn.call(
+                    "clock", timeout=10.0,
+                    fault_site="serve.dist.telemetry")["value"]["t"])
+            except Exception:
+                cs = None  # clock probe lost: merge uncorrected
+        self.telemetry.host_online(
+            f"w{idx}", clock_sync=cs,
+            thread=(f"dist-worker-{idx}"
+                    if self._spawn_mode == "thread" else None),
+            pid=sup.pid)
 
     def _init_timeout(self) -> float:
         # a spawned process imports jax and compiles from cold; a
@@ -716,6 +812,28 @@ class DistFleet(ServeFleet):
             else:                          # a thread
                 p.join(timeout=5.0)
 
+    def retire_replica(self, idx):
+        """Scale-down retire, federation side: the worker's per-peer
+        transport series and its telemetry host slot leave with it —
+        a retired host must not freeze into the federated exposition
+        (the dist analogue of ``EngineStats.unregister``)."""
+        super().retire_replica(idx)
+        self._unregister_host(idx)
+
+    def _unregister_host(self, idx):
+        ms = self._peer_metrics.pop(idx, None)
+        if ms:
+            self._reg.remove(*ms)
+            self._dist_registered = [
+                m for m in self._dist_registered if m not in ms]
+        if self._federate:
+            self.telemetry.remove_host(f"w{idx}")
+
+    def _teardown_federation(self):
+        if self._federate:
+            _reqs.set_host_namer(None)
+            _federate.uninstall(self.telemetry)
+
     def close(self):
         was_closed = self._closed
         super().close()
@@ -724,6 +842,8 @@ class DistFleet(ServeFleet):
             self._reap()
             self._reg.remove(*self._dist_registered)
             self._dist_registered = []
+            self._peer_metrics = {}
+            self._teardown_federation()
 
     def __exit__(self, exc_type, *a):
         closed_before = self._closed
@@ -733,6 +853,8 @@ class DistFleet(ServeFleet):
             self._reap()
             self._reg.remove(*self._dist_registered)
             self._dist_registered = []
+            self._peer_metrics = {}
+            self._teardown_federation()
         return r
 
     # -- drive: overlapped stepping, ping-based watchdog -----------------
@@ -768,6 +890,46 @@ class DistFleet(ServeFleet):
                 sup.ping()
             except RestartBudgetExceededError as e:
                 self._mark_down(rep, e)
+        self._maybe_pull_telemetry()
+
+    def _maybe_pull_telemetry(self, force=False):
+        """Periodic (or forced on-demand) telemetry pull from every
+        healthy worker.  Rides its OWN fault site
+        (``serve.dist.telemetry``) so chaos tests partitioning the
+        control plane never have their injected fault consumed by a
+        background pull.  ANY failure degrades that host to a typed
+        ``stale`` marker — telemetry loss never raises into the step
+        loop and never blocks serving."""
+        if not self._federate:
+            return
+        now = self._clock()
+        if not force and self._t_last_pull is not None \
+                and now - self._t_last_pull < self._telemetry_interval:
+            return
+        self._t_last_pull = now
+        process = self._spawn_mode == "process"
+        for rep in self._replicas:
+            host = f"w{rep.idx}"
+            if rep.retired or host not in self.telemetry.hosts:
+                continue
+            if not rep.healthy:
+                self.telemetry.mark_stale(host, "replica down")
+                continue
+            try:
+                # thread mode shares this process's observe globals —
+                # pull nothing but liveness (registry/ledger/trace are
+                # already visible locally); process mode drains the
+                # worker's private copies across the wire
+                msg = rep.sup._conn.call(
+                    "telemetry",
+                    {"registry": process, "ledger": process,
+                     "trace": process, "drain": process},
+                    timeout=10.0, fault_site="serve.dist.telemetry")
+                if not msg["ok"]:
+                    raise load_exc(msg["err"])
+                self.telemetry.ingest(host, msg["value"], t=now)
+            except Exception as e:
+                self.telemetry.mark_stale(host, repr(e))
 
     # -- streamed KV shipping --------------------------------------------
     def _before_build_advance(self, sjob):
@@ -834,12 +996,17 @@ class DistFleet(ServeFleet):
 
     def _land_shipped(self, sjob, src_rep, dst_rep, path, n, nbytes,
                       t0):
-        self.ship_window.append(self._clock() - t0)
+        exposed = self._clock() - t0
+        self.ship_window.append(exposed)
+        hidden = self._ship_hidden.pop(sjob.rid, 0.0)
+        self._c_ship_hidden.inc(hidden)
+        self._c_ship_exposed.inc(exposed)
         return super()._land_shipped(sjob, src_rep, dst_rep, path, n,
                                      nbytes, t0)
 
     def _abandon_build(self, sjob):
         stream = self._ship_streams.pop(sjob.rid, None)
+        self._ship_hidden.pop(sjob.rid, None)
         if stream is not None:
             dst_sup, ship_id = stream
             dst_sup.ship_abort(ship_id)  # frees the staging buffers
@@ -861,5 +1028,25 @@ class DistFleet(ServeFleet):
                 if c.name == "serve.dist.frame_bytes"),
             "ship_s_mean": self.ship_window.mean(300.0),
             "ship_s_p95": self.ship_window.quantile(0.95, 300.0),
+            "retries": sum(c.value for c in self._dist_registered
+                           if c.name == "serve.dist.retries"),
+            "ship_wire_hidden_s": self._c_ship_hidden.value,
+            "ship_wire_exposed_s": self._c_ship_exposed.value,
+            "ship_overlap_efficiency": self._ship_overlap(),
+            "telemetry": {
+                h.host: {"stale": h.stale,
+                         "stale_reason": h.stale_reason,
+                         "pulls": h.pulls}
+                for h in self.telemetry.hosts.values()
+            } if self._federate else None,
         }
         return snap
+
+    def _ship_overlap(self):
+        """Fraction of streamed-ship wire time hidden behind source
+        prefill: hidden / (hidden + exposed).  None until a streamed
+        ship lands."""
+        hidden = self._c_ship_hidden.value
+        exposed = self._c_ship_exposed.value
+        total = hidden + exposed
+        return (hidden / total) if total > 0 else None

@@ -133,12 +133,52 @@ class Conn:
         self.last_rx = time.monotonic()
         self._seq = 0
         self._log = get_channel("serve")
+        # transport self-observability (attach_metrics): None until a
+        # registry attaches — the unobserved cost is one truthiness
+        # check per frame
+        self._m_frames = None
+        self._m_bytes = None
+        self._m_retries = None
+        self._m_rtt = None
         # TCP_NODELAY: RPCs are small request/response frames; Nagle
         # would add 40ms floors to every fleet step
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
+
+    def attach_metrics(self, reg, peer, **labels) -> list:
+        """Register this connection's per-peer transport metrics:
+        ``serve.dist.{frames,bytes,retries}{peer=}`` counters (frames/
+        bytes cover BOTH directions — everything that crossed this
+        socket) and a ``serve.dist.rtt_s{peer=}`` histogram observed
+        per successful ``call`` round trip (the bucket ladder starts
+        at 10µs — loopback RPCs live far below the default 1ms
+        floor).  Returns the metric objects so the owner can
+        ``registry.remove(*them)`` on retire — the PR 15
+        retire-unregisters contract."""
+        lbl = dict(labels, peer=str(peer))
+        self._m_frames = reg.counter(
+            "serve.dist.frames",
+            help="framed messages crossing this peer connection "
+                 "(both directions)", **lbl)
+        self._m_bytes = reg.counter(
+            "serve.dist.bytes",
+            help="wire bytes crossing this peer connection (headers "
+                 "included, both directions)", **lbl)
+        self._m_retries = reg.counter(
+            "serve.dist.retries",
+            help="RPC timeout retries re-sent on this connection",
+            **lbl)
+        self._m_rtt = reg.histogram(
+            "serve.dist.rtt_s",
+            help="RPC round-trip seconds to this peer (send -> "
+                 "matching reply)",
+            buckets=(1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
+                     2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0), **lbl)
+        return [self._m_frames, self._m_bytes, self._m_retries,
+                self._m_rtt]
 
     # -- framing ---------------------------------------------------------
     def send(self, kind, obj):
@@ -152,6 +192,9 @@ class Conn:
             raise PeerGoneError(
                 f"send to peer {self.label or '?'} failed: {e!r}",
                 started=None) from e
+        if self._m_frames is not None:
+            self._m_frames.inc()
+            self._m_bytes.inc(_HEAD.size + len(payload))
 
     def recv(self, timeout=None):
         """One ``(kind, obj)`` frame.  ``timeout`` None blocks
@@ -192,6 +235,9 @@ class Conn:
                 f"frame crc mismatch from peer {self.label or '?'}: "
                 f"payload corrupted in transit")
         self.last_rx = time.monotonic()
+        if self._m_frames is not None:
+            self._m_frames.inc()
+            self._m_bytes.inc(_HEAD.size + length)
         return kind, pickle.loads(payload)
 
     def age(self) -> float:
@@ -201,17 +247,21 @@ class Conn:
 
     # -- RPC (caller side) -----------------------------------------------
     def call(self, op, payload=None, timeout=60.0, retries=0,
-             backoff=0.05):
+             backoff=0.05, fault_site="serve.dist.rpc"):
         """Synchronous RPC: send ``CALL {seq, op, ...}``, wait for the
         matching ``REPLY``.  ``retries`` re-sends on TIMEOUT only
         (with exponential backoff) and must only be used for
         idempotent ops — a retried ``submit`` could double-admit.
-        Checks the ``serve.dist.rpc`` fault site first: a fired fault
-        is a modeled partition and surfaces as :class:`PeerGoneError`.
+        Checks the ``fault_site`` (default ``serve.dist.rpc``) first:
+        a fired fault is a modeled partition and surfaces as
+        :class:`PeerGoneError`.  Telemetry pulls pass their OWN site
+        (``serve.dist.telemetry``) so a chaos test partitioning the
+        control plane never has its injected fault consumed by a
+        background telemetry call instead.
         """
         if _faults._armed:
             try:
-                _faults.check("serve.dist.rpc")
+                _faults.check(fault_site)
             except Exception as e:
                 raise PeerGoneError(
                     f"partition injected on RPC {op!r} to peer "
@@ -220,6 +270,7 @@ class Conn:
         while True:
             self._seq += 1
             seq = self._seq
+            t_send = time.monotonic()
             self.send(MSG_CALL, {"seq": seq, "op": op,
                                  "payload": payload})
             try:
@@ -234,11 +285,16 @@ class Conn:
                             f"out-of-sequence reply from peer "
                             f"{self.label or '?'}: got "
                             f"{msg.get('seq')}, want {seq}")
+                    if self._m_rtt is not None:
+                        self._m_rtt.observe(
+                            time.monotonic() - t_send)
                     return msg
             except PeerTimeoutError:
                 if attempt >= retries:
                     raise
                 attempt += 1
+                if self._m_retries is not None:
+                    self._m_retries.inc()
                 self._log.warning(
                     "RPC %s to peer %s timed out; retry %d/%d", op,
                     self.label or "?", attempt, retries)

@@ -37,6 +37,7 @@ cold fallback, never a wrong token.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 
 import numpy as np
@@ -165,6 +166,7 @@ class _Worker:
         self._staged = {}      # ship_id -> (meta, leaf buffers)
         self._ids = itertools.count(1)
         self._stop = False
+        self._led = None       # this process's RequestLedger (federate)
 
     # engine-side streaming callback: tokens ride the next step reply
     def _on_token(self, req, tok):
@@ -229,9 +231,22 @@ class _Worker:
         model = p["spec"].build()
         self.sup = EngineSupervisor(model, **p["sup_kw"],
                                     **p["engine_kw"])
+        # federation flags arrive ONLY in process mode: this process's
+        # observe globals are private, so enabling the ledger/trace
+        # here cannot clobber the fleet's own (in thread mode they are
+        # the SAME globals — the fleet never sends the flags there)
+        fed = p.get("federate") or {}
+        if fed.get("ledger"):
+            from ...observe import requests as _w_reqs
+            self._led = _w_reqs.enable(
+                capacity=int(fed.get("capacity", 4096)))
+        if fed.get("trace"):
+            from ...observe import trace as _w_trace
+            # align the trace clock with the ledger/probe clock so one
+            # per-host offset corrects every shipped timestamp
+            _w_trace.enable(clock=self._clock)
         eng = self.sup.engine
         arena = eng.paged_arena
-        import os
 
         return {
             "max_slots": eng.max_slots, "max_len": eng.max_len,
@@ -446,6 +461,33 @@ class _Worker:
 
     def op_ping(self, p):
         return {}
+
+    def op_clock(self, p):
+        """NTP-style probe target: the worker's monotonic now.  The
+        fleet brackets this reply with its own clock reads to estimate
+        the peer offset (error bounded by RTT/2)."""
+        return {"t": self._clock()}
+
+    def op_telemetry(self, p):
+        """Telemetry pull: registry dump, sealed ledger entries and
+        (optionally drained) trace events, each gated by a request
+        flag.  Read-only over observe state — never touches the
+        engine, so a pull can never wedge serving."""
+        out = {"clock": self._clock(), "pid": os.getpid()}
+        if p.get("registry"):
+            from ...observe.registry import registry as _w_registry
+            out["registry"] = _w_registry().dump()
+        if p.get("ledger") and self._led is not None:
+            out["ledger"] = self._led.entries()
+        if p.get("trace"):
+            from ...observe import trace as _w_trace
+            if _w_trace.is_enabled():
+                out["trace"] = (_w_trace.drain() if p.get("drain")
+                                else _w_trace.events())
+        if p.get("jit"):
+            from ..jitpin import jit_cache_size
+            out["jit_cache"] = jit_cache_size()
+        return out
 
     def op_shutdown(self, p):
         self._stop = True

@@ -394,3 +394,192 @@ def test_dist_process_mode_parity(model, spec):
         pids = [f2.supervisor(i).pid for i in range(2)]
     assert got == want, (got, want)
     assert all(p and p != os.getpid() for p in pids), pids
+
+
+# ---------------------------------------------------------------------------
+# telemetry federation (the federation round, fleet half; unit half in
+# test_federate.py): clock-aligned merge, typed stale degradation,
+# rejection observability, retire unregistration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def _observing():
+    from singa_tpu import observe
+
+    observe.clear()
+    observe.enable()
+    led = observe.requests.enable(capacity=1024)
+    yield led
+    observe.requests.disable()
+    observe.disable()
+    observe.clear()
+
+
+def test_dist_federation_hosts_health_and_peer_metrics(
+        model, spec, _observing):
+    """The federated surface over a live thread fleet: every sealed
+    hop carries a host id, health_report()["serve"]["dist"] names the
+    straggler host and decomposes latency with the exact ``ship``
+    phase (fractions summing to 1), and the transport's per-peer
+    self-observability (frames/bytes counters + RTT histogram) is
+    registered while the fleet lives and gone when it closes."""
+    from singa_tpu.observe import health_report, registry
+
+    prompts = _prompts(4, seed=3)
+    with DistFleet(spec, replicas=2, spawn="thread", max_slots=2,
+                   telemetry_interval_s=0.0) as fleet:
+        _run(fleet, prompts, new=4, prefix="f")
+        fleet._maybe_pull_telemetry(force=True)
+        entries = _observing.entries()
+        assert entries
+        for e in entries:
+            assert e["hops"][-1]["host"] in ("w0", "w1"), e
+        ds = health_report()["serve"]["dist"]
+        assert ds["enabled"] is True
+        assert sorted(ds["hosts"]) == ["w0", "w1"]
+        assert ds["stale_hosts"] == []
+        assert all(h["pulls"] >= 1 for h in ds["hosts"].values())
+        ws = ds["why_slow"]
+        lat = ws["latency_p99_attribution"]
+        assert set(lat) == {"queue", "prefill", "ship", "decode",
+                            "stall", "preempted", "hops"}
+        assert sum(p["frac"] for p in lat.values()) \
+            == pytest.approx(1.0)
+        assert "ship" in ws["ttft_p99_attribution"]
+        assert ws["straggler_host"]["host"] in ("w0", "w1")
+        assert set(ws["per_host"]) <= {"w0", "w1", "local"}
+        # satellite: per-peer transport metrics live in the registry
+        snap = registry().snapshot()
+        for peer in ("w0", "w1"):
+            assert snap["counters"][
+                f"serve.dist.frames{{peer={peer}}}"] > 0
+            assert snap["counters"][
+                f"serve.dist.bytes{{peer={peer}}}"] > 0
+            assert f"serve.dist.rtt_s{{peer={peer}}}" \
+                in snap["histograms"]
+        dist = fleet.snapshot()["dist"]
+        assert dist["retries"] == 0
+        assert "ship_overlap_efficiency" in dist
+        assert dist["telemetry"]["w0"]["pulls"] >= 1
+    # close(): peer series unregister, health section detaches
+    snap = registry().snapshot()
+    assert not any("peer=" in k for k in snap["counters"])
+    assert health_report()["serve"]["dist"] == {"enabled": False}
+
+
+def test_dist_telemetry_death_degrades_stale_serving_unaffected(
+        model, spec, _observing):
+    """Kill the telemetry channel mid-run: the host degrades to a
+    typed ``stale`` marker, serving continues untouched (every request
+    completes — 0 wedged, 0 lost), and the next successful pull clears
+    the marker.  Conversely a pull must never CONSUME a fault injected
+    on the RPC site — the partition lands on real control traffic."""
+    from singa_tpu.observe import health_report
+
+    prompts = _prompts(4, seed=5)
+    with DistFleet(spec, replicas=2, spawn="thread", max_slots=2,
+                   telemetry_interval_s=0.0) as fleet:
+        hs = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=5, request_id=f"t{i}"))
+            for i, p in enumerate(prompts)]
+        fleet.step()
+        faults.inject("serve.dist.telemetry", FailOnce())
+        fleet._maybe_pull_telemetry(force=True)
+        ds = health_report()["serve"]["dist"]
+        assert ds["stale_hosts"] == ["w0"]
+        assert ds["hosts"]["w0"]["stale_reason"]
+        # serving is unaffected by the lost pull
+        fleet.run_until_complete(max_steps=800)
+        for h in hs:
+            assert h.result().finish_reason == "length"
+        assert fleet.healthy_replicas == 2
+        led = _observing
+        assert led.snapshot()["open"] == 0  # nothing wedged
+        # recovery: the next pull clears the typed marker
+        fleet._maybe_pull_telemetry(force=True)
+        assert health_report()["serve"]["dist"]["stale_hosts"] == []
+        prom = fleet.telemetry.prometheus_text()
+        assert 'singa_tpu_federation_stale{host="w0"} 0' in prom
+        # fault-site isolation: an armed RPC partition survives any
+        # number of telemetry pulls and fires on real control traffic
+        faults.inject("serve.dist.rpc", FailOnce())
+        fleet._maybe_pull_telemetry(force=True)
+        assert health_report()["serve"]["dist"]["stale_hosts"] == []
+        with pytest.raises(PeerGoneError):
+            fleet.supervisor(0).ping()
+
+
+def test_dist_peer_loss_rejections_are_observable(
+        model, spec, _observing):
+    """Satellite: a worker lost mid-flight must leave evidence — a
+    ``serve/request_rejected`` instant on the dist path and a ledger
+    hop reject carrying reason ``peer_lost`` and the delivery-started
+    verdict (False here: no token had streamed, so the requeue serves
+    the caller byte-identically)."""
+    from singa_tpu import observe
+
+    prompts = _prompts(4, seed=2)
+    with DistFleet(spec, replicas=2, spawn="thread",
+                   max_slots=2) as fleet:
+        hs = [fleet.submit(GenerationRequest(
+            p, max_new_tokens=4, request_id=f"x{i}"))
+            for i, p in enumerate(prompts)]
+        fleet.step()
+        fleet.kill_worker(0)
+        fleet.run_until_complete(max_steps=800)
+        for h in hs:
+            assert h.result().finish_reason == "length"
+    inst = [e for e in observe.events()
+            if e["name"] == "serve/request_rejected"
+            and (e["args"] or {}).get("reason") == "peer_lost"]
+    assert inst, "no serve/request_rejected instant for the lost peer"
+    assert inst[0]["args"]["started"] is False
+    rejects = [
+        (e["request_id"], h["reject"])
+        for e in _observing.entries() for h in e["hops"]
+        if h["reject"] is not None
+        and h["reject"]["reason"] == "peer_lost"]
+    assert rejects, "peer_lost never landed in the ledger"
+    assert all(r["started"] is False for _, r in rejects)
+    # the requeued requests still COMPLETED: reject evidence is on the
+    # lost hop, the final outcome on the survivor's
+    done = {e["request_id"]: e["outcome"]
+            for e in _observing.entries()}
+    for rid, _ in rejects:
+        assert done[rid] == "length"
+
+
+def test_dist_retire_and_revive_federation_lifecycle(
+        model, spec, _observing):
+    """Satellite: retire unregisters the worker's federated series
+    (telemetry host slot AND per-peer transport metrics); revive
+    re-registers both fresh."""
+    from singa_tpu.observe import registry
+
+    with DistFleet(spec, replicas=2, spawn="thread", max_slots=2,
+                   telemetry_interval_s=0.0) as fleet:
+        _run(fleet, _prompts(2, seed=7), new=3, prefix="r")
+        fleet._maybe_pull_telemetry(force=True)
+        assert sorted(fleet.telemetry.hosts) == ["w0", "w1"]
+        fleet.start_drain(1)
+        for _ in range(50):
+            if fleet.drained(1):
+                break
+            fleet.step()
+        fleet.retire_replica(1)
+        assert sorted(fleet.telemetry.hosts) == ["w0"]
+        snap = registry().snapshot()
+        assert not any("peer=w1" in k for k in snap["counters"])
+        assert any("peer=w0" in k for k in snap["counters"])
+        assert 'host="w1"' not in fleet.telemetry.prometheus_text()
+        # scale back up through the same slot: fresh host, fresh series
+        fleet.revive(1)
+        assert sorted(fleet.telemetry.hosts) == ["w0", "w1"]
+        assert fleet.telemetry.hosts["w1"].pulls == 0
+        snap = registry().snapshot()
+        assert any("peer=w1" in k for k in snap["counters"])
+        h = fleet.submit(GenerationRequest(
+            _prompts(1, seed=8)[0], max_new_tokens=3,
+            request_id="post-revive"))
+        fleet.run_until_complete(max_steps=300)
+        assert h.result().finish_reason == "length"

@@ -1,0 +1,423 @@
+"""Cross-host telemetry federation (the federation round), unit half:
+clock-offset estimation against fake skewed/drifting clocks, merged
+timeline shifting and graft, ingest idempotence, the federated
+Prometheus exposition (``host=`` labels, per-series ``+Inf == _count``,
+cross-host bucket aggregation == ``sum(rate(x_bucket)) by (le)``), and
+the typed ``stale`` degradation.  Everything here is synthetic — no
+engines, no sockets — so this module is collection-order-safe; the
+fleet-level federation behavior (thread workers, telemetry-channel
+chaos, retire unregistration) lives in test_dist_fleet.py, which sorts
+after the paged cost-table hazard boundary."""
+
+import math
+
+import pytest
+
+from singa_tpu.observe import requests as reqtrace
+from singa_tpu.observe.federate import (ClockSync, FleetTelemetry,
+                                        merge_bucket_counts,
+                                        quantile_from_buckets)
+from singa_tpu.observe.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation
+# ---------------------------------------------------------------------------
+
+class _TwoClocks:
+    """A controller clock and a peer clock offset by ``skew`` (plus
+    optional drift), with a controllable per-probe RTT: the probe sees
+    the peer's clock exactly halfway through the round trip."""
+
+    def __init__(self, skew, rtt=0.002, drift=0.0):
+        self.t = 100.0
+        self.skew = skew
+        self.rtt = rtt
+        self.drift = drift
+
+    def local(self):
+        return self.t
+
+    def probe(self):
+        # request travels rtt/2, peer reads its clock, reply travels
+        # rtt/2; the peer clock also drifts per probe
+        self.t += self.rtt / 2.0
+        self.skew += self.drift
+        peer_now = self.t + self.skew
+        self.t += self.rtt / 2.0
+        return peer_now
+
+
+def test_clock_sync_recovers_skew_within_half_rtt():
+    for skew in (-3.5, 0.0, 0.25, 120.0):
+        w = _TwoClocks(skew, rtt=0.004)
+        cs = ClockSync(clock=w.local).sample(w.probe, samples=5)
+        assert abs(cs.offset - skew) <= cs.uncertainty + 1e-12
+        assert cs.uncertainty <= w.rtt / 2.0 + 1e-12
+        # mapping a peer reading back lands within the error bound
+        t_peer = w.probe()
+        assert abs(cs.to_local(t_peer) - w.local()) \
+            <= cs.uncertainty + w.rtt + 1e-9
+
+
+def test_clock_sync_asymmetric_rtt_keeps_min_sample():
+    """NTP filter: noisy (large-RTT) probes never override a tighter
+    earlier sample, so queueing spikes cannot degrade the estimate."""
+    w = _TwoClocks(1.0, rtt=0.001)
+    cs = ClockSync(clock=w.local).sample(w.probe, samples=3)
+    tight = cs.rtt
+    w.rtt = 0.5  # the link got congested
+    cs.sample(w.probe, samples=3)
+    assert cs.rtt == tight
+    assert abs(cs.offset - 1.0) <= tight / 2.0 + 1e-12
+    assert cs.samples == 6
+
+
+def test_clock_sync_drifting_peer_reestimate():
+    """A drifting peer clock: re-running sample() (what the fleet does
+    on reconnect/replace_dead) re-anchors the offset; the new estimate
+    tracks the CURRENT skew within RTT/2."""
+    w = _TwoClocks(0.5, rtt=0.002, drift=0.0)
+    cs = ClockSync(clock=w.local).sample(w.probe, samples=4)
+    w.skew += 2.0          # the peer restarted with a new clock base
+    cs2 = ClockSync(clock=w.local).sample(w.probe, samples=4)
+    assert abs(cs2.offset - w.skew) <= cs2.uncertainty + 1e-12
+    assert abs(cs2.offset - cs.offset - 2.0) <= 0.004
+    # summary is JSON-shaped
+    s = cs2.summary()
+    assert set(s) == {"offset_s", "rtt_s", "uncertainty_s", "samples"}
+
+
+def test_clock_sync_rejects_zero_samples():
+    with pytest.raises(ValueError):
+        ClockSync().sample(lambda: 0.0, samples=0)
+
+
+# ---------------------------------------------------------------------------
+# bucket-ladder merge + aggregated quantiles
+# ---------------------------------------------------------------------------
+
+def test_merge_bucket_counts_is_elementwise_sum():
+    a = [[0.1, 2], [1.0, 5], [float("inf"), 7]]
+    b = [[0.1, 1], [1.0, 1], [float("inf"), 4]]
+    merged = merge_bucket_counts([a, b])
+    assert merged == [(0.1, 3), (1.0, 6), (float("inf"), 11)]
+    # the prometheus identity the exposition relies on: the merged
+    # +Inf bucket is the fleet-wide count
+    assert merged[-1][1] == 7 + 4
+
+
+def test_quantile_from_buckets_interpolates():
+    buckets = [(1.0, 10), (2.0, 20), (float("inf"), 20)]
+    assert quantile_from_buckets(buckets, 0.25) == pytest.approx(0.5)
+    assert quantile_from_buckets(buckets, 0.75) == pytest.approx(1.5)
+    # overflow-bucket quantile clamps to the highest finite bound
+    buckets = [(1.0, 2), (float("inf"), 8)]
+    assert quantile_from_buckets(buckets, 0.99) == 1.0
+    # nothing observed under a finite bound: no honest answer
+    assert math.isnan(
+        quantile_from_buckets([(1.0, 0), (float("inf"), 8)], 0.5))
+    assert math.isnan(
+        quantile_from_buckets([(1.0, 0), (float("inf"), 0)], 0.5))
+
+
+# ---------------------------------------------------------------------------
+# ingest: idempotence, staleness, host lifecycle
+# ---------------------------------------------------------------------------
+
+def _entry(rid, t0, host=None, replica=0, ttft=0.5, total=1.0,
+           tokens=4):
+    """A minimal sealed ledger entry with one served hop."""
+    steps = [[t0 + ttft + 0.1 * i, 1] for i in range(tokens - 1)]
+    return {
+        "request_id": rid, "prompt_len": 8, "max_new_tokens": tokens,
+        "t_submit": t0, "t_retire": t0 + total, "outcome": "length",
+        "reason": None, "started": True, "tokens_out": tokens,
+        "ttft_s": ttft, "tpot_s": 0.1, "phases": None,
+        "hops": [{
+            "engine": f"r{replica}:engine-0", "replica": replica,
+            "host": host, "via": "route", "t_submit": t0,
+            "t_admit": t0 + 0.1, "admit_kind": "cold",
+            "hit_tokens": 0, "slot": 0, "chunks": [[t0 + 0.2, 8]],
+            "t_first_token": t0 + ttft, "steps": steps,
+            "tokens": tokens, "preemptions": [], "reject": None,
+            "ship_s": None,
+        }],
+    }
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_ingest_is_idempotent_and_clears_stale():
+    clk = _FakeClock()
+    ft = FleetTelemetry(clock=clk)
+    ft.host_online("w0")
+    payload = {"ledger": [_entry("a", 10.0)], "pid": 111,
+               "registry": {"schema": "singa_tpu.telemetry/1",
+                            "metrics": []}}
+    ft.ingest("w0", payload)
+    ft.mark_stale("w0", "socket severed")
+    assert ft.hosts["w0"].stale
+    assert ft.hosts["w0"].stale_reason == "socket severed"
+    # the SAME seal re-shipped (pull overlap) merges to one entry and
+    # a successful pull clears the typed stale marker
+    ft.ingest("w0", payload)
+    assert not ft.hosts["w0"].stale
+    assert ft.hosts["w0"].pulls == 2
+    assert list(ft.hosts["w0"].entries) == ["a"]
+    merged_once = ft.merged_entries(local_entries=[])
+    merged_twice = ft.merged_entries(local_entries=[])
+    assert merged_once == merged_twice  # merge never mutates state
+    # a LATER seal of the same rid replaces the interim one
+    late = _entry("a", 10.0, total=2.0)
+    ft.ingest("w0", {"ledger": [late]})
+    assert ft.hosts["w0"].entries["a"]["t_retire"] == 12.0
+
+
+def test_mark_stale_on_unknown_host_never_raises():
+    ft = FleetTelemetry(clock=_FakeClock())
+    ft.mark_stale("w9", "first contact failed")
+    assert ft.hosts["w9"].stale
+
+
+def test_host_online_drops_predecessor_and_remove_host():
+    ft = FleetTelemetry(clock=_FakeClock())
+    ft.host_online("w0")
+    ft.ingest("w0", {"ledger": [_entry("a", 1.0)]})
+    # replace_dead respawns the same slot: fresh host, no frozen state
+    ft.host_online("w0", pid=222)
+    assert ft.hosts["w0"].entries == {}
+    assert ft.hosts["w0"].pid == 222
+    ft.remove_host("w0")
+    assert "w0" not in ft.hosts
+    assert "w0" not in ft.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# merged timelines: clock shift + graft
+# ---------------------------------------------------------------------------
+
+def test_merged_entries_shift_into_controller_time():
+    """Worker entries arrive on a clock 5 s ahead; after the merge
+    every timestamp is in controller time and per-hop ordering
+    (submit <= admit <= first token <= retire) holds."""
+    clk = _FakeClock()
+    ft = FleetTelemetry(clock=clk)
+    cs = ClockSync()
+    cs.offset, cs.rtt = 5.0, 0.001
+    ft.host_online("w0", clock_sync=cs)
+    ft.ingest("w0", {"ledger": [_entry("a", 105.0)]})  # worker clock
+    merged = ft.merged_entries(local_entries=[])
+    assert len(merged) == 1
+    e = merged[0]
+    assert e["t_submit"] == pytest.approx(100.0)
+    assert e["t_retire"] == pytest.approx(101.0)
+    hop = e["hops"][0]
+    assert hop["host"] == "w0"
+    assert hop["t_submit"] <= hop["t_admit"] <= hop["t_first_token"]
+    assert e["t_submit"] <= hop["t_admit"] <= e["t_retire"]
+    for t, _n in hop["steps"]:
+        assert hop["t_first_token"] <= t <= e["t_retire"] + 1e-9
+
+
+def test_merged_entries_graft_worker_detail_into_mirror():
+    """Process mode: the controller mirror has the routing skeleton
+    (submit/retire, replica stamp) but no engine detail; the worker's
+    record fills admission/first-token/steps and the derived
+    ttft/phases are recomputed — after which the merged why_slow can
+    attribute the request's latency."""
+    ft = FleetTelemetry(clock=_FakeClock())
+    cs = ClockSync()
+    cs.offset, cs.rtt = -2.0, 0.001  # worker clock 2 s BEHIND
+    ft.host_online("w1", clock_sync=cs)
+    mirror = {
+        "request_id": "a", "prompt_len": 8, "max_new_tokens": 4,
+        "t_submit": 50.0, "t_retire": 51.0, "outcome": "length",
+        "reason": None, "started": True, "tokens_out": 4,
+        "ttft_s": None, "tpot_s": None, "phases": None,
+        "hops": [{
+            "engine": "r1:engine-0", "replica": 1, "host": "w1",
+            "via": "route", "t_submit": 50.0, "t_admit": None,
+            "admit_kind": None, "hit_tokens": 0, "slot": None,
+            "chunks": [], "t_first_token": None, "steps": [],
+            "tokens": 0, "preemptions": [], "reject": None,
+            "ship_s": None,
+        }],
+    }
+    ft.ingest("w1", {"ledger": [_entry("a", 48.0, ttft=0.4)]})
+    merged = ft.merged_entries(local_entries=[mirror])
+    assert len(merged) == 1
+    hop = merged[0]["hops"][0]
+    assert hop["t_admit"] == pytest.approx(48.1 + 2.0)
+    assert hop["t_first_token"] == pytest.approx(48.4 + 2.0)
+    assert merged[0]["ttft_s"] == pytest.approx(0.4)
+    assert merged[0]["phases"] is not None
+    ws = ft.why_slow(local_entries=[mirror])
+    fr = ws["ttft_p99_attribution"]
+    assert "ship" in fr
+    assert sum(p["frac"] for p in fr.values()) == pytest.approx(1.0)
+    assert ws["straggler_host"]["host"] == "w1"
+    lat = ws["latency_p99_attribution"]
+    assert set(lat) == {"queue", "prefill", "ship", "decode", "stall",
+                        "preempted", "hops"}
+    assert sum(p["frac"] for p in lat.values()) == pytest.approx(1.0)
+
+
+def test_merged_entries_never_mutate_live_ledger():
+    led = reqtrace.RequestLedger(capacity=8)
+    t = 0.0
+    led.on_submit("a", engine="e0", t=t, prompt_len=4,
+                  max_new_tokens=2)
+    led.on_admit("a", engine="e0", t=0.1, slot=0)
+    led.on_first_token("a", engine="e0", t=0.2)
+    led.on_retire("a", engine="e0", t=0.5, finish_reason="length",
+                  tokens=2)
+    before = [dict(e) for e in led.entries()]
+    ft = FleetTelemetry(clock=_FakeClock())
+    ft.host_online("w0")
+    ft.merged_entries(local_entries=led.entries())
+    assert led.entries() == before
+
+
+# ---------------------------------------------------------------------------
+# federated exposition
+# ---------------------------------------------------------------------------
+
+def _dump_with_histogram(n_obs, scale=1.0):
+    """A real registry dump with one counter and one histogram."""
+    reg = MetricsRegistry()
+    c = reg.counter("serve.dist.rpcs", help="calls", peer="x")
+    c.inc(n_obs)
+    h = reg.histogram("serve.dist.rtt_s", help="rtt",
+                      buckets=(0.001, 0.01, 0.1, 1.0), peer="x")
+    for i in range(n_obs):
+        h.observe(scale * (i + 1) / n_obs)
+    return reg.dump()
+
+
+def _parse_prom(text):
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_lbl, val = line.rsplit(" ", 1)
+        samples[name_lbl] = float(val.replace("+Inf", "inf"))
+    return samples
+
+
+def test_prometheus_federation_host_labels_and_inf_invariant():
+    ft = FleetTelemetry(clock=_FakeClock())
+    ft.host_online("w0")
+    ft.host_online("w1")
+    ft.ingest("w0", {"registry": _dump_with_histogram(10, 0.05)})
+    ft.ingest("w1", {"registry": _dump_with_histogram(6, 0.5)})
+    text = ft.prometheus_text()
+    samples = _parse_prom(text)
+    # every series is host-labeled; counters keep the _total suffix
+    assert samples[
+        'singa_tpu_serve_dist_rpcs_total{host="w0",peer="x"}'] == 10
+    assert samples[
+        'singa_tpu_serve_dist_rpcs_total{host="w1",peer="x"}'] == 6
+    # the per-series prometheus identity: +Inf bucket == _count
+    for host, n in (("w0", 10), ("w1", 6)):
+        inf_key = ('singa_tpu_serve_dist_rtt_s_bucket'
+                   f'{{host="{host}",le="+Inf",peer="x"}}')
+        cnt_key = ('singa_tpu_serve_dist_rtt_s_count'
+                   f'{{host="{host}",peer="x"}}')
+        assert samples[inf_key] == samples[cnt_key] == n
+    # TYPE lines: declared once per family, histogram stays histogram
+    assert text.count(
+        "# TYPE singa_tpu_serve_dist_rtt_s histogram") == 1
+    assert text.count(
+        "# TYPE singa_tpu_serve_dist_rpcs_total counter") == 1
+
+
+def test_fleet_quantile_equals_sum_by_le():
+    """The promQL the docs teach —
+    ``histogram_quantile(q, sum(rate(x_bucket)) by (le))`` — computed
+    two ways must agree: merged_histogram's ladder IS the sum-by-le of
+    the per-host ladders, and the aggregated p99 interpolates on it."""
+    ft = FleetTelemetry(clock=_FakeClock())
+    ft.host_online("w0")
+    ft.host_online("w1")
+    ft.ingest("w0", {"registry": _dump_with_histogram(10, 0.05)})
+    ft.ingest("w1", {"registry": _dump_with_histogram(6, 0.5)})
+    agg = ft.merged_histogram("serve.dist.rtt_s")
+    assert agg["count"] == 16
+    assert agg["per_host_counts"] == {"w0": 10, "w1": 6}
+    # hand-built sum() by (le) over the exposition's bucket samples
+    samples = _parse_prom(ft.prometheus_text())
+    by_le = {}
+    for k, v in samples.items():
+        if k.startswith("singa_tpu_serve_dist_rtt_s_bucket"):
+            le = k.split('le="')[1].split('"')[0]
+            by_le[float(le)] = by_le.get(float(le), 0) + v
+    assert {le: c for le, c in agg["buckets"]} == by_le
+    p99 = quantile_from_buckets(agg["buckets"], 0.99)
+    assert agg["p99"] == p99
+    # 99th of 16 obs lands in w1's tail: above w0's whole range
+    assert p99 > 0.05
+    assert by_le[float("inf")] == agg["count"]
+
+
+def test_chrome_trace_cross_host_flow_arrows():
+    """A two-hop request whose hops ran on different hosts draws one
+    flow arrow between the two host pids; a kv_ship hop's arrow spans
+    its measured wire time."""
+    ft = FleetTelemetry(clock=_FakeClock())
+    ft.host_online("w0")
+    ft.host_online("w1")
+    e = _entry("a", 10.0, host="w0", replica=0)
+    hop2 = dict(e["hops"][0], host="w1", replica=1, via="kv_ship",
+                t_submit=10.6, t_admit=10.7, t_first_token=10.8,
+                ship_s=0.2)
+    e["hops"].append(hop2)
+    doc = ft.chrome_trace(events=[], requests=[e])
+    assert doc["otherData"]["cross_host_flows"] == 1
+    pids = {ev["pid"] for ev in doc["traceEvents"]}
+    assert {10, 11} <= pids  # one pid per host
+    s = [ev for ev in doc["traceEvents"] if ev.get("ph") == "s"
+         and ev.get("cat") == "fleet"]
+    f = [ev for ev in doc["traceEvents"] if ev.get("ph") == "f"
+         and ev.get("cat") == "fleet"]
+    assert len(s) == len(f) == 1
+    assert s[0]["id"] == f[0]["id"]
+    assert s[0]["pid"] == 10 and f[0]["pid"] == 11
+    assert s[0]["args"]["src_host"] == "w0"
+    assert f[0]["args"]["dst_host"] == "w1"
+    # the arrow spans the ship's wire time, ending at hop arrival
+    assert f[0]["ts"] == pytest.approx(10.6 * 1e6)
+    assert f[0]["ts"] - s[0]["ts"] == pytest.approx(0.2 * 1e6)
+    # same-host consecutive hops draw NO arrow
+    e2 = _entry("b", 11.0, host="w0")
+    e2["hops"].append(dict(e2["hops"][0]))
+    doc2 = ft.chrome_trace(events=[], requests=[e2])
+    assert doc2["otherData"]["cross_host_flows"] == 0
+
+
+def test_section_reports_stale_and_clock():
+    clk = _FakeClock()
+    ft = FleetTelemetry(clock=clk)
+    cs = ClockSync()
+    cs.offset, cs.rtt, cs.samples = 0.25, 0.002, 5
+    ft.host_online("w0", clock_sync=cs)
+    ft.ingest("w0", {"registry": {"schema": "singa_tpu.telemetry/1",
+                                  "metrics": []}})
+    ft.mark_stale("w1", "PeerGoneError('severed')")
+    clk.t += 3.0
+    sec = ft.section()
+    assert sec["enabled"] is True
+    assert sec["stale_hosts"] == ["w1"]
+    assert sec["hosts"]["w0"]["clock"]["offset_s"] == 0.25
+    assert sec["hosts"]["w0"]["last_pull_age_s"] == pytest.approx(3.0)
+    assert sec["hosts"]["w1"]["stale_reason"].startswith("PeerGone")
+    # the exposition carries the typed stale marker as a gauge
+    samples = _parse_prom(ft.prometheus_text())
+    assert samples['singa_tpu_federation_stale{host="w0"}'] == 0
+    assert samples['singa_tpu_federation_stale{host="w1"}'] == 1
