@@ -626,9 +626,12 @@ def _federation_evidence(f, args, jit_cold, jit_warm):
     next pull recovers), take the final federated pull, then write and
     strictly re-parse the three merged artifacts, checking the gate's
     invariants — >=2 host pids + a cross-host flow arrow in the trace,
-    ``+Inf`` bucket == ``_count`` for every federated histogram
-    ladder, why_slow latency fractions summing to 1 with the exact
-    ``ship`` phase, and zero warm recompiles with federation on."""
+    dual per-host step-anatomy lanes (cat step.host/step.device under
+    every host pid, observe.stepprof on each worker) with a measured
+    per-host bubble, ``+Inf`` bucket == ``_count`` for every federated
+    histogram ladder, why_slow latency fractions summing to 1 with the
+    exact ``ship`` phase, and zero warm recompiles with federation
+    (profiler included) on."""
     from singa_tpu.observe import health_report
     from singa_tpu.resilience import FailOnce, faults
 
@@ -684,6 +687,20 @@ def _federation_evidence(f, args, jit_cold, jit_warm):
     pids = sorted({e["pid"] for e in doc["traceEvents"]})
     host_pids = [p for p in pids if p >= 10]
     flows = doc["otherData"]["cross_host_flows"]
+    # per-host step-anatomy lanes (observe.stepprof on every worker,
+    # enabled by the federate init flags): the workers' cat=step.host/
+    # step.device records ship over the trace channel and land as two
+    # lanes inside each host's pid in the merged document — the
+    # host-vs-device decomposition is per-HOST evidence, not just a
+    # single-process number
+    step_lane_pids = sorted({e["pid"] for e in doc["traceEvents"]
+                             if e.get("cat") == "step.host"
+                             and e["pid"] >= 10})
+    dev_lane_pids = sorted({e["pid"] for e in doc["traceEvents"]
+                            if e.get("cat") == "step.device"
+                            and e["pid"] >= 10})
+    host_anatomy = {h: d.get("step_anatomy")
+                    for h, d in ds["hosts"].items()}
 
     fed = {
         "hosts": sorted(ds["hosts"]),
@@ -699,7 +716,13 @@ def _federation_evidence(f, args, jit_cold, jit_warm):
         },
         "trace": {"events": n_ev, "pids": pids,
                   "host_pids": host_pids,
-                  "cross_host_flows": flows},
+                  "cross_host_flows": flows,
+                  "step_anatomy_host_pids": step_lane_pids,
+                  "step_device_host_pids": dev_lane_pids},
+        # per-host mean device-bubble from the shipped serve.step.*
+        # registries (federate.section()): which HOST's engine is
+        # host-bound — the fleet-scale ROADMAP item-5 baseline
+        "step_anatomy": host_anatomy,
         "prometheus": {
             "bytes": len(prom),
             "host_labeled_series": prom.count('host="'),
@@ -729,6 +752,15 @@ def _federation_evidence(f, args, jit_cold, jit_warm):
     assert "ship" in ws["ttft_p99_attribution"], ws
     assert len(host_pids) >= 2, pids
     assert flows >= 1, doc["otherData"]
+    # dual step-anatomy lanes must appear under EVERY host pid, and
+    # every host's shipped registry must carry a measured bubble —
+    # the dist gate's step-anatomy acceptance
+    assert len(step_lane_pids) >= 2, (step_lane_pids, pids)
+    assert step_lane_pids == dev_lane_pids, (step_lane_pids,
+                                             dev_lane_pids)
+    assert all(a is not None and a["steps"] > 0
+               and a["bubble_frac"] > 0.0
+               for a in host_anatomy.values()), host_anatomy
     assert fams > 0 and inf_ok, (fams, inf_ok)
     assert fed["recompiles_warm"] in (0, None), fed
     assert fed["recompiles_federation"] in (0, None), fed

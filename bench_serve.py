@@ -63,6 +63,12 @@ health report's ``why_slow`` tail-latency attribution; with
 ``--trace-out`` the Chrome trace additionally carries per-request
 tracks with hop flow arrows.  ``--prom-out PATH`` writes the
 Prometheus text exposition (bucketed histogram families) at exit.
+``--step-anatomy`` replays the workload with the step profiler ON
+(``observe.stepprof``) and embeds the ``step_anatomy`` section: the
+per-step host/device decomposition (segment fractions summing to 1,
+exact arithmetic), the baseline device-bubble fraction ROADMAP item
+5's overlap work must close, parity against the unprofiled run, and
+the recompile pin proving the fences never enter jitted code.
 """
 
 import argparse
@@ -1438,6 +1444,82 @@ def run_static(m, workload, max_slots):
     return wall, outs, ttfts
 
 
+def run_step_anatomy(m, workload, max_slots, baseline_outs, useful):
+    """The --step-anatomy measurement: replay the standard workload
+    with the step profiler ON (``observe.stepprof``) and commit the
+    baseline device-bubble fraction — the ROADMAP item-5 measuring
+    stick.  Every future overlap-the-host-with-the-device PR diffs
+    its bubble against this section.
+
+    Four pins ride along, asserted by the tier1 serve gate:
+    per-segment fractions sum to 1 (±1e-6 — exclusive-time exact
+    arithmetic), the measured bubble is nonzero (a claim of zero
+    bubble on an unoverlapped step loop means the instrument is
+    broken), token parity against the unprofiled run (the profiler
+    must observe, not perturb), and zero runtime recompiles (fences
+    and the block_until_ready hook never enter jitted code).
+
+    CPU-measured: the absolute bubble is chip-pending (a CPU "device"
+    is the same silicon as the host, so the bubble runs high); the
+    INSTRUMENT and its pins are platform-independent."""
+    from singa_tpu.observe import stepprof
+    from singa_tpu.serve import GenerationRequest
+
+    prof = stepprof.enable()
+    jit_before = _serve_jit_cache_size()
+    eng = m.serve(max_slots=max_slots)
+    handles = []
+    pending = list(workload)
+    t0 = time.perf_counter()
+    while pending or eng.pending:
+        while pending and pending[0]["arrival_step"] <= eng.step_count:
+            w = pending.pop(0)
+            handles.append(eng.submit(GenerationRequest(
+                w["prompt"], max_new_tokens=w["n_new"])))
+        eng.step()
+    wall = time.perf_counter() - t0
+    outs = [h.result() for h in handles]
+    jit_after = _serve_jit_cache_size()
+    parity = all(np.array_equal(a.tokens, b.tokens)
+                 for a, b in zip(outs, baseline_outs))
+    sec = prof.section()
+    # overall fractions over ONE denominator across the profiled
+    # run's engines (one engine here; the schema holds for more)
+    seg = {}
+    for a in prof._agg.values():
+        for k, v in a["seg"].items():
+            seg[k] = seg.get(k, 0.0) + v
+    denom = sum(seg.values())
+    fractions = ({k: v / denom for k, v in sorted(seg.items())}
+                 if denom > 0 else {})
+    why = prof.why_slow_summary()
+    # fences off FIRST, series kept readable, THEN close: the
+    # registry snapshot and the --prom-out exposition at exit must
+    # carry the serve.step.* families this section's numbers came
+    # from (a close under a live profiler would forget_engine them),
+    # while the profiled engine's own serve.* stats unregister as
+    # every other section's timed engine does
+    stepprof.disable(unregister=False)
+    eng.close()
+    return {
+        "steps": sec["steps"],
+        "wall_s": wall,
+        "tokens_per_s": useful / wall,
+        "bubble_frac": why["bubble_frac"] if why else None,
+        "device_frac": why["device_frac"] if why else None,
+        "top_host_segment": (why["top_host_segment"] if why
+                             else None),
+        "fractions": fractions,
+        "fractions_sum": sum(fractions.values()),
+        "engines": sec["engines"],
+        "parity": bool(parity),
+        "recompiles": jit_after - jit_before,
+        # CPU host == CPU "device": the absolute bubble is not a TPU
+        # number — the instrument and its pins are what this commits
+        "chip_pending": True,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--trace-out", default=None, metavar="PATH",
@@ -1457,6 +1539,14 @@ def main():
                     help="also write the Prometheus text exposition "
                          "of the live metrics registry (bucketed "
                          "histogram families) at exit")
+    ap.add_argument("--step-anatomy", action="store_true",
+                    help="also replay the workload with the step "
+                         "profiler ON (observe.stepprof) and embed "
+                         "the step_anatomy section — per-segment "
+                         "host/device fractions (sum to 1), the "
+                         "baseline device-bubble fraction ROADMAP "
+                         "item 5 diffs against, parity vs the "
+                         "unprofiled run, recompile pin")
     ap.add_argument("--paged", action="store_true",
                     help="also run the workload through the paged-KV "
                          "engine vs the slot arena at the SAME KV "
@@ -1659,6 +1749,12 @@ def main():
         "health": observe.health_report(engine_snapshots=[snap],
                                         include_registry=False),
     }
+    if args.step_anatomy:
+        report["step_anatomy"] = run_step_anatomy(
+            m, workload, max_slots, outs_e, useful)
+        report["registry"] = observe.registry().snapshot()
+        report["health"] = observe.health_report(
+            engine_snapshots=[snap], include_registry=False)
     if args.paged:
         report["paged"] = run_paged(m, workload, outs_e)
         report["registry"] = observe.registry().snapshot()

@@ -1,0 +1,150 @@
+"""Perf-trend guard: diff a FRESH bench_serve report against the
+committed baseline (BENCH_SERVE.json) so a PR that quietly regresses
+the serving engine fails loudly in CI instead of surfacing three PRs
+later as "when did decode get slow?".
+
+Two classes of check, deliberately different in temperament:
+
+* **strict** (exit 1): correctness invariants that must never drift —
+  byte parity with the static-batch reference (core run AND the
+  profiled ``--step-anatomy`` run), and ZERO runtime recompiles in
+  every section of the fresh report that carries a ``recompiles``
+  census (the jit-cache pin: observability and new features must not
+  push anything into jitted code).
+* **advisory** (exit 0, loud warning): throughput and latency trends —
+  ``engine.tokens_per_s`` and ``engine.ttft_p50_s`` vs the committed
+  numbers.  CI runners are noisy shared CPU boxes, so the tolerances
+  are generous (default: flag < 0.5x throughput or > 2.0x TTFT) and a
+  trip is a WARNING in the verdict JSON, not a failure — the committed
+  baseline is re-recorded by the same PR that legitimately moves it.
+
+Usage::
+
+    python bench_trend.py --fresh /tmp/bench_serve_ci.json
+    python bench_trend.py            # runs bench_serve itself
+
+``--fresh`` reuses a report another CI step already produced (the
+serve gate's), so the trend check costs one JSON diff, not a second
+multi-minute bench run — the fast-lane budget discipline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import tempfile
+
+
+def _walk_recompiles(node, path=""):
+    """Every ``recompiles`` census in the report tree, with its
+    section path — new sections are gated automatically."""
+    if isinstance(node, dict):
+        for k, v in node.items():
+            sub = f"{path}.{k}" if path else k
+            if k == "recompiles":
+                yield path or "<root>", v
+            else:
+                yield from _walk_recompiles(v, sub)
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            yield from _walk_recompiles(v, f"{path}[{i}]")
+
+
+def _run_fresh(out_path):
+    cmd = [sys.executable, "bench_serve.py", "--step-anatomy"]
+    with open(out_path, "w") as fh:
+        subprocess.run(cmd, stdout=fh, check=True)
+
+
+def trend(baseline, fresh, tput_floor=0.5, ttft_ceil=2.0):
+    """The diff.  Returns the verdict dict; ``verdict["passed"]`` is
+    the strict gate (advisory trips never clear it)."""
+    strict, advisory = [], []
+
+    # -- strict: parity ----------------------------------------------
+    if fresh.get("parity") is not True:
+        strict.append("core parity is not True in the fresh report")
+    sa = fresh.get("step_anatomy")
+    if sa is not None and sa.get("parity") is not True:
+        strict.append("step-anatomy parity is not True (profiler ON"
+                      " changed tokens)")
+
+    # -- strict: the recompile pin, every census in the report -------
+    for where, n in _walk_recompiles(fresh):
+        if n not in (None, 0):
+            strict.append(f"recompiles={n} in section {where!r}"
+                          " (jit-cache pin broken)")
+
+    # -- advisory: throughput / latency trend ------------------------
+    comp = {}
+    be, fe = baseline.get("engine", {}), fresh.get("engine", {})
+    b_tps, f_tps = be.get("tokens_per_s"), fe.get("tokens_per_s")
+    if b_tps and f_tps:
+        ratio = f_tps / b_tps
+        comp["tokens_per_s"] = {"baseline": round(b_tps, 1),
+                                "fresh": round(f_tps, 1),
+                                "ratio": round(ratio, 3)}
+        if ratio < tput_floor:
+            advisory.append(
+                f"throughput {f_tps:.0f} tok/s is {ratio:.2f}x the"
+                f" committed {b_tps:.0f} (floor {tput_floor}x)")
+    b_tt, f_tt = be.get("ttft_p50_s"), fe.get("ttft_p50_s")
+    if b_tt and f_tt:
+        ratio = f_tt / b_tt
+        comp["ttft_p50_s"] = {"baseline": round(b_tt, 4),
+                              "fresh": round(f_tt, 4),
+                              "ratio": round(ratio, 3)}
+        if ratio > ttft_ceil:
+            advisory.append(
+                f"TTFT p50 {f_tt * 1e3:.1f}ms is {ratio:.2f}x the"
+                f" committed {b_tt * 1e3:.1f}ms (ceiling"
+                f" {ttft_ceil}x)")
+    bsa = baseline.get("step_anatomy")
+    if bsa and sa and bsa.get("bubble_frac") and sa.get("bubble_frac"):
+        comp["bubble_frac"] = {"baseline": round(bsa["bubble_frac"], 4),
+                               "fresh": round(sa["bubble_frac"], 4)}
+
+    return {"bench": "serve_trend", "schema": "singa_tpu.trend/1",
+            "strict_failures": strict, "advisory_warnings": advisory,
+            "comparison": comp, "passed": not strict}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default="BENCH_SERVE.json",
+                    help="committed reference report")
+    ap.add_argument("--fresh", default=None,
+                    help="existing fresh report to diff (skips the"
+                         " bench run)")
+    ap.add_argument("--tput-floor", type=float, default=0.5,
+                    help="advisory: flag fresh/baseline tokens/s"
+                         " below this ratio")
+    ap.add_argument("--ttft-ceil", type=float, default=2.0,
+                    help="advisory: flag fresh/baseline TTFT p50"
+                         " above this ratio")
+    args = ap.parse_args(argv)
+
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+    if args.fresh is None:
+        tmp = tempfile.NamedTemporaryFile(
+            suffix=".json", prefix="bench_trend_", delete=False)
+        tmp.close()
+        _run_fresh(tmp.name)
+        args.fresh = tmp.name
+    with open(args.fresh) as fh:
+        fresh = json.load(fh)
+
+    verdict = trend(baseline, fresh, tput_floor=args.tput_floor,
+                    ttft_ceil=args.ttft_ceil)
+    print(json.dumps(verdict, indent=1))
+    for w in verdict["advisory_warnings"]:
+        print(f"bench_trend ADVISORY: {w}", file=sys.stderr)
+    for f in verdict["strict_failures"]:
+        print(f"bench_trend STRICT FAILURE: {f}", file=sys.stderr)
+    return 0 if verdict["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

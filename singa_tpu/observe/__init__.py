@@ -49,6 +49,9 @@ from .registry import (Counter, Gauge, Histogram,  # noqa: F401
 from .trace import (clear, disable, drain, dropped,  # noqa: F401
                     enable, event, events, is_enabled, set_max_events,
                     span, traced)
+from . import stepprof  # noqa: F401  (step-anatomy profiler:
+#                                      host/device attribution)
+from .stepprof import StepProfiler  # noqa: F401
 from . import monitor  # noqa: F401  (imports trace/registry only)
 from . import requests  # noqa: F401  (per-request lifecycle ledger)
 from .requests import RequestLedger  # noqa: F401

@@ -49,7 +49,8 @@ from .registry import Counter, Histogram, registry as _registry
 
 __all__ = ["jsonl_lines", "write_jsonl", "chrome_trace",
            "write_chrome_trace", "request_trace_events",
-           "prometheus_text", "write_prometheus", "json_sanitize"]
+           "step_trace_events", "prometheus_text", "write_prometheus",
+           "json_sanitize"]
 
 
 def json_sanitize(obj):
@@ -166,13 +167,72 @@ def request_trace_events(entries, pid=1) -> list:
     return out
 
 
-def chrome_trace(events=None, metadata=None, requests=None) -> dict:
+def step_trace_events(records, pid=2) -> list:
+    """Dual-lane step-anatomy tracks from
+    :func:`~singa_tpu.observe.stepprof.records` entries: per engine a
+    HOST lane (one ``X`` slice per host segment piece, named by
+    segment, the step's wall as a ``step N`` parent slice) stacked
+    directly above a DEVICE lane (one slice per dispatch→ready
+    window).  The bubble is what you SEE: every gap in the device lane
+    under host activity is device idle time — ROADMAP item 5's target
+    rendered as empty pixels.  Rides its own ``step anatomy`` process
+    (``pid``) next to the subsystem (pid 0) and request (pid 1)
+    tracks."""
+    out = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "step anatomy"}}]
+    labels = []
+    for rec in records:
+        if rec["engine"] not in labels:
+            labels.append(rec["engine"])
+    lanes = {}
+    for i, lbl in enumerate(labels):
+        host_tid, dev_tid = 2 * i, 2 * i + 1
+        lanes[lbl] = (host_tid, dev_tid)
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": host_tid,
+                    "args": {"name": f"e{lbl} host"}})
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": dev_tid,
+                    "args": {"name": f"e{lbl} device"}})
+    for rec in records:
+        host_tid, dev_tid = lanes[rec["engine"]]
+        base = {"engine": rec["engine"], "step": rec["step"]}
+        # the step's wall as the host lane's top-level slice: nested
+        # segment pieces render inside it, and its args carry the
+        # sealed totals (the hover-card summary)
+        out.append({"name": f"step {rec['step']}", "cat": "step.host",
+                    "ph": "X", "pid": pid, "tid": host_tid,
+                    "ts": rec["t0"] * 1e6, "dur": rec["wall_s"] * 1e6,
+                    "args": dict(base,
+                                 bubble_frac=round(
+                                     rec["bubble_frac"], 4),
+                                 host_s=rec["host_s"],
+                                 device_s=rec["device_s"])})
+        for name, t0, dur in rec["pieces"]:
+            if name == "device" or dur <= 0.0:
+                continue  # device windows render on their own lane
+            out.append({"name": name, "cat": "step.host", "ph": "X",
+                        "pid": pid, "tid": host_tid, "ts": t0 * 1e6,
+                        "dur": dur * 1e6, "args": base})
+        for t0, dur in rec["device_windows"]:
+            out.append({"name": "device", "cat": "step.device",
+                        "ph": "X", "pid": pid, "tid": dev_tid,
+                        "ts": t0 * 1e6, "dur": dur * 1e6,
+                        "args": base})
+    return out
+
+
+def chrome_trace(events=None, metadata=None, requests=None,
+                 steps=None) -> dict:
     """Build the trace-event object: spans as complete ("X") events,
     instants as "i", one tid per subsystem category with a
     ``thread_name`` row label.  ``metadata`` is merged into the
     top-level ``otherData``.  ``requests``: optional sealed
     request-ledger entries rendered as per-request tracks
-    (:func:`request_trace_events`) in the same document."""
+    (:func:`request_trace_events`) in the same document.  ``steps``:
+    optional step-anatomy ring records
+    (``stepprof.records()``) rendered as dual host/device lanes per
+    engine (:func:`step_trace_events`)."""
     if events is None:
         events = _trace.events()
     cats = []
@@ -199,21 +259,26 @@ def chrome_trace(events=None, metadata=None, requests=None) -> dict:
         out.append(ev)
     if requests:
         out.extend(request_trace_events(requests, pid=1))
+    if steps:
+        out.extend(step_trace_events(steps, pid=2))
     doc = {"traceEvents": out, "displayTimeUnit": "ms",
            "otherData": {"source": "singa_tpu.observe",
                          "dropped_events": _trace.dropped()}}
     if requests:
         doc["otherData"]["request_tracks"] = len(requests)
+    if steps:
+        doc["otherData"]["step_records"] = len(steps)
     if metadata:
         doc["otherData"].update(metadata)
     return doc
 
 
 def write_chrome_trace(path, events=None, metadata=None,
-                       requests=None) -> int:
+                       requests=None, steps=None) -> int:
     """Write the Chrome trace JSON; returns the trace-event count
     (metadata rows included)."""
-    doc = chrome_trace(events, metadata, requests=requests)
+    doc = chrome_trace(events, metadata, requests=requests,
+                       steps=steps)
     with open(path, "w") as f:
         # default=str: span args routinely carry numpy/jax scalars; a
         # trace must never be lost at export time over a dtype

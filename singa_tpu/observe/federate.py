@@ -595,6 +595,12 @@ class FleetTelemetry:
                 "clock": (h.clock.summary() if h.clock is not None
                           else None),
                 "ledger_entries": len(h.entries),
+                # per-host step anatomy from the shipped registry
+                # dump (observe.stepprof on the worker): mean device
+                # bubble + step count, None until the host ships
+                # serve.step.* series — the straggler question
+                # "which HOST's engine is host-bound" answered here
+                "step_anatomy": _host_step_anatomy(h.registry),
             }
         return {
             "enabled": True,
@@ -604,6 +610,28 @@ class FleetTelemetry:
                                   if d["stale"]),
             "why_slow": self.why_slow(top_k=top_k),
         }
+
+
+def _host_step_anatomy(dump):
+    """Mean device-bubble fraction + step count for one host, from
+    its shipped registry dump (pure dict work — the worker's
+    ``serve.step.{bubble_frac,wall_s}`` running sums/counts summed
+    across its engine labels).  None until the host ships the
+    families (profiler off, or no pull yet)."""
+    if not dump:
+        return None
+    bub_sum = bub_n = steps = 0
+    for m in dump.get("metrics", ()):
+        if m.get("kind") != "histogram":
+            continue
+        if m["name"] == "serve.step.bubble_frac":
+            bub_sum += m.get("sum", 0.0)
+            bub_n += m.get("count", 0)
+        elif m["name"] == "serve.step.wall_s":
+            steps += m.get("count", 0)
+    if bub_n == 0:
+        return None
+    return {"steps": steps, "bubble_frac": bub_sum / bub_n}
 
 
 def _seal_key(e):

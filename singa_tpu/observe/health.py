@@ -34,6 +34,7 @@ from . import federate as _federate
 from . import monitor as _monitor
 from . import requests as _requests
 from . import slo as _slo
+from . import stepprof as _stepprof
 from . import trace as _trace
 from .registry import registry as _registry
 
@@ -378,6 +379,25 @@ def _step_time_sections(snap_hists: dict) -> dict:
     return out
 
 
+def _why_slow_with_anatomy() -> dict:
+    """The request ledger's why_slow section with the step profiler's
+    host-vs-device verdict riding along.  The ledger decomposes WHICH
+    requests are slow and in which lifecycle phase; the anatomy rider
+    says whether the ENGINE's steps are host-bound or device-bound
+    while they were — the two answers compose (a decode-phase p99
+    regression plus ``culprit: "host"`` points at the step loop, not
+    the model).  The rider only appears when ``stepprof`` is live AND
+    has sealed at least one step, so the section's shape is unchanged
+    for existing consumers when the profiler is off."""
+    section = _requests.why_slow_section()
+    if _stepprof._active:
+        anatomy = _stepprof.why_slow_summary()
+        if anatomy is not None:
+            section = dict(section)
+            section["step_anatomy"] = anatomy
+    return section
+
+
 def health_report(reg=None, engine_snapshots=(),
                   include_registry=True) -> dict:
     """Build the unified health dict.  ``engine_snapshots``: optional
@@ -437,7 +457,14 @@ def health_report(reg=None, engine_snapshots=(),
             # decomposes the TTFT/TPOT p99 population and the top-K
             # slowest requests into queue/prefill/decode/stall/hop
             # phase components — the "WHY did p99 regress" answer
-            "why_slow": _requests.why_slow_section(),
+            "why_slow": _why_slow_with_anatomy(),
+            # per-step host/device decomposition (observe.stepprof):
+            # always present; {"enabled": False} until
+            # stepprof.enable().  When live it carries per-engine
+            # segment fractions (summing to 1 — exact arithmetic over
+            # one denominator, the ledger's seal-time idiom) and the
+            # device-bubble fraction ROADMAP item 5 is measured by
+            "step_anatomy": _stepprof.section(),
             # cross-host federation (observe.federate): always
             # present; {"enabled": False} until a federated DistFleet
             # installs its FleetTelemetry.  When live it carries

@@ -53,6 +53,7 @@ import time
 import traceback
 from collections import deque
 
+from . import stepprof as _stepprof
 from . import trace as _trace
 from .registry import registry as _registry
 
@@ -551,10 +552,20 @@ class Watchdog:
             z = (dt - st.ewma_mean) / math.sqrt(st.ewma_var)
             if z > self.z_threshold:
                 st.anom.inc()
+                extra = {}
+                if _stepprof._active:
+                    # the step profiler names the CULPRIT lane for
+                    # this source's anomaly — host-bound (scheduling
+                    # bubble) vs device-bound (model got slower) —
+                    # from its most recent sealed step, so the alert
+                    # carries the answer, not just the symptom
+                    verdict = _stepprof.culprit(source)
+                    if verdict is not None:
+                        extra = verdict
                 _trace.event(
                     "monitor/step_time_anomaly", cat="monitor",
                     source=source, step_time=dt, z=round(z, 2),
-                    ewma_mean=st.ewma_mean)
+                    ewma_mean=st.ewma_mean, **extra)
         a = self.alpha
         if st.n_samples == 0:
             st.ewma_mean = dt

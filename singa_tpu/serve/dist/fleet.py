@@ -720,7 +720,7 @@ class DistFleet(ServeFleet):
             # ships them on telemetry pulls; thread mode must NOT —
             # its observe globals are the controller's (shared)
             init["federate"] = {"ledger": True, "trace": True,
-                                "capacity": 4096}
+                                "stepprof": True, "capacity": 4096}
         ack = conn.call("init", init, timeout=self._init_timeout())
         if not ack["ok"]:
             conn.close()

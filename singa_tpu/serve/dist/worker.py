@@ -245,6 +245,14 @@ class _Worker:
             # align the trace clock with the ledger/probe clock so one
             # per-host offset corrects every shipped timestamp
             _w_trace.enable(clock=self._clock)
+        if fed.get("stepprof"):
+            from ...observe import stepprof as _w_stepprof
+            # per-step host/device anatomy: the profiler's trace
+            # records (cat step.host/step.device) ride the trace
+            # shipping above, so the controller's merged Chrome trace
+            # grows dual per-host step lanes for free; the probe clock
+            # keeps them on the same correctable time base
+            _w_stepprof.enable(clock=self._clock)
         eng = self.sup.engine
         arena = eng.paged_arena
 

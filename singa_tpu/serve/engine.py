@@ -112,6 +112,7 @@ from ..models.gpt2_decode import (_advance_chunk, _advance_one,
                                   spec_verify)
 from ..observe import monitor as _monitor
 from ..observe import requests as _reqs
+from ..observe import stepprof as _stepprof
 from ..observe import trace as _trace
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
@@ -619,6 +620,78 @@ class _LocalExec:
         return _read_slot(kc, vc, slot)
 
 
+class _ProfExec:
+    """The step-anatomy hook at the executor seam: every dispatch the
+    engine makes routes through ``self._x``, so wrapping HERE times
+    dispatch (host) and dispatch→``block_until_ready`` (device) for
+    every parallelism mode — ``_LocalExec`` and the tp/ep/pp sharded
+    executors alike — without the step loop knowing.  Disabled cost is
+    one module-flag read per dispatch (the ``trace._active``
+    discipline); with the profiler ON the only added work is a
+    ``block_until_ready`` on outputs the engine was about to sync
+    anyway, so nothing enters jitted code and the recompile pin
+    holds."""
+
+    __slots__ = ("_inner",)
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        # non-dispatch surface (executor-specific attrs) falls through
+        return getattr(self._inner, name)
+
+    def pool_decode_step(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.pool_decode_step(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.pool_decode_step,
+                                        a, kw)
+
+    def pool_spec_step(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.pool_spec_step(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.pool_spec_step,
+                                        a, kw)
+
+    def paged_decode_step(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.paged_decode_step(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.paged_decode_step,
+                                        a, kw)
+
+    def paged_spec_step(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.paged_spec_step(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.paged_spec_step,
+                                        a, kw)
+
+    def prefill_one(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.prefill_one(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.prefill_one, a, kw)
+
+    def prefill_batch(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.prefill_batch(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.prefill_batch,
+                                        a, kw)
+
+    def chunk_row(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.chunk_row(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.chunk_row, a, kw)
+
+    def write_slot(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.write_slot(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.write_slot, a, kw)
+
+    def read_slot(self, *a, **kw):
+        if not _stepprof._active:
+            return self._inner.read_slot(*a, **kw)
+        return _stepprof.timed_dispatch(self._inner.read_slot, a, kw)
+
+
 class _Slot:
     """Host-side bookkeeping for one pool row (the decode position
     lives in the engine's per-slot arrays — the jitted step's
@@ -1021,8 +1094,11 @@ class InferenceEngine:
         #: and late-statics calls below go through this seam so the
         #: host-side step loop never knows which mesh it runs over
         self._shard = (self.tp_exec or self.ep_exec or self.pp_exec)
-        self._x = (self._shard if self._shard is not None
-                   else _LocalExec(self))
+        # the step-anatomy shim wraps the seam permanently: one
+        # module-flag read per dispatch when the profiler is off
+        # (observe/stepprof.py), dispatch/ready timestamps when on
+        self._x = _ProfExec(self._shard if self._shard is not None
+                            else _LocalExec(self))
         # fixed-shape KV arena keyed on (max_slots, max_len): L layers,
         # H_kv heads (GQA keeps the narrow cache), compute dtype —
         # or (int8 values, f32 scales) tuples for cache_dtype="int8"
@@ -1403,6 +1479,7 @@ class InferenceEngine:
     def _release_everything(self):
         self.stats.unregister()
         _monitor.forget(self._hb_source)
+        _stepprof.forget_engine(self.stats.engine_label)
         if self.prefix_cache is not None:
             self.prefix_cache.unregister()
         if self.paged_arena is not None:
@@ -1477,6 +1554,8 @@ class InferenceEngine:
             # lets the watchdog see an armed, then-silent source — a
             # re-arm only after the dispatch returns would never come
             _monitor.heartbeat(self._hb_source)
+        if _stepprof._active:
+            _stepprof.begin(self.stats.engine_label, self.step_count)
         try:
             if self.paged_arena is not None:
                 # paged growth: every live slot must own the block(s)
@@ -1486,11 +1565,20 @@ class InferenceEngine:
                 self._grow_live_slots()
             if any(s is not None for s in self._slots):
                 self._decode_once()
+            if _stepprof._active:
+                _stepprof.push("schedule")
             self._schedule(self._clock())
+            if _stepprof._active:
+                _stepprof.pop()
         except Exception as e:
+            # a raising step has no meaningful anatomy: drop the open
+            # record so a later dispatch can't land on a stale state
+            _stepprof.abort()
             raise self._fail(e) from e
         self.stats.on_schedule(self.scheduler.queue_depth)
         self.step_count += 1
+        if _stepprof._active:
+            _stepprof.end()
         pending = self.pending
         if not pending and _monitor.active():
             # drained: refresh liveness but DISARM hang detection —
@@ -1728,8 +1816,12 @@ class InferenceEngine:
                         jnp.asarray(self._pos), jnp.asarray(live),
                         self._keys, jnp.asarray(self._temps),
                         self._top_p)
+                if _stepprof._active:
+                    _stepprof.push("sync")
                 out = np.asarray(out)
                 a_draft = np.asarray(a_draft)
+                if _stepprof._active:
+                    _stepprof.pop()
         else:
             with _trace.span("serve/decode_step", cat="serve",
                              step=self.step_count, live=n_live,
@@ -1790,7 +1882,11 @@ class InferenceEngine:
                             jnp.asarray(self._pos),
                             jnp.asarray(live), self._keys,
                             jnp.asarray(self._temps), self._top_p)
+                if _stepprof._active:
+                    _stepprof.push("sync")
                 next_toks = np.asarray(next_toks)
+                if _stepprof._active:
+                    _stepprof.pop()
         if _mon:
             _monitor.heartbeat(
                 self._hb_source,
@@ -1800,6 +1896,9 @@ class InferenceEngine:
         t_emit = self._clock()
         led = _reqs._ledger if _reqs._active else None
         lbl = self.stats.engine_label
+        _sp = _stepprof._active
+        if _sp:
+            _stepprof.push("emit")
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -1807,7 +1906,11 @@ class InferenceEngine:
             if a_draft is None:
                 self._emit(i, slot, int(next_toks[i]), t_emit)
                 if led is not None:
+                    if _sp:
+                        _stepprof.push("ledger")
                     led.on_step(rid, engine=lbl, t=t_emit, tokens=1)
+                    if _sp:
+                        _stepprof.pop()
                 self._toks[i] = next_toks[i]
                 self._pos[i] += 1
                 continue
@@ -1830,12 +1933,18 @@ class InferenceEngine:
                 # emitted tokens (may stop mid-chunk), accepted
                 # proposals, proposals offered (lands on the sealed
                 # entry when the last token retired the request)
+                if _sp:
+                    _stepprof.push("ledger")
                 led.on_step(rid, engine=lbl, t=t_emit, tokens=emitted,
                             accepted=int(a_draft[i]),
                             drafted=self.spec_k - 1)
+                if _sp:
+                    _stepprof.pop()
             if self._slots[i] is slot:
                 self._toks[i] = int(out[i, emitted - 1])
                 self._pos[i] += emitted
+        if _sp:
+            _stepprof.pop()
 
     def _emit(self, idx, slot, token, now):
         slot.emitted.append(token)
@@ -1885,14 +1994,21 @@ class InferenceEngine:
     def _retire(self, idx, slot, now, finish_reason="length"):
         req = slot.handle.request
         n = len(slot.emitted)
+        _sp = _stepprof._active
+        if _sp:
+            _stepprof.push("retire")
         _trace.event("serve/retire", cat="serve",
                      request=req.request_id, slot=idx, tokens=n,
                      step=self.step_count)
         if _reqs._active:
+            if _sp:
+                _stepprof.push("ledger")
             _reqs._ledger.on_retire(req.request_id,
                                     engine=self.stats.engine_label,
                                     t=now, finish_reason=finish_reason,
                                     tokens=n)
+            if _sp:
+                _stepprof.pop()
         submit_t = getattr(slot.handle, "_submit_time", slot.admit_time)
         ttft = slot.first_token_time - submit_t
         tpot = ((now - slot.first_token_time) / (n - 1)
@@ -1922,6 +2038,8 @@ class InferenceEngine:
         # entry keeps a long-lived engine's memory flat under sustained
         # traffic
         self._handles.pop(req.request_id, None)
+        if _sp:
+            _stepprof.pop()
 
     def _release_prefix(self, slot):
         if self.prefix_cache is not None and slot.prefix_nodes:
@@ -2531,9 +2649,16 @@ class InferenceEngine:
         B = arena.block_size
         plen = len(req.prompt_ids)
         cache = self.prefix_cache
+        _sp = _stepprof._active
+        if _sp:
+            _stepprof.push("admit")
         nodes = []
         if cache is not None:
+            if _sp:
+                _stepprof.push("prefix_lookup")
             nodes = cache.lookup(req.prompt_ids)[:(plen - 1) // B]
+            if _sp:
+                _stepprof.pop()
             if nodes:
                 cache.acquire(nodes)
         j_lo0 = 0
@@ -2548,6 +2673,8 @@ class InferenceEngine:
         if new_blocks is None:
             if cache is not None and nodes:
                 cache.release(nodes)
+            if _sp:
+                _stepprof.pop()
             return None
         if _reqs._active:
             _reqs._ledger.on_admit(req.request_id,
@@ -2602,6 +2729,8 @@ class InferenceEngine:
                      request=req.request_id, slot=idx,
                      prompt_len=plen, step=self.step_count,
                      chunks=(pf.last_off - pf.off) // B + 1)
+        if _sp:
+            _stepprof.pop()
         return idx
 
     def _advance_prefilling(self, idx, left, now):
@@ -2722,8 +2851,14 @@ class InferenceEngine:
         cap exists to bound)."""
         cache = self.prefix_cache
         plen = len(req.prompt_ids)
-        usable = min(len(cache.lookup(req.prompt_ids)),
-                     (plen - 1) // cache.block_size)
+        if _stepprof._active:
+            _stepprof.push("prefix_lookup")
+            usable = min(len(cache.lookup(req.prompt_ids)),
+                         (plen - 1) // cache.block_size)
+            _stepprof.pop()
+        else:
+            usable = min(len(cache.lookup(req.prompt_ids)),
+                         (plen - 1) // cache.block_size)
         if usable > 0 and plen - usable * cache.block_size \
                 <= cache.block_size:
             return 0
@@ -2818,10 +2953,17 @@ class InferenceEngine:
         handle = self._handles[req.request_id]
         plen = len(req.prompt_ids)
         cache = self.prefix_cache
+        _sp = _stepprof._active
+        if _sp:
+            _stepprof.push("admit")
         nodes = []
         if cache is not None:
+            if _sp:
+                _stepprof.push("prefix_lookup")
             nodes = cache.lookup(req.prompt_ids)[
                 :(plen - 1) // cache.block_size]
+            if _sp:
+                _stepprof.pop()
         arena = self.paged_arena
         new_blocks = []
         if arena is not None:
@@ -2855,6 +2997,8 @@ class InferenceEngine:
             if new_blocks is None:
                 if cache is not None and nodes:
                     cache.release(nodes)
+                if _sp:
+                    _stepprof.pop()
                 return False
         if _reqs._active:
             # admission started: the queue-wait phase of this hop ends
@@ -3001,6 +3145,8 @@ class InferenceEngine:
         else:
             self._keys = self._keys.at[idx].set(carry_key)
         self._emit(idx, slot, tok0, t_first)
+        if _sp:
+            _stepprof.pop()
         return True
 
     def _admit_warm(self, ids, plen, nodes, key0, temp, rid=None):
@@ -3133,6 +3279,13 @@ class InferenceEngine:
         B = self.paged_arena.block_size
         left = (job.last_off - job.off + B if max_tokens is None
                 else int(max_tokens))
+        # a prefill specialist never runs the decode step loop, so its
+        # anatomy comes from here: each budgeted advance is one step
+        # quantum (no-op when a step is already open — a build driven
+        # from inside step() stays attributed to that step)
+        quantum = (_stepprof.begin_quantum(self.stats.engine_label,
+                                           step=self.step_count)
+                   if _stepprof._active else False)
         try:
             while left >= B and job.off <= job.last_off:
                 if _faults._armed:
@@ -3144,12 +3297,20 @@ class InferenceEngine:
                 job.off += B
                 left -= B
                 if _reqs._active and rid is not None:
+                    if quantum:
+                        _stepprof.push("ledger")
                     _reqs._ledger.on_prefill_chunk(
                         rid, engine=self.stats.engine_label,
                         t=self._clock(), offset=off)
+                    if quantum:
+                        _stepprof.pop()
         except Exception as e:
+            if quantum:
+                _stepprof.abort()
             self.abandon_prefix_build(job)
             raise self._fail(e) from e
+        if quantum:
+            _stepprof.end()
         return job.off > job.last_off
 
     def abandon_prefix_build(self, job):

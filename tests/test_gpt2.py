@@ -1172,6 +1172,7 @@ def test_speculative_decode_matches_target_greedy():
     assert 0.0 <= stats["acceptance_rate"] <= 1.0
 
 
+@pytest.mark.slow
 def test_speculative_self_draft_accepts_everything():
     """Draft == target on a trained model: every proposal verifies, so
     acceptance is 1.0 and each chunk emits spec_k tokens (spec_k - 1
@@ -1188,6 +1189,7 @@ def test_speculative_self_draft_accepts_everything():
     assert stats["tokens_per_chunk"] >= 3.0, stats
 
 
+@pytest.mark.slow  # variant: decode_matches_target_greedy is fast rep
 def test_speculative_validates_and_composes():
     from singa_tpu.models import gpt2_decode
 

@@ -168,6 +168,7 @@ def test_warm_admission_never_evicts_its_own_match(model):
     eng.close()
 
 
+@pytest.mark.slow  # int8 variant: serve-gate cache_int8 parity is the fast rep
 def test_int8_paged_parity_vs_offline_oracle(model):
     """int8 pools ((values, scales) pytree leaves) page byte-exactly:
     engine streams equal the offline int8 generate oracle."""

@@ -95,6 +95,7 @@ def test_cold_parity_pp2(model):
     assert snap["paged"]["blocks_used"] == 0
 
 
+@pytest.mark.slow  # variant: test_cold_parity_pp2 is the fast rep
 def test_deep_model_stage_per_layer():
     """The scenario the subsystem exists for — a model DEEPER than one
     device: 4 layers across 4 stages, one layer per device, parity
@@ -108,6 +109,7 @@ def test_deep_model_stage_per_layer():
     assert snap["pp"]["layers_per_stage"] == 1
 
 
+@pytest.mark.slow
 def test_microbatch_widths_and_compaction(model):
     """The GPipe microbatch count clamps (gcd) to the compacted
     dispatch width: a pool whose live width collapses below the
@@ -133,6 +135,7 @@ def test_gqa_parity_pp2():
     assert _parity(outs, base)
 
 
+@pytest.mark.slow
 def test_int8_parity_pp2(model):
     """int8 pools under PP: the (values, scales) leaves both slice on
     the layer axis; token parity vs the single-device int8 paged
@@ -158,6 +161,7 @@ def test_int8_parity_pp2(model):
     assert _parity(outs, base)
 
 
+@pytest.mark.slow
 def test_warm_prefix_parity_pp2(model):
     """Prefix cache on a PP engine: warm chunks flow stage-to-stage
     through the chunk twin against layer-sharded cache rows; streams
@@ -179,6 +183,7 @@ def test_warm_prefix_parity_pp2(model):
     assert snap["prefix"]["hits"] > 0, "workload never went warm"
 
 
+@pytest.mark.slow
 def test_preempt_resume_parity_pp2(model):
     """Preemption/swap against stage-sliced pools: the pool<->row
     copy twins run with layer-axis specs and the host image

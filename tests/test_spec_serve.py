@@ -119,6 +119,7 @@ def test_spec_greedy_streams_byte_identical():
     assert big.tpot is not None
 
 
+@pytest.mark.slow
 def test_spec_gqa_parity():
     """GQA target+draft (narrow H_kv caches in BOTH arenas): greedy
     spec streams still equal the oracle token for token."""
@@ -203,6 +204,7 @@ def test_spec_sampled_chi2_matches_direct_sampling():
 # ---------------------------------------------------------------------------
 # int8 KV arenas
 
+@pytest.mark.slow  # variant: spec_greedy_streams is the fast rep
 def test_int8_engine_parity():
     """int8 arena streams equal offline generate(cache_dtype='int8')
     bit for bit — greedy, seeded sampling, and GQA (the engine and the
