@@ -1225,6 +1225,160 @@ def chaos_dist_halfship(report):
     assert d["blocks_leaked"] == 0, d
 
 
+def chaos_dist_blip(report):
+    """A transient NETWORK BLIP mid-decode (the recover round): the
+    controller-side socket is severed without the worker knowing — the
+    worker redials with full-jitter backoff, the session RESUMES
+    inside the reconnect window (same seq space, same epoch), and the
+    one in-flight step CALL replays exactly-once against the worker's
+    reply cache.  The hard numbers: ZERO failovers, ZERO requeues,
+    ZERO respawns — the fleet never even noticed at the routing layer
+    — and every stream is byte-identical to the single-model oracle."""
+    from singa_tpu.serve import DistFleet, GenerationRequest
+
+    m, spec = _dist_model_spec()
+    rng = np.random.RandomState(23)
+    workload = [(rng.randint(0, 256, rng.randint(3, 7)).astype(np.int32),
+                 int(rng.randint(3, 6))) for _ in range(5)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    fleet = DistFleet(spec, replicas=2, spawn="thread", max_slots=2)
+    handles = [fleet.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0))
+        for p, n in workload]
+    for _ in range(3):
+        fleet.step()           # decode is genuinely mid-flight
+    fleet.blip_worker(0)
+    fleet.run_until_complete(max_steps=800)
+    completed = wedged = 0
+    for h, want in zip(handles, base):
+        if not h.done():
+            wedged += 1
+            continue
+        assert np.array_equal(h.result().tokens, want), \
+            "dist stream diverged across the blip"
+        completed += 1
+    snap = fleet.snapshot()
+    respawns = sum(fleet.supervisor(i).restarts
+                   for i in range(fleet.replicas))
+    fleet.close()
+
+    report["serve_dist_blip"] = {
+        "replicas": 2,
+        "requests": len(workload),
+        "completed_with_parity": completed,
+        "wedged_or_lost": wedged,
+        "reconnects": snap["dist"]["reconnects"],
+        "resumed_calls": snap["dist"]["resumed_calls"],
+        "epoch": snap["dist"]["epoch"],
+        "failovers": snap["failovers"],
+        "requeues": snap["requeues"],
+        "respawns": respawns,
+        "replicas_healthy_after": snap["replicas_healthy"],
+    }
+    d = report["serve_dist_blip"]
+    assert d["wedged_or_lost"] == 0, d
+    assert d["completed_with_parity"] == d["requests"], d
+    assert d["reconnects"] >= 1, d
+    assert d["resumed_calls"] >= 1, d
+    assert d["epoch"] == 1, d              # a resume, not an adoption
+    assert d["failovers"] == 0, d
+    assert d["requeues"] == 0, d
+    assert d["respawns"] == 0, d
+    assert d["replicas_healthy_after"] == 2, d
+
+
+def chaos_dist_controller(report):
+    """CONTROLLER CRASH + fenced adoption (the recover round's
+    tentpole): the controller dies mid-flight with every request still
+    decoding — no shutdown RPCs, no drains.  The orphaned workers keep
+    stepping, journal progress, and redial; a successor controller
+    ADOPTS them at their old address — fencing epoch bumped to 2 (the
+    dead controller is refused typed on every op from that moment),
+    journals reconciled (live work re-attached, parked results
+    claimed exactly-once, never-started work requeued in arrival
+    order, nothing rejected), and routing resumes against engines that
+    were NEVER rebuilt.  The hard numbers: zero lost tokens, zero
+    duplicated tokens (byte parity per request), zero wedged, zero
+    recompiles (the jit cache is the same size after adoption — warm
+    engines survived the controller)."""
+    from singa_tpu.serve import DistFleet, GenerationRequest
+    from singa_tpu.serve.jitpin import jit_cache_size
+
+    m, spec = _dist_model_spec()
+    rng = np.random.RandomState(24)
+    workload = [(rng.randint(0, 256, rng.randint(3, 7)).astype(np.int32),
+                 int(rng.randint(4, 7))) for _ in range(5)]
+    base = [np.asarray(m.generate(p, max_new_tokens=n,
+                                  temperature=0.0))
+            for p, n in workload]
+
+    A = DistFleet(spec, replicas=2, spawn="thread", max_slots=2)
+    port, token = A._listener.port, A._token
+    handles = [A.submit(GenerationRequest(
+        p, max_new_tokens=n, temperature=0.0))
+        for p, n in workload]
+    for _ in range(2):
+        A.step()
+    assert not any(h.done() for h in handles), \
+        "crash must land mid-flight for the scenario to mean anything"
+    jit_before = jit_cache_size()
+    A.crash()
+
+    B = DistFleet.adopt(spec, port=port, token=token, replicas=2,
+                        spawn="thread", max_slots=2)
+    rep = B.adoption
+    assert rep["rejected"] == {}, rep["rejected"]
+    adopted = dict(rep["resumed"])
+    adopted.update(rep["delivered"])
+    adopted.update(rep["requeued"])
+    B.run_until_complete(max_steps=800)
+    completed = wedged = 0
+    for h, want in zip(handles, base):
+        rid = h.request.request_id
+        bh = adopted.get(rid)
+        if bh is None or not bh.done():
+            wedged += 1
+            continue
+        # byte parity == zero lost AND zero duplicated tokens: any
+        # replayed decode step would append a duplicate, any dropped
+        # parked result would truncate the stream
+        assert np.array_equal(bh.result().tokens, want), \
+            "dist stream diverged across the controller adoption"
+        completed += 1
+    snap = B.snapshot()
+    recompiles = jit_cache_size() - jit_before
+    B.close()
+
+    report["serve_dist_controller"] = {
+        "replicas": 2,
+        "requests": len(workload),
+        "completed_with_parity": completed,
+        "wedged_or_lost": wedged,
+        "adopted_resumed": len(rep["resumed"]),
+        "adopted_delivered": len(rep["delivered"]),
+        "adopted_requeued": len(rep["requeued"]),
+        "adopted_rejected": len(rep["rejected"]),
+        "parked_results": snap["dist"]["parked_results"],
+        "epoch_after": snap["dist"]["epoch"],
+        "recompiles": recompiles,
+        "replicas_healthy_after": snap["replicas_healthy"],
+    }
+    d = report["serve_dist_controller"]
+    assert d["wedged_or_lost"] == 0, d
+    assert d["completed_with_parity"] == d["requests"], d
+    assert (d["adopted_resumed"] + d["adopted_delivered"]
+            + d["adopted_requeued"]) == d["requests"], d
+    assert d["adopted_rejected"] == 0, d
+    assert d["epoch_after"] == 2, d
+    assert d["recompiles"] == 0, \
+        f"adoption recompiled {d['recompiles']} entries — the warm " \
+        f"engines were not actually adopted"
+    assert d["replicas_healthy_after"] == 2, d
+
+
 def chaos_autoscale(report):
     """Fault the ``serve.autoscale`` site mid-scale-up (the autoscale
     round): the scaling DECISION aborts typed — ledger records
@@ -1372,6 +1526,8 @@ def main():
     chaos_disagg(report)
     chaos_dist_partition(report)
     chaos_dist_halfship(report)
+    chaos_dist_blip(report)
+    chaos_dist_controller(report)
     chaos_autoscale(report)
 
     health = observe.health_report(include_registry=False)

@@ -11,17 +11,30 @@
   processes (or threads), with wire KV shipping — bulk images and
   layer-wise streamed frames — and the cross-host residency directory.
 
-See docs/SERVING.md "Multi-host serving".
+Controller survivability: a dropped socket enters a bounded
+reconnect-with-resume window instead of condemning the peer (the
+worker redials, the session resumes, the one unacked CALL replays
+exactly-once); workers journal per-request progress and PARK finished
+results when the controller vanishes; ``DistFleet.adopt`` attaches a
+successor controller to the live workers under a bumped fencing epoch
+— the dead controller's frames are refused typed
+(:class:`StaleEpochError`), parked results re-deliver exactly once,
+and routing resumes with warm jit caches.
+
+See docs/SERVING.md "Multi-host serving" and "Controller recovery".
 """
 
 from .fleet import DistFleet, DistSession, RemoteSupervisor
-from .transport import (PROTO_VERSION, Conn, Listener, PeerGoneError,
-                        PeerTimeoutError, TransportError)
+from .transport import (IDEMPOTENT_OPS, PROTO_VERSION, Conn, Listener,
+                        NonIdempotentReplayError, PeerGoneError,
+                        PeerTimeoutError, StaleEpochError,
+                        TransportError, resume_worker)
 from .worker import ModelSpec, gpt2_spec, worker_main
 
 __all__ = [
     "DistFleet", "DistSession", "RemoteSupervisor",
     "ModelSpec", "gpt2_spec", "worker_main",
-    "PROTO_VERSION", "Conn", "Listener", "PeerGoneError",
-    "PeerTimeoutError", "TransportError",
+    "PROTO_VERSION", "IDEMPOTENT_OPS", "Conn", "Listener",
+    "PeerGoneError", "PeerTimeoutError", "TransportError",
+    "StaleEpochError", "NonIdempotentReplayError", "resume_worker",
 ]

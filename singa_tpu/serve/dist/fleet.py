@@ -73,14 +73,16 @@ from ...observe import trace as _trace
 from ...observe.federate import ClockSync, FleetTelemetry
 from ...observe.timeseries import WindowRing
 from ...resilience import faults as _faults
-from ..fleet import ServeFleet
+from ..fleet import ServeFleet, _Route
 from ..kvimage import KVImage, KVImageError
 from ..prefix import SessionHandle
 from ..request import (EngineFailedError, RequestHandle,
                        RestartBudgetExceededError)
-from .transport import (MSG_CALL, Listener, PeerGoneError,
+from .transport import (MSG_RESUME, Listener, PeerGoneError,
+                        PeerTimeoutError, StaleEpochError,
                         TransportError)
-from .worker import (ModelSpec, dump_request, load_exc, worker_main)
+from .worker import (ModelSpec, dump_request, load_exc, load_request,
+                     worker_main)
 from ..request import GenerationResult
 
 __all__ = ["DistFleet", "RemoteSupervisor"]
@@ -254,16 +256,47 @@ class RemoteSupervisor:
                 retries=retries)
         except TransportError as e:
             # framing lost: the stream cannot be trusted — peer loss
+            # unless the worker redials inside the reconnect window
             self._c_rpc_errors.inc()
-            raise PeerGoneError(
+            cause = PeerGoneError(
                 f"worker r{self._idx} framing lost: {e}",
-                started=None) from e
-        except PeerGoneError:
+                started=None)
+            cause.__cause__ = e
+            msg = self._resume_and_replay(cause, timeout)
+        except PeerGoneError as e:
             self._c_rpc_errors.inc()
-            raise
+            msg = self._resume_and_replay(e, timeout)
         if not msg["ok"]:
             raise load_exc(msg["err"])
         return msg["value"]
+
+    def _resume_and_replay(self, cause, timeout=None):
+        """A socket-level loss mid-RPC: hold the replica inside its
+        reconnect window instead of condemning it.  If the worker
+        redials in time, replay the unacked CALL (exactly-once — the
+        worker's reply cache dedupes by seq) and return its reply;
+        otherwise re-raise ``cause`` into the existing PeerGone
+        failover path.  Injected partition faults carry ``no_resume``
+        and always escalate — the peer's socket never actually broke,
+        so no redial is coming."""
+        if getattr(cause, "no_resume", False) or self.engine._closed:
+            raise cause
+        frame = self._fleet._resume_peer(self)
+        if frame is None:
+            raise cause
+        try:
+            msg = self._conn.finish_pending(
+                int(frame["last_seq"]),
+                timeout=(timeout if timeout is not None
+                         else self._fleet._rpc_timeout))
+        except TransportError as e:
+            raise PeerGoneError(
+                f"worker r{self._idx} framing lost during replay: "
+                f"{e}", started=None) from e
+        if msg is None:
+            raise cause
+        self._fleet._c_resumed.inc()
+        return msg
 
     def _apply_view(self, v):
         eng = self.engine
@@ -369,7 +402,9 @@ class RemoteSupervisor:
         """Send this replica's step CALL without waiting for the
         reply — DistFleet._step_replicas overlaps every peer's step.
         Checks the ``serve.dist.rpc`` partition fault exactly like a
-        synchronous call would."""
+        synchronous call would.  A send-side socket loss tries the
+        reconnect window and re-sends the SAME seq on the new
+        socket."""
         if self.engine._closed:
             raise PeerGoneError(
                 f"worker r{self._idx} is closed", started=None)
@@ -378,38 +413,41 @@ class RemoteSupervisor:
                 _faults.check("serve.dist.rpc")
             except Exception as e:
                 self._c_rpc_errors.inc()
-                raise PeerGoneError(
+                err = PeerGoneError(
                     f"partition injected on step RPC to worker "
-                    f"r{self._idx} ({e!r})", started=None) from e
+                    f"r{self._idx} ({e!r})", started=None)
+                err.no_resume = True
+                raise err from e
         self._c_rpcs.inc()
-        self._conn._seq += 1
-        seq = self._conn._seq
-        self._conn.send(MSG_CALL, {"seq": seq, "op": "step",
-                                   "payload": None})
-        return seq
+        try:
+            return self._conn.send_call("step")
+        except PeerGoneError as e:
+            self._c_rpc_errors.inc()
+            if getattr(e, "no_resume", False):
+                raise
+            frame = self._fleet._resume_peer(self)
+            if frame is None:
+                raise
+            self._fleet._c_resumed.inc()
+            return self._conn.resend_pending()
 
     def step_finish(self, seq):
         """Collect the reply for :meth:`step_begin` and apply its
-        deltas (streamed tokens, resolved handles, the load view)."""
+        deltas (streamed tokens, resolved handles, the load view).
+        A recv-side socket loss tries the reconnect window and
+        replays the step call (the worker's reply cache dedupes)."""
         try:
-            while True:
-                kind, msg = self._conn.recv(self._fleet._rpc_timeout)
-                if kind != 2:  # MSG_REPLY
-                    continue
-                if msg.get("seq") != seq:
-                    raise TransportError(
-                        f"out-of-sequence step reply from r"
-                        f"{self._idx}: got {msg.get('seq')}, want "
-                        f"{seq}")
-                break
+            msg = self._conn.wait_reply(seq, self._fleet._rpc_timeout)
         except TransportError as e:
             self._c_rpc_errors.inc()
-            raise PeerGoneError(
+            cause = PeerGoneError(
                 f"worker r{self._idx} framing lost: {e}",
-                started=None) from e
-        except PeerGoneError:
+                started=None)
+            cause.__cause__ = e
+            msg = self._resume_and_replay(cause)
+        except PeerGoneError as e:
             self._c_rpc_errors.inc()
-            raise
+            msg = self._resume_and_replay(e)
         if not msg["ok"]:
             raise load_exc(msg["err"])
         reply = msg["value"]
@@ -607,7 +645,10 @@ class RemoteSupervisor:
         except (PeerGoneError, TransportError):
             pass
         self._conn.close()
-        self._fleet._graveyard.append(self._proc)
+        if self._proc is not None:
+            # adopted workers have no spawn handle to reap — they were
+            # spawned by the controller this one replaced
+            self._fleet._graveyard.append(self._proc)
 
     def __enter__(self):
         return self
@@ -639,7 +680,9 @@ class DistFleet(ServeFleet):
     def __init__(self, spec, replicas=2, spawn="thread",
                  stream_ships=True, rpc_timeout=60.0,
                  heartbeat_timeout=30.0, federate=True,
-                 telemetry_interval_s=2.0, **kw):
+                 telemetry_interval_s=2.0, reconnect_window_s=2.0,
+                 reconnect_grace_s=4.0, park_ttl_s=60.0,
+                 journal_cap=256, _adopt=None, **kw):
         if not isinstance(spec, ModelSpec):
             raise TypeError(
                 f"DistFleet needs a ModelSpec (the worker's model "
@@ -660,8 +703,29 @@ class DistFleet(ServeFleet):
         self.stream_ships = bool(stream_ships)
         self._rpc_timeout = float(rpc_timeout)
         self._hb_timeout = float(heartbeat_timeout)
-        self._token = os.urandom(16)
-        self._listener = Listener(token=self._token)
+        # -- controller survivability ---------------------------------
+        self._reconnect_window = float(reconnect_window_s)
+        self._reconnect_grace = float(reconnect_grace_s)
+        self._park_ttl = float(park_ttl_s)
+        self._journal_cap = int(journal_cap)
+        self._resume_pool = {}    # idx -> (RESUME frame, Conn) parked
+        self._pending_clock_resync = set()
+        self._adopt_src = _adopt
+        self._adopting = _adopt is not None
+        self.adoption = None      # reconciliation report (adopt only)
+        if _adopt is None:
+            self._token = os.urandom(16)
+            self._listener = Listener(token=self._token)
+            #: fencing epoch every frame to the workers is stamped
+            #: with; an adopting successor bumps it and the workers
+            #: refuse this controller's frames typed from then on
+            self._epoch = 1
+        else:
+            a_host, a_port, a_token = _adopt
+            self._token = a_token
+            self._listener = Listener(host=a_host, port=a_port,
+                                      token=a_token)
+            self._epoch = None    # negotiated from the workers' offers
         self._graveyard = []
         self._dist_registered = []
         self._ship_streams = {}   # rid -> (dst RemoteSupervisor, ship_id)
@@ -697,11 +761,39 @@ class DistFleet(ServeFleet):
             "serve.dist.ship_wire_exposed_s",
             help="ship completion wall seconds on the request's "
                  "critical path (export+commit+land)", **lblf)
+        self._c_reconnects = self._reg.counter(
+            "serve.dist.reconnects",
+            help="worker sessions resumed after a socket loss "
+                 "(reconnect window hits — each one is a failover "
+                 "plus respawn that did NOT happen)", **lblf)
+        self._c_resumed = self._reg.counter(
+            "serve.dist.resumed_calls",
+            help="unacked CALLs replayed across a resumed session "
+                 "(exactly-once: the worker's reply cache dedupes)",
+            **lblf)
+        self._c_parked = self._reg.counter(
+            "serve.dist.parked_results",
+            help="journaled terminal results claimed from workers at "
+                 "adoption and re-delivered exactly once", **lblf)
+        self._g_epoch = self._reg.gauge(
+            "serve.dist.epoch",
+            help="this controller's fencing epoch (workers refuse "
+                 "frames from any lower epoch typed)", **lblf)
+        self._g_epoch.set(self._epoch)
         self._dist_registered += [self._c_ship_hidden,
-                                  self._c_ship_exposed]
+                                  self._c_ship_exposed,
+                                  self._c_reconnects, self._c_resumed,
+                                  self._c_parked, self._g_epoch]
+        if self._adopting:
+            self._adopting = False
+            self.adoption = self._reconcile_adoption()
 
     # -- replica construction / teardown ---------------------------------
     def _new_supervisor(self, idx):
+        if self._adopting:
+            # adoption path: the worker is already alive and built —
+            # attach to its redial instead of spawning
+            return self._adopt_supervisor(idx)
         proc = self._spawn_worker(idx)
         widx, conn = self._listener.accept_worker(
             timeout=self._init_timeout())
@@ -710,11 +802,15 @@ class DistFleet(ServeFleet):
             raise TransportError(
                 f"worker handshake says replica {widx}, expected "
                 f"{idx}")
+        conn.epoch = self._epoch
         sup_kw = {k: v for k, v in self._sup_kw.items()
                   if k != "clock"}  # callables don't ship; the worker
         #                             keeps its own monotonic clock
         init = {"spec": self._spec, "sup_kw": sup_kw,
-                "engine_kw": self._replica_kw(idx)}
+                "engine_kw": self._replica_kw(idx),
+                "epoch": self._epoch,
+                "recover": {"park_ttl": self._park_ttl,
+                            "journal_cap": self._journal_cap}}
         if self._federate and self._spawn_mode == "process":
             # the worker process records its OWN ledger + trace and
             # ships them on telemetry pulls; thread mode must NOT —
@@ -726,6 +822,37 @@ class DistFleet(ServeFleet):
             conn.close()
             raise load_exc(ack["err"])
         sup = RemoteSupervisor(self, idx, conn, proc, ack["value"])
+        self._register_host(idx, sup)
+        return sup
+
+    def _adopt_supervisor(self, idx):
+        """Attach to a LIVE worker orphaned by a dead controller: wait
+        for its redial, negotiate the fencing epoch one past the
+        highest offer (the dead controller — and anything replaying
+        its frames — is refused typed from this moment), and size the
+        proxy from a ``describe`` probe instead of an INIT build.
+        ``recompiles: 0`` falls out of this: the worker's engine and
+        jit caches are never touched."""
+        deadline = time.monotonic() + self._init_timeout()
+        got = self._accept_resume(idx, deadline)
+        if got is None:
+            raise PeerTimeoutError(
+                f"no RESUME redial from worker r{idx} within the "
+                f"adoption window", started=None)
+        frame, conn = got
+        offered = int(frame.get("epoch", 0))
+        if self._epoch is None or offered >= self._epoch:
+            self._epoch = offered + 1
+        conn.send(MSG_RESUME, {"ok": True, "epoch": self._epoch})
+        conn.epoch = self._epoch
+        # continue the worker's seq space: its reply cache and journal
+        # acks are keyed by it
+        conn._seq = int(frame.get("last_seq", 0))
+        ack = conn.call("describe", timeout=self._init_timeout())
+        if not ack["ok"]:
+            conn.close()
+            raise load_exc(ack["err"])
+        sup = RemoteSupervisor(self, idx, conn, None, ack["value"])
         self._register_host(idx, sup)
         return sup
 
@@ -784,9 +911,11 @@ class DistFleet(ServeFleet):
     def kill_worker(self, idx):
         """Chaos/test hook: make replica ``idx``'s worker DIE without
         telling the fleet — process mode kills the process, thread
-        mode severs the socket under the worker loop.  The next RPC to
-        it raises :class:`PeerGoneError` and the normal failover path
-        takes over."""
+        mode tells the worker loop to stop (a one-way ``die``) before
+        severing the socket, so the worker does NOT redial: a killed
+        worker must stay dead (contrast :meth:`blip_worker`).  The
+        next RPC to it raises :class:`PeerGoneError` and the normal
+        failover path takes over once the reconnect window drains."""
         sup = self._replicas[idx].sup
         proc = sup._proc
         if self._spawn_mode == "process" \
@@ -794,7 +923,304 @@ class DistFleet(ServeFleet):
             proc.terminate()
             proc.join(timeout=10.0)
         else:
+            try:
+                # TCP ordering lands the die ahead of the FIN, so the
+                # worker stops instead of entering its redial loop
+                sup._conn.send_oneway("die")
+            except PeerGoneError:
+                pass
             sup._conn.close()
+
+    def blip_worker(self, idx):
+        """Chaos/test hook: sever the controller-side socket WITHOUT
+        telling the worker anything — a modeled transient network
+        blip.  The worker's recv fails, it redials with full-jitter
+        backoff, and the session resumes inside the reconnect window:
+        no failover, no respawn, no cold KV arena."""
+        self._replicas[idx].sup._conn.close()
+
+    def crash(self):
+        """Chaos/test hook: die the way a crashed controller process
+        dies — no shutdown RPCs, no engine closes, no drains.  Workers
+        keep stepping live work, journal finished results, and redial;
+        a successor attaches to them with :meth:`adopt`.  This fleet
+        object is unusable afterwards (its registry entries and
+        federation hooks are released so the successor can install
+        its own)."""
+        self._listener.close()
+        for rep in self._replicas:
+            rep.sup.engine._closed = True
+            try:
+                rep.sup._conn.close()
+            except Exception:
+                pass
+        self._closed = True
+        self._reg.remove(*self._registered)
+        self._reg.remove(*self._dist_registered)
+        self._dist_registered = []
+        self._peer_metrics = {}
+        self._teardown_federation()
+
+    # -- reconnect-with-resume -------------------------------------------
+    def _accept_resume(self, idx, deadline):
+        """Accept redials until worker ``idx``'s RESUME arrives (or
+        the deadline does).  Other workers' resumes landing first are
+        parked in the resume pool — with several replicas blipped at
+        once, whichever redials first must not be dropped on the
+        floor while we wait for a specific one."""
+        got = self._resume_pool.pop(idx, None)
+        if got is not None:
+            return got
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                kind, frame, conn = self._listener.accept_any(
+                    timeout=remaining)
+            except PeerTimeoutError:
+                return None
+            except (TransportError, PeerGoneError):
+                continue  # a refused handshake does not end the window
+            if kind != MSG_RESUME:
+                conn.close()  # a fresh HELLO here is a stray spawn
+                continue
+            widx = int(frame["idx"])
+            if widx == idx:
+                return frame, conn
+            old = self._resume_pool.pop(widx, None)
+            if old is not None:
+                old[1].close()
+            self._resume_pool[widx] = (frame, conn)
+
+    def _resume_peer(self, sup):
+        """Controller half of reconnect-with-resume: hold replica
+        ``sup._idx`` inside its reconnect window, accept the worker's
+        redial, verify the fence, and swap the session onto the new
+        socket (seq space and the pending CALL carry over).  Returns
+        the worker's RESUME frame, or None when the window closes
+        (callers then escalate to the normal PeerGone failover).
+        While the window — and the grace period after it — runs, the
+        replica's ``reconnect_deadline`` gates the autoscaler's
+        ``_replace_dead`` so a blipped worker is never concurrently
+        respawned."""
+        idx = sup._idx
+        rep = (self._replicas[idx]
+               if idx < len(self._replicas) else None)
+        now = time.monotonic()
+        if rep is not None:
+            rep.reconnect_deadline = now + max(
+                self._reconnect_window, self._reconnect_grace)
+        got = self._accept_resume(idx, now + self._reconnect_window)
+        if got is None:
+            return None
+        frame, conn = got
+        if int(frame.get("epoch", 0)) > self._epoch:
+            # the worker is fenced HIGHER than us: a successor already
+            # adopted the fleet and THIS controller is the stale side
+            # of the split brain — refuse the session and fail typed
+            conn.close()
+            raise StaleEpochError(
+                f"worker r{idx} is fenced at epoch {frame['epoch']}, "
+                f"this controller at {self._epoch}: a successor "
+                f"adopted the fleet; this controller is stale")
+        conn.send(MSG_RESUME, {"ok": True, "epoch": self._epoch})
+        old = sup._conn
+        conn.label = old.label or f"r{idx}"
+        conn.epoch = self._epoch
+        # carry the session: the seq space continues (the worker's
+        # reply cache and journal acks key off it) and the unacked
+        # pending CALL crosses to the new socket for replay
+        conn._seq = max(old._seq, int(frame.get("last_seq", 0)))
+        conn._pending = old._pending
+        sup._conn = conn
+        try:
+            old.close()
+        except Exception:
+            pass
+        self._c_reconnects.inc()
+        self._after_resume(sup)
+        if rep is not None:
+            rep.reconnect_deadline = None
+        return frame
+
+    def _after_resume(self, sup):
+        """Federation bookkeeping for a resumed session: the old
+        socket's transport series are retired for fresh ones (same
+        retire-unregisters contract as replace_dead), and process-mode
+        clock sync re-estimates — deferred to the watchdog while a
+        replay is still pending, because an interleaved clock RPC
+        would corrupt the replayed call's seq space."""
+        idx = sup._idx
+        old = self._peer_metrics.pop(idx, None)
+        if old:
+            self._reg.remove(*old)
+            self._dist_registered = [
+                m for m in self._dist_registered if m not in old]
+        ms = sup._conn.attach_metrics(self._reg, peer=f"w{idx}")
+        self._peer_metrics[idx] = ms
+        self._dist_registered += ms
+        if not self._federate or self._spawn_mode != "process":
+            return
+        if sup._conn._pending is None:
+            self._clock_resync(sup)
+        else:
+            self._pending_clock_resync.add(idx)
+
+    def _clock_resync(self, sup):
+        """Fresh NTP-style offset estimate after a reconnect: the
+        worker process kept its clock base, but the blip may have been
+        a host stall — re-measuring keeps federated timestamps
+        honest."""
+        cs = ClockSync(clock=self._clock)
+        try:
+            cs.sample(lambda: sup._conn.call(
+                "clock", timeout=10.0,
+                fault_site="serve.dist.telemetry")["value"]["t"])
+        except Exception:
+            cs = None
+        h = self.telemetry.hosts.get(f"w{sup._idx}")
+        if h is not None:
+            h.clock = cs
+
+    # -- fenced adoption --------------------------------------------------
+    @classmethod
+    def adopt(cls, spec, port, token, host="127.0.0.1", replicas=2,
+              **kw):
+        """Attach a NEW controller to live workers orphaned by a dead
+        one.  Binds the dead controller's listener address, accepts
+        each worker's RESUME redial, bumps the fencing epoch (the dead
+        controller — or anything replaying its frames — is refused
+        typed on EVERY op from that moment: split-brain routing is
+        impossible by construction), reconciles the workers' request
+        journals, and resumes routing against engines that were never
+        rebuilt — jit caches warm, ``recompiles: 0``.
+
+        The reconciliation report lands on ``fleet.adoption``::
+
+            {"resumed":   {rid: RequestHandle},  # still decoding
+             "delivered": {rid: RequestHandle},  # parked result,
+                                                 #  re-delivered once
+             "requeued":  {rid: RequestHandle},  # never started,
+                                                 #  resubmitted in
+                                                 #  arrival order
+             "rejected":  {rid: error}}          # started-and-dead /
+                                                 #  TTL-expired: typed
+        """
+        return cls(spec, replicas=replicas,
+                   _adopt=(host, port, token), **kw)
+
+    def _note_adopt_hop(self, rid, req, idx, kind):
+        """Ledger: adoption is a routing hop (``via=adopt``).  Process
+        mode opens a minimal entry first — the successor's ledger
+        never saw the original submit (it happened in a dead
+        process); thread mode shares the predecessor's globals, so
+        the original entry is already there."""
+        if not _reqs._active:
+            return
+        if self._spawn_mode == "process":
+            _reqs._ledger.on_submit(
+                rid,
+                engine=self._replicas[idx].sup.engine.stats
+                .engine_label,
+                t=self._clock(), prompt_len=len(req.prompt_ids),
+                max_new_tokens=req.max_new_tokens)
+        _reqs._ledger.annotate_hop(rid, replica=idx, via="adopt",
+                                   adopt=kind)
+
+    def _reconcile_adoption(self) -> dict:
+        """Merge every worker's journal into one fleet-wide verdict,
+        processed in original arrival order: live work re-attaches
+        (the worker kept decoding the whole time), parked terminal
+        results are claimed and re-delivered exactly once, work that
+        never started is resubmitted through normal admission, and
+        anything unrecoverable (TTL-expired, started on a dead
+        engine) is refused typed — never silently re-run, because a
+        replay after delivered tokens could duplicate them."""
+        report = {"resumed": {}, "delivered": {}, "requeued": {},
+                  "rejected": {}}
+        entries = []
+        for rep in self._replicas:
+            inv = rep.sup._rpc("reconcile")
+            for rid, ent in inv["requests"].items():
+                entries.append((int(ent["order"]), rep.idx, rid, ent))
+        entries.sort(key=lambda t: (t[0], t[1]))
+        for _order, idx, rid, ent in entries:
+            sup = self._replicas[idx].sup
+            st = ent["state"]
+            if st == "live":
+                req = load_request(ent["req"], clock=self._clock)
+                inner = RequestHandle(req)
+                sup._inner[rid] = inner
+                sup._order.append(rid)
+                if ent.get("cursor", 0) > 0:
+                    # tokens already streamed (to the dead
+                    # controller): NOT safely re-runnable — pin the
+                    # delivery-started verdict for any later failover
+                    sup._streamed.add(rid)
+                handle = RequestHandle(req)
+                route = _Route(handle, self.step_count)
+                route.attempts.append((idx, inner))
+                self._routes[rid] = route
+                self._order.append(rid)
+                self._note_adopt_hop(rid, req, idx, "resumed")
+                report["resumed"][rid] = handle
+                continue
+            if st == "parked":
+                out = sup._rpc("claim", {"rid": rid})
+                if out.get("status") == "parked":
+                    self._c_parked.inc()
+                    payload = out["out"]
+                    req_d = out.get("req")
+                    if "result" in payload:
+                        req = load_request(req_d, clock=self._clock)
+                        handle = RequestHandle(req)
+                        handle._finish(
+                            sup._load_result(payload["result"]))
+                        self._note_adopt_hop(rid, req, idx,
+                                             "delivered")
+                        report["delivered"][rid] = handle
+                        continue
+                    err = load_exc(payload["err"])
+                    if getattr(err, "started", None) is False \
+                            and req_d is not None:
+                        # rejected without ever occupying a slot
+                        # (e.g. the engine died while it sat queued):
+                        # same seed -> same chain -> safe to requeue
+                        req = load_request(req_d, clock=self._clock)
+                        try:
+                            handle = self.submit(req)
+                        except Exception as e:
+                            report["rejected"][rid] = e
+                            continue
+                        if _reqs._active:
+                            _reqs._ledger.annotate_hop(
+                                rid, via="adopt", adopt="requeued")
+                        report["requeued"][rid] = handle
+                        continue
+                    report["rejected"][rid] = err
+                    if _reqs._active:
+                        _reqs._ledger.on_reject(
+                            rid, t=self._clock(),
+                            reason="adopt_dead",
+                            started=getattr(err, "started", None))
+                    continue
+                st = out.get("status") or "gone"
+            # expired / gone: the terminal result is unrecoverable and
+            # the cursor says whether tokens ever streamed — refuse
+            # typed rather than risk duplicating delivered tokens
+            cursor = int(ent.get("cursor", 0))
+            err = EngineFailedError(
+                f"{rid}: unrecoverable across controller adoption "
+                f"({st}, cursor={cursor})", request_id=rid,
+                started=(True if cursor > 0 else None))
+            report["rejected"][rid] = err
+            if _reqs._active:
+                _reqs._ledger.on_reject(
+                    rid, t=self._clock(), reason=f"adopt_{st}",
+                    started=err.started)
+        self._g_epoch.set(self._epoch)
+        return report
 
     def _reap(self):
         """Join/terminate every worker handed to the graveyard (and
@@ -890,6 +1316,13 @@ class DistFleet(ServeFleet):
                 sup.ping()
             except RestartBudgetExceededError as e:
                 self._mark_down(rep, e)
+        # deferred post-resume clock re-estimates: safe now if the
+        # replayed CALL has been answered (no pending seq to corrupt)
+        for idx in list(self._pending_clock_resync):
+            rep = self._replicas[idx]
+            if rep.healthy and rep.sup._conn._pending is None:
+                self._pending_clock_resync.discard(idx)
+                self._clock_resync(rep.sup)
         self._maybe_pull_telemetry()
 
     def _maybe_pull_telemetry(self, force=False):
@@ -1030,6 +1463,10 @@ class DistFleet(ServeFleet):
             "ship_s_p95": self.ship_window.quantile(0.95, 300.0),
             "retries": sum(c.value for c in self._dist_registered
                            if c.name == "serve.dist.retries"),
+            "reconnects": self._c_reconnects.value,
+            "resumed_calls": self._c_resumed.value,
+            "parked_results": self._c_parked.value,
+            "epoch": self._epoch,
             "ship_wire_hidden_s": self._c_ship_hidden.value,
             "ship_wire_exposed_s": self._c_ship_exposed.value,
             "ship_overlap_efficiency": self._ship_overlap(),

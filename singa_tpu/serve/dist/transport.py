@@ -13,7 +13,13 @@ socket.  Three message kinds ride it —
   destination while the source is still prefilling) and best-effort
   aborts/shutdowns;
 * ``HELLO`` — the connect-time handshake: a worker proves it belongs
-  to THIS fleet (shared token) and says which replica index it is.
+  to THIS fleet (shared token, compared constant-time, plus a single-
+  use session nonce) and says which replica index it is;
+* ``RESUME`` — the reconnect handshake: a worker whose socket dropped
+  redials and offers to CONTINUE its session (fencing epoch +
+  last-executed seq, HMAC-authenticated) instead of being respawned —
+  the controller replays the one unacked CALL and routing resumes
+  with warm jit caches.
 
 Frame layout (all integers network byte order)::
 
@@ -45,7 +51,11 @@ which is exactly what a partition looks like from one end.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import pickle
+import random
 import socket
 import struct
 import time
@@ -56,8 +66,10 @@ from ...utils.logging import get_channel
 from ..request import RestartBudgetExceededError
 
 __all__ = ["PROTO_VERSION", "TransportError", "PeerGoneError",
-           "PeerTimeoutError", "Conn", "Listener", "MSG_CALL",
-           "MSG_REPLY", "MSG_ONEWAY", "MSG_HELLO"]
+           "PeerTimeoutError", "StaleEpochError",
+           "NonIdempotentReplayError", "IDEMPOTENT_OPS", "Conn",
+           "Listener", "MSG_CALL", "MSG_REPLY", "MSG_ONEWAY",
+           "MSG_HELLO", "MSG_RESUME", "resume_auth"]
 
 #: bump when the frame layout or the RPC envelope changes; a peer on a
 #: different proto version fails the handshake typed instead of
@@ -68,6 +80,20 @@ MSG_CALL = 1
 MSG_REPLY = 2
 MSG_ONEWAY = 3
 MSG_HELLO = 4
+MSG_RESUME = 5
+
+#: ops a reconnecting controller may safely RE-ISSUE under a fresh seq
+#: when replay state has diverged (the worker may have executed the
+#: lost call once already).  Everything else — submit, step, the ship/
+#: build protocol — mutates worker state in a way a blind second
+#: delivery would corrupt (double-admit, double-step), so divergence
+#: on those aborts typed via :class:`NonIdempotentReplayError` and the
+#: fleet's normal failover reconciles instead.
+IDEMPOTENT_OPS = frozenset({
+    "ping", "clock", "snapshot", "telemetry", "prefix_lookup",
+    "validate", "cache_release", "session_release", "build_abandon",
+    "abandon", "reconcile", "describe", "shutdown", "die",
+})
 
 _MAGIC = b"STPU"
 _HEAD = struct.Struct("!4sBBIQ")
@@ -104,6 +130,41 @@ class PeerTimeoutError(PeerGoneError):
     the whole fleet's step loop."""
 
 
+class StaleEpochError(RuntimeError):
+    """The frame carried a fencing epoch older than the receiver's:
+    the sender is a DEPOSED controller (someone adopted this worker
+    under a higher epoch).  Refused typed on every op so split-brain
+    dual routing is impossible by construction — a stale controller
+    cannot step, submit to, or ship through a fenced worker.  Crosses
+    the wire (registered in the worker's error table)."""
+
+
+class NonIdempotentReplayError(PeerGoneError):
+    """A reconnect found an unacked in-flight CALL whose replay state
+    diverged AND whose op is not in :data:`IDEMPOTENT_OPS`: the worker
+    may have executed it exactly once already, and re-issuing could
+    double-admit or double-step.  Controller-side only — subclasses
+    :class:`PeerGoneError` so the fleet's existing failover path
+    (reject started work typed, requeue never-started) reconciles."""
+
+
+def _full_jitter(rng, base, attempt, cap):
+    """Full-jitter backoff: uniform in ``[0, min(base·2^attempt, cap))``
+    — N workers redialing a restarted controller decorrelate instead
+    of thundering in lockstep (the ``RetryPolicy.seed=None`` idiom)."""
+    return rng.random() * min(base * (2.0 ** attempt), cap)
+
+
+def resume_auth(token, nonce, idx, epoch, last_seq) -> str:
+    """HMAC proving a RESUME frame was minted by a holder of the fleet
+    token for THIS (nonce, replica, epoch, seq) tuple — a captured
+    frame replays as garbage under any other session nonce."""
+    key = token if isinstance(token, (bytes, bytearray)) else \
+        str(token).encode()
+    msg = f"{nonce}:{int(idx)}:{int(epoch)}:{int(last_seq)}".encode()
+    return hmac.new(bytes(key), msg, hashlib.sha256).hexdigest()
+
+
 def _recv_exact(sock, n):
     """Read exactly ``n`` bytes or raise on EOF mid-read (the
     mid-stream-EOF case: a peer that died between frames raises
@@ -132,6 +193,16 @@ class Conn:
         self.label = label
         self.last_rx = time.monotonic()
         self._seq = 0
+        #: fencing epoch stamped into every CALL/ONEWAY envelope when
+        #: set — workers refuse stale epochs typed (StaleEpochError)
+        self.epoch = None
+        #: the one unacked in-flight CALL ``(seq, op, payload)`` —
+        #: what a reconnect must replay (the protocol is strictly
+        #: serial, so there is never more than one)
+        self._pending = None
+        #: OS-entropy rng for full-jitter backoff — deliberately NOT
+        #: seeded so concurrent redialers decorrelate
+        self._rng = random.Random()
         self._log = get_channel("serve")
         # transport self-observability (attach_metrics): None until a
         # registry attaches — the unobserved cost is one truthiness
@@ -246,15 +317,63 @@ class Conn:
         return time.monotonic() - self.last_rx
 
     # -- RPC (caller side) -----------------------------------------------
+    def send_call(self, op, payload=None) -> int:
+        """Low-level CALL send: allocate the next seq, stamp the
+        fencing epoch (when set), RECORD the call as pending (so a
+        reconnect knows exactly what to replay), and put the frame on
+        the wire.  Returns the seq the caller must await."""
+        self._seq += 1
+        seq = self._seq
+        env = {"seq": seq, "op": op, "payload": payload}
+        if self.epoch is not None:
+            env["epoch"] = self.epoch
+        self._pending = (seq, op, payload)
+        self.send(MSG_CALL, env)
+        return seq
+
+    def resend_pending(self) -> int:
+        """Re-put the pending CALL on the (new, post-resume) wire
+        under its ORIGINAL seq — first delivery if it never arrived,
+        a reply-cache hit on the worker if it did."""
+        seq, op, payload = self._pending
+        env = {"seq": seq, "op": op, "payload": payload}
+        if self.epoch is not None:
+            env["epoch"] = self.epoch
+        self.send(MSG_CALL, env)
+        return seq
+
+    def wait_reply(self, seq, timeout=60.0):
+        """Wait for the REPLY matching ``seq``; clears the pending
+        record on success.  Stray one-ways are skipped, a wrong-seq
+        reply is a framing loss (TransportError)."""
+        while True:
+            kind, msg = self.recv(timeout)
+            if kind != MSG_REPLY:
+                # a stray one-way (late ship abort ack etc.) is not
+                # an error; skip it
+                continue
+            if msg.get("seq") != seq:
+                raise TransportError(
+                    f"out-of-sequence reply from peer "
+                    f"{self.label or '?'}: got {msg.get('seq')}, "
+                    f"want {seq}")
+            self._pending = None
+            return msg
+
     def call(self, op, payload=None, timeout=60.0, retries=0,
-             backoff=0.05, fault_site="serve.dist.rpc"):
+             backoff=0.05, backoff_cap=2.0,
+             fault_site="serve.dist.rpc"):
         """Synchronous RPC: send ``CALL {seq, op, ...}``, wait for the
         matching ``REPLY``.  ``retries`` re-sends on TIMEOUT only
-        (with exponential backoff) and must only be used for
-        idempotent ops — a retried ``submit`` could double-admit.
-        Checks the ``fault_site`` (default ``serve.dist.rpc``) first:
-        a fired fault is a modeled partition and surfaces as
-        :class:`PeerGoneError`.  Telemetry pulls pass their OWN site
+        (full-jitter backoff capped at ``backoff_cap`` — lockstep
+        retry storms decorrelate) and must only be used for idempotent
+        ops — a retried ``submit`` could double-admit.  Checks the
+        ``fault_site`` (default ``serve.dist.rpc``) first: a fired
+        fault is a modeled partition and surfaces as
+        :class:`PeerGoneError` with ``no_resume`` set — injected
+        partitions must hit the failover path directly, never the
+        reconnect window (the peer's socket never actually broke, so
+        no redial is coming).  Telemetry pulls pass their OWN site
         (``serve.dist.telemetry``) so a chaos test partitioning the
         control plane never has its injected fault consumed by a
         background telemetry call instead.
@@ -263,32 +382,20 @@ class Conn:
             try:
                 _faults.check(fault_site)
             except Exception as e:
-                raise PeerGoneError(
+                err = PeerGoneError(
                     f"partition injected on RPC {op!r} to peer "
-                    f"{self.label or '?'} ({e!r})", started=None) from e
+                    f"{self.label or '?'} ({e!r})", started=None)
+                err.no_resume = True
+                raise err from e
         attempt = 0
         while True:
-            self._seq += 1
-            seq = self._seq
             t_send = time.monotonic()
-            self.send(MSG_CALL, {"seq": seq, "op": op,
-                                 "payload": payload})
+            seq = self.send_call(op, payload)
             try:
-                while True:
-                    kind, msg = self.recv(timeout)
-                    if kind != MSG_REPLY:
-                        # a stray one-way (late ship abort ack etc.)
-                        # is not an error; skip it
-                        continue
-                    if msg.get("seq") != seq:
-                        raise TransportError(
-                            f"out-of-sequence reply from peer "
-                            f"{self.label or '?'}: got "
-                            f"{msg.get('seq')}, want {seq}")
-                    if self._m_rtt is not None:
-                        self._m_rtt.observe(
-                            time.monotonic() - t_send)
-                    return msg
+                msg = self.wait_reply(seq, timeout)
+                if self._m_rtt is not None:
+                    self._m_rtt.observe(time.monotonic() - t_send)
+                return msg
             except PeerTimeoutError:
                 if attempt >= retries:
                     raise
@@ -298,11 +405,53 @@ class Conn:
                 self._log.warning(
                     "RPC %s to peer %s timed out; retry %d/%d", op,
                     self.label or "?", attempt, retries)
-                time.sleep(backoff * (2 ** (attempt - 1)))
+                time.sleep(_full_jitter(self._rng, backoff,
+                                        attempt - 1, backoff_cap))
+
+    def finish_pending(self, peer_last_seq, timeout=60.0):
+        """Replay the one unacked in-flight CALL after a resume.
+
+        The worker told us (in its RESUME frame) the last seq it
+        EXECUTED.  Three cases against our pending ``(seq, op, ...)``:
+
+        * ``seq <= peer_last_seq`` — the call arrived and ran; only
+          the reply was lost.  Resend the SAME seq: the worker's
+          reply cache answers from memory without re-executing
+          (exactly-once by seq dedupe).
+        * ``seq == peer_last_seq + 1`` — the call never arrived.
+          Resend the same seq: this is first delivery, not a replay.
+        * anything else — the seq spaces diverged (should not happen
+          on a serial protocol; defensive).  Idempotent ops re-issue
+          under a fresh seq; non-idempotent ops abort typed with
+          :class:`NonIdempotentReplayError` so failover reconciles.
+
+        Returns the reply message, or None when nothing was pending.
+        """
+        if self._pending is None:
+            return None
+        seq, op, payload = self._pending
+        if seq <= peer_last_seq + 1:
+            self.resend_pending()
+            return self.wait_reply(seq, timeout)
+        if op in IDEMPOTENT_OPS:
+            self._pending = None
+            self._seq = max(self._seq, peer_last_seq)
+            return self.call(op, payload, timeout=timeout)
+        self._pending = None
+        raise NonIdempotentReplayError(
+            f"cannot replay non-idempotent RPC {op!r} (seq {seq}) to "
+            f"peer {self.label or '?'}: peer last executed seq "
+            f"{peer_last_seq}; aborting typed rather than risking a "
+            f"double execution", started=None)
 
     def send_oneway(self, op, payload=None):
-        """Fire-and-forget (ship frames, aborts): no reply, no seq."""
-        self.send(MSG_ONEWAY, {"op": op, "payload": payload})
+        """Fire-and-forget (ship frames, aborts): no reply, no seq.
+        Carries the fencing epoch when set — a fenced worker silently
+        drops stale one-ways (there is no reply channel to refuse on)."""
+        env = {"op": op, "payload": payload}
+        if self.epoch is not None:
+            env["epoch"] = self.epoch
+        self.send(MSG_ONEWAY, env)
 
     def close(self):
         try:
@@ -313,21 +462,45 @@ class Conn:
 
 class Listener:
     """The fleet's accept side: workers dial back here and prove
-    membership with the shared ``token`` in their HELLO frame."""
+    membership — HELLO with the shared ``token`` (compared constant-
+    time), RESUME with an HMAC over a per-session nonce.  Nonces are
+    single-use per listener: a captured handshake frame replayed
+    against the same listener is refused."""
 
     def __init__(self, host="127.0.0.1", port=0, token=b""):
         self.token = token
         self._log = get_channel("serve")
+        #: nonces already accepted — replaying a captured HELLO/RESUME
+        #: frame (same nonce) is refused even with a valid token/HMAC
+        self._seen_nonces = set()
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
         self.sock.listen(64)
         self.host, self.port = self.sock.getsockname()
 
-    def accept_worker(self, timeout=120.0):
-        """Accept one worker connection and run its HELLO handshake.
-        Returns ``(replica_idx, Conn)``.  The generous default timeout
-        covers a spawned process importing jax from cold."""
+    def _check_nonce(self, frame, addr, conn):
+        nonce = frame.get("nonce")
+        if not isinstance(nonce, str) or not nonce \
+                or nonce in self._seen_nonces:
+            conn.close()
+            raise TransportError(
+                f"handshake from {addr} refused: missing or replayed "
+                f"session nonce")
+        self._seen_nonces.add(nonce)
+        # bound the set — a long-lived listener must not grow without
+        # limit; dropping ancient nonces only re-opens replay of
+        # frames older than 4096 handshakes, far past any socket's TTL
+        if len(self._seen_nonces) > 4096:
+            self._seen_nonces.pop()
+
+    def accept_any(self, timeout=120.0):
+        """Accept one inbound connection and classify its first frame.
+        Returns ``(kind, frame, Conn)`` where kind is MSG_HELLO (fresh
+        worker) or MSG_RESUME (a worker redialing after a drop) — the
+        caller routes them to registration vs session resume.  Both
+        handshakes are verified here: token via ``hmac.compare_digest``
+        for HELLO, the nonce-keyed HMAC for RESUME."""
         self.sock.settimeout(timeout)
         try:
             sock, addr = self.sock.accept()
@@ -336,21 +509,62 @@ class Listener:
                 f"no worker connected within {timeout}s",
                 started=None) from e
         conn = Conn(sock)
-        kind, hello = conn.recv(timeout=timeout)
+        kind, frame = conn.recv(timeout=timeout)
+        if kind == MSG_HELLO:
+            tok = frame.get("token")
+            ours = self.token if isinstance(self.token, bytes) \
+                else str(self.token).encode()
+            theirs = tok if isinstance(tok, bytes) else \
+                str(tok).encode() if tok is not None else b""
+            if not hmac.compare_digest(theirs, ours) \
+                    or frame.get("proto") != PROTO_VERSION:
+                conn.close()
+                raise TransportError(
+                    f"worker handshake from {addr} refused (token or "
+                    f"proto mismatch: proto={frame.get('proto')})")
+            self._check_nonce(frame, addr, conn)
+        elif kind == MSG_RESUME:
+            if frame.get("proto") != PROTO_VERSION:
+                conn.close()
+                raise TransportError(
+                    f"resume from {addr} refused (proto "
+                    f"{frame.get('proto')})")
+            want = resume_auth(self.token, frame.get("nonce", ""),
+                               frame.get("idx", -1),
+                               frame.get("epoch", -1),
+                               frame.get("last_seq", -1))
+            got = frame.get("auth", "")
+            if not isinstance(got, str) \
+                    or not hmac.compare_digest(got, want):
+                conn.close()
+                raise TransportError(
+                    f"resume from {addr} refused (bad auth)")
+            self._check_nonce(frame, addr, conn)
+        else:
+            conn.close()
+            raise TransportError(
+                f"first frame from {addr} was kind {kind}, not "
+                f"HELLO/RESUME")
+        idx = int(frame["idx"])
+        conn.label = f"r{idx}"
+        self._log.info(
+            "worker r%d %s from %s", idx,
+            "connected" if kind == MSG_HELLO else "resuming", addr)
+        return kind, frame, conn
+
+    def accept_worker(self, timeout=120.0):
+        """Accept one FRESH worker connection (HELLO handshake).
+        Returns ``(replica_idx, Conn)``.  The generous default timeout
+        covers a spawned process importing jax from cold.  A RESUME
+        arriving here (a redialing worker racing a fresh spawn) is
+        refused — the caller's accept loop owns resume routing."""
+        kind, frame, conn = self.accept_any(timeout)
         if kind != MSG_HELLO:
             conn.close()
             raise TransportError(
-                f"first frame from {addr} was kind {kind}, not HELLO")
-        if hello.get("token") != self.token \
-                or hello.get("proto") != PROTO_VERSION:
-            conn.close()
-            raise TransportError(
-                f"worker handshake from {addr} refused (token or "
-                f"proto mismatch: proto={hello.get('proto')})")
-        idx = int(hello["idx"])
-        conn.label = f"r{idx}"
-        self._log.info("worker r%d connected from %s", idx, addr)
-        return idx, conn
+                f"expected a fresh worker HELLO, got a RESUME from "
+                f"r{frame.get('idx')}")
+        return int(frame["idx"]), conn
 
     def close(self):
         try:
@@ -361,9 +575,36 @@ class Listener:
 
 def connect_worker(host, port, token, idx, timeout=60.0) -> Conn:
     """Worker side of the handshake: dial the fleet's listener and
-    introduce this replica."""
+    introduce this replica.  The fresh nonce makes the frame single-
+    use — captured HELLOs cannot open a second session."""
     sock = socket.create_connection((host, port), timeout=timeout)
     conn = Conn(sock, label="fleet")
     conn.send(MSG_HELLO, {"token": token, "idx": int(idx),
-                          "proto": PROTO_VERSION})
+                          "proto": PROTO_VERSION,
+                          "nonce": os.urandom(16).hex()})
     return conn
+
+
+def resume_worker(host, port, token, idx, epoch, last_seq,
+                  timeout=5.0):
+    """Worker side of session resume: redial the listener and offer to
+    continue the existing session — ``epoch`` is the fencing epoch the
+    worker last obeyed, ``last_seq`` the last CALL seq it EXECUTED
+    (the controller replays anything after it).  Authenticated by an
+    HMAC over (nonce, idx, epoch, last_seq) so membership is proven
+    without the token itself crossing the wire again.  Returns
+    ``(conn, ack)`` where ack is the controller's MSG_RESUME verdict
+    — ``{"ok": bool, "epoch": int}``."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    conn = Conn(sock, label="fleet")
+    nonce = os.urandom(16).hex()
+    conn.send(MSG_RESUME, {
+        "idx": int(idx), "proto": PROTO_VERSION, "nonce": nonce,
+        "epoch": int(epoch), "last_seq": int(last_seq),
+        "auth": resume_auth(token, nonce, idx, epoch, last_seq)})
+    kind, ack = conn.recv(timeout=timeout)
+    if kind != MSG_RESUME:
+        conn.close()
+        raise TransportError(
+            f"resume ack was kind {kind}, not RESUME")
+    return conn, ack

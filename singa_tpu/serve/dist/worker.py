@@ -38,11 +38,13 @@ from __future__ import annotations
 
 import itertools
 import os
+import random
 import time
 
 import numpy as np
 
-from .transport import PeerGoneError, TransportError, connect_worker
+from .transport import (PeerGoneError, StaleEpochError, TransportError,
+                        _full_jitter, connect_worker, resume_worker)
 from .transport import MSG_CALL, MSG_ONEWAY, MSG_REPLY
 from ..kvimage import KVIMAGE_VERSION, KVImage, KVImageError, leaf_list
 from ..request import (DeadlineExceededError, EngineFailedError,
@@ -59,7 +61,7 @@ _ERR_TYPES = {
     c.__name__: c for c in (
         QueueFullError, DeadlineExceededError, EngineFailedError,
         RestartBudgetExceededError, FleetDownError, LoadShedError,
-        KVImageError, ValueError, RuntimeError)}
+        KVImageError, StaleEpochError, ValueError, RuntimeError)}
 
 
 def dump_exc(e) -> dict:
@@ -154,7 +156,7 @@ def gpt2_spec(model, compile_len=16) -> ModelSpec:
 
 # -- the worker loop -----------------------------------------------------
 class _Worker:
-    def __init__(self, conn, clock=time.monotonic):
+    def __init__(self, conn, clock=time.monotonic, redial=None):
         self.conn = conn
         self.sup = None
         self._clock = clock
@@ -167,10 +169,49 @@ class _Worker:
         self._ids = itertools.count(1)
         self._stop = False
         self._led = None       # this process's RequestLedger (federate)
+        # -- controller-survivability state --------------------------
+        #: (host, port, token, idx) to redial on socket loss; None
+        #: disables reconnect (legacy / test harness direct conns)
+        self._redial = redial
+        #: fencing epoch last obeyed — frames stamped with an OLDER
+        #: epoch come from a deposed controller and are refused typed
+        self._epoch = 0
+        #: single-entry reply cache: the strictly serial protocol
+        #: means at most ONE reply can be in flight, so caching the
+        #: last (seq, reply) gives exactly-once call semantics across
+        #: a reconnect — a replayed seq answers from memory without
+        #: re-executing
+        self._last_seq = 0
+        self._last_reply = None
+        #: (reply_seq, [rids]) whose terminal results rode the reply
+        #: — deleted from the journal once a STRICTLY NEWER call
+        #: proves the controller received it (piggybacked ack)
+        self._unacked = None
+        #: rid -> {state, req, cursor, order, out, t} — the request
+        #: journal an adopting controller reconciles against.  States:
+        #: live (queued or decoding), resolved (handle done, result
+        #: still on the handle), done (result drained into ``out``,
+        #: awaiting ack), expired (TTL tombstone)
+        self._journal = {}
+        self._arrival = itertools.count(1)
+        self._park_ttl = 60.0
+        self._journal_cap = 256
+        self._reconnect_attempts = 20
+        self._backoff_base = 0.1
+        self._backoff_cap = 2.0
+        self._redial_timeout = 5.0
+        self._rng = random.Random()
 
     # engine-side streaming callback: tokens ride the next step reply
     def _on_token(self, req, tok):
         self._tokens.append((req.request_id, int(tok)))
+        ent = self._journal.get(req.request_id)
+        if ent is not None:
+            # the emitted-token cursor: how far this request's stream
+            # has progressed — an adopting controller reads it to tell
+            # started work (cursor > 0: not safely re-runnable) from
+            # never-started
+            ent["cursor"] += 1
 
     @property
     def _eng(self):
@@ -222,7 +263,55 @@ class _Worker:
                 out[rid] = {"err": dump_exc(h._error)}
             else:
                 out[rid] = {"result": self._dump_result(h._result)}
+            ent = self._journal.get(rid)
+            if ent is not None:
+                # drained into a reply: journal the terminal result
+                # until a newer call acks the reply (or the TTL fires)
+                ent["state"] = "done"
+                ent["out"] = out[rid]
+                ent["t"] = self._clock()
         return out
+
+    # -- journal maintenance ---------------------------------------------
+    def _stamp_resolved(self):
+        """Mark journal entries whose handle finished as ``resolved``.
+        The result deliberately STAYS on the handle: if the same
+        controller resumes, the next ``op_step``'s normal drain
+        delivers it; only an adopting controller claims it out of the
+        journal."""
+        for rid, (h, _req) in list(self._handles.items()):
+            if not h.done():
+                continue
+            ent = self._journal.get(rid)
+            if ent is not None and ent["state"] == "live":
+                ent["state"] = "resolved"
+                ent["t"] = self._clock()
+
+    def _sweep_journal(self):
+        """Expire parked results past their TTL: the result is dropped
+        (nobody came back for it) and a tombstone remains so a late
+        adopter gets a typed ``expired`` verdict instead of silence."""
+        now = self._clock()
+        for rid in list(self._journal):
+            ent = self._journal[rid]
+            if ent["state"] in ("resolved", "done") \
+                    and now - ent["t"] > self._park_ttl:
+                self._handles.pop(rid, None)
+                self._journal[rid] = {
+                    "state": "expired", "req": None, "out": None,
+                    "cursor": ent["cursor"], "order": ent["order"],
+                    "t": now}
+
+    def _trim_journal(self):
+        """Bound the journal: evict the oldest non-live entries past
+        the cap (live entries are already bounded by the engine's own
+        admission control, so eviction always terminates)."""
+        while len(self._journal) > self._journal_cap:
+            victim = next((rid for rid, ent in self._journal.items()
+                           if ent["state"] != "live"), None)
+            if victim is None:
+                break
+            del self._journal[victim]
 
     # -- op handlers -----------------------------------------------------
     def op_init(self, p):
@@ -253,9 +342,26 @@ class _Worker:
             # grows dual per-host step lanes for free; the probe clock
             # keeps them on the same correctable time base
             _w_stepprof.enable(clock=self._clock)
+        if "epoch" in p:
+            self._epoch = int(p["epoch"])
+        rec = p.get("recover") or {}
+        self._park_ttl = float(rec.get("park_ttl", self._park_ttl))
+        self._journal_cap = int(rec.get("journal_cap",
+                                        self._journal_cap))
+        self._reconnect_attempts = int(rec.get(
+            "attempts", self._reconnect_attempts))
+        self._backoff_base = float(rec.get("base",
+                                           self._backoff_base))
+        self._backoff_cap = float(rec.get("cap", self._backoff_cap))
+        return self._ack()
+
+    def _ack(self) -> dict:
+        """The engine-description dict the controller sizes its
+        RemoteSupervisor from — returned by INIT at first build and by
+        ``describe`` when an adopting controller attaches to an
+        already-built worker."""
         eng = self.sup.engine
         arena = eng.paged_arena
-
         return {
             "max_slots": eng.max_slots, "max_len": eng.max_len,
             "budget": eng._budget,
@@ -272,6 +378,11 @@ class _Worker:
             "pid": os.getpid(),
         }
 
+    def op_describe(self, p):
+        """Adoption probe: re-describe the live engine to a controller
+        that did not build it (and therefore never saw the INIT ack)."""
+        return self._ack()
+
     def op_submit(self, p):
         d = p["request"]
         req = load_request(
@@ -279,6 +390,11 @@ class _Worker:
             clock=self._clock)
         h = self.sup.submit(req)
         self._handles[req.request_id] = (h, req)
+        self._journal[req.request_id] = {
+            "state": "live", "req": d, "cursor": 0,
+            "order": next(self._arrival), "out": None,
+            "t": self._clock()}
+        self._trim_journal()
         return {"view": self._view()}
 
     def op_validate(self, p):
@@ -497,6 +613,66 @@ class _Worker:
             out["jit_cache"] = jit_cache_size()
         return out
 
+    def op_reconcile(self, p):
+        """Adoption inventory: per journaled request, its state (live
+        / parked / expired), token cursor, arrival order, and — for
+        live work — the original wire request (so the adopter can
+        rebuild its fleet-side handle or requeue).  Parked = a
+        terminal result is being held for exactly-once claim."""
+        self._stamp_resolved()
+        self._sweep_journal()
+        out = {}
+        for rid, ent in self._journal.items():
+            st = ent["state"]
+            if st in ("resolved", "done"):
+                st = "parked"
+            out[rid] = {"state": st, "cursor": ent["cursor"],
+                        "order": ent["order"],
+                        "req": ent["req"] if st == "live" else None}
+        return {"requests": out, "epoch": self._epoch}
+
+    def op_claim(self, p):
+        """Hand a PARKED terminal result to an adopting controller and
+        forget it — exactly-once: the journal entry is deleted on
+        claim, and a lost reply is covered by the seq-dedupe cache
+        (the resend answers from memory, never re-executes)."""
+        rid = p["rid"]
+        self._stamp_resolved()
+        ent = self._journal.get(rid)
+        if ent is None:
+            return {"status": "gone"}
+        if ent["state"] == "expired":
+            return {"status": "expired", "cursor": ent["cursor"]}
+        if ent["state"] == "live":
+            return {"status": "live"}
+        out = ent["out"]
+        if out is None:
+            h, _req = self._handles.pop(rid)
+            if h._error is not None:
+                out = {"err": dump_exc(h._error)}
+            else:
+                out = {"result": self._dump_result(h._result)}
+        else:
+            self._handles.pop(rid, None)
+        del self._journal[rid]
+        # the claimed result carries the FULL token array; drop any
+        # streamed-token backlog for this rid so it cannot ride a
+        # later step reply into a controller that never submitted it
+        self._tokens = [(r, t) for r, t in self._tokens if r != rid]
+        return {"status": "parked", "out": out, "req": ent["req"],
+                "cursor": ent["cursor"], "order": ent["order"]}
+
+    def op_die(self, p):
+        """Chaos/kill hook (one-way): stop WITHOUT redialing — a
+        deliberately killed worker must stay dead, so thread-mode
+        ``kill_worker`` sends this before closing its socket end
+        (TCP ordering lands it ahead of the FIN)."""
+        self._stop = True
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
     def op_shutdown(self, p):
         self._stop = True
         if self.sup is not None:
@@ -506,14 +682,93 @@ class _Worker:
                 pass
         return {}
 
+    # -- disconnected mode ----------------------------------------------
+    def _park_pass(self):
+        """One disconnected-mode pass: keep stepping live work (a
+        controller blip must never wedge decode mid-request), stamp
+        newly finished handles ``resolved`` (results stay ON the
+        handle so a same-controller resume drains them through the
+        normal step reply), and sweep the park TTL."""
+        if self.sup is not None:
+            try:
+                if self.sup.pending:
+                    self.sup.step()
+            except Exception:
+                pass  # budget exhaustion resolves handles typed
+        self._stamp_resolved()
+        self._sweep_journal()
+
+    def _reconnect(self) -> bool:
+        """Bounded reconnect window: redial the controller address
+        with full-jitter backoff, offering to RESUME this session
+        (epoch + last executed seq).  Between attempts the engine
+        keeps stepping (``_park_pass``).  Returns True with
+        ``self.conn`` swapped on success; False when the budget is
+        spent or the controller refuses (worker then dies and the
+        fleet's failover owns the requests)."""
+        host, port, token, idx = self._redial
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        for attempt in range(self._reconnect_attempts):
+            self._park_pass()
+            try:
+                conn, ack = resume_worker(
+                    host, port, token, idx, self._epoch,
+                    self._last_seq, timeout=self._redial_timeout)
+            except (OSError, PeerGoneError, TransportError):
+                time.sleep(_full_jitter(
+                    self._rng, self._backoff_base, attempt,
+                    self._backoff_cap))
+                continue
+            if not ack.get("ok") \
+                    or ack.get("epoch", -1) < self._epoch:
+                # an explicit refusal, or a controller offering an
+                # OLDER epoch (the stale side of a split brain —
+                # never downgrade the fence)
+                conn.close()
+                return False
+            self._epoch = int(ack["epoch"])
+            self.conn = conn
+            return True
+        return False
+
+    def _lost_controller(self) -> bool:
+        """Socket loss: True means give up (stop requested, reconnect
+        disabled, or the redial budget spent)."""
+        return (self._stop or self._redial is None
+                or not self._reconnect())
+
     # -- loop ------------------------------------------------------------
     def run(self):
         while not self._stop:
             try:
                 kind, msg = self.conn.recv(timeout=None)
             except (PeerGoneError, TransportError):
-                break  # the fleet went away: die quietly
+                if self._lost_controller():
+                    break
+                continue
             op = msg.get("op", "")
+            # fencing: frames stamped with an epoch OLDER than the one
+            # this worker last obeyed come from a deposed controller.
+            # CALLs are refused typed (StaleEpochError crosses the
+            # wire); one-ways are dropped — checked BEFORE dispatch so
+            # every op is fenced by construction.
+            ep = msg.get("epoch")
+            if ep is not None and ep < self._epoch:
+                if kind == MSG_CALL:
+                    reply = {"seq": msg.get("seq"), "ok": False,
+                             "err": dump_exc(StaleEpochError(
+                                 f"epoch {ep} < fleet epoch "
+                                 f"{self._epoch}: controller is "
+                                 f"stale; op {op!r} refused"))}
+                    try:
+                        self.conn.send(MSG_REPLY, reply)
+                    except PeerGoneError:
+                        if self._lost_controller():
+                            break
+                continue
             handler = getattr(self, f"op_{op}", None)
             if kind == MSG_ONEWAY:
                 if handler is not None:
@@ -524,22 +779,48 @@ class _Worker:
                 continue
             if kind != MSG_CALL:
                 continue
-            if handler is None:
-                reply = {"seq": msg["seq"], "ok": False,
-                         "err": dump_exc(
-                             RuntimeError(f"unknown op {op!r}"))}
+            seq = msg.get("seq")
+            if seq == self._last_seq and self._last_reply is not None:
+                # replayed seq after a resume: the call already ran,
+                # only the reply was lost — answer from the cache
+                # without re-executing (exactly-once)
+                reply = self._last_reply
             else:
-                try:
-                    reply = {"seq": msg["seq"], "ok": True,
-                             "value": handler(msg.get("payload")
-                                              or {})}
-                except Exception as e:
-                    reply = {"seq": msg["seq"], "ok": False,
-                             "err": dump_exc(e)}
+                if self._unacked is not None and seq is not None \
+                        and seq > self._unacked[0]:
+                    # a strictly newer call proves the reply carrying
+                    # these terminal results landed: ack the journal
+                    for rid in self._unacked[1]:
+                        ent = self._journal.get(rid)
+                        if ent is not None and ent["state"] == "done":
+                            del self._journal[rid]
+                    self._unacked = None
+                if handler is None:
+                    reply = {"seq": seq, "ok": False,
+                             "err": dump_exc(
+                                 RuntimeError(f"unknown op {op!r}"))}
+                else:
+                    try:
+                        reply = {"seq": seq, "ok": True,
+                                 "value": handler(msg.get("payload")
+                                                  or {})}
+                    except Exception as e:
+                        reply = {"seq": seq, "ok": False,
+                                 "err": dump_exc(e)}
+                if seq is not None:
+                    self._last_seq = seq
+                    self._last_reply = reply
+                if reply.get("ok") and isinstance(
+                        reply.get("value"), dict):
+                    rids = list((reply["value"].get("resolved")
+                                 or {}).keys())
+                    if rids:
+                        self._unacked = (seq, rids)
             try:
                 self.conn.send(MSG_REPLY, reply)
             except PeerGoneError:
-                break
+                if self._lost_controller():
+                    break
         # fleet gone or shutdown: release engine state (idempotent)
         if self.sup is not None and not self.sup.engine._closed:
             try:
@@ -551,6 +832,7 @@ class _Worker:
 
 def worker_main(host, port, token, idx):
     """Process (or thread) entry point: dial the fleet, serve the
-    command loop until shutdown or fleet loss."""
+    command loop until shutdown or fleet loss — transient loss enters
+    the bounded reconnect window instead of dying."""
     conn = connect_worker(host, port, token, idx)
-    _Worker(conn).run()
+    _Worker(conn, redial=(host, port, token, idx)).run()

@@ -214,7 +214,8 @@ class _Replica:
     ``revive()`` reuses the slot."""
 
     __slots__ = ("idx", "sup", "healthy", "needs_failover",
-                 "down_error", "draining", "retired")
+                 "down_error", "draining", "retired",
+                 "reconnect_deadline")
 
     def __init__(self, idx, sup):
         self.idx = idx
@@ -224,6 +225,10 @@ class _Replica:
         self.down_error = None
         self.draining = False
         self.retired = False
+        # monotonic deadline while the replica's transport is inside
+        # its reconnect(+grace) window: the autoscaler's _replace_dead
+        # must not respawn a peer that may be about to resume
+        self.reconnect_deadline = None
 
 
 class _Route:
@@ -970,6 +975,7 @@ class ServeFleet:
         rep.down_error = None
         rep.draining = False
         rep.retired = False
+        rep.reconnect_deadline = None
         self._refresh_gauges()
         self._log.info("replica %d revived; %d/%d healthy", idx,
                        self.healthy_replicas, len(self._replicas))
