@@ -40,6 +40,13 @@ recovery invariants the unit tests assert piecewise:
   the survivor (stream parity), fails started work typed, keeps
   serving new requests, and the jit cache stays pinned at zero
   recompiles across the failover.
+* **fault mid-branch (CoW fork family)** — a ``serve.fork_copy``
+  fault fires on the copy-on-write block copy inside a best-of-n
+  family: the WRITING branch rejects typed (``FaultInjected``) and
+  frees its private blocks, its siblings complete with byte parity
+  against the clean run, the ENGINE never fails (blast radius is one
+  branch — zero restarts), zero blocks leak, and a fresh-pool rerun
+  reproduces the clean streams exactly.
 * **disaggregated fleet under fire** — a ``serve.kv_ship`` fault
   mid-transfer requeues the shipped request COLD with byte parity
   (nothing streams during a ship) and leaks zero blocks on either
@@ -495,6 +502,98 @@ def chaos_paged(report):
         "not exercised"
     assert restarts == injected > 0, \
         f"restarts ({restarts}) != injected copy faults ({injected})"
+
+
+def chaos_fork(report):
+    """A fault on the copy-on-write block copy (``serve.fork_copy``
+    fires inside ``PagedKVArena.copy_block`` when a forked branch
+    first writes a sibling-shared block): the WRITING branch rejects
+    typed and its private blocks return to the pool; siblings keep
+    decoding to byte parity with the clean run; the ENGINE survives —
+    the blast radius of a CoW fault is ONE branch, so unlike every
+    other serve scenario here there is no supervisor restart to
+    count (the bench asserts restarts stayed ZERO).  A fresh-pool
+    rerun of the same family reproduces the clean streams, proving
+    the fault never corrupted the shared prompt blocks."""
+    from singa_tpu import tensor
+    from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from singa_tpu.observe.registry import registry
+    from singa_tpu.resilience import FailAfterN, FaultInjected, faults
+    from singa_tpu.serve import GenerationRequest, PagedConfig
+
+    cfg = GPT2Config.tiny(dropout=0.0)
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+
+    rng = np.random.RandomState(15)
+    prompt = rng.randint(0, 256, 12).astype(np.int32)
+    pcfg = PagedConfig(block_size=8, num_blocks=32)
+    assert pcfg.kernel == "block"
+    n_branches = 3
+
+    def run(inject):
+        eng = m.serve(max_slots=4, paged=pcfg)
+        fh = eng.submit(GenerationRequest(
+            prompt, max_new_tokens=16, temperature=0.9, seed=3,
+            n=n_branches))
+        pol = None
+        if inject:
+            # the FIRST CoW copy of the family fires the fault
+            pol = faults.inject("serve.fork_copy",
+                                FailAfterN(0, times=1))
+        try:
+            eng.run_until_complete(max_steps=4000)
+        finally:
+            faults.clear()
+        outs = {}
+        typed = 0
+        for b in fh.branches:
+            try:
+                r = b.result()
+                outs[r.branch] = r.tokens
+            except FaultInjected as e:
+                assert e.site == "serve.fork_copy", e.site
+                typed += 1
+        # the leak invariant: every pool block accounted after drain
+        leaked = eng.check_block_accounting()
+        eng.close()
+        return outs, typed, (pol.fired if pol else 0), leaked
+
+    restarts0 = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0)
+    clean, typed0, _, leak0 = run(False)
+    assert typed0 == 0 and len(clean) == n_branches
+    faulted, typed, fired, leak1 = run(True)
+    parity = sum(1 for b, toks in faulted.items()
+                 if np.array_equal(toks, clean[b]))
+    fresh, typed2, _, leak2 = run(False)
+    fresh_parity = (typed2 == 0 and len(fresh) == n_branches
+                    and all(np.array_equal(fresh[b], clean[b])
+                            for b in fresh))
+    restarts = registry().snapshot()["counters"].get(
+        "resilience.engine_restarts", 0) - restarts0
+
+    report["serve_fork"] = {
+        "requests": n_branches,
+        "completed_with_parity": parity,
+        "typed_failures": typed,
+        "wedged_or_lost": n_branches - len(faulted) - typed,
+        "cow_faults_injected": fired,
+        "engine_restarts": restarts,
+        "blocks_leaked": leak0 + leak1 + leak2,
+        "fresh_pool_parity": bool(fresh_parity),
+        "kernel": pcfg.kernel,
+    }
+    sf = report["serve_fork"]
+    assert sf["wedged_or_lost"] == 0, "fork branches wedged/lost"
+    assert sf["cow_faults_injected"] == 1 == sf["typed_failures"]
+    assert sf["completed_with_parity"] == len(faulted) \
+        == n_branches - 1, "a surviving sibling diverged"
+    assert sf["engine_restarts"] == 0, \
+        "a CoW fault must reject one branch, not restart the engine"
+    assert sf["blocks_leaked"] == 0, sf["blocks_leaked"]
+    assert sf["fresh_pool_parity"] is True
 
 
 def chaos_tp(report):
@@ -1518,6 +1617,7 @@ def main():
     chaos_prefix(report)
     chaos_spec(report)
     chaos_paged(report)
+    chaos_fork(report)
     chaos_longctx(report)
     chaos_tp(report)
     chaos_ep(report)

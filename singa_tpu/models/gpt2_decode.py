@@ -1196,7 +1196,8 @@ def _filter_logits(logit, temperature, top_p, top_k, use_top_p):
 
 
 def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p,
-            min_p=1.0, use_min_p=False, rep_mask=None, rep_penalty=1.0):
+            min_p=1.0, use_min_p=False, rep_mask=None, rep_penalty=1.0,
+            mask=None):
     """One token from a (V,) logit row.  ``greedy``/``top_k``/
     ``use_top_p``/``use_min_p`` are static; ``temperature``/``top_p``/
     ``min_p``/``rep_penalty`` are traced.  Filter order follows the
@@ -1207,8 +1208,17 @@ def _sample(logit, key, temperature, top_p, greedy, top_k, use_top_p,
     ``rep_mask`` (V,) bool marks tokens already in the sequence
     (prompt + emitted); their logits are divided by ``rep_penalty``
     when positive and multiplied when negative (CTRL semantics, as in
-    HF)."""
+    HF).
+
+    ``mask`` (V,) bool is the CONSTRAINED-decoding vocab mask (the
+    serve engine's grammar automaton, serve/structured.py): False
+    lanes drop to NEG_INF before greedy argmax AND before the filter
+    chain, so both modes sample only grammar-legal tokens.  None (the
+    default) and an all-True mask are bitwise no-ops — unconstrained
+    streams cannot drift."""
     logit = logit.astype(jnp.float32)
+    if mask is not None:
+        logit = jnp.where(mask, logit, NEG_INF)
     if rep_mask is not None:
         pen = jnp.where(logit > 0, logit / rep_penalty,
                         logit * rep_penalty)

@@ -84,8 +84,10 @@ __all__ = ["RequestLedger", "enable", "disable", "active", "ledger",
 _active = False
 _ledger = None
 
-#: outcomes that mean "completed normally" (engine finish reasons)
-_COMPLETED = ("length", "stop")
+#: outcomes that mean "completed normally" (engine finish reasons —
+#: "pruned" is a fork branch cut on purpose, a sealed result, not a
+#: rejection)
+_COMPLETED = ("length", "stop", "pruned")
 
 # replica index -> host id, installed by a DistFleet (observe.federate)
 # so hop records carry WHERE a hop ran across the process boundary;
@@ -183,6 +185,8 @@ def _new_hop(engine, t):
         "admit_kind": None,     # cold | warm
         "hit_tokens": 0,
         "slot": None,
+        "branch": None,         # fork branch index (serve/fork.py);
+        #                         None outside a fork family
         "chunks": [],           # [t, offset] per warm prefill chunk
         "t_first_token": None,
         "steps": [],            # [t, tokens] or [t, tokens, acc, drafted]
@@ -298,14 +302,21 @@ class RequestLedger:
                     and hop.get("replica") is not None:
                 hop["host"] = _host_namer(hop["replica"])
 
-    def on_admit(self, rid, engine, t, slot=None, step=None):
+    def on_admit(self, rid, engine, t, slot=None, step=None,
+                 branch=None):
         """Admission started: the request left the queue for a pool
         slot (cold/warm classification arrives from the prefix cache's
-        hook; no cache means it stays the cold default)."""
+        hook; no cache means it stays the cold default).  ``branch``:
+        the fork branch index for a branch spawned off a live sibling
+        (serve/fork.py) — its hop has zero queue and prefill by
+        construction, and the branch id keeps the family legible in
+        why_slow rows."""
         _, hop = self._hop(rid, engine)
         if hop is not None:
             hop["t_admit"] = t
             hop["slot"] = slot
+            if branch is not None:
+                hop["branch"] = int(branch)
             if hop["admit_kind"] is None:
                 hop["admit_kind"] = "cold"
 
@@ -693,7 +704,9 @@ class RequestLedger:
                 "hops": [{"engine": h.get("engine"),
                           "replica": h.get("replica"),
                           "host": h.get("host"),
-                          "via": h.get("via")} for h in e["hops"]],
+                          "via": h.get("via"),
+                          "branch": h.get("branch")}
+                         for h in e["hops"]],
             })
         return out
 

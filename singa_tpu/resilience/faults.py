@@ -51,6 +51,11 @@ SITES = (
     "serve.prefix_copy",   # prefix-cache pool<->slot block copies
     "serve.route",         # fleet router admission (ServeFleet.submit)
     "serve.kv_ship",       # disaggregated KV ship (export + import)
+    "serve.fork_copy",     # KV-fork copy-on-first-write block copy
+    #                        (serve/paged.py copy_block — a fired
+    #                        fault rejects ONLY the writing branch;
+    #                        sibling branches keep decoding on their
+    #                        intact shared bytes)
     "serve.autoscale",     # autoscaler scale-up/retire actions
     #                        (serve/autoscale.py — checked BEFORE any
     #                        replica construction or registration, so

@@ -85,9 +85,28 @@ single-engine oracle.  See docs/SERVING.md "Disaggregated serving"::
         replicas=4, roles=("prefill", "prefill", "decode", "decode"),
         paged=PagedConfig(block_size=16, num_blocks=96),
         prefix_cache=PrefixCacheConfig(block_size=16))
+
+Since the fork round, live KV forks copy-on-write on the paged pool:
+``GenerationRequest(n=4)`` decodes 4 branches off ONE prompt (every
+prompt block shared, per-branch tails allocated on first divergent
+write) and returns a ``ForkHandle`` whose ``best()`` ranks branches
+by cumulative chosen-token logprob; any live streaming handle can
+``fork()``/``prune()`` mid-generation for tree-shaped search; and
+``structured=JsonSchemaAutomaton(schema, vocab)`` constrains every
+emitted token to a JSON-schema grammar via per-slot vocab masks
+applied inside the jitted sample (recompiles stay 0).  See
+docs/SERVING.md "Parallel sampling and structured output"::
+
+    h = eng.submit(GenerationRequest(prompt, n=4, temperature=0.8,
+                                     max_new_tokens=32))
+    eng.run_until_complete()
+    h.best().tokens                       # highest-scoring branch
 """
 
 from .engine import InferenceEngine  # noqa: F401
+from .fork import BranchHandle, ForkHandle  # noqa: F401
+from .structured import (JsonSchemaAutomaton,  # noqa: F401
+                         TokenAutomaton)
 from .fleet import Router, ServeFleet  # noqa: F401
 from .dist import DistFleet, ModelSpec, gpt2_spec  # noqa: F401
 from .autoscale import AutoscaleConfig, Autoscaler  # noqa: F401

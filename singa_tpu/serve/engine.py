@@ -99,6 +99,7 @@ import inspect
 import itertools
 import math
 import time
+from dataclasses import replace
 from functools import partial
 
 import jax
@@ -116,6 +117,7 @@ from ..observe import stepprof as _stepprof
 from ..observe import trace as _trace
 from ..resilience import faults as _faults
 from ..utils.logging import get_channel
+from .fork import BranchHandle, ForkHandle
 from .paged import (PagedConfig, PagedKVArena, _aot_call,
                     _paged_decode_kernel, _paged_decode_step,
                     _paged_spec_kernel, _paged_spec_step)
@@ -128,7 +130,8 @@ from .scheduler import FIFOScheduler, PriorityScheduler
 from .stats import EngineStats
 
 
-def _select_sample(logit, key, temp, top_k, top_p, use_top_p):
+def _select_sample(logit, key, temp, top_k, top_p, use_top_p,
+                   mask=None):
     """Per-row sampling with a TRACED greedy flag.  The offline paths
     bake ``greedy`` in as a static (one compile per mode); a slot pool
     mixes greedy and sampled requests in one executable, so compute
@@ -136,16 +139,20 @@ def _select_sample(logit, key, temp, top_k, top_p, use_top_p):
     select — the greedy branch is argmax over the identical f32 logit,
     the sampled branch divides by max(temp, 1e-6) exactly as
     ``generate`` does, so either way the chosen token matches the
-    offline token bit for bit."""
-    g = _sample(logit, key, temp, top_p, True, top_k, use_top_p)
+    offline token bit for bit.  ``mask`` (V,) bool or None is the
+    constrained-decoding vocab mask, forwarded to the shared
+    ``_sample`` (None / all-True are bitwise no-ops)."""
+    g = _sample(logit, key, temp, top_p, True, top_k, use_top_p,
+                mask=mask)
     s = _sample(logit, key, jnp.maximum(temp, 1e-6), top_p, False,
-                top_k, use_top_p)
+                top_k, use_top_p, mask=mask)
     return jnp.where(temp <= 0.0, g, s).astype(jnp.int32)
 
 
 def _decode_row(params, kc_r, vc_r, tok, pos_r, live_r, key, temp,
                 top_p, n_head, eps, moe_top_k, top_k, use_top_p,
-                tp_axis=None, tp_world=1, ep=None):
+                tp_axis=None, tp_world=1, ep=None, mask=None,
+                with_lp=False):
     """ONE slot's decode-step math — kc_r/vc_r: (L, H_kv, max_len, D)
     cache rows (int8 arenas are (values, scales) pytrees, so the
     batch-axis insert/strip is tree-mapped rather than indexed).
@@ -164,9 +171,18 @@ def _decode_row(params, kc_r, vc_r, tok, pos_r, live_r, key, temp,
         ep=ep)
     ks = jax.random.split(key)
     nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
-                         use_top_p)
-    return (nxt, jax.tree.map(lambda a: a[:, 0], kc2),
-            jax.tree.map(lambda a: a[:, 0], vc2), ks[1])
+                         use_top_p, mask=mask)
+    out = (nxt, jax.tree.map(lambda a: a[:, 0], kc2),
+           jax.tree.map(lambda a: a[:, 0], vc2), ks[1])
+    if with_lp:
+        # chosen-token logprob under the RAW model distribution (not
+        # the filtered one) — the fork round's best-of-n ranking
+        # signal; an extra output, never an input, so the sampled
+        # token chain is untouched
+        lp = jax.nn.log_softmax(
+            logits[0].astype(jnp.float32))[nxt]
+        out = out + (lp,)
+    return out
 
 
 @partial(jax.jit,
@@ -201,7 +217,8 @@ def _pool_decode_step(params, kc, vc, toks, pos, live, keys, temps,
                           "tp_world"))
 def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
                  eps, moe_top_k, top_k, use_top_p, quant=False,
-                 window=None, tp_axis=None, tp_world=1, ep=None):
+                 window=None, tp_axis=None, tp_world=1, ep=None,
+                 mask=None):
     """Admission prefill for ONE request: ids (1, max_len)
     right-padded.  Returns (first token, carried key, kc_row, vc_row)
     with cache rows (L, 1, H_kv, max_len, D) ready to write into the
@@ -221,7 +238,8 @@ def _prefill_one(params, ids, prompt_len, key, temp, top_p, n_head,
         hidden, prompt_len - 1, axis=1, keepdims=False)      # (1, E)
     logit0 = _logits(last_h[:, None, :], params)[0, 0]       # (V,)
     ks = jax.random.split(key)
-    tok0 = _select_sample(logit0, ks[0], temp, top_k, top_p, use_top_p)
+    tok0 = _select_sample(logit0, ks[0], temp, top_k, top_p, use_top_p,
+                          mask=mask)
     return tok0, ks[1], kc, vc
 
 
@@ -297,7 +315,7 @@ def _chunk_row(params, ids, kc_row, vc_row, off, n_head, eps,
 
 @partial(jax.jit, static_argnames=("top_k", "use_top_p"))
 def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
-                       use_top_p):
+                       use_top_p, mask=None):
     """Sample the admission token from a chunk's hidden block: row
     ``row`` of ``hidden`` (1, chunk, E) is position prompt_len-1.
     Mirrors the tail of ``_prefill_one`` exactly — same (1, 1, E)
@@ -308,7 +326,8 @@ def _first_from_hidden(params, hidden, row, key, temp, top_p, top_k,
                                           keepdims=False)     # (1, E)
     logit0 = _logits(last_h[:, None, :], params)[0, 0]        # (V,)
     ks = jax.random.split(key)
-    tok0 = _select_sample(logit0, ks[0], temp, top_k, top_p, use_top_p)
+    tok0 = _select_sample(logit0, ks[0], temp, top_k, top_p, use_top_p,
+                          mask=mask)
     return tok0, ks[1]
 
 
@@ -398,7 +417,8 @@ def _spec_row(t_params, d_params, kc_r, vc_r, dkc_r, dvc_r, tok, pos_r,
 def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
                       key, temp, top_p, n_blk, block, trash, n_head,
                       eps, moe_top_k, top_k, use_top_p, window=None,
-                      blk_lo=None, tp_axis=None, tp_world=1, ep=None):
+                      blk_lo=None, tp_axis=None, tp_world=1, ep=None,
+                      mask=None, with_lp=False):
     """ONE slot's BLOCK-NATIVE decode-step math (the gather-tax
     round): same embed / sample chain as :func:`_decode_row`, but the
     attention runs directly over the block pool through
@@ -421,7 +441,11 @@ def _decode_row_paged(params, pool_k, pool_v, tbl, tok, pos_r, live_r,
         tp_axis=tp_axis, tp_world=tp_world, ep=ep)
     ks = jax.random.split(key)
     nxt = _select_sample(logits[0], ks[0], temp, top_k, top_p,
-                         use_top_p)
+                         use_top_p, mask=mask)
+    if with_lp:
+        lp = jax.nn.log_softmax(
+            logits[0].astype(jnp.float32))[nxt]
+        return nxt, kb, vb, ks[1], lp
     return nxt, kb, vb, ks[1]
 
 
@@ -562,7 +586,7 @@ class _LocalExec:
 
     def paged_decode_step(self, params, pool_k, pool_v, tables, toks,
                           pos, live, keys, temps, top_p, block,
-                          kernel="block"):
+                          kernel="block", masks=None, with_lp=False):
         name, fn = (("paged_decode_kernel", _paged_decode_kernel)
                     if kernel == "block"
                     else ("paged_decode_step", _paged_decode_step))
@@ -570,9 +594,11 @@ class _LocalExec:
                  else {})  # gather path is refused for windowed models
         return _aot_call(name, fn,
                          params, pool_k, pool_v, tables, toks, pos,
-                         live, keys, temps, top_p, block=block,
+                         live, keys, temps, top_p, masks, block=block,
                          _memo=self._aot_memo,
-                         _token=(name, toks.shape[0]),
+                         _token=(name, toks.shape[0],
+                                 masks is not None, with_lp),
+                         with_lp=with_lp,
                          **self._e._statics, **extra)
 
     def paged_spec_step(self, t_params, d_params, pool_k, pool_v, dkc,
@@ -597,11 +623,12 @@ class _LocalExec:
                          top_k=st["top_k"],
                          use_top_p=st["use_top_p"])
 
-    def prefill_one(self, params, ids, prompt_len, key, temp, top_p):
+    def prefill_one(self, params, ids, prompt_len, key, temp, top_p,
+                    mask=None):
         e = self._e
         return _prefill_one(params, ids, prompt_len, key, temp, top_p,
                             **e._statics, quant=e._quant,
-                            window=e._window)
+                            window=e._window, mask=mask)
 
     def prefill_batch(self, params, ids, plens, seeds, temps, top_p):
         e = self._e
@@ -699,11 +726,22 @@ class _Slot:
     block table (pool block ids, grown block-by-block as decode
     advances) and ``n_shared`` the count of leading blocks REFERENCED
     from the prefix cache (never written, never freed by this slot —
-    only released)."""
+    only released).
+
+    Fork-round fields: ``group`` ties sibling branches of one fork
+    family together (None for plain requests — it also gates the
+    per-step logprob output that feeds ``score``, the best-of-n
+    ranking signal), ``branch`` is this slot's index in the family,
+    and ``cow`` marks a slot whose tail blocks MAY still be shared
+    with a sibling (the growth pass copy-on-first-writes them).
+    ``automaton``/``astate`` carry a structured request's grammar
+    state between steps (serve/structured.py)."""
 
     __slots__ = ("handle", "emitted", "remaining",
                  "first_token_time", "admit_time", "admitted_step",
-                 "prefix_nodes", "blocks", "n_shared")
+                 "prefix_nodes", "blocks", "n_shared",
+                 "group", "branch", "score", "cow",
+                 "automaton", "astate")
 
     def __init__(self, handle, max_new, now, step):
         self.handle = handle
@@ -715,6 +753,12 @@ class _Slot:
         self.prefix_nodes = []   # cached-prefix refs held while live
         self.blocks = []         # paged mode: the slot's block table
         self.n_shared = 0        # leading blocks shared with the cache
+        self.group = None        # fork family id (None = plain)
+        self.branch = 0          # branch index within the family
+        self.score = 0.0         # cumulative chosen-token logprob
+        self.cow = False         # tail blocks may be sibling-shared
+        self.automaton = None    # structured-decoding grammar
+        self.astate = None       # its current state
 
 
 class _Prefilling:
@@ -765,7 +809,8 @@ class _Swapped:
     __slots__ = ("handle", "request", "emitted", "remaining",
                  "first_token_time", "admit_time", "admitted_step",
                  "pos", "tok", "temp", "key", "image", "dkc_h",
-                 "dvc_h", "n_data", "seq", "t_preempt", "j_lo")
+                 "dvc_h", "n_data", "seq", "t_preempt", "j_lo",
+                 "group", "branch", "score", "automaton", "astate")
 
     @property
     def priority(self):
@@ -1306,6 +1351,38 @@ class InferenceEngine:
                      "prefill token budget split admissions into",
                 engine=self.stats.engine_label)
             self._own_metrics.append(self._c_budget_chunks)
+        # -- CoW KV forking (serve/fork.py): fork-family id sequence
+        # and the fork-round metrics (paged engines only — forking
+        # rides on the arena's block refcounts)
+        self._fork_seq = itertools.count(1)
+        self._c_fork_branches = self._c_fork_cow = None
+        self._c_fork_pruned = self._g_fork_shared = None
+        if self.paged_arena is not None:
+            self._c_fork_branches = self.stats.registry.counter(
+                "serve.fork.branches",
+                help="decoding branches forked off live slots "
+                     "(n>1 admissions and explicit fork() calls)",
+                engine=self.stats.engine_label)
+            self._c_fork_cow = self.stats.registry.counter(
+                "serve.fork.cow_copies",
+                help="copy-on-write block copies: a branch reached a "
+                     "block a sibling still references and got a "
+                     "private copy",
+                engine=self.stats.engine_label)
+            self._c_fork_pruned = self.stats.registry.counter(
+                "serve.fork.pruned",
+                help="branches cut by prune() (private blocks freed, "
+                     "result sealed finish_reason=pruned)",
+                engine=self.stats.engine_label)
+            self._g_fork_shared = self.stats.registry.gauge(
+                "serve.fork.shared_blocks",
+                help="arena blocks currently referenced by more than "
+                     "one live slot (each saves a full block of KV "
+                     "per extra reference)",
+                engine=self.stats.engine_label)
+            self._own_metrics.extend([
+                self._c_fork_branches, self._c_fork_cow,
+                self._c_fork_pruned, self._g_fork_shared])
         # -- ring-attention prefill (TPConfig(ring_prefill=True)):
         # cold long-prompt admissions prefill SEQUENCE-sharded over
         # the tp mesh (parallel/ring_attention.py) — composition was
@@ -1388,6 +1465,12 @@ class InferenceEngine:
             raise
         handle._submit_time = t_sub
         self._handles[request.request_id] = handle
+        if request.n > 1:
+            # best-of-n: the scheduler will fork n-1 siblings off this
+            # slot the moment the prompt admits (serve/fork.py);
+            # surface the n-branch view instead of the bare handle
+            handle._fork_children = []
+            return ForkHandle(self, handle)
         return handle
 
     def validate_request(self, request):
@@ -1448,6 +1531,70 @@ class InferenceEngine:
                     f"paged pool holds {self.paged_arena.num_blocks}; "
                     f"raise PagedConfig.num_blocks or lower "
                     f"max_new_tokens")
+        if request.n > 1 or request.structured is not None:
+            what = (f"n={request.n}" if request.n > 1
+                    else "structured decoding")
+            if self.paged_arena is None:
+                raise ValueError(
+                    f"{what} needs a paged engine (model.serve("
+                    f"paged=PagedConfig(...))) — forking rides on the "
+                    f"arena's per-block refcounts and structured masks "
+                    f"on its per-row dispatch")
+            if self.draft is not None:
+                raise ValueError(
+                    f"{what} is incompatible with speculative decoding "
+                    f"(the verify chunk samples several tokens per "
+                    f"dispatch; per-token masks and branch logprobs "
+                    f"need the one-token step)")
+            if self._shard is not None:
+                raise ValueError(
+                    f"{what} is not supported on the tensor-parallel "
+                    f"backend yet (the tp twins predate the mask/"
+                    f"logprob dispatch signature)")
+        if request.n > 1:
+            if self._window is not None:
+                raise ValueError(
+                    f"n={request.n} on a sliding-window engine: "
+                    f"windowed slots DROP out-of-window blocks, which "
+                    f"a sibling may still share — fork needs the full "
+                    f"block table")
+            if self._budget is not None or self._ring:
+                raise ValueError(
+                    f"n={request.n} with chunked/ring prefill: "
+                    f"branches fork off the admission pass, which "
+                    f"these paths split across steps; use a plain "
+                    f"paged admission for forked requests")
+            B = self.paged_arena.block_size
+            plen = len(request.prompt_ids)
+            shared = plen // B
+            tail = (plen + request.max_new_tokens - 1) // B + 1 - shared
+            if shared + request.n * tail > self.paged_arena.num_blocks:
+                raise ValueError(
+                    f"n={request.n} needs up to {shared} shared + "
+                    f"{request.n}x{tail} per-branch KV blocks but the "
+                    f"paged pool holds {self.paged_arena.num_blocks}; "
+                    f"raise PagedConfig.num_blocks, lower n, or lower "
+                    f"max_new_tokens")
+        if request.structured is not None:
+            a = request.structured
+            vs = getattr(a, "vocab_size", None)
+            if vs is not None and int(vs) != int(self.cfg.vocab_size):
+                raise ValueError(
+                    f"structured automaton covers vocab_size={vs} but "
+                    f"the model's vocab is {self.cfg.vocab_size} — the "
+                    f"mask would mis-index logits")
+            m0 = np.asarray(a.mask(a.initial()), bool)
+            if m0.shape != (int(self.cfg.vocab_size),):
+                raise ValueError(
+                    f"structured mask shape {m0.shape} != "
+                    f"({self.cfg.vocab_size},) — masks must be one "
+                    f"bool per vocab token")
+            if not m0.any():
+                raise ValueError(
+                    "structured automaton's initial state accepts NO "
+                    "token — the grammar is unsatisfiable under this "
+                    "vocab (every legal first emission simulates to a "
+                    "dead end)")
 
     @property
     def pending(self) -> bool:
@@ -1457,6 +1604,39 @@ class InferenceEngine:
                 or any(s is not None for s in self._slots)
                 or bool(self._prefilling)
                 or bool(self._swapped))
+
+    def check_block_accounting(self):
+        """Leak invariant for the paged arena: every used pool block
+        is owned by exactly one of (a) the prefix cache's radix tree,
+        (b) a live slot's block table, (c) an in-flight chunked
+        prefill.  Anything else is a leaked block — raised as an
+        AssertionError naming the counts, so benches and tests can
+        assert ``arena.used == cached + live_referenced`` after a
+        drain with one call.  Returns the used-block count.  Fork-
+        shared blocks are counted ONCE here (ownership is the block
+        id, not the refcount) — the arena's refcounts only govern
+        when ``free`` actually recycles."""
+        arena = self.paged_arena
+        if arena is None:
+            return 0
+        owned = set()
+        if self.prefix_cache is not None:
+            owned.update(self.prefix_cache.cached_block_ids())
+        n_cached = len(owned)
+        for s in self._slots:
+            if s is not None:
+                owned.update(b for b in s.blocks if b != arena.trash)
+        for pf in self._prefilling.values():
+            owned.update(b for b in pf.blocks if b != arena.trash)
+        used = arena.blocks_used
+        if used != len(owned):
+            raise AssertionError(
+                f"paged-arena block leak: arena reports {used} used "
+                f"blocks but owners account for {len(owned)} "
+                f"({n_cached} cached + {len(owned) - n_cached} "
+                f"live/prefilling) — "
+                f"{used - len(owned)} block(s) leaked")
+        return used
 
     # -- lifecycle -------------------------------------------------------
     def close(self, force=False):
@@ -1474,6 +1654,13 @@ class InferenceEngine:
                 f"close() with work in flight (queue="
                 f"{self.scheduler.queue_depth}, live={self.live_slots});"
                 f" drain with run_until_complete() first")
+        if (not force and not self._failed
+                and self.paged_arena is not None):
+            # leak invariant: a drained engine's arena holds exactly
+            # the cache-owned blocks — any extra used block is a leak
+            # (a forked branch that freed a shared block, a preempt
+            # path that dropped a refcount on the floor)
+            self.check_block_accounting()
         self._release_everything()
 
     def _release_everything(self):
@@ -1787,6 +1974,7 @@ class InferenceEngine:
         _mon = _monitor.active()
         _hb_t0 = time.perf_counter() if _mon else 0.0
         a_draft = None
+        lps = None
         arena = self.paged_arena
         # (speculative paged steps run at full width: the DRAFT arena
         # is slot-indexed — compacting would have to gather/scatter
@@ -1823,6 +2011,58 @@ class InferenceEngine:
                 if _stepprof._active:
                     _stepprof.pop()
         else:
+            # fork/structured pre-dispatch pass (paged, non-spec):
+            # per-slot grammar masks computed on the HOST between
+            # steps, stacked into one fixed-shape (S, V) bool input
+            # (plain slots get all-True rows — a bitwise no-op in the
+            # shared _sample), and the chosen-token logprob output
+            # turned on whenever any live slot belongs to a fork
+            # family.  Both are signature STATICS only in their
+            # presence (masks-or-not, lp-or-not), so the warmed jit
+            # cache covers every grammar and every fork pattern.
+            masks_np = None
+            need_lp = False
+            if arena is not None:
+                t_rej = None
+                for i, s in enumerate(self._slots):
+                    if s is None:
+                        continue
+                    if s.group is not None:
+                        need_lp = True
+                    if s.automaton is None:
+                        continue
+                    m = np.asarray(s.automaton.mask(s.astate), bool)
+                    if not m.any():
+                        # no vocab token continues the grammar from
+                        # here (incomplete output, nothing legal to
+                        # emit): that request is dead, typed — the
+                        # engine keeps serving everyone else
+                        t_rej = self._clock()
+                        rid = s.handle.request.request_id
+                        self._log.warning(
+                            "structured automaton for %s reached a "
+                            "dead end (no legal token); rejecting "
+                            "that request", rid)
+                        self._reject_live(
+                            i, s,
+                            ValueError(
+                                f"{rid}: structured automaton state "
+                                f"{s.astate!r} admits no vocab token "
+                                f"— the grammar cannot complete from "
+                                f"here"),
+                            "structured_dead_end", t_rej)
+                        continue
+                    if masks_np is None:
+                        masks_np = np.ones(
+                            (self.max_slots, self.cfg.vocab_size),
+                            bool)
+                    masks_np[i] = m
+                if t_rej is not None:
+                    live = np.asarray(
+                        [s is not None for s in self._slots])
+                    n_live = int(live.sum())
+                    if n_live == 0:
+                        return
             with _trace.span("serve/decode_step", cat="serve",
                              step=self.step_count, live=n_live,
                              paged=arena is not None):
@@ -1848,8 +2088,16 @@ class InferenceEngine:
                         sel_in = np.where(sel < 0, 0, sel)
                         keys_w = _take_rows(self._keys,
                                             jnp.asarray(sel_in))
-                        (nt_w, arena.pool_k, arena.pool_v,
-                         keys2) = self._x.paged_decode_step(
+                        # masks/with_lp only when active: the sharded
+                        # executors (tp/ep/pp) predate the fork
+                        # signature and validation refuses fork on
+                        # them, so the plain call must stay kwarg-free
+                        fkw = {}
+                        if masks_np is not None:
+                            fkw["masks"] = jnp.asarray(masks_np[sel_in])
+                        if need_lp:
+                            fkw["with_lp"] = True
+                        res = self._x.paged_decode_step(
                             self._params, arena.pool_k, arena.pool_v,
                             self._block_tables(list(sel)),
                             jnp.asarray(self._toks[sel_in]),
@@ -1857,23 +2105,37 @@ class InferenceEngine:
                             jnp.asarray(live_w), keys_w,
                             jnp.asarray(self._temps[sel_in]),
                             self._top_p, arena.block_size,
-                            kernel=arena.config.kernel)
+                            kernel=arena.config.kernel, **fkw)
+                        nt_w, arena.pool_k, arena.pool_v, keys2 = \
+                            res[:4]
                         self._keys = _set_rows(
                             self._keys, jnp.asarray(lanes),
                             keys2[:len(lanes)])
                         next_toks = np.zeros(self.max_slots, np.int32)
                         next_toks[lanes] = \
                             np.asarray(nt_w)[:len(lanes)]
+                        if need_lp:
+                            lps = np.zeros(self.max_slots)
+                            lps[lanes] = \
+                                np.asarray(res[4])[:len(lanes)]
                     else:
-                        (next_toks, arena.pool_k, arena.pool_v,
-                         self._keys) = self._x.paged_decode_step(
+                        fkw = {}
+                        if masks_np is not None:
+                            fkw["masks"] = jnp.asarray(masks_np)
+                        if need_lp:
+                            fkw["with_lp"] = True
+                        res = self._x.paged_decode_step(
                             self._params, arena.pool_k, arena.pool_v,
                             self._block_tables(),
                             jnp.asarray(self._toks),
                             jnp.asarray(self._pos), jnp.asarray(live),
                             self._keys, jnp.asarray(self._temps),
                             self._top_p, arena.block_size,
-                            kernel=arena.config.kernel)
+                            kernel=arena.config.kernel, **fkw)
+                        (next_toks, arena.pool_k, arena.pool_v,
+                         self._keys) = res[:4]
+                        if need_lp:
+                            lps = np.asarray(res[4])
                 else:
                     next_toks, self._kc, self._vc, self._keys = \
                         self._x.pool_decode_step(
@@ -1904,6 +2166,12 @@ class InferenceEngine:
                 continue
             rid = slot.handle.request.request_id
             if a_draft is None:
+                if lps is not None and slot.group is not None:
+                    # best-of-n ranking signal: cumulative chosen-
+                    # token logprob under the raw distribution,
+                    # accumulated BEFORE _emit (which may retire the
+                    # slot and seal the score into the result)
+                    slot.score += float(lps[i])
                 self._emit(i, slot, int(next_toks[i]), t_emit)
                 if led is not None:
                     if _sp:
@@ -1967,20 +2235,27 @@ class InferenceEngine:
                     "on_token callback for %s raised (%r); rejecting "
                     "that request, slot %d freed", req.request_id, e,
                     idx)
-                self._release_prefix(slot)
-                self._free_slot_blocks(slot)
-                self._slots[idx] = None
-                self._handles.pop(req.request_id, None)
-                _trace.event("serve/request_rejected", cat="serve",
-                             request=req.request_id,
-                             reason="on_token_callback")
-                if _reqs._active:
-                    # started=True: tokens streamed — never requeued
-                    _reqs._ledger.on_reject(
-                        req.request_id, t=now,
-                        reason="on_token_callback",
-                        engine=self.stats.engine_label, started=True)
-                slot.handle._reject(e)
+                self._reject_live(idx, slot, e, "on_token_callback",
+                                  now)
+                return
+        if slot.automaton is not None:
+            # structured decoding: advance the grammar with the token
+            # the mask admitted.  A mismatch here means the mask and
+            # the automaton disagree — an automaton bug, charged to
+            # THIS request (typed reject), never an engine death.
+            try:
+                slot.astate = slot.automaton.advance(slot.astate,
+                                                     token)
+            except Exception as e:
+                self._log.warning(
+                    "structured automaton for %s rejected its own "
+                    "masked token (%r); rejecting that request",
+                    req.request_id, e)
+                self._reject_live(idx, slot, e, "structured_advance",
+                                  now)
+                return
+            if slot.automaton.done(slot.astate):
+                self._retire(idx, slot, now, finish_reason="stop")
                 return
         stop = (req.stop_token is not None and token == req.stop_token)
         if stop or slot.remaining <= 0:
@@ -2022,7 +2297,9 @@ class InferenceEngine:
             ttft=ttft, tpot=tpot,
             queue_time=slot.admit_time - submit_t,
             admitted_step=slot.admitted_step,
-            finished_step=self.step_count)
+            finished_step=self.step_count,
+            branch=slot.branch,
+            score=(slot.score if slot.group is not None else None))
         if self.paged_arena is not None:
             self._paged_retire(idx, slot, req, result)
         elif self.prefix_cache is not None:
@@ -2038,8 +2315,35 @@ class InferenceEngine:
         # entry keeps a long-lived engine's memory flat under sustained
         # traffic
         self._handles.pop(req.request_id, None)
+        if self.paged_arena is not None:
+            self._fork_gauge()
         if _sp:
             _stepprof.pop()
+
+    def _reject_live(self, idx, slot, error, reason, now):
+        """Reject a LIVE slot's request typed (client callback raised,
+        structured dead end, CoW copy faulted): release its prefix
+        refs, free/deref its blocks, drop the slot, and seal the
+        handle with ``error``.  Started=True — tokens streamed, never
+        requeue-safe.  The engine keeps serving everyone else."""
+        req = slot.handle.request
+        self._release_prefix(slot)
+        self._free_slot_blocks(slot)
+        self._slots[idx] = None
+        self._handles.pop(req.request_id, None)
+        _trace.event("serve/request_rejected", cat="serve",
+                     request=req.request_id, reason=reason)
+        if _reqs._active:
+            _reqs._ledger.on_reject(
+                req.request_id, t=now, reason=reason,
+                engine=self.stats.engine_label, started=True)
+        slot.handle._reject(error)
+        if self.paged_arena is not None:
+            self._fork_gauge()
+
+    def _fork_gauge(self):
+        if self._g_fork_shared is not None:
+            self._g_fork_shared.set(self.paged_arena.shared_blocks)
 
     def _release_prefix(self, slot):
         if self.prefix_cache is not None and slot.prefix_nodes:
@@ -2142,6 +2446,20 @@ class InferenceEngine:
                     arena.on_window_drop(len(drop))
                     for j in range(min(dead, len(slot.blocks))):
                         slot.blocks[j] = arena.trash
+            if slot.cow:
+                # copy-on-first-write (serve/fork.py): this step
+                # writes position pos into block pos // B — if a
+                # sibling still references that block, give this slot
+                # a private byte copy BEFORE the dispatch so the
+                # sibling's KV is never clobbered.  Fork geometry
+                # keeps wb >= n_shared always (branches share at the
+                # write frontier, past the cache-owned prefix), so
+                # cache-owned blocks are never copied here.
+                wb = pos // B
+                if wb < len(slot.blocks) \
+                        and arena.is_shared(slot.blocks[wb]):
+                    if not self._cow_copy(i, slot, wb):
+                        continue
             need = (pos + self._spec_pad) // B + 1
             short = need - len(slot.blocks)
             if short <= 0:
@@ -2152,6 +2470,39 @@ class InferenceEngine:
                 self._preempt_slot(i, reason="pool_exhausted")
                 continue
             slot.blocks.extend(got)
+
+    def _cow_copy(self, idx, slot, wb):
+        """Give ``slot`` a private copy of its sibling-shared block
+        ``wb`` before this step writes into it.  Returns False when
+        the slot did not survive (pool exhausted → self-preempt, or
+        the copy dispatch faulted → typed reject) — the caller skips
+        the slot this pass."""
+        arena = self.paged_arena
+        prio = getattr(slot.handle.request, "priority", 0)
+        got = self._alloc_blocks(1, prio, exclude_idx=idx)
+        if got is None:
+            self._preempt_slot(idx, reason="pool_exhausted")
+            return False
+        old = slot.blocks[wb]
+        try:
+            arena.copy_block(old, got[0])
+        except Exception as e:
+            # the CoW copy is this BRANCH's work, not the engine's:
+            # a fault here (resilience site serve.fork_copy) rejects
+            # the one branch typed and frees its claim — siblings and
+            # unrelated tenants keep streaming
+            arena.free(got)
+            self._log.warning(
+                "CoW block copy for %s faulted (%r); rejecting that "
+                "branch, slot %d freed",
+                slot.handle.request.request_id, e, idx)
+            self._reject_live(idx, slot, e, "fork_copy", self._clock())
+            return False
+        slot.blocks[wb] = got[0]
+        arena.free([old])  # drop this slot's reference; sibling keeps it
+        self._c_fork_cow.inc()
+        self._fork_gauge()
+        return True
 
     def _alloc_blocks(self, n, priority, exclude_idx=None):
         """``n`` pool blocks for a request at ``priority``, evicting
@@ -2174,8 +2525,12 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             avail += self.prefix_cache.evictable_blocks()
         trash = arena.trash
+        # a victim's sibling-shared blocks do NOT come back to the
+        # free list (free only drops a reference), so they cannot
+        # count toward feasibility
         avail += sum(
-            sum(1 for b in s.blocks[s.n_shared:] if b != trash)
+            sum(1 for b in s.blocks[s.n_shared:]
+                if b != trash and not arena.is_shared(b))
             for i, s in enumerate(self._slots)
             if s is not None and i != exclude_idx
             and getattr(s.handle.request, "priority", 0) < priority)
@@ -2252,6 +2607,13 @@ class InferenceEngine:
                         - sw.j_lo)
         sw.seq = next(self._swap_seq)
         sw.t_preempt = self._clock()
+        # fork/structured state rides the swap image too: the resumed
+        # slot scores and masks exactly as the uninterrupted one would
+        sw.group = slot.group
+        sw.branch = slot.branch
+        sw.score = slot.score
+        sw.automaton = slot.automaton
+        sw.astate = slot.astate
         # the swap image rides the shared versioned host format
         # (serve/kvimage.py) — the same one KV shipping uses, so the
         # two host-image paths cannot drift
@@ -2324,6 +2686,15 @@ class InferenceEngine:
             # uninterrupted slot would hold at this pos)
             slot.blocks = [arena.trash] * j_lo + blocks
             slot.n_shared = 0
+            # the swap-in scattered a private byte copy of every
+            # block, so the resumed slot shares nothing: cow stays
+            # False, but its fork identity/score and grammar state
+            # continue where they left off
+            slot.group = getattr(sw, "group", None)
+            slot.branch = getattr(sw, "branch", 0)
+            slot.score = getattr(sw, "score", 0.0)
+            slot.automaton = getattr(sw, "automaton", None)
+            slot.astate = getattr(sw, "astate", None)
             self._slots[idx] = slot
             self._toks[idx] = sw.tok
             self._pos[idx] = sw.pos
@@ -2364,6 +2735,15 @@ class InferenceEngine:
             # was never allocated — a session pins one block less (the
             # next turn's admission recomputes the tail block anyway)
             n_goal = min(n_goal, len(slot.blocks))
+            # fork: never adopt a block a LIVE sibling still shares —
+            # the tree would own a block the sibling may CoW-free, and
+            # double-ownership breaks the accounting invariant.  The
+            # LAST retiring sibling sees refcount 1 everywhere and
+            # adopts the full prefix, so the cache still wins it.
+            for j in range(slot.n_shared, n_goal):
+                if arena.is_shared(slot.blocks[j]):
+                    n_goal = j
+                    break
             path = []
             if n_goal > 0:
                 if want_session and n_goal > plen // B:
@@ -2487,6 +2867,13 @@ class InferenceEngine:
                 if getattr(r, "priority", 0) <= blocked_p:
                     break
                 batchable.append(r)
+        # forked (n>1) and structured admissions keep the per-request
+        # path: the batch prefill samples tok0 unmasked and its rows
+        # predate the fork bookkeeping
+        if not all(getattr(r, "n", 1) == 1
+                   and getattr(r, "structured", None) is None
+                   for r in batchable):
+            batchable = []
         prefilled = {}
         if (self.paged_arena is not None and self.draft is None
                 and self.prefix_cache is None and not self._ring
@@ -2513,11 +2900,22 @@ class InferenceEngine:
                 self._batch_cache = (tuple(batchable), prefilled,
                                      self._admit_batch)
         for k, req in enumerate(admit):
-            if (blocked_p is not None
-                    and getattr(req, "priority", 0) <= blocked_p) \
-                    or not self._admit(free.pop(0), req, now,
-                                       prefilled=prefilled.get(
-                                           req.request_id)):
+            n_br = getattr(req, "n", 1)
+            ok = False
+            if (blocked_p is None
+                    or getattr(req, "priority", 0) > blocked_p) \
+                    and len(free) >= n_br:
+                # n>1 admits only when the WHOLE family fits this
+                # pass (one slot per branch): a partially-forked
+                # family would leave branch count dependent on
+                # scheduling noise
+                ph = self._handles[req.request_id]
+                ok = self._admit(free.pop(0), req, now,
+                                 prefilled=prefilled.get(
+                                     req.request_id))
+                if ok and n_br > 1:
+                    self._fork_group_admit(req, ph, free, now)
+            if not ok:
                 # capacity block: the head request's blocks do not fit
                 # even after eviction + priority preemption (or a
                 # swapped request outranks it).  Push it AND
@@ -2541,6 +2939,262 @@ class InferenceEngine:
         unoccupied AND not reserved by an in-flight chunked prefill."""
         return [i for i, s in enumerate(self._slots)
                 if s is None and i not in self._prefilling]
+
+    # -- CoW KV forking (serve/fork.py) ----------------------------------
+    def _fork_group_admit(self, req, handle, free, now):
+        """Spawn branches 1..n-1 of an ``n > 1`` admission off the
+        freshly admitted parent slot, inside the same scheduling pass
+        (the admit loop reserved one free slot per branch up front).
+        If tok0 already resolved the parent — its stop token landed on
+        the first sample, or its on_token callback rejected it — every
+        sibling would have produced the same single token, so they
+        seal immediately with the parent's outcome instead of
+        forking."""
+        rid = req.request_id
+        pidx = next((i for i, s in enumerate(self._slots)
+                     if s is not None and s.handle is handle), None)
+        if pidx is None:
+            for k in range(1, req.n):
+                ch = RequestHandle(req)
+                if handle._result is not None:
+                    ch._finish(replace(handle._result,
+                                       request_id=f"{rid}#{k}",
+                                       branch=k))
+                else:
+                    ch._reject(handle._error)
+                handle._fork_children.append(ch)
+            return
+        parent = self._slots[pidx]
+        if parent.group is None:
+            parent.group = next(self._fork_seq)
+        for k in range(1, req.n):
+            self._spawn_branch(pidx, free.pop(0), k, now)
+
+    def _spawn_branch(self, parent_idx, child_idx, branch, now,
+                      seed=None, max_new=None):
+        """Clone the live slot at ``parent_idx`` into ``child_idx`` as
+        fork branch ``branch``: the child's block table is a COPY of
+        the parent's with every non-cache-owned block's arena refcount
+        bumped (zero KV bytes move), prefix-cache refs re-acquired,
+        and host decode state (token, position, temperature, emitted
+        list, grammar state) duplicated.  Both slots turn ``cow`` on:
+        the next write into a still-shared block copies it first
+        (:meth:`_cow_copy`).  The child re-keys via
+        ``fold_in(parent_key, branch)`` (or a fresh chain from
+        ``seed``) so siblings sample independently from the shared
+        distribution."""
+        arena = self.paged_arena
+        cache = self.prefix_cache
+        # deferred same-pass admission writes must land before the
+        # parent's key/pool state is read below
+        if self._pending_scatter or self._pending_keys:
+            self._flush_admission_writes()
+        parent = self._slots[parent_idx]
+        preq = parent.handle.request
+        rid = preq.request_id
+        child_rid = f"{rid}#{branch}"
+        child_req = replace(
+            preq, request_id=child_rid, n=1,
+            max_new_tokens=(preq.max_new_tokens if max_new is None
+                            else int(max_new)))
+        child_handle = RequestHandle(child_req)
+        child_handle._submit_time = now
+        child = _Slot(child_handle,
+                      parent.remaining if max_new is None
+                      else int(max_new),
+                      now, self.step_count)
+        child.emitted = list(parent.emitted)
+        # the branch point IS its first token: a branch pruned before
+        # its first own decode still seals with real latency numbers
+        child.first_token_time = now
+        shared = [b for b in parent.blocks[parent.n_shared:]
+                  if b != arena.trash]
+        arena.share(shared)
+        child.blocks = list(parent.blocks)
+        if cache is not None and parent.prefix_nodes:
+            cache.acquire(parent.prefix_nodes)
+            child.prefix_nodes = list(parent.prefix_nodes)
+        child.n_shared = parent.n_shared
+        child.group = parent.group
+        child.branch = branch
+        child.score = parent.score
+        child.automaton = parent.automaton
+        child.astate = parent.astate
+        parent.cow = child.cow = True
+        if seed is None:
+            ck = jax.random.fold_in(self._keys[parent_idx],
+                                    int(branch))
+        else:
+            ck = jax.random.split(
+                jax.random.PRNGKey(int(seed)), 1)[0]
+        self._keys = self._keys.at[child_idx].set(ck)
+        self._toks[child_idx] = self._toks[parent_idx]
+        self._pos[child_idx] = self._pos[parent_idx]
+        self._temps[child_idx] = self._temps[parent_idx]
+        self._slots[child_idx] = child
+        self._handles[child_rid] = child_handle
+        kids = getattr(parent.handle, "_fork_children", None)
+        if kids is None:
+            kids = parent.handle._fork_children = []
+        kids.append(child_handle)
+        # a branch is a submission that skipped the queue and the
+        # prefill (its KV is the parent's, by reference): submitted
+        # counts balance completions, but no admission latency sample
+        # is recorded — zero queue/prefill would drag the TTFT
+        # distribution with samples no client experienced
+        self.stats.on_submit()
+        if _reqs._active:
+            lbl = self.stats.engine_label
+            _reqs._ledger.on_submit(
+                child_rid, engine=lbl, t=now,
+                prompt_len=len(preq.prompt_ids),
+                max_new_tokens=child_req.max_new_tokens)
+            _reqs._ledger.on_admit(child_rid, engine=lbl, t=now,
+                                   slot=child_idx,
+                                   step=self.step_count,
+                                   branch=branch)
+            _reqs._ledger.on_first_token(child_rid, engine=lbl, t=now)
+        _trace.event("serve/fork", cat="serve", request=child_rid,
+                     parent=rid, slot=child_idx, branch=branch,
+                     shared_blocks=len(shared),
+                     pos=int(self._pos[parent_idx]))
+        self._c_fork_branches.inc()
+        self._fork_gauge()
+        return child_handle
+
+    def fork(self, request_id, *, seed=None, max_new_tokens=None):
+        """Split the LIVE request ``request_id`` into two branches
+        sharing every block decoded so far copy-on-write (tree-shaped
+        search: fork the promising branch, ``prune`` the losers).
+        Returns a :class:`~singa_tpu.serve.fork.BranchHandle` for the
+        new branch; the original keeps streaming unchanged.  ``seed``
+        re-keys the new branch from a fresh chain (default:
+        ``fold_in`` of the parent's current key by the branch index);
+        ``max_new_tokens`` caps the new branch's REMAINING budget
+        (default: inherit the parent's)."""
+        if self._closed:
+            raise RuntimeError(
+                "engine is closed; build a new one with model.serve()")
+        if self._failed:
+            raise EngineFailedError(
+                "engine has failed; rebuild it (EngineSupervisor does "
+                "this automatically)", engine_step=self.step_count)
+        if (self.paged_arena is None or self.draft is not None
+                or self._shard is not None or self._window is not None
+                or self._ring):
+            raise ValueError(
+                "fork() needs a plain paged engine (no draft, no "
+                "tensor-parallel backend, no sliding window, no ring "
+                "prefill) — same support matrix as "
+                "GenerationRequest(n>1)")
+        if max_new_tokens is not None and int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        pidx = next(
+            (i for i, s in enumerate(self._slots)
+             if s is not None
+             and s.handle.request.request_id == request_id), None)
+        if pidx is None:
+            if self._handles.get(request_id) is None:
+                raise ValueError(
+                    f"{request_id}: unknown or already finished — "
+                    f"fork() splits a LIVE branch")
+            if any(sw.request.request_id == request_id
+                   for sw in self._swapped):
+                state = "swapped out (preempted)"
+            elif any(pf.request.request_id == request_id
+                     for pf in self._prefilling.values()):
+                state = "mid chunked prefill"
+            else:
+                state = "still queued"
+            raise ValueError(
+                f"{request_id} is {state}: fork() needs a live "
+                f"decoding slot (step the engine until it is "
+                f"decoding, then fork)")
+        parent = self._slots[pidx]
+        if parent.handle.request.pin_session:
+            raise ValueError(
+                f"{request_id} pins a session: a session continues "
+                f"ONE stream — fork before pinning, or continue the "
+                f"session and fork the continuation")
+        free = self._free_slots()
+        if not free:
+            raise RuntimeError(
+                f"no free slot to fork {request_id} into "
+                f"(max_slots={self.max_slots}, all occupied) — retire "
+                f"or prune a branch first")
+        if parent.group is None:
+            parent.group = next(self._fork_seq)
+        kids = getattr(parent.handle, "_fork_children", None)
+        branch = len(kids) + 1 if kids else 1
+        now = self._clock()
+        ch = self._spawn_branch(pidx, free[0], branch, now,
+                                seed=seed, max_new=max_new_tokens)
+        return BranchHandle(self, ch, branch)
+
+    def prune(self, request_id):
+        """Cut a fork branch (or any live/swapped request): free its
+        private blocks, drop its references on shared ones, and seal a
+        complete ``finish_reason="pruned"`` result carrying everything
+        emitted so far — the handle resolves, never wedges.  Typed
+        ValueError for a request that is not live or swapped (queued
+        requests cancel by deadline; finished ones are already
+        sealed)."""
+        if self._closed:
+            raise RuntimeError(
+                "engine is closed; build a new one with model.serve()")
+        now = self._clock()
+        for i, s in enumerate(self._slots):
+            if s is not None \
+                    and s.handle.request.request_id == request_id:
+                if self._c_fork_pruned is not None:
+                    self._c_fork_pruned.inc()
+                _trace.event("serve/prune", cat="serve",
+                             request=request_id, slot=i,
+                             tokens=len(s.emitted))
+                self._retire(i, s, now, finish_reason="pruned")
+                return
+        for j, sw in enumerate(self._swapped):
+            if sw.request.request_id != request_id:
+                continue
+            # a swapped branch holds no pool blocks (freed at
+            # preempt) — sealing it is pure host bookkeeping
+            n = len(sw.emitted)
+            submit_t = getattr(sw.handle, "_submit_time",
+                               sw.admit_time)
+            result = GenerationResult(
+                request_id=request_id,
+                tokens=np.concatenate(
+                    [sw.request.prompt_ids,
+                     np.asarray(sw.emitted, np.int32)]),
+                finish_reason="pruned",
+                ttft=sw.first_token_time - submit_t,
+                tpot=((now - sw.first_token_time) / (n - 1)
+                      if n > 1 else None),
+                queue_time=sw.admit_time - submit_t,
+                admitted_step=sw.admitted_step,
+                finished_step=self.step_count,
+                branch=getattr(sw, "branch", 0),
+                score=(sw.score
+                       if getattr(sw, "group", None) is not None
+                       else None))
+            if _reqs._active:
+                _reqs._ledger.on_retire(
+                    request_id, engine=self.stats.engine_label,
+                    t=now, finish_reason="pruned", tokens=n)
+            if self._c_fork_pruned is not None:
+                self._c_fork_pruned.inc()
+            _trace.event("serve/prune", cat="serve",
+                         request=request_id, slot=None, tokens=n)
+            sw.handle._finish(result)
+            self.stats.on_complete(result)
+            del self._swapped[j]
+            self._handles.pop(request_id, None)
+            return
+        raise ValueError(
+            f"{request_id}: not a live or swapped request — prune() "
+            f"cuts a decoding branch (queued requests expire by "
+            f"deadline; finished ones are already sealed)")
 
     def _sched_admissions(self, navail, now):
         """One scheduler consultation, shared by the whole-prompt and
@@ -2774,11 +3428,18 @@ class InferenceEngine:
         arena = self.paged_arena
         req = pf.request
         plen = len(req.prompt_ids)
+        ast0 = mask0 = None
+        if req.structured is not None:
+            # budgeted admission of a structured request: the first
+            # token samples here, so the initial mask applies here
+            ast0 = req.structured.initial()
+            mask0 = jnp.asarray(
+                np.asarray(req.structured.mask(ast0), bool))
         tok0, carry_key = _first_from_hidden(
             self._params, pf.hidden,
             jnp.int32(plen - 1 - pf.last_off), pf.key0, pf.temp,
             self._top_p, top_k=self._statics["top_k"],
-            use_top_p=self._statics["use_top_p"])
+            use_top_p=self._statics["use_top_p"], mask=mask0)
         lanes = {j: pf.blocks[j]
                  for j in range(pf.n_shared, plen // arena.block_size
                                 + 1)
@@ -2800,6 +3461,8 @@ class InferenceEngine:
         slot.prefix_nodes = pf.nodes
         slot.blocks = pf.blocks
         slot.n_shared = pf.n_shared
+        slot.automaton = req.structured
+        slot.astate = ast0
         del self._prefilling[idx]
         self._slots[idx] = slot
         tok0 = int(np.asarray(tok0))   # device sync: prefill done
@@ -3008,6 +3671,15 @@ class InferenceEngine:
                                    engine=self.stats.engine_label,
                                    t=now, slot=idx,
                                    step=self.step_count)
+        ast0 = mask0 = None
+        if req.structured is not None:
+            # structured decoding: the FIRST token samples inside the
+            # prefill executable, so the initial state's vocab mask
+            # threads into it (fixed (vocab,) shape — no new
+            # signature per grammar)
+            ast0 = req.structured.initial()
+            mask0 = jnp.asarray(
+                np.asarray(req.structured.mask(ast0), bool))
         with _trace.span("serve/prefill", cat="serve",
                          request=req.request_id, slot=idx,
                          prompt_len=plen, step=self.step_count,
@@ -3043,8 +3715,9 @@ class InferenceEngine:
             elif nodes or (cache is not None and self._quant):
                 tok0, carry_key, kc_row, vc_row = self._admit_warm(
                     ids, plen, nodes, key0, temp,
-                    rid=req.request_id)
-            elif arena is not None and self._ring_eligible(plen):
+                    rid=req.request_id, mask=mask0)
+            elif arena is not None and self._ring_eligible(plen) \
+                    and req.structured is None:
                 # ring-attention prefill (the long-context round):
                 # the prompt's sequence axis shards over the tp mesh
                 # and K/V blocks rotate the ICI ring
@@ -3085,7 +3758,8 @@ class InferenceEngine:
                     pf_ids = ids_j[:, :wn]
                 tok0, carry_key, kc_row, vc_row = self._x.prefill_one(
                     self._params, pf_ids, plen, key0, temp,
-                    self._top_p)
+                    self._top_p,
+                    **({"mask": mask0} if mask0 is not None else {}))
             if arena is not None:
                 # the prefilled lanes past the shared prefix scatter
                 # into the request's freshly-allocated pool blocks;
@@ -3123,6 +3797,8 @@ class InferenceEngine:
         self.stats.on_prefill()
         slot = _Slot(handle, req.max_new_tokens, now, self.step_count)
         slot.prefix_nodes = nodes
+        slot.automaton = req.structured
+        slot.astate = ast0
         if arena is not None:
             slot.blocks = ([n.block for n in nodes]
                            + [arena.trash] * j_lo0 + new_blocks)
@@ -3149,7 +3825,8 @@ class InferenceEngine:
             _stepprof.pop()
         return True
 
-    def _admit_warm(self, ids, plen, nodes, key0, temp, rid=None):
+    def _admit_warm(self, ids, plen, nodes, key0, temp, rid=None,
+                    mask=None):
         """Warm admission: one gather copies the matched blocks into a
         fresh cache row, then block-width ``_chunk_row`` calls prefill
         [divergence, last-block-end) — fixed shapes throughout, so the
@@ -3172,7 +3849,7 @@ class InferenceEngine:
         tok0, carry_key = _first_from_hidden(
             self._params, hidden, jnp.int32(plen - 1 - last_off),
             key0, temp, self._top_p, top_k=self._statics["top_k"],
-            use_top_p=self._statics["use_top_p"])
+            use_top_p=self._statics["use_top_p"], mask=mask)
         return tok0, carry_key, kc_row, vc_row
 
     # -- disaggregated prefill / KV shipping (the disagg round) ----------

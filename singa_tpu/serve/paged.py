@@ -231,6 +231,17 @@ def _row_to_pool(pool_k, pool_v, kc_row, vc_row, idx, block):
             jax.tree.map(lambda p, r: s(p, r), pool_v, vc_row))
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
+def _copy_pool_block(pool_k, pool_v, src, dst):
+    """Copy ONE block's bytes ``src`` -> ``dst`` inside the pool (both
+    traced ints — one executable per engine geometry).  The
+    copy-on-first-write path of KV forking: a forked branch about to
+    write into a block a sibling still references gets its own byte
+    copy first, so siblings never observe each other's writes."""
+    cp = lambda p: p.at[:, dst].set(p[:, src])
+    return jax.tree.map(cp, pool_k), jax.tree.map(cp, pool_v)
+
+
 @partial(jax.jit, static_argnames=("block",), donate_argnums=(0, 1))
 def _rows_to_pool(pool_k, pool_v, kc_rows, vc_rows, sel, idx, block):
     """Batched admission scatter (the gather-tax round): rows
@@ -280,42 +291,56 @@ def _slice_block(leaf, off, block):
 
 @partial(jax.jit,
          static_argnames=("block", "n_head", "eps", "moe_top_k",
-                          "top_k", "use_top_p", "tp_axis", "tp_world"),
+                          "top_k", "use_top_p", "tp_axis", "tp_world",
+                          "with_lp"),
          donate_argnums=(1, 2))
 def _paged_decode_step(params, pool_k, pool_v, tables, toks, pos, live,
-                       keys, temps, top_p, block, n_head, eps,
-                       moe_top_k, top_k, use_top_p, tp_axis=None,
-                       tp_world=1):
+                       keys, temps, top_p, masks=None, block=None,
+                       n_head=None, eps=None, moe_top_k=None,
+                       top_k=None, use_top_p=None, tp_axis=None,
+                       tp_world=1, with_lp=False):
     """Advance EVERY slot one token against the block pool: tables
     (S, W//B) int32 block ids (trash-padded), pools donated.  Per slot:
     gather its blocks into a row, run the shared decode-row math, then
     scatter ONLY the block containing ``pos`` back (one written block
     per slot per step; dead slots write the trash block).  Returns
-    (next_toks, pool_k, pool_v, new_keys)."""
+    (next_toks, pool_k, pool_v, new_keys) — plus a (S,) chosen-token
+    logprob vector when ``with_lp`` (static; the fork round's
+    best-of-n ranking signal).  ``masks`` is None (legacy math,
+    bitwise unchanged) or a (S, V) bool vocab-mask batch (constrained
+    decoding — False lanes are NEG_INF'd before the shared sample
+    chain; an all-True row is a bitwise no-op)."""
     from .engine import _decode_row
 
     trash = jax.tree.leaves(pool_k)[0].shape[1] - 1
 
-    def row(tbl, tok, pos_r, live_r, key, temp):
+    def row(tbl, tok, pos_r, live_r, key, temp, mask_r):
         kc_r = jax.tree.map(lambda p: _gather_leaf(p, tbl), pool_k)
         vc_r = jax.tree.map(lambda p: _gather_leaf(p, tbl), pool_v)
-        nxt, kc2, vc2, k2 = _decode_row(
+        res = _decode_row(
             params, kc_r, vc_r, tok, pos_r, live_r, key, temp, top_p,
             n_head, eps, moe_top_k, top_k, use_top_p,
-            tp_axis=tp_axis, tp_world=tp_world)
+            tp_axis=tp_axis, tp_world=tp_world, mask=mask_r,
+            with_lp=with_lp)
+        nxt, kc2, vc2, k2 = res[:4]
+        lp = res[4] if with_lp else jnp.float32(0.0)
         p_c = jnp.where(live_r, pos_r, 0)
         blk = p_c // block
         off = blk * block
         kb = jax.tree.map(lambda a: _slice_block(a, off, block), kc2)
         vb = jax.tree.map(lambda a: _slice_block(a, off, block), vc2)
         dst = jnp.where(live_r, tbl[blk], trash)
-        return nxt, kb, vb, dst, k2
+        return nxt, kb, vb, dst, k2, lp
 
-    nxt, kb, vb, dst, keys2 = jax.vmap(
-        row, in_axes=(0, 0, 0, 0, 0, 0),
-        out_axes=(0, 1, 1, 0, 0))(tables, toks, pos, live, keys, temps)
+    m_ax = None if masks is None else 0
+    nxt, kb, vb, dst, keys2, lps = jax.vmap(
+        row, in_axes=(0, 0, 0, 0, 0, 0, m_ax),
+        out_axes=(0, 1, 1, 0, 0, 0))(tables, toks, pos, live, keys,
+                                     temps, masks)
     pool_k = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_k, kb)
     pool_v = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_v, vb)
+    if with_lp:
+        return nxt, pool_k, pool_v, keys2, lps
     return nxt, pool_k, pool_v, keys2
 
 
@@ -393,12 +418,14 @@ def _paged_spec_step(t_params, d_params, pool_k, pool_v, dkc, dvc,
 @partial(jax.jit,
          static_argnames=("block", "n_head", "eps", "moe_top_k",
                           "top_k", "use_top_p", "window", "tp_axis",
-                          "tp_world"),
+                          "tp_world", "with_lp"),
          donate_argnums=(1, 2))
 def _paged_decode_kernel(params, pool_k, pool_v, tables, toks, pos,
-                         live, keys, temps, top_p, block, n_head, eps,
-                         moe_top_k, top_k, use_top_p, window=None,
-                         tp_axis=None, tp_world=1):
+                         live, keys, temps, top_p, masks=None,
+                         block=None, n_head=None, eps=None,
+                         moe_top_k=None, top_k=None, use_top_p=None,
+                         window=None, tp_axis=None, tp_world=1,
+                         with_lp=False):
     """Advance EVERY slot one token against the block pool WITHOUT
     gathering rows: per slot, online-softmax attention over its live
     blocks (beyond-``pos`` and trash lanes masked) plus the step's
@@ -426,21 +453,28 @@ def _paged_decode_kernel(params, pool_k, pool_v, tables, toks, pos,
         lo = jnp.maximum(0, (p_all - window + 1) // block)
         blk_lo = jnp.min(jnp.where(live, lo, n_blk))
 
-    def row(tbl, tok, pos_r, live_r, key, temp):
-        nxt, kb, vb, k2 = _decode_row_paged(
+    def row(tbl, tok, pos_r, live_r, key, temp, mask_r):
+        res = _decode_row_paged(
             params, pool_k, pool_v, tbl, tok, pos_r, live_r, key,
             temp, top_p, n_blk, block, trash, n_head, eps, moe_top_k,
             top_k, use_top_p, window=window, blk_lo=blk_lo,
-            tp_axis=tp_axis, tp_world=tp_world)
+            tp_axis=tp_axis, tp_world=tp_world, mask=mask_r,
+            with_lp=with_lp)
+        nxt, kb, vb, k2 = res[:4]
+        lp = res[4] if with_lp else jnp.float32(0.0)
         p_c = jnp.where(live_r, pos_r, 0)
         dst = jnp.where(live_r, tbl[p_c // block], trash)
-        return nxt, kb, vb, dst, k2
+        return nxt, kb, vb, dst, k2, lp
 
-    nxt, kb, vb, dst, keys2 = jax.vmap(
-        row, in_axes=(0, 0, 0, 0, 0, 0),
-        out_axes=(0, 1, 1, 0, 0))(tables, toks, pos, live, keys, temps)
+    m_ax = None if masks is None else 0
+    nxt, kb, vb, dst, keys2, lps = jax.vmap(
+        row, in_axes=(0, 0, 0, 0, 0, 0, m_ax),
+        out_axes=(0, 1, 1, 0, 0, 0))(tables, toks, pos, live, keys,
+                                     temps, masks)
     pool_k = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_k, kb)
     pool_v = jax.tree.map(lambda p, b: p.at[:, dst].set(b), pool_v, vb)
+    if with_lp:
+        return nxt, pool_k, pool_v, keys2, lps
     return nxt, pool_k, pool_v, keys2
 
 
@@ -630,6 +664,17 @@ class PagedKVArena:
         self.pool_k = pool((head_dim,))
         self.pool_v = pool((head_dim,))
         self._free = list(range(N))
+        # LIVE-slot reference counts (the fork round): a block a forked
+        # branch shares with its siblings carries an entry here (count
+        # >= 2; allocated-but-unshared blocks have an implicit count of
+        # 1 and no entry).  ``free`` decrements and only returns a
+        # block to the free list at count 1 — existing callers see the
+        # historical free() exactly when nothing is forked.  Disjoint
+        # from the prefix tree's node refs by construction: tree-owned
+        # (cached) blocks are never arena-shared, live tails are never
+        # tree-owned until retire adoption (which is capped below the
+        # first shared block by the engine).
+        self._refs = {}
         # soft free space: the engine wires this to the prefix cache's
         # LRU leaf eviction so cached-but-unreferenced blocks are
         # reclaimed before an allocation fails
@@ -700,8 +745,60 @@ class PagedKVArena:
         return out
 
     def free(self, blocks):
-        self._free.extend(blocks)
+        """Release ``blocks``: a block no live reference still shares
+        returns to the free list; a SHARED block (a forked sibling
+        still holds it) just sheds one reference — bytes stay put
+        until the last holder frees it.  With no forks in flight this
+        is exactly the historical extend-the-free-list."""
+        if not self._refs:
+            self._free.extend(blocks)
+            self._update_gauges()
+            return
+        for b in blocks:
+            c = self._refs.get(b)
+            if c is None:
+                self._free.append(b)
+            elif c <= 2:
+                del self._refs[b]
+            else:
+                self._refs[b] = c - 1
         self._update_gauges()
+
+    # -- live-slot sharing (the fork round) ------------------------------
+    @property
+    def shared_blocks(self) -> int:
+        """Blocks currently referenced by MORE than one live slot."""
+        return len(self._refs)
+
+    def share(self, blocks):
+        """Add one live reference to each of ``blocks`` (a fork's
+        block-table copy: the child's table points at the parent's
+        blocks; nothing moves on device)."""
+        for b in blocks:
+            self._refs[b] = self._refs.get(b, 1) + 1
+
+    def is_shared(self, block) -> bool:
+        return block in self._refs
+
+    def ref_count(self, block) -> int:
+        return self._refs.get(block, 1)
+
+    def copy_block(self, src, dst):
+        """Copy ``src``'s bytes into ``dst`` — the copy-on-first-write
+        of a forked branch about to write into a block a sibling still
+        references.  Checks the ``serve.fork_copy`` fault site (the
+        chaos_fork scenario's injection point: a raising copy rejects
+        ONLY the writing branch; siblings keep their intact bytes)."""
+        if _faults._armed:
+            _faults.check("serve.fork_copy")
+        if self._tp is not None:
+            # fork is typed-rejected on sharded executors at submit;
+            # reaching here means a caller bypassed validation
+            raise RuntimeError(
+                "copy_block on a tensor-parallel pool: KV forking "
+                "requires the default executor")
+        self.pool_k, self.pool_v = _copy_pool_block(
+            self.pool_k, self.pool_v, jnp.int32(src), jnp.int32(dst))
 
     # -- device copies ---------------------------------------------------
     def _pad_idx(self, blocks):
@@ -889,4 +986,5 @@ class PagedKVArena:
             "swap_out": self._c_swap_out.value,
             "swap_in": self._c_swap_in.value,
             "quant": self.quant,
+            "shared_blocks": self.shared_blocks,
         }

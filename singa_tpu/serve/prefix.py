@@ -449,6 +449,13 @@ class PrefixCache:
     def cached_blocks(self) -> int:
         return len(self._nodes_by_block)
 
+    def cached_block_ids(self):
+        """Pool slots the radix tree currently owns — the cache's side
+        of the engine's block-accounting invariant (every used arena
+        block is either cached here or owned by a live/prefilling
+        row)."""
+        return list(self._nodes_by_block)
+
     def _block_keys(self, tokens):
         B = self.block_size
         toks = np.asarray(tokens, np.int32).reshape(-1)

@@ -109,7 +109,21 @@ class GenerationRequest:
     ignores it; the fleet router uses it for STICKY routing — the
     continuation lands on the replica whose prefix cache holds the
     pinned session, so session KV reuse stays replica-local (any other
-    replica would serve it cold but correct)."""
+    replica would serve it cold but correct).
+    ``n``: parallel-sampling width (the fork round).  ``n > 1`` admits
+    ONE prompt and decodes n branches that share every prompt block in
+    the paged pool copy-on-first-write (serve/fork.py) — branch 0 is
+    the exact stream ``n=1`` would produce, branches 1..n-1 re-key via
+    ``fold_in(key, branch)``.  Paged engines only; incompatible with
+    ``pin_session`` (a session pins ONE continuation) and requires
+    ``max_new_tokens >= 2`` (branches share the first token and
+    diverge after it).
+    ``structured``: a token automaton (``serve.structured`` —
+    ``JsonSchemaAutomaton`` or anything with
+    ``initial``/``mask``/``advance``/``done``) constraining every
+    emitted token to the grammar: the engine applies its per-state
+    vocab mask inside the jitted sample executable and retires the
+    request the moment the automaton completes."""
 
     prompt_ids: np.ndarray
     max_new_tokens: int = 20
@@ -121,6 +135,8 @@ class GenerationRequest:
     pin_session: bool = False
     session_of: Optional[object] = None
     stop_token: Optional[int] = None
+    n: int = 1
+    structured: Optional[object] = None
     request_id: str = field(
         default_factory=lambda: f"req-{next(_req_counter)}")
 
@@ -135,6 +151,30 @@ class GenerationRequest:
                 " (a serve request that generates nothing is a no-op)")
         if self.stop_token is not None:
             self.stop_token = int(self.stop_token)
+        self.n = int(self.n)
+        if self.n < 1:
+            raise ValueError(f"n must be >= 1, got {self.n}")
+        if self.n > 1 and self.pin_session:
+            raise ValueError(
+                f"n={self.n} with pin_session: a pinned session "
+                "continues ONE stream — fork the continuation instead "
+                "(submit n=1 with pin_session, then fork() the handle)")
+        if self.n > 1 and self.max_new_tokens < 2:
+            raise ValueError(
+                f"n={self.n} with max_new_tokens="
+                f"{self.max_new_tokens}: branches share the prompt AND "
+                "the first sampled token, so a 1-token request has "
+                "nothing to diverge on — all n streams would be "
+                "identical; raise max_new_tokens or drop n")
+        if self.structured is not None:
+            for attr in ("initial", "mask", "advance", "done"):
+                if not callable(getattr(self.structured, attr, None)):
+                    raise ValueError(
+                        f"structured= must be a token automaton with "
+                        f"initial()/mask()/advance()/done() (see "
+                        f"serve.structured.JsonSchemaAutomaton); "
+                        f"{type(self.structured).__name__} has no "
+                        f"callable {attr!r}")
 
 
 @dataclass
@@ -143,7 +183,8 @@ class GenerationResult:
     continuation (the exact array single-prompt ``generate`` would
     return); ``finish_reason`` is ``"length"`` for a spent token
     budget, ``"stop"`` when the request's ``stop_token`` ended it
-    early.
+    early, or ``"pruned"`` when a forked branch was cut by ``prune()``
+    (the fork round — a pruned branch still seals a complete result).
     Latency fields are on the engine clock: ``ttft`` measures submit →
     first token, ``tpot`` the mean inter-token time after it."""
 
@@ -158,6 +199,12 @@ class GenerationResult:
     # set when the request asked pin_session=True: the multi-turn
     # continuation handle (serve/prefix.py SessionHandle)
     session: Optional[object] = None
+    # fork round: which branch of a fork group produced this result
+    # (0 for plain requests) and its cumulative chosen-token logprob
+    # under the RAW model distribution — the best-of-n ranking signal
+    # (None outside a fork group; the shared first token scores 0.0)
+    branch: int = 0
+    score: Optional[float] = None
 
 
 class RequestHandle:

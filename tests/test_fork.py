@@ -1,0 +1,494 @@
+"""Copy-on-write KV forking + constrained structured decoding (the
+fork round: serve/fork.py, serve/structured.py, the engine's
+``n>1``/``fork()``/``prune()``/``structured=`` surface).
+
+Everything deterministic on CPU.  Parity oracles: branch 0 of an
+``n>1`` group must be BYTE-identical to the plain ``n=1`` stream
+(greedy, seeded sampling, GQA, int8, warm prefix), and a forked
+parent's stream must be unchanged by its children's divergent writes
+(CoW isolation).  The leak invariant is asserted through
+``InferenceEngine.check_block_accounting`` after every drain."""
+
+import json
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+from singa_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from singa_tpu.observe import requests as reqtrace
+from singa_tpu.observe.registry import registry
+from singa_tpu.resilience import FailAfterN, FaultInjected, faults
+from singa_tpu.serve import (ForkHandle, GenerationRequest,
+                             JsonSchemaAutomaton, PagedConfig,
+                             PrefixCacheConfig, PriorityScheduler)
+
+
+def _build(cfg):
+    m = GPT2LMHead(cfg)
+    m.compile([tensor.from_numpy(np.zeros((1, 16), np.int32))],
+              is_train=False, use_graph=False)
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _build(GPT2Config.tiny(dropout=0.0))
+
+
+@pytest.fixture(scope="module")
+def model256():
+    # byte-sized vocab so token ids ARE characters for the
+    # structured-decoding tests
+    return _build(GPT2Config.tiny(dropout=0.0, vocab_size=256))
+
+
+_VOCAB = [chr(c) for c in range(256)]
+
+
+def _paged(**kw):
+    base = dict(block_size=8, num_blocks=32)
+    base.update(kw)
+    return PagedConfig(**base)
+
+
+def _drained_ok(eng):
+    """The leak invariant: after a drain every used block is
+    cache-owned (check_block_accounting raises on any leak)."""
+    used = eng.check_block_accounting()
+    cached = (eng.prefix_cache.cached_blocks
+              if eng.prefix_cache is not None else 0)
+    assert used == cached
+    return used
+
+
+def _plain_stream(model, prompt, n_new, temperature, seed, **serve_kw):
+    eng = model.serve(max_slots=4, paged=_paged(), **serve_kw)
+    h = eng.submit(GenerationRequest(prompt, max_new_tokens=n_new,
+                                     temperature=temperature,
+                                     seed=seed))
+    eng.run_until_complete()
+    out = h.result().tokens
+    eng.close()
+    return out
+
+
+# -- best-of-n -----------------------------------------------------------
+
+@pytest.mark.parametrize("temperature,seed", [(0.0, 0), (0.9, 7)])
+def test_branch0_byte_parity(model, temperature, seed):
+    """Branch 0 of an n=3 group is the EXACT stream n=1 produces —
+    greedy and seeded sampling — and every sibling completes with a
+    score.  Greedy siblings are identical (same argmax); sampled ones
+    diverge after the shared first token."""
+    prompt = (np.arange(6, dtype=np.int32) + 11)
+    base = _plain_stream(model, prompt, 8, temperature, seed)
+    eng = model.serve(max_slots=4, paged=_paged())
+    fh = eng.submit(GenerationRequest(
+        prompt, max_new_tokens=8, temperature=temperature, seed=seed,
+        n=3))
+    assert isinstance(fh, ForkHandle)
+    eng.run_until_complete()
+    assert fh.done()
+    res = fh.results()
+    assert len(res) == 3
+    assert np.array_equal(res[0].tokens, base)
+    for k, r in enumerate(res):
+        assert r.branch == k
+        assert r.score is not None
+        assert len(r.tokens) == len(prompt) + 8
+        # the first sampled token is shared (fork happens after it)
+        assert r.tokens[len(prompt)] == base[len(prompt)]
+    if temperature == 0.0:
+        assert all(np.array_equal(r.tokens, base) for r in res)
+    else:
+        assert any(not np.array_equal(r.tokens, base)
+                   for r in res[1:]), "siblings never diverged"
+    ranked = fh.ranked()
+    assert [r.score for r in ranked] == sorted(
+        (r.score for r in ranked), reverse=True)
+    assert fh.best() is ranked[0]
+    _drained_ok(eng)
+    snap = eng.stats.snapshot()
+    assert snap["paged"]["blocks_used"] == 0
+    eng.close()
+
+
+def test_gqa_branch0_parity():
+    """GQA models (narrow H_kv cache leaves) fork identically."""
+    m = _build(GPT2Config.tiny(dropout=0.0, n_kv_head=2))
+    prompt = (np.arange(5, dtype=np.int32) + 3)
+    base = _plain_stream(m, prompt, 6, 0.8, 3)
+    eng = m.serve(max_slots=3, paged=_paged())
+    fh = eng.submit(GenerationRequest(prompt, max_new_tokens=6,
+                                      temperature=0.8, seed=3, n=2))
+    eng.run_until_complete()
+    assert np.array_equal(fh.results()[0].tokens, base)
+    _drained_ok(eng)
+    eng.close()
+
+
+def test_int8_branch0_parity(model):
+    """Quantized arena: branch 0 equals the plain int8 stream."""
+    prompt = (np.arange(7, dtype=np.int32) + 5)
+    base = _plain_stream(model, prompt, 6, 0.7, 11,
+                         cache_dtype="int8")
+    eng = model.serve(max_slots=3, paged=_paged(),
+                      cache_dtype="int8")
+    fh = eng.submit(GenerationRequest(prompt, max_new_tokens=6,
+                                      temperature=0.7, seed=11, n=2))
+    eng.run_until_complete()
+    assert np.array_equal(fh.results()[0].tokens, base)
+    _drained_ok(eng)
+    eng.close()
+
+
+def test_shared_prompt_blocks_accounted(model):
+    """While branches decode, the shared prompt blocks are counted
+    ONCE by the accounting invariant (ownership is the block id) and
+    the fork gauge reports them shared."""
+    prompt = (np.arange(17, dtype=np.int32) + 2)  # 2 full blocks at B=8
+    eng = model.serve(max_slots=4, paged=_paged())
+    fh = eng.submit(GenerationRequest(prompt, max_new_tokens=10,
+                                      temperature=0.9, seed=5, n=3))
+    eng.step()  # admits the parent and forks the siblings
+    assert len(fh.branches) == 3
+    arena = eng.paged_arena
+    assert arena.shared_blocks >= 2, "prompt blocks not shared"
+    # n branches over one prompt use FEWER blocks than n independent
+    # admissions would (the whole point): shared prefix counted once
+    independent = 3 * (len(prompt) // 8 + 1)
+    assert arena.blocks_used < independent
+    eng.check_block_accounting()  # shared != leaked, mid-flight
+    eng.run_until_complete()
+    _drained_ok(eng)
+    eng.close()
+
+
+# -- tree search: fork() / prune() ---------------------------------------
+
+def test_midstream_fork_cow_isolation(model):
+    """Forking a live stream mid-generation leaves the PARENT's
+    remaining tokens byte-identical to the unforked run (the child's
+    divergent writes land in CoW copies, never in shared blocks), and
+    the child's stream shares exactly the pre-fork tokens."""
+    prompt = (np.arange(6, dtype=np.int32) + 21)
+    base = _plain_stream(model, prompt, 12, 0.85, 13)
+    eng = model.serve(max_slots=4, paged=_paged())
+    h = eng.submit(GenerationRequest(prompt, max_new_tokens=12,
+                                     temperature=0.85, seed=13))
+    rid = h.request.request_id
+    # run the parent a few tokens in, then split
+    for _ in range(4):
+        eng.step()
+    bh = eng.fork(rid)
+    assert bh.branch == 1
+    eng.run_until_complete()
+    got = h.result().tokens
+    assert np.array_equal(got, base), "fork perturbed the parent"
+    child = bh.result()
+    assert child.request_id == f"{rid}#1"
+    # shared history: prompt + pre-fork tokens identical, then the
+    # child's re-keyed chain takes over
+    pre = len(prompt) + 4
+    assert np.array_equal(child.tokens[:pre], base[:pre])
+    assert not np.array_equal(child.tokens, base)
+    lbl = eng.stats.engine_label
+    assert registry().snapshot()["counters"][
+        f"serve.fork.cow_copies{{engine={lbl}}}"] >= 1
+    _drained_ok(eng)
+    eng.close()
+
+
+def test_prune_frees_private_blocks(model):
+    """prune() seals a complete finish_reason="pruned" result and
+    returns the branch's PRIVATE blocks to the pool immediately;
+    shared prompt blocks stay until the last sibling drops them."""
+    prompt = (np.arange(9, dtype=np.int32) + 4)
+    eng = model.serve(max_slots=4, paged=_paged())
+    fh = eng.submit(GenerationRequest(prompt, max_new_tokens=16,
+                                      temperature=0.9, seed=2, n=3))
+    for _ in range(10):
+        eng.step()
+    arena = eng.paged_arena
+    used_before = arena.blocks_used
+    victim = fh.branches[2]
+    victim.prune()
+    r = victim.result()
+    assert r.finish_reason == "pruned"
+    assert r.branch == 2 and r.score is not None
+    assert len(r.tokens) > len(prompt)  # everything emitted so far
+    assert arena.blocks_used < used_before, "prune freed nothing"
+    victim.prune()  # idempotent no-op once done
+    eng.run_until_complete()
+    assert fh.done()
+    # pruned branches are excluded from the ranking
+    assert all(rr.finish_reason != "pruned" for rr in fh.ranked())
+    assert len(fh.results()) == 3
+    lbl = eng.stats.engine_label
+    assert registry().snapshot()["counters"][
+        f"serve.fork.pruned{{engine={lbl}}}"] == 1
+    _drained_ok(eng)
+    eng.close()
+
+
+def test_fork_with_prefix_cache(model):
+    """Fork over a warm radix-tree admission: cache-owned prefix
+    blocks are referenced (never CoW-copied), branch 0 keeps byte
+    parity, and after the drain every used block is cache-owned —
+    the last retiring sibling donates the prompt."""
+    rng = np.random.RandomState(8)
+    system = rng.randint(0, 256, 24).astype(np.int32)
+    prompt = np.concatenate(
+        [system, rng.randint(0, 256, 6).astype(np.int32)])
+    kw = dict(prefix_cache=PrefixCacheConfig(block_size=8))
+    eng = model.serve(max_slots=4, paged=_paged(num_blocks=48), **kw)
+    # first pass populates the tree; second forks off a warm hit
+    eng.submit(GenerationRequest(prompt, max_new_tokens=4))
+    eng.run_until_complete()
+    base = _plain_stream(model, prompt, 8, 0.9, 17, **kw)
+    fh = eng.submit(GenerationRequest(prompt, max_new_tokens=8,
+                                      temperature=0.9, seed=17, n=3))
+    eng.run_until_complete()
+    assert np.array_equal(fh.results()[0].tokens, base)
+    snap = eng.stats.snapshot()
+    assert snap["prefix"]["hit_tokens"] > 0
+    used = _drained_ok(eng)
+    assert used == snap["prefix"]["cached_blocks"]
+    eng.close()
+
+
+def test_fork_under_priority_preemption(model):
+    """Composition with priority preemption: a higher-priority
+    arrival preempts forked branches (byte-copied swap), they resume
+    and finish with the SAME streams a roomy pool produces, and
+    nothing leaks."""
+    prompt = (np.arange(10, dtype=np.int32) + 6)
+    req = dict(max_new_tokens=20, temperature=0.9, seed=9, n=3)
+    hi_prompt = (np.arange(12, dtype=np.int32) + 40)
+
+    def run(num_blocks):
+        eng = model.serve(max_slots=4, scheduler=PriorityScheduler(),
+                          paged=_paged(num_blocks=num_blocks))
+        fh = eng.submit(GenerationRequest(prompt, **req))
+        for _ in range(4):
+            eng.step()
+        hi = eng.submit(GenerationRequest(
+            hi_prompt, max_new_tokens=26, priority=5))
+        eng.run_until_complete()
+        outs = [r.tokens for r in fh.results()] \
+            + [hi.result().tokens]
+        preempts = eng.stats.snapshot()["paged"]["preemptions"]
+        _drained_ok(eng)
+        eng.close()
+        return outs, preempts
+
+    roomy, _ = run(64)
+    tight, preempts = run(10)
+    assert preempts > 0, "pool never over-committed"
+    assert all(np.array_equal(a, b) for a, b in zip(roomy, tight))
+
+
+def test_cow_copy_fault_rejects_one_branch(model):
+    """A fault at the serve.fork_copy site (the CoW block copy)
+    rejects ONLY the writing branch, typed; siblings and the parent
+    finish with parity, the engine never fails, nothing leaks."""
+    prompt = (np.arange(6, dtype=np.int32) + 31)
+    eng = model.serve(max_slots=4, paged=_paged())
+    fh = eng.submit(GenerationRequest(prompt, max_new_tokens=12,
+                                      temperature=0.9, seed=21, n=3))
+    base = None  # parity oracle: same group, no fault
+    pol = faults.inject("serve.fork_copy", FailAfterN(0, times=1))
+    try:
+        eng.run_until_complete()
+    finally:
+        faults.clear()
+    assert pol.fired == 1
+    done = rejected = 0
+    for b in fh.branches:
+        try:
+            b.result()
+            done += 1
+        except FaultInjected as e:
+            assert e.site == "serve.fork_copy"
+            rejected += 1
+    assert rejected == 1 and done == 2
+    _drained_ok(eng)
+    # fresh-pool parity: the unfaulted group on a new engine matches
+    # the survivors' streams (the fault never corrupted shared KV)
+    eng2 = model.serve(max_slots=4, paged=_paged())
+    fh2 = eng2.submit(GenerationRequest(prompt, max_new_tokens=12,
+                                        temperature=0.9, seed=21,
+                                        n=3))
+    eng2.run_until_complete()
+    clean = {r.branch: r.tokens for r in fh2.results()}
+    for b in fh.branches:
+        if b.done():
+            try:
+                r = b.result()
+            except FaultInjected:
+                continue
+            assert np.array_equal(r.tokens, clean[r.branch])
+    _drained_ok(eng2)
+    eng.close()
+    eng2.close()
+
+
+# -- structured decoding -------------------------------------------------
+
+_SCHEMA = {"type": "object", "properties": {
+    "verdict": {"enum": ["yes", "no", "maybe"]},
+    "count": {"type": "integer"},
+    "flag": {"type": "boolean"},
+}}
+
+
+def _decode_txt(tokens, plen):
+    return "".join(_VOCAB[t] for t in tokens[plen:])
+
+
+@pytest.mark.parametrize("temperature,seed",
+                         [(0.0, 0), (0.9, 1), (1.2, 42)])
+def test_structured_always_schema_valid(model256, temperature, seed):
+    """Every constrained stream — greedy or sampled, any seed —
+    json.loads-parses and matches the schema's keys and types, and
+    the request retires "stop" when the automaton completes."""
+    a = JsonSchemaAutomaton(_SCHEMA, _VOCAB, max_digits=4)
+    prompt = (np.arange(5, dtype=np.int32) + 60)
+    eng = model256.serve(max_slots=2, paged=_paged())
+    h = eng.submit(GenerationRequest(
+        prompt, max_new_tokens=64, temperature=temperature, seed=seed,
+        structured=a))
+    eng.run_until_complete()
+    r = h.result()
+    assert r.finish_reason == "stop"
+    obj = json.loads(_decode_txt(r.tokens, len(prompt)))
+    assert set(obj) == {"verdict", "count", "flag"}
+    assert obj["verdict"] in ("yes", "no", "maybe")
+    assert isinstance(obj["count"], int)
+    assert isinstance(obj["flag"], bool)
+    _drained_ok(eng)
+    eng.close()
+
+
+def test_structured_composes_with_fork(model256):
+    """n>1 x structured: every branch independently satisfies the
+    grammar (branches share the automaton but advance private state
+    snapshots)."""
+    a = JsonSchemaAutomaton(_SCHEMA, _VOCAB, max_digits=3)
+    prompt = (np.arange(4, dtype=np.int32) + 90)
+    eng = model256.serve(max_slots=4, paged=_paged())
+    fh = eng.submit(GenerationRequest(
+        prompt, max_new_tokens=64, temperature=1.0, seed=6, n=3,
+        structured=a))
+    eng.run_until_complete()
+    texts = set()
+    for r in fh.results():
+        assert r.finish_reason == "stop"
+        txt = _decode_txt(r.tokens, len(prompt))
+        json.loads(txt)
+        texts.add(txt)
+    assert len(texts) > 1, "constrained branches never diverged"
+    _drained_ok(eng)
+    eng.close()
+
+
+def test_automaton_compile_validation():
+    """Ambiguous or unsupported schemas fail typed at CONSTRUCTION,
+    never inside the serve loop."""
+    with pytest.raises(ValueError, match="at least one property"):
+        JsonSchemaAutomaton({"type": "array"}, _VOCAB)
+    with pytest.raises(ValueError, match="unsupported value schema"):
+        JsonSchemaAutomaton(
+            {"type": "object",
+             "properties": {"x": {"type": "number"}}}, _VOCAB)
+    with pytest.raises(ValueError, match="first char"):
+        JsonSchemaAutomaton(
+            {"type": "object",
+             "properties": {"x": {"enum": ["yes", "yellow"]}}},
+            _VOCAB)
+    with pytest.raises(ValueError, match="enum must be non-empty"):
+        JsonSchemaAutomaton(
+            {"type": "object", "properties": {"x": {"enum": []}}},
+            _VOCAB)
+
+
+# -- typed configuration errors ------------------------------------------
+
+def test_request_validation_typed(model, model256):
+    prompt = (np.arange(5, dtype=np.int32) + 1)
+    with pytest.raises(ValueError, match="pin_session"):
+        GenerationRequest(prompt, n=2, pin_session=True,
+                          max_new_tokens=4)
+    with pytest.raises(ValueError, match="nothing to diverge"):
+        GenerationRequest(prompt, n=2, max_new_tokens=1)
+    with pytest.raises(ValueError, match="n must be >= 1"):
+        GenerationRequest(prompt, n=0)
+    with pytest.raises(ValueError, match="callable"):
+        GenerationRequest(prompt, structured=object())
+
+    # n>1 / structured need a paged engine
+    eng = model.serve(max_slots=2)
+    with pytest.raises(ValueError, match="paged engine"):
+        eng.submit(GenerationRequest(prompt, n=2, max_new_tokens=4))
+    with pytest.raises(ValueError, match="paged engine"):
+        eng.submit(GenerationRequest(
+            prompt, structured=JsonSchemaAutomaton(
+                _SCHEMA, _VOCAB), max_new_tokens=4))
+    with pytest.raises(ValueError):
+        eng.fork("nope")
+    eng.close()
+
+    # family over the block budget fails at submit, typed
+    eng = model.serve(max_slots=4, paged=_paged(num_blocks=8))
+    with pytest.raises(ValueError, match="per-branch"):
+        eng.submit(GenerationRequest(prompt, n=4, max_new_tokens=30))
+    # vocab mismatch between automaton and model
+    with pytest.raises(ValueError, match="vocab"):
+        eng.submit(GenerationRequest(
+            prompt, max_new_tokens=8,
+            structured=JsonSchemaAutomaton(_SCHEMA, _VOCAB[:100])))
+    # fork verbs on unknown / non-live requests
+    with pytest.raises(ValueError, match="unknown or already"):
+        eng.fork("req-does-not-exist")
+    with pytest.raises(ValueError, match="not a live or swapped"):
+        eng.prune("req-does-not-exist")
+    h = eng.submit(GenerationRequest(prompt, max_new_tokens=4))
+    with pytest.raises(ValueError, match="still queued"):
+        eng.fork(h.request.request_id)
+    eng.run_until_complete()
+    _drained_ok(eng)
+    eng.close()
+
+
+# -- ledger: branch-aware timelines --------------------------------------
+
+def test_ledger_branch_hops_and_pruned_seal(model):
+    """Forked branches record their branch id on the admission hop
+    with zero queue/prefill phases; a pruned branch seals as a
+    COMPLETED outcome (never a wedged or rejected entry)."""
+    reqtrace.enable(capacity=64)
+    try:
+        prompt = (np.arange(6, dtype=np.int32) + 2)
+        eng = model.serve(max_slots=4, paged=_paged())
+        fh = eng.submit(GenerationRequest(
+            prompt, max_new_tokens=10, temperature=0.9, seed=4, n=2))
+        for _ in range(4):
+            eng.step()
+        fh.branches[1].prune()
+        eng.run_until_complete()
+        led = reqtrace.ledger()
+        parent = led.entry(fh.request_id)
+        child = led.entry(f"{fh.request_id}#1")
+        assert parent["outcome"] in ("length", "stop")
+        assert parent["hops"][0]["branch"] is None
+        assert child["outcome"] == "pruned"
+        hop = child["hops"][0]
+        assert hop["branch"] == 1
+        # branch admissions skip queue and prefill by construction
+        assert child["phases"]["queue"] == 0.0
+        assert child["phases"]["prefill"] == 0.0
+        _drained_ok(eng)
+        eng.close()
+    finally:
+        reqtrace.disable()
